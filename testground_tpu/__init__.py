@@ -1,0 +1,21 @@
+"""testground-tpu: a TPU-native platform for testing, benchmarking, and
+simulating distributed and p2p systems at scale.
+
+This framework keeps the contracts of the reference Testground platform
+(composition TOML, test-plan manifests, the run/build/collect CLI and task
+engine, the Signal/Barrier/Publish coordination primitives, per-link
+latency/bandwidth/jitter/loss shaping) and executes test plans either as:
+
+- real host processes (the ``local:exec`` runner, like the reference's
+  ``pkg/runner/local_exec.go``), or
+- a vectorized discrete-event network simulation on TPU (the ``sim:jax``
+  runner): each instance's main loop is lifted with ``jax.vmap``, sync
+  primitives lower to ``jax.lax.psum``/``all_gather`` over a device mesh, and
+  link policies become per-instance/per-rule state tensors stepped each tick,
+  so one chip hosts thousands of simulated peers.
+
+Layer map (mirrors reference SURVEY.md §1):
+    cli -> client -> daemon -> engine -> {builders, runners} -> sdk/sync/sim
+"""
+
+__version__ = "0.1.0"

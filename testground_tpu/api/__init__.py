@@ -1,0 +1,67 @@
+"""Spec types shared across the framework: compositions, manifests, run
+input/output frames.
+
+Behavioral twin of the reference's ``pkg/api`` package (composition.go,
+manifest.go, composition_preparation.go, composition_validation.go,
+runner.go, builder.go) re-expressed as Python dataclasses.
+"""
+
+from .composition import (
+    Build,
+    Composition,
+    CompositionRunGroup,
+    Dependency,
+    Global,
+    Group,
+    Instances,
+    Metadata,
+    Resources,
+    Run,
+    RunParams,
+)
+from .manifest import InstanceConstraints, Parameter, TestCase, TestPlanManifest
+from .preparation import (
+    generate_default_run,
+    load_composition,
+    prepare_for_build,
+    prepare_for_run,
+)
+from .run_input import (
+    BuildInput,
+    BuildOutput,
+    CollectionInput,
+    RunGroup,
+    RunInput,
+    RunOutput,
+)
+from .validation import CompositionError, validate_for_build, validate_for_run
+
+__all__ = [
+    "Build",
+    "BuildInput",
+    "BuildOutput",
+    "CollectionInput",
+    "Composition",
+    "generate_default_run",
+    "prepare_for_build",
+    "prepare_for_run",
+    "RunOutput",
+    "CompositionError",
+    "CompositionRunGroup",
+    "Dependency",
+    "Global",
+    "Group",
+    "Instances",
+    "InstanceConstraints",
+    "Metadata",
+    "Parameter",
+    "Resources",
+    "Run",
+    "RunGroup",
+    "RunInput",
+    "RunParams",
+    "TestCase",
+    "TestPlanManifest",
+    "validate_for_build",
+    "validate_for_run",
+]

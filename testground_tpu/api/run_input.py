@@ -1,0 +1,121 @@
+"""Framed inputs/outputs exchanged between the engine and builders/runners.
+
+Behavioral twin of the reference's ``pkg/api/runner.go:36-109`` and
+``pkg/api/builder.go:29-75``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from .composition import Resources
+
+__all__ = [
+    "BuildInput",
+    "BuildOutput",
+    "CollectionInput",
+    "RunGroup",
+    "RunInput",
+    "RunOutput",
+]
+
+
+@dataclass
+class RunGroup:
+    """One group's slice of a run (``pkg/api/runner.go:65-85``)."""
+
+    id: str
+    instances: int
+    artifact_path: str = ""
+    parameters: dict[str, str] = field(default_factory=dict)
+    profiles: dict[str, str] = field(default_factory=dict)
+    resources: Resources = field(default_factory=Resources)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "instances": self.instances,
+            "artifact_path": self.artifact_path,
+            "parameters": dict(self.parameters),
+            "profiles": dict(self.profiles),
+            "resources": self.resources.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RunGroup":
+        return cls(
+            id=d["id"],
+            instances=int(d["instances"]),
+            artifact_path=d.get("artifact_path", ""),
+            parameters=dict(d.get("parameters", {})),
+            profiles=dict(d.get("profiles", {})),
+            resources=Resources.from_dict(d.get("resources", {})),
+        )
+
+
+@dataclass
+class RunInput:
+    """Input options for running one test run (``pkg/api/runner.go:36-63``)."""
+
+    run_id: str
+    test_plan: str
+    test_case: str
+    total_instances: int
+    groups: list[RunGroup] = field(default_factory=list)
+    runner_config: Any = None
+    disable_metrics: bool = False
+    # EnvConfig equivalent is attached by the engine at dispatch time.
+    env: Any = None
+
+    def to_dict(self) -> dict:
+        return {
+            "run_id": self.run_id,
+            "test_plan": self.test_plan,
+            "test_case": self.test_case,
+            "total_instances": self.total_instances,
+            "groups": [g.to_dict() for g in self.groups],
+            "disable_metrics": self.disable_metrics,
+        }
+
+
+@dataclass
+class RunOutput:
+    """Output from a run (``pkg/api/runner.go:87-102``)."""
+
+    run_id: str
+    composition: Any = None
+    result: Any = None
+
+
+@dataclass
+class CollectionInput:
+    """Input for collecting a run's outputs (``pkg/api/runner.go:104-114``)."""
+
+    run_id: str
+    runner_id: str
+    runner_config: Any = None
+    env: Any = None
+
+
+@dataclass
+class BuildInput:
+    """Input options for building a test plan (``pkg/api/builder.go:29-58``)."""
+
+    build_id: str
+    test_plan: str
+    unpacked_plan_dir: str = ""
+    unpacked_sdk_dir: str = ""
+    selectors: list[str] = field(default_factory=list)
+    dependencies: dict[str, tuple[str, str]] = field(default_factory=dict)
+    build_config: Any = None
+    env: Any = None
+
+
+@dataclass
+class BuildOutput:
+    """Output from a build (``pkg/api/builder.go:60-75``)."""
+
+    builder_id: str
+    artifact_path: str
+    dependencies: dict[str, str] = field(default_factory=dict)

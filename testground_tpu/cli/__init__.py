@@ -1,0 +1,1 @@
+"""Command-line interface. Twin of the reference's ``pkg/cmd``."""

@@ -1,0 +1,26 @@
+"""``tg`` CLI entry point. Command surface mirrors the reference's
+``pkg/cmd/root.go:10-24`` verbs; commands land with the engine layer."""
+
+from __future__ import annotations
+
+import sys
+
+from testground_tpu import __version__
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] in ("version", "--version"):
+        print(f"testground-tpu {__version__}")
+        return 0
+    print(
+        "testground-tpu: TPU-native distributed-systems test platform\n"
+        "commands: run build plan describe daemon collect terminate "
+        "healthcheck tasks status logs version",
+        file=sys.stderr,
+    )
+    return 0 if not argv else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""Environment configuration: ``$TESTGROUND_HOME`` layout, ``.env.toml``
+loading, and config coalescing. Twin of the reference's ``pkg/config``."""
+
+from .coalescing import CoalescedConfig
+from .dirs import Directories
+from .env import (
+    DEFAULT_LISTEN_ADDR,
+    DEFAULT_QUEUE_SIZE,
+    DEFAULT_TASK_REPO_TYPE,
+    DEFAULT_WORKERS,
+    RUNNER_DISABLED_FLAG,
+    ClientConfig,
+    DaemonConfig,
+    EnvConfig,
+    SchedulerConfig,
+)
+
+__all__ = [
+    "CoalescedConfig",
+    "ClientConfig",
+    "DaemonConfig",
+    "DEFAULT_LISTEN_ADDR",
+    "DEFAULT_QUEUE_SIZE",
+    "DEFAULT_TASK_REPO_TYPE",
+    "DEFAULT_WORKERS",
+    "Directories",
+    "EnvConfig",
+    "RUNNER_DISABLED_FLAG",
+    "SchedulerConfig",
+]

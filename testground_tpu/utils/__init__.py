@@ -1,0 +1,5 @@
+"""Small shared utilities: TOML emission, value conversions, tar streams.
+
+Twin of the reference's ``pkg/conv`` plus the TOML-encode half of
+BurntSushi/toml that the stdlib lacks.
+"""

@@ -1,0 +1,26 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (the reference's kind-cluster
+analog — SURVEY.md §4): multi-chip sharding is validated without TPU
+hardware. Must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tg_home(tmp_path, monkeypatch):
+    """Isolated $TESTGROUND_HOME for engine/runner tests."""
+    home = tmp_path / "tghome"
+    home.mkdir()
+    monkeypatch.setenv("TESTGROUND_HOME", str(home))
+    return home

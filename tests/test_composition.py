@@ -1,0 +1,285 @@
+"""Composition type tests, mirroring the reference's
+``pkg/api/composition_test.go`` scenarios."""
+
+import pytest
+
+from testground_tpu.api import (
+    Build,
+    Composition,
+    CompositionError,
+    Dependency,
+    Global,
+    Group,
+    Instances,
+    Run,
+    CompositionRunGroup,
+    validate_for_build,
+    validate_for_run,
+)
+
+
+def make_composition(**kwargs):
+    defaults = dict(
+        global_=Global(
+            plan="foo_plan",
+            case="foo_case",
+            builder="docker:go",
+            runner="local:docker",
+            total_instances=0,
+        ),
+        groups=[Group(id="a", instances=Instances(count=1))],
+    )
+    defaults.update(kwargs)
+    return Composition(**defaults)
+
+
+class TestValidation:
+    def test_groups_unique(self):
+        c = make_composition(
+            groups=[
+                Group(id="dup", instances=Instances(count=1)),
+                Group(id="dup", instances=Instances(count=1)),
+            ]
+        )
+        with pytest.raises(CompositionError, match="not unique"):
+            validate_for_build(c)
+
+    def test_missing_builder(self):
+        c = make_composition()
+        c.global_.builder = ""
+        with pytest.raises(CompositionError, match="missing a builder"):
+            validate_for_build(c)
+
+    def test_group_level_builder_is_enough(self):
+        c = make_composition(
+            groups=[Group(id="a", builder="exec:py", instances=Instances(count=1))]
+        )
+        c.global_.builder = ""
+        validate_for_build(c)  # must not raise
+
+    def test_count_xor_percentage(self):
+        c = make_composition(
+            groups=[Group(id="a", instances=Instances(count=2, percentage=0.5))]
+        )
+        with pytest.raises(CompositionError, match="count"):
+            validate_for_build(c)
+
+    def test_run_references_unknown_group(self):
+        c = make_composition(
+            runs=[
+                Run(
+                    id="r1",
+                    groups=[
+                        CompositionRunGroup(id="nope", instances=Instances(count=1))
+                    ],
+                )
+            ]
+        )
+        with pytest.raises(CompositionError, match="non-existent group"):
+            validate_for_run(c)
+
+    def test_run_ids_unique(self):
+        rg = lambda: CompositionRunGroup(id="a", instances=Instances(count=1))
+        c = make_composition(
+            runs=[Run(id="r", groups=[rg()]), Run(id="r", groups=[rg()])]
+        )
+        with pytest.raises(CompositionError, match="runs ids not unique"):
+            validate_for_run(c)
+
+
+class TestInstanceCounts:
+    def test_total_computed_from_counts(self):
+        """composition_test.go:93 TestTotalInstancesIsComputedWhenPossible."""
+        r = Run(
+            id="r",
+            groups=[
+                CompositionRunGroup(id="a", instances=Instances(count=2)),
+                CompositionRunGroup(id="b", instances=Instances(count=3)),
+            ],
+        )
+        r.recalculate_instance_counts()
+        assert r.total_instances == 5
+        assert [g.calculated_instance_count for g in r.groups] == [2, 3]
+
+    def test_percentage_requires_total(self):
+        r = Run(
+            id="r",
+            groups=[CompositionRunGroup(id="a", instances=Instances(percentage=0.5))],
+        )
+        with pytest.raises(ValueError, match="total_instance"):
+            r.recalculate_instance_counts()
+
+    def test_percentage_resolution(self):
+        r = Run(
+            id="r",
+            total_instances=10,
+            groups=[
+                CompositionRunGroup(id="a", instances=Instances(percentage=0.3)),
+                CompositionRunGroup(id="b", instances=Instances(percentage=0.7)),
+            ],
+        )
+        r.recalculate_instance_counts()
+        assert [g.calculated_instance_count for g in r.groups] == [3, 7]
+
+    def test_total_mismatch_rejected(self):
+        r = Run(
+            id="r",
+            total_instances=10,
+            groups=[CompositionRunGroup(id="a", instances=Instances(count=3))],
+        )
+        with pytest.raises(ValueError, match="mismatch"):
+            r.recalculate_instance_counts()
+
+
+class TestBuildKey:
+    def test_requires_builder(self):
+        """composition_test.go:246 TestBuildKeyWithoutBuilderPanics."""
+        with pytest.raises(ValueError):
+            Group(id="a").build_key()
+
+    def test_depends_on_builder(self):
+        """composition_test.go:257 TestBuildKeyDependsOnBuilder."""
+        a = Group(id="a", builder="docker:go")
+        b = Group(id="a", builder="exec:py")
+        assert a.build_key() != b.build_key()
+
+    def test_selector_order_canonicalized(self):
+        a = Group(id="a", builder="b", build=Build(selectors=["x", "y"]))
+        b = Group(id="b", builder="b", build=Build(selectors=["y", "x"]))
+        assert a.build_key() == b.build_key()
+
+    def test_dependency_order_canonicalized(self):
+        a = Group(
+            id="a",
+            builder="b",
+            build=Build(
+                dependencies=[
+                    Dependency(module="m1", version="1"),
+                    Dependency(module="m2", version="2"),
+                ]
+            ),
+        )
+        b = Group(
+            id="b",
+            builder="b",
+            build=Build(
+                dependencies=[
+                    Dependency(module="m2", version="2"),
+                    Dependency(module="m1", version="1"),
+                ]
+            ),
+        )
+        assert a.build_key() == b.build_key()
+
+
+class TestAccessors:
+    def _comp(self):
+        return make_composition(
+            groups=[
+                Group(id="g1", instances=Instances(count=1)),
+                Group(id="g2", builder="exec:py", instances=Instances(count=1)),
+            ],
+            runs=[
+                Run(
+                    id="r1",
+                    groups=[CompositionRunGroup(id="g1", instances=Instances(count=1))],
+                ),
+                Run(
+                    id="r2",
+                    groups=[
+                        CompositionRunGroup(
+                            id="x", group_id="g2", instances=Instances(count=1)
+                        )
+                    ],
+                ),
+            ],
+        )
+
+    def test_list_builders(self):
+        """composition_test.go:223 TestListBuilders."""
+        assert self._comp().list_builders() == ["docker:go", "exec:py"]
+
+    def test_list_ids(self):
+        c = self._comp()
+        assert c.list_run_ids() == ["r1", "r2"]
+        assert c.list_group_ids() == ["g1", "g2"]
+
+    def test_frame_for_runs(self):
+        """composition_test.go:367 TestFrameForRun."""
+        c = self._comp().frame_for_runs("r2")
+        assert [r.id for r in c.runs] == ["r2"]
+        assert [g.id for g in c.groups] == ["g2"]
+
+    def test_frame_for_unknown_run(self):
+        with pytest.raises(KeyError):
+            self._comp().frame_for_runs("nope")
+
+    def test_pick_groups(self):
+        c = self._comp().pick_groups(1)
+        assert [g.id for g in c.groups] == ["g2"]
+
+
+class TestTomlRoundTrip:
+    def test_marshal_is_idempotent(self):
+        """composition_test.go:517 TestMarshalIsIdempotent."""
+        c = make_composition()
+        c2 = Composition.from_toml(c.to_toml())
+        assert c2.to_dict() == c.to_dict()
+        assert Composition.from_toml(c2.to_toml()).to_dict() == c.to_dict()
+
+    def test_parses_reference_style_toml(self):
+        """Reference compositions parse unchanged (issue-1493 style with
+        [[runs]]; composition_test.go:290)."""
+        text = """
+[metadata]
+name = "pingpong"
+
+[global]
+plan = "network"
+case = "ping-pong"
+total_instances = 2
+builder = "exec:py"
+runner = "local:exec"
+
+[global.run]
+[global.run.test_params]
+maxlat = "100"
+
+[[groups]]
+id = "nodes"
+[groups.instances]
+count = 2
+
+[[runs]]
+id = "with-runs"
+[runs.test_params]
+extra = "1"
+[[runs.groups]]
+id = "nodes"
+[runs.groups.instances]
+count = 2
+"""
+        c = Composition.from_toml(text)
+        assert c.metadata.name == "pingpong"
+        assert c.global_.plan == "network"
+        assert c.global_.run.test_params["maxlat"] == "100"
+        assert c.groups[0].instances.count == 2
+        assert c.runs[0].id == "with-runs"
+        assert c.runs[0].test_params["extra"] == "1"
+        validate_for_run(c)
+
+
+def test_run_group_may_inherit_instances_from_backing_group():
+    """Reference-valid pattern: [[runs.groups]] with no instances inherits
+    from the backing group at prepare time; validation must accept it."""
+    c = make_composition(
+        groups=[Group(id="a", instances=Instances(count=2))],
+        runs=[Run(id="r", groups=[CompositionRunGroup(id="a")])],
+    )
+    validate_for_run(c)  # must not raise
+
+
+def test_pick_groups_rejects_negative_index():
+    c = make_composition()
+    with pytest.raises(IndexError):
+        c.pick_groups(-1)

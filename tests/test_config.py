@@ -1,0 +1,86 @@
+"""EnvConfig / directories / coalescing tests (``pkg/config``)."""
+
+from dataclasses import dataclass, field
+
+from testground_tpu.config import CoalescedConfig, EnvConfig
+
+
+def test_defaults_applied(tg_home):
+    e = EnvConfig.load()
+    assert e.daemon.listen == "localhost:8042"
+    assert e.daemon.scheduler.workers == 2
+    assert e.daemon.scheduler.queue_size == 100
+    assert e.daemon.scheduler.task_repo_type == "memory"
+    assert e.client.endpoint == "http://localhost:8042"
+
+
+def test_directory_layout_created(tg_home):
+    e = EnvConfig.load()
+    assert e.dirs.home == str(tg_home)
+    for d in e.dirs.all():
+        import os
+
+        assert os.path.isdir(d)
+    assert e.dirs.outputs().endswith("data/outputs")
+    assert e.dirs.work().endswith("data/work")
+
+
+def test_env_toml_overrides(tg_home):
+    (tg_home / ".env.toml").write_text(
+        """
+[daemon]
+listen = ":9999"
+
+[daemon.scheduler]
+workers = 5
+task_repo_type = "disk"
+
+[client]
+endpoint = "http://somewhere:9999"
+user = "me"
+
+[runners."local:exec"]
+disabled = true
+
+[runners."sim:jax"]
+default_dt_ms = 5
+"""
+    )
+    e = EnvConfig.load()
+    assert e.daemon.listen == ":9999"
+    assert e.daemon.scheduler.workers == 5
+    assert e.daemon.scheduler.task_repo_type == "disk"
+    assert e.daemon.scheduler.queue_size == 100  # default survives
+    assert e.client.user == "me"
+    assert e.runner_is_disabled("local:exec")
+    assert not e.runner_is_disabled("sim:jax")
+    assert e.runners["sim:jax"]["default_dt_ms"] == 5
+
+
+def test_coalesced_config():
+    @dataclass
+    class RunnerCfg:
+        workers: int = 1
+        name: str = ""
+        extras: list = field(default_factory=list)
+
+    c = (
+        CoalescedConfig({"workers": 2, "unknown_key": True})
+        .append({"name": "a"})
+        .append({"name": "b"})
+        .append(None)
+    )
+    cfg = c.coalesce_into(RunnerCfg)
+    assert cfg.workers == 2
+    assert cfg.name == "b"  # later layers win
+    assert cfg.extras == []
+
+
+def test_coalesced_config_nested_dataclass():
+    from testground_tpu.config import DaemonConfig
+
+    cfg = CoalescedConfig({"listen": ":1", "scheduler": {"workers": 5}}).coalesce_into(
+        DaemonConfig
+    )
+    assert cfg.listen == ":1"
+    assert cfg.scheduler.workers == 5  # nested dict became SchedulerConfig

@@ -1,0 +1,72 @@
+"""Manifest tests (``pkg/api/manifest_test.go`` + reference manifest TOML
+compatibility)."""
+
+from testground_tpu.api import TestPlanManifest
+
+
+REFERENCE_STYLE = """
+name = "placebo"
+
+[defaults]
+builder = "exec:py"
+runner = "local:exec"
+
+[builders."exec:py"]
+enabled = true
+
+[runners."local:exec"]
+enabled = true
+
+[runners."sim:jax"]
+enabled = true
+
+[[testcases]]
+name = "ok"
+instances = { min = 1, max = 200, default = 1 }
+
+  [testcases.params]
+  some_param = { type = "int", desc = "some param", unit = "peers" }
+
+[[testcases]]
+name = "stall"
+instances = { min = 1, max = 250, default = 1 }
+
+[[testcases]]
+name = "barrier"
+instances = { min = 1, max = 50000, default = 1 }
+
+  [testcases.params]
+  barrier_iterations = { type = "int", desc = "iterations", unit = "n", default = 10 }
+"""
+
+
+def test_parses_reference_style_manifest():
+    m = TestPlanManifest.from_toml(REFERENCE_STYLE)
+    assert m.name == "placebo"
+    assert m.has_builder("exec:py")
+    assert m.has_runner("local:exec") and m.has_runner("sim:jax")
+    assert not m.has_builder("docker:go")
+    assert m.defaults["builder"] == "exec:py"
+
+    tc = m.testcase_by_name("ok")
+    assert tc.instances.minimum == 1
+    assert tc.instances.maximum == 200
+    assert tc.instances.default == 1
+    assert tc.parameters["some_param"].type == "int"
+    assert tc.parameters["some_param"].unit == "peers"
+
+    assert m.testcase_by_name("nope") is None
+
+
+def test_default_parameters_json_encodes_non_strings():
+    m = TestPlanManifest.from_toml(REFERENCE_STYLE)
+    assert m.default_parameters("barrier") == {"barrier_iterations": "10"}
+    # params with no default are omitted
+    assert m.default_parameters("ok") == {}
+
+
+def test_describe():
+    m = TestPlanManifest.from_toml(REFERENCE_STYLE)
+    text = m.describe()
+    assert '"placebo"' in text
+    assert "3 test cases" in text

@@ -1,0 +1,355 @@
+"""Preparation pipeline tests, mirroring the reference's
+``pkg/api/composition_preparation_test.go`` scenarios."""
+
+import pytest
+
+from testground_tpu.api import (
+    Build,
+    Composition,
+    CompositionRunGroup,
+    Dependency,
+    Global,
+    Group,
+    InstanceConstraints,
+    Instances,
+    Parameter,
+    Run,
+    RunParams,
+    TestCase,
+    TestPlanManifest,
+    generate_default_run,
+    prepare_for_build,
+    prepare_for_run,
+)
+
+
+def manifest(**kwargs):
+    defaults = dict(
+        name="foo_plan",
+        builders={"docker:go": {}},
+        runners={"local:docker": {}},
+        testcases=[
+            TestCase(
+                name="foo_case",
+                instances=InstanceConstraints(minimum=1, maximum=100),
+                parameters={
+                    "param4": Parameter(
+                        type="string", default="value4:default:manifest"
+                    )
+                },
+            )
+        ],
+    )
+    defaults.update(kwargs)
+    return TestPlanManifest(**defaults)
+
+
+class TestDefaultTestParams:
+    """composition_preparation_test.go:11 TestDefaultTestParamsApplied."""
+
+    def test_precedence(self):
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                total_instances=3,
+                builder="docker:go",
+                runner="local:docker",
+                run=RunParams(
+                    test_params={
+                        "param1": "value1:default:composition",
+                        "param2": "value2:default:composition",
+                        "param3": "value3:default:composition",
+                    }
+                ),
+            ),
+            groups=[
+                Group(
+                    id="all_set",
+                    instances=Instances(count=1),
+                    run=RunParams(
+                        test_params={
+                            "param1": "value1:set",
+                            "param2": "value2:set",
+                            "param3": "value3:set",
+                        }
+                    ),
+                ),
+                Group(id="none_set", instances=Instances(count=1)),
+                Group(
+                    id="first_set",
+                    instances=Instances(count=1),
+                    run=RunParams(test_params={"param1": "value1:set"}),
+                ),
+            ],
+        )
+
+        ret = prepare_for_run(c, manifest())
+        g = ret.runs[0].groups
+
+        assert g[0].test_params["param1"] == "value1:set"
+        assert g[0].test_params["param2"] == "value2:set"
+        assert g[0].test_params["param3"] == "value3:set"
+        assert g[0].test_params["param4"] == "value4:default:manifest"
+
+        assert g[1].test_params["param1"] == "value1:default:composition"
+        assert g[1].test_params["param2"] == "value2:default:composition"
+        assert g[1].test_params["param3"] == "value3:default:composition"
+        assert g[1].test_params["param4"] == "value4:default:manifest"
+
+        assert g[2].test_params["param1"] == "value1:set"
+        assert g[2].test_params["param2"] == "value2:default:composition"
+        assert g[2].test_params["param4"] == "value4:default:manifest"
+
+
+class TestDefaultBuildParams:
+    """composition_preparation_test.go:101 TestDefaultBuildParamsApplied."""
+
+    def _comp(self):
+        return Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                total_instances=3,
+                builder="docker:go",
+                runner="local:docker",
+                build=Build(
+                    selectors=["default_selector_1", "default_selector_2"],
+                    dependencies=[
+                        Dependency(module="dependency:a", version="1.0.0.default"),
+                        Dependency(module="dependency:b", version="2.0.0.default"),
+                    ],
+                ),
+            ),
+            groups=[
+                Group(id="no_local_settings"),
+                Group(
+                    id="dep_override",
+                    build=Build(
+                        dependencies=[
+                            Dependency(
+                                module="dependency:a", version="1.0.0.overridden"
+                            ),
+                            Dependency(
+                                module="dependency:c", version="1.0.0.locally_set"
+                            ),
+                        ]
+                    ),
+                ),
+                Group(
+                    id="selector_override",
+                    build=Build(selectors=["overridden"]),
+                ),
+            ],
+        )
+
+    def test_build_defaults(self):
+        ret = prepare_for_build(self._comp(), manifest())
+
+        g0 = ret.groups[0]
+        assert g0.build.selectors == ["default_selector_1", "default_selector_2"]
+        assert {(d.module, d.version) for d in g0.build.dependencies} == {
+            ("dependency:a", "1.0.0.default"),
+            ("dependency:b", "2.0.0.default"),
+        }
+
+        g1 = ret.groups[1]
+        assert {(d.module, d.version) for d in g1.build.dependencies} == {
+            ("dependency:a", "1.0.0.overridden"),
+            ("dependency:b", "2.0.0.default"),
+            ("dependency:c", "1.0.0.locally_set"),
+        }
+
+        g2 = ret.groups[2]
+        assert g2.build.selectors == ["overridden"]
+
+    def test_unsupported_builder_rejected(self):
+        c = self._comp()
+        c.global_.builder = "docker:nope"
+        with pytest.raises(ValueError, match="does not support builder"):
+            prepare_for_build(c, manifest())
+
+
+class TestBuildConfigTrickleDown:
+    """composition_preparation_test.go:187 TestDefaultBuildConfigTrickleDown."""
+
+    def test_precedence_group_global_manifest(self):
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                builder="docker:go",
+                runner="local:docker",
+                build_config={"build_base_image": "base_image_global"},
+            ),
+            groups=[
+                Group(id="from_global"),
+                Group(
+                    id="from_group",
+                    build_config={"build_base_image": "base_image_group"},
+                ),
+            ],
+        )
+        m = manifest(
+            builders={"docker:go": {"build_base_image": "base_image_manifest",
+                                    "enabled": True}}
+        )
+        ret = prepare_for_build(c, m)
+        assert ret.groups[0].build_config["build_base_image"] == "base_image_global"
+        assert ret.groups[0].build_config["enabled"] is True
+        assert ret.groups[1].build_config["build_base_image"] == "base_image_group"
+
+
+class TestPrepareForRun:
+    def test_generates_default_run(self):
+        """composition_preparation.go:93-110 GenerateDefaultRun."""
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                builder="docker:go",
+                runner="local:docker",
+            ),
+            groups=[
+                Group(id="a", instances=Instances(count=2)),
+                Group(id="b", instances=Instances(count=3)),
+            ],
+        )
+        ret = prepare_for_run(c, manifest())
+        assert len(ret.runs) == 1
+        assert ret.runs[0].id == "default"
+        assert ret.runs[0].total_instances == 5
+        assert [g.calculated_instance_count for g in ret.runs[0].groups] == [2, 3]
+
+    def test_instance_bounds_enforced(self):
+        """composition_preparation.go:223-227."""
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                builder="docker:go",
+                runner="local:docker",
+            ),
+            groups=[Group(id="a", instances=Instances(count=500))],
+        )
+        with pytest.raises(ValueError, match="outside of allowable range"):
+            prepare_for_run(c, manifest())
+
+    def test_unknown_case_rejected(self):
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="nope",
+                builder="docker:go",
+                runner="local:docker",
+            ),
+            groups=[Group(id="a", instances=Instances(count=1))],
+        )
+        with pytest.raises(ValueError, match="not found"):
+            prepare_for_run(c, manifest())
+
+    def test_unsupported_runner_rejected(self):
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                builder="docker:go",
+                runner="cluster:nope",
+            ),
+            groups=[Group(id="a", instances=Instances(count=1))],
+        )
+        with pytest.raises(ValueError, match="does not support runner"):
+            prepare_for_run(c, manifest())
+
+    def test_runner_config_trickle_down(self):
+        """composition_preparation_test.go:412 TestRunConfigTrickleDown."""
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                builder="docker:go",
+                runner="local:docker",
+                run_config={"keep": "composition"},
+            ),
+            groups=[Group(id="a", instances=Instances(count=1))],
+        )
+        m = manifest(
+            runners={"local:docker": {"keep": "manifest", "extra": "manifest"}}
+        )
+        ret = prepare_for_run(c, m)
+        assert ret.global_.run_config["keep"] == "composition"
+        assert ret.global_.run_config["extra"] == "manifest"
+
+    def test_runs_preserved_when_present(self):
+        """composition_test.go:290 issue-1493: explicit [[runs]] survive."""
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                builder="docker:go",
+                runner="local:docker",
+            ),
+            groups=[Group(id="a", instances=Instances(count=1))],
+            runs=[
+                Run(
+                    id="custom",
+                    groups=[
+                        CompositionRunGroup(id="a", instances=Instances(count=2))
+                    ],
+                )
+            ],
+        )
+        ret = prepare_for_run(c, manifest())
+        assert [r.id for r in ret.runs] == ["custom"]
+        assert ret.runs[0].total_instances == 2
+
+    def test_run_group_inherits_group_instances(self):
+        """Run groups fall back to the backing group's instances
+        (composition.go:472-489 merge)."""
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                builder="docker:go",
+                runner="local:docker",
+            ),
+            groups=[Group(id="a", instances=Instances(count=4))],
+            runs=[Run(id="r", groups=[CompositionRunGroup(id="a")])],
+        )
+        ret = prepare_for_run(c, manifest())
+        assert ret.runs[0].groups[0].calculated_instance_count == 4
+
+    def test_default_parameters_json_encoded(self):
+        m = manifest(
+            testcases=[
+                TestCase(
+                    name="foo_case",
+                    instances=InstanceConstraints(minimum=1, maximum=10),
+                    parameters={
+                        "num": Parameter(type="int", default=5),
+                        "s": Parameter(type="string", default="x"),
+                    },
+                )
+            ]
+        )
+        assert m.default_parameters("foo_case") == {"num": "5", "s": "x"}
+
+    def test_inputs_not_mutated(self):
+        c = Composition(
+            global_=Global(
+                plan="foo_plan",
+                case="foo_case",
+                builder="docker:go",
+                runner="local:docker",
+            ),
+            groups=[Group(id="a", instances=Instances(count=1))],
+        )
+        prepare_for_run(c, manifest())
+        assert c.runs == []  # original untouched
+
+    def test_generate_default_run_only_when_absent(self):
+        c = Composition(
+            groups=[Group(id="a", instances=Instances(count=1))],
+            runs=[Run(id="keep")],
+        )
+        assert [r.id for r in generate_default_run(c).runs] == ["keep"]

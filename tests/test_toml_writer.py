@@ -1,0 +1,29 @@
+"""TOML emitter round-trip tests."""
+
+import tomllib
+
+import pytest
+
+from testground_tpu.utils.toml_writer import dumps
+
+
+@pytest.mark.parametrize(
+    "doc",
+    [
+        {"a": 1, "b": "x", "c": True, "d": 1.5},
+        {"t": {"nested": {"k": "v"}}, "top": "x"},
+        {"arr": [1, 2, 3], "sarr": ["a", "b"]},
+        {"groups": [{"id": "a", "n": 1}, {"id": "b", "n": 2}]},
+        {"s": 'quote " backslash \\ newline \n tab \t'},
+        {"weird key.with dots": {"inner": 1}},
+        {"global": {"run": {"test_params": {"k": "v"}}}},
+        {"empty_list": [], "empty_table": {}},
+    ],
+)
+def test_round_trip(doc):
+    assert tomllib.loads(dumps(doc)) == doc
+
+
+def test_rejects_unencodable():
+    with pytest.raises(TypeError):
+        dumps({"x": object()})
