@@ -1,0 +1,109 @@
+"""example: demonstrates the SDK surface.
+
+Port of the reference's ``plans/example`` testcases (output / failure /
+panic / params / sync / metrics / artifact — ``plans/example/main.go:11-19``).
+"""
+
+import os
+import random
+import time
+
+from testground_tpu.sdk import invoke_map
+
+
+def output(runenv, initctx):
+    """(``plans/example/output.go``)."""
+    runenv.record_message("Hello, World.")
+    runenv.record_message(
+        "Additional arguments: %d", len(runenv.test_instance_params)
+    )
+    runenv.R().record_point("donkeypower", 3.0)
+
+
+def failure(runenv, initctx):
+    """(``plans/example/failure.go``)."""
+    runenv.record_message("This is what happens when there is a failure")
+    return "intentional oops"
+
+
+def panic(runenv, initctx):
+    """(``plans/example/panic.go``)."""
+    runenv.record_message("About to hit an unhandled error")
+    raise RuntimeError("intentional panic")
+
+
+def params(runenv, initctx):
+    """(``plans/example/params.go``)."""
+    runenv.record_message("Params are defined in toml manifest")
+    for k, v in runenv.test_instance_params.items():
+        runenv.record_message("key: %s, value: %s", k, v)
+    runenv.record_message(
+        "The value of param2 is %s", runenv.string_param("param2")
+    )
+
+
+def sync(runenv, initctx):
+    """Leader/follower release via signal + barrier
+    (``plans/example/sync.go``): first to signal 'enrolled' leads; it waits
+    for all followers on 'ready', then signals 'released'."""
+    client = initctx.sync_client
+    seq = client.signal_entry("enrolled")
+    runenv.record_message("my sequence ID: %d", seq)
+
+    if seq == 1:
+        runenv.record_message("i'm the leader.")
+        num_followers = runenv.test_instance_count - 1
+        runenv.record_message(
+            "waiting for %d instances to become ready", num_followers
+        )
+        client.barrier("ready", num_followers)
+        runenv.record_message("the followers are all ready")
+        client.signal_entry("released")
+        return None
+
+    sleep = random.random() * 0.5
+    runenv.record_message("i'm a follower; signalling ready after %f", sleep)
+    time.sleep(sleep)
+    client.signal_entry("ready")
+    client.barrier("released", 1)
+    runenv.record_message("i have been released")
+
+
+def metrics(runenv, initctx):
+    """(``plans/example/metrics.go``, shortened from 30s to stay test-fast)."""
+    counter = runenv.R().counter("example.counter1")
+    histogram = runenv.R().histogram("example.histogram1")
+    gauge = runenv.R().gauge("example.gauge1")
+    for _ in range(20):
+        data = random.randint(0, 14)
+        runenv.record_message("Doing work: %d", data)
+        counter.inc(data)
+        histogram.update(float(data))
+        gauge.update(float(data))
+        time.sleep(0.01)
+
+
+def artifact(runenv, initctx):
+    """(``plans/example/artifact.go``): reads a file shipped with the build
+    artifact."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifact.txt")
+    try:
+        with open(path) as f:
+            runenv.record_message(f.read().strip())
+    except OSError as e:
+        runenv.record_failure(e)
+        return str(e)
+
+
+if __name__ == "__main__":
+    invoke_map(
+        {
+            "output": output,
+            "failure": failure,
+            "panic": panic,
+            "params": params,
+            "sync": sync,
+            "metrics": metrics,
+            "artifact": artifact,
+        }
+    )

@@ -1,0 +1,54 @@
+"""placebo: the do-nothing fixture plan.
+
+Port of the reference's ``plans/placebo/main.go`` testcases (ok / panic /
+stall, plus abort and metrics declared in its manifest): the ladder's basic
+success/failure/timeout fixtures used by the integration suite
+(``integration_tests/03-05``, 14, 16).
+"""
+
+import time
+
+from testground_tpu.sdk import invoke_map
+
+
+def ok(runenv):
+    runenv.record_message("placebo is fine")
+
+
+def abort(runenv):
+    """Failure via explicit record + error return (integration test 14:
+    silent failure must still fail the run)."""
+    runenv.record_message("about to abort")
+    return "aborting on purpose"
+
+
+def panic(runenv):
+    raise RuntimeError("this is an intentional panic")
+
+
+def stall(runenv):
+    """Stalls until the task timeout kills the run
+    (``placebo/main.go`` stall sleeps 24h)."""
+    runenv.record_message("Now stalling for 24 hours")
+    time.sleep(24 * 3600)
+
+
+def metrics(runenv):
+    c = runenv.R().counter("placebo.counter")
+    h = runenv.R().histogram("placebo.histogram")
+    for i in range(10):
+        c.inc(1)
+        h.update(float(i))
+    runenv.R().record_point("placebo.point", 42.0)
+
+
+if __name__ == "__main__":
+    invoke_map(
+        {
+            "ok": ok,
+            "abort": abort,
+            "panic": panic,
+            "stall": stall,
+            "metrics": metrics,
+        }
+    )

@@ -1,0 +1,11 @@
+"""Builder plugin registry. Twin of the reference's ``pkg/build``.
+
+Builders registered here (mirroring ``pkg/engine/engine.go:25-30``):
+- ``exec:py`` — resolves a Python plan source dir into a runnable module
+  (the analog of ``exec:go``'s host executable).
+- ``sim:plan`` — resolves a plan's sim program for the ``sim:jax`` runner.
+"""
+
+from .base import Builder
+
+__all__ = ["Builder"]

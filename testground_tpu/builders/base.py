@@ -1,0 +1,30 @@
+"""Builder interface (``pkg/api/builder.go:14-26``)."""
+
+from __future__ import annotations
+
+import abc
+import threading
+
+from testground_tpu.api import BuildInput, BuildOutput
+from testground_tpu.rpc import OutputWriter
+
+__all__ = ["Builder"]
+
+
+class Builder(abc.ABC):
+    """A builder takes a test plan and builds it into executable form so it
+    can be scheduled by a runner."""
+
+    @abc.abstractmethod
+    def id(self) -> str: ...
+
+    @abc.abstractmethod
+    def build(
+        self, inp: BuildInput, ow: OutputWriter, cancel: threading.Event
+    ) -> BuildOutput: ...
+
+    def purge(self, testplan: str, ow: OutputWriter) -> None:
+        """Free resources such as caches."""
+
+    def config_type(self) -> type | None:
+        return None

@@ -1,0 +1,73 @@
+"""``exec:py`` builder: resolve a Python plan into a runnable artifact.
+
+The analog of the reference's ``exec:go`` (``pkg/build/exec_go.go``: compile
+to a host executable at ``<work>/exec-go--<plan>-<id>``). Python needs no
+compilation; the build snapshots the plan sources into
+``<work>/exec-py--<plan>-<build-id>/`` (immutable artifact, so later source
+edits don't mutate queued runs), validates the entry point, and returns the
+snapshot's ``main.py`` as the artifact path. Dependency overrides map to
+extra ``PYTHONPATH`` entries recorded in ``deps.json`` (the analog of go.mod
+replace directives, ``exec_go.go:94-118``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+
+from testground_tpu.api import BuildInput, BuildOutput
+from testground_tpu.rpc import OutputWriter
+
+from .base import Builder
+
+__all__ = ["ExecPyBuilder"]
+
+
+class ExecPyBuilder(Builder):
+    def id(self) -> str:
+        return "exec:py"
+
+    def build(
+        self, inp: BuildInput, ow: OutputWriter, cancel: threading.Event
+    ) -> BuildOutput:
+        src = inp.unpacked_plan_dir
+        if not src or not os.path.isdir(src):
+            raise ValueError(f"plan sources not found: {src!r}")
+        entry = os.path.join(src, "main.py")
+        if not os.path.isfile(entry):
+            raise ValueError(f"plan has no main.py entry point: {src}")
+
+        work = inp.env.dirs.work()
+        dest = os.path.join(work, f"exec-py--{inp.test_plan}-{inp.build_id}")
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        shutil.copytree(
+            src,
+            dest,
+            ignore=shutil.ignore_patterns(
+                "__pycache__", "*.pyc", ".git", "_compositions"
+            ),
+        )
+
+        deps = {mod: {"target": t, "version": v} for mod, (t, v) in
+                inp.dependencies.items()}
+        with open(os.path.join(dest, "deps.json"), "w") as f:
+            json.dump({"selectors": inp.selectors, "dependencies": deps}, f)
+
+        artifact = os.path.join(dest, "main.py")
+        ow.infof("exec:py built %s -> %s", inp.test_plan, artifact)
+        return BuildOutput(
+            builder_id=self.id(),
+            artifact_path=artifact,
+            dependencies={m: d["version"] for m, d in deps.items()},
+        )
+
+    def purge(self, testplan: str, ow: OutputWriter) -> None:
+        """Remove snapshot artifacts for a plan (``exec_go`` has no cache;
+        this clears the snapshots)."""
+        # The work dir is per-EnvConfig; purge walks known prefixes.
+        # Engine passes no env here, so this is a no-op placeholder kept for
+        # interface parity; per-plan purge happens via the engine's work dir.
+        ow.infof("exec:py purge: snapshots are removed with the work dir")

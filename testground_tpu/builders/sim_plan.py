@@ -1,0 +1,53 @@
+"""``sim:plan`` builder: resolve a plan's simulation program for the
+``sim:jax`` runner.
+
+The sim runner executes plans as traceable JAX state machines, not
+processes, so the "artifact" is the plan source dir itself (validated to
+expose ``sim_plans`` — see ``testground_tpu.sim.api``). Snapshotting is
+shared with ``exec:py`` so queued runs are immune to source edits.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+
+from testground_tpu.api import BuildInput, BuildOutput
+from testground_tpu.rpc import OutputWriter
+
+from .base import Builder
+
+__all__ = ["SimPlanBuilder"]
+
+
+class SimPlanBuilder(Builder):
+    def id(self) -> str:
+        return "sim:plan"
+
+    def build(
+        self, inp: BuildInput, ow: OutputWriter, cancel: threading.Event
+    ) -> BuildOutput:
+        src = inp.unpacked_plan_dir
+        if not src or not os.path.isdir(src):
+            raise ValueError(f"plan sources not found: {src!r}")
+        if not (
+            os.path.isfile(os.path.join(src, "sim.py"))
+            or os.path.isfile(os.path.join(src, "main.py"))
+        ):
+            raise ValueError(
+                f"plan has neither sim.py nor main.py entry point: {src}"
+            )
+        work = inp.env.dirs.work()
+        dest = os.path.join(work, f"sim-plan--{inp.test_plan}-{inp.build_id}")
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        shutil.copytree(
+            src,
+            dest,
+            ignore=shutil.ignore_patterns(
+                "__pycache__", "*.pyc", ".git", "_compositions"
+            ),
+        )
+        ow.infof("sim:plan built %s -> %s", inp.test_plan, dest)
+        return BuildOutput(builder_id=self.id(), artifact_path=dest)

@@ -1,0 +1,583 @@
+"""CLI command implementations (twin of ``pkg/cmd/{run,build,plan,describe,
+collect,terminate,healthcheck,tasks,status,logs}.go``).
+
+Output phrasing for run queueing/completion matches the reference so the
+shell-level assertions keep working (``integration_tests/header.sh`` greps
+"run is queued with ID" / "finished run with ID").
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import time
+
+from testground_tpu.api import (
+    Composition,
+    Global,
+    Group,
+    Instances,
+    TestPlanManifest,
+    load_composition,
+    validate_for_run,
+)
+from testground_tpu.config import EnvConfig
+from testground_tpu.engine import Engine, Outcome, State
+from testground_tpu.rpc import OutputWriter
+from testground_tpu.utils.conv import parse_key_values
+
+# --------------------------------------------------------------- plumbing
+
+
+def _engine(args) -> Engine:
+    """In-process engine (daemon transport arrives with the daemon layer).
+
+    Task state must survive across CLI invocations (status/logs/tasks run in
+    fresh processes), so the memory default upgrades to disk unless
+    .env.toml explicitly chose memory."""
+    if getattr(args, "endpoint", ""):
+        raise NotImplementedError(
+            "--endpoint (remote daemon) is not wired up yet; "
+            "commands run against the in-process engine"
+        )
+    env = EnvConfig.load()
+    if not env.task_repo_explicit:
+        env.daemon.scheduler.task_repo_type = "disk"
+    engine = Engine.new_default(env)
+    engine.start_workers()
+    return engine
+
+
+def _print_chunk_line(line: str, raw_fallback: bool = True) -> None:
+    """Decode one task-log chunk line to the console (shared by run-follow
+    and ``tg logs``)."""
+    from testground_tpu.rpc import Chunk
+
+    try:
+        c = Chunk.from_json(line)
+    except Exception:  # noqa: BLE001 — non-chunk lines pass through
+        if raw_fallback:
+            sys.stdout.write(line)
+        return
+    if c.type == "p" and isinstance(c.payload, str):
+        sys.stdout.write(c.payload)
+    elif c.type == "e" and c.error:
+        print(f"error: {c.error}", file=sys.stderr)
+
+
+def _resolve_plan(env: EnvConfig, plan: str) -> tuple[str, TestPlanManifest]:
+    """Resolve a plan name/path to (source dir, manifest) — the reference
+    resolves against $TESTGROUND_HOME/plans (``pkg/cmd/run.go:181``)."""
+    candidates = [
+        plan,
+        os.path.join(env.dirs.plans(), plan),
+    ]
+    for c in candidates:
+        manifest_path = os.path.join(c, "manifest.toml")
+        if os.path.isfile(manifest_path):
+            return os.path.abspath(c), TestPlanManifest.load_file(manifest_path)
+    raise FileNotFoundError(
+        f"plan {plan!r} not found (searched: {candidates}); "
+        f"import it with `tg plan import --from <dir>`"
+    )
+
+
+def _wait_task(engine: Engine, task_id: str, follow_logs: bool = True):
+    if follow_logs:
+        for line in engine.logs(task_id, follow=True):
+            _print_chunk_line(line, raw_fallback=False)
+    while True:
+        t = engine.get_task(task_id)
+        if t is not None and t.state().state in (State.COMPLETE, State.CANCELED):
+            return t
+        time.sleep(0.1)
+
+
+def _collect_to_file(engine: Engine, runner_id: str, run_id: str, dest: str):
+    from testground_tpu.rpc import discard_writer
+
+    with open(dest, "wb") as f:
+        engine.do_collect_outputs(runner_id, run_id, f, discard_writer())
+    print(f"downloaded outputs to {dest}")
+
+
+# ------------------------------------------------------------------- run
+
+
+def _help_func(parser):
+    """Default func for command groups invoked bare: print usage, exit 2."""
+
+    def fn(args):
+        parser.print_help()
+        return 2
+
+    return fn
+
+
+def register_run(sub) -> None:
+    p = sub.add_parser("run", help="(builds and) runs a composition or single test case")
+    p.set_defaults(func=_help_func(p))
+    psub = p.add_subparsers(dest="run_mode")
+
+    pc = psub.add_parser("composition", help="run a composition file")
+    pc.add_argument("-f", "--file", required=True, help="composition TOML file")
+    pc.add_argument("--collect", action="store_true", help="collect outputs after run")
+    pc.add_argument("--collect-file", default="", help="write outputs tgz here")
+    pc.add_argument(
+        "--write-artifacts",
+        action="store_true",
+        help="write built artifacts back into the composition file",
+    )
+    pc.add_argument(
+        "--ignore-artifacts",
+        action="store_true",
+        help="ignore artifacts in the composition; rebuild",
+    )
+    pc.add_argument("--run-ids", default="", help="only run these [[runs]] ids (csv)")
+    pc.add_argument(
+        "--result-file", default="", help="append run results as CSV rows"
+    )
+    pc.set_defaults(func=run_composition_cmd)
+
+    ps = psub.add_parser("single", help="run a single plan/case")
+    ps.add_argument("plan_case", help="<plan>:<case>")
+    ps.add_argument("--builder", default="")
+    ps.add_argument("--runner", default="")
+    ps.add_argument("-i", "--instances", type=int, default=0)
+    ps.add_argument(
+        "-tp",
+        "--test-param",
+        action="append",
+        default=[],
+        help="test param k=v (repeatable)",
+    )
+    ps.add_argument("--collect", action="store_true")
+    ps.set_defaults(func=run_single_cmd)
+
+
+def run_composition_cmd(args) -> int:
+    comp = load_composition(args.file)
+    if args.ignore_artifacts:
+        for g in comp.groups:
+            g.run.artifact = ""
+    # validate before frame_for_runs so a bad composition is rejected even
+    # when --run-ids selects a subset (queue_run re-validates the framed
+    # composition; reference order is the same, run.go:157 → FrameForRuns)
+    validate_for_run(comp)
+    if args.run_ids:
+        comp = comp.frame_for_runs(*args.run_ids.split(","))
+    return _run(args, comp, write_artifacts_to=args.file if args.write_artifacts else "")
+
+
+def run_single_cmd(args) -> int:
+    """(``pkg/cmd/run.go`` runSingleCmd + createSingletonComposition)."""
+    plan, _, case = args.plan_case.partition(":")
+    if not case:
+        raise ValueError("expected <plan>:<case>")
+    env = EnvConfig.load()
+    _, manifest = _resolve_plan(env, plan)
+    builder = args.builder or manifest.defaults.get("builder", "")
+    runner = args.runner or manifest.defaults.get("runner", "")
+    tc = manifest.testcase_by_name(case)
+    instances = args.instances or (tc.instances.default if tc else 1) or 1
+    comp = Composition(
+        global_=Global(plan=plan, case=case, builder=builder, runner=runner),
+        groups=[
+            Group(
+                id="single",
+                instances=Instances(count=instances),
+            )
+        ],
+    )
+    comp.groups[0].run.test_params = {
+        k: str(v) for k, v in parse_key_values(args.test_param).items()
+    }
+    from testground_tpu.api import generate_default_run
+
+    comp = generate_default_run(comp)
+    print(
+        'created a synthetic composition file for this job; all instances '
+        'will run under singleton group "single"'
+    )
+    return _run(args, comp)
+
+
+def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
+    engine = _engine(args)
+    try:
+        env = engine.env
+        src_dir, manifest = _resolve_plan(env, comp.global_.plan)
+        task_id = engine.queue_run(comp, manifest, sources_dir=src_dir)
+        print(f"run is queued with ID: {task_id}")
+        t = _wait_task(engine, task_id)
+        outcome = t.outcome()
+        print(f"finished run with ID: {task_id} (outcome: {outcome.value})")
+
+        if write_artifacts_to and isinstance(t.result, dict):
+            comp_out = t.result.get("composition")
+            if comp_out:
+                Composition.from_dict(comp_out).write_file(write_artifacts_to)
+                print(f"wrote artifacts into composition {write_artifacts_to}")
+
+        collect_file = getattr(args, "collect_file", "")
+        if getattr(args, "collect", False) or collect_file:
+            dest = collect_file or f"{task_id}.tgz"
+            _collect_to_file(engine, comp.global_.runner, task_id, dest)
+
+        result_file = getattr(args, "result_file", "")
+        if result_file:
+            import csv
+
+            new = not os.path.exists(result_file)
+            with open(result_file, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["task_id", "plan_case", "outcome", "error"])
+                w.writerow([t.id, t.name(), outcome.value, t.error])
+
+        return 0 if outcome == Outcome.SUCCESS else 1
+    finally:
+        engine.stop()
+
+
+# ------------------------------------------------------------------ build
+
+
+def register_build(sub) -> None:
+    p = sub.add_parser("build", help="builds a composition or single plan")
+    p.set_defaults(func=_help_func(p))
+    psub = p.add_subparsers(dest="build_mode")
+    pc = psub.add_parser("composition")
+    pc.add_argument("-f", "--file", required=True)
+    pc.add_argument("--write-artifacts", action="store_true")
+    pc.set_defaults(func=build_composition_cmd)
+    ps = psub.add_parser("single")
+    ps.add_argument("plan", help="plan name")
+    ps.add_argument("--builder", default="")
+    ps.set_defaults(func=build_single_cmd)
+
+
+def build_composition_cmd(args) -> int:
+    comp = load_composition(args.file)
+    engine = _engine(args)
+    try:
+        src_dir, manifest = _resolve_plan(engine.env, comp.global_.plan)
+        task_id = engine.queue_build(comp, manifest, sources_dir=src_dir)
+        print(f"build is queued with ID: {task_id}")
+        t = _wait_task(engine, task_id)
+        print(f"finished build with ID: {task_id} (outcome: {t.outcome().value})")
+        if args.write_artifacts and isinstance(t.result, dict):
+            comp_out = t.result.get("composition")
+            if comp_out:
+                Composition.from_dict(comp_out).write_file(args.file)
+                print(f"wrote artifacts into composition {args.file}")
+        return 0 if t.outcome() == Outcome.SUCCESS else 1
+    finally:
+        engine.stop()
+
+
+def build_single_cmd(args) -> int:
+    engine = _engine(args)
+    try:
+        src_dir, manifest = _resolve_plan(engine.env, args.plan)
+        builder = args.builder or manifest.defaults.get("builder", "")
+        comp = Composition(
+            global_=Global(plan=args.plan, builder=builder),
+            groups=[Group(id="single", instances=Instances(count=1))],
+        )
+        task_id = engine.queue_build(comp, manifest, sources_dir=src_dir)
+        print(f"build is queued with ID: {task_id}")
+        t = _wait_task(engine, task_id)
+        print(f"finished build with ID: {task_id} (outcome: {t.outcome().value})")
+        return 0 if t.outcome() == Outcome.SUCCESS else 1
+    finally:
+        engine.stop()
+
+
+# ------------------------------------------------------------------- plan
+
+
+def register_plan(sub) -> None:
+    p = sub.add_parser("plan", help="manage test plans in $TESTGROUND_HOME/plans")
+    p.set_defaults(func=_help_func(p))
+    psub = p.add_subparsers(dest="plan_mode")
+
+    pl = psub.add_parser("list", help="list known plans")
+    pl.add_argument("--testcases", action="store_true", help="also list testcases")
+    pl.set_defaults(func=plan_list_cmd)
+
+    pi = psub.add_parser("import", help="import a plan directory")
+    pi.add_argument("--from", dest="source", required=True, help="source dir")
+    pi.add_argument("--name", default="", help="rename the plan on import")
+    pi.add_argument(
+        "--force", action="store_true", help="overwrite an existing plan"
+    )
+    pi.set_defaults(func=plan_import_cmd)
+
+    pr = psub.add_parser("rm", help="remove an imported plan")
+    pr.add_argument("plan")
+    pr.set_defaults(func=plan_rm_cmd)
+
+    pc = psub.add_parser("create", help="scaffold a new plan")
+    pc.add_argument("plan")
+    pc.set_defaults(func=plan_create_cmd)
+
+
+def plan_list_cmd(args) -> int:
+    env = EnvConfig.load()
+    root = env.dirs.plans()
+    for name in sorted(os.listdir(root)) if os.path.isdir(root) else []:
+        manifest_path = os.path.join(root, name, "manifest.toml")
+        if not os.path.isfile(manifest_path):
+            continue
+        print(name)
+        if args.testcases:
+            m = TestPlanManifest.load_file(manifest_path)
+            for tc in m.testcases:
+                print(f"  {name}:{tc.name}")
+    return 0
+
+
+def plan_import_cmd(args) -> int:
+    env = EnvConfig.load()
+    src = os.path.abspath(args.source)
+    if not os.path.isfile(os.path.join(src, "manifest.toml")):
+        raise FileNotFoundError(f"{src} has no manifest.toml")
+    name = args.name or os.path.basename(src.rstrip("/"))
+    dest = os.path.join(env.dirs.plans(), name)
+    if os.path.exists(dest):
+        if not args.force:
+            raise FileExistsError(
+                f"plan {name} already exists at {dest}; pass --force to replace"
+            )
+        shutil.rmtree(dest)
+    shutil.copytree(src, dest, ignore=shutil.ignore_patterns("__pycache__", ".git"))
+    print(f"imported plan {name} -> {dest}")
+    return 0
+
+
+def plan_rm_cmd(args) -> int:
+    env = EnvConfig.load()
+    dest = os.path.join(env.dirs.plans(), args.plan)
+    if not os.path.isdir(dest):
+        raise FileNotFoundError(f"no such plan: {args.plan}")
+    shutil.rmtree(dest)
+    print(f"removed plan {args.plan}")
+    return 0
+
+
+_PLAN_TEMPLATE = '''"""{name}: a testground-tpu plan."""
+
+from testground_tpu.sdk import invoke_map
+
+
+def ok(runenv):
+    runenv.record_message("hello from {name}")
+
+
+if __name__ == "__main__":
+    invoke_map({{"ok": ok}})
+'''
+
+_MANIFEST_TEMPLATE = """name = "{name}"
+
+[defaults]
+builder = "exec:py"
+runner = "local:exec"
+
+[builders."exec:py"]
+enabled = true
+
+[runners."local:exec"]
+enabled = true
+
+[[testcases]]
+name = "ok"
+instances = {{ min = 1, max = 100, default = 1 }}
+"""
+
+
+def plan_create_cmd(args) -> int:
+    env = EnvConfig.load()
+    dest = os.path.join(env.dirs.plans(), args.plan)
+    if os.path.exists(dest):
+        raise FileExistsError(f"plan {args.plan} already exists")
+    os.makedirs(dest)
+    with open(os.path.join(dest, "main.py"), "w") as f:
+        f.write(_PLAN_TEMPLATE.format(name=args.plan))
+    with open(os.path.join(dest, "manifest.toml"), "w") as f:
+        f.write(_MANIFEST_TEMPLATE.format(name=args.plan))
+    print(f"created plan {args.plan} at {dest}")
+    return 0
+
+
+# --------------------------------------------------------------- describe
+
+
+def register_describe(sub) -> None:
+    p = sub.add_parser("describe", help="describe a plan or test case")
+    p.add_argument("plan", help="<plan> or <plan>:<case>")
+    p.set_defaults(func=describe_cmd)
+
+
+def describe_cmd(args) -> int:
+    env = EnvConfig.load()
+    plan, _, case = args.plan.partition(":")
+    _, manifest = _resolve_plan(env, plan)
+    if case:
+        tc = manifest.testcase_by_name(case)
+        if tc is None:
+            raise KeyError(f"test case {case} not found in plan {plan}")
+        print(tc.describe())
+    else:
+        print(manifest.describe())
+        for tc in manifest.testcases:
+            print(tc.describe())
+    return 0
+
+
+# ---------------------------------------------------------- tasks / status
+
+
+def register_tasks(sub) -> None:
+    p = sub.add_parser("tasks", help="list tasks")
+    p.add_argument("--state", action="append", default=[], help="filter by state")
+    p.add_argument("--type", action="append", default=[], help="filter by type")
+    p.add_argument("-n", "--limit", type=int, default=0)
+    p.set_defaults(func=tasks_cmd)
+
+
+def tasks_cmd(args) -> int:
+    engine = _engine(args)
+    try:
+        tasks = engine.tasks(
+            states=args.state or None, types=args.type or None, limit=args.limit
+        )
+        for t in tasks:
+            print(
+                f"{t.id}  {t.type.value:5}  {t.name():24}  "
+                f"{t.state().state.value:10}  {t.outcome().value}"
+            )
+        return 0
+    finally:
+        engine.stop()
+
+
+def register_status(sub) -> None:
+    p = sub.add_parser("status", help="get task status")
+    p.add_argument("-t", "--task", required=True, help="task id")
+    p.add_argument("--extended", action="store_true")
+    p.set_defaults(func=status_cmd)
+
+
+def status_cmd(args) -> int:
+    engine = _engine(args)
+    try:
+        t = engine.get_task(args.task)
+        if t is None:
+            raise KeyError(f"unknown task {args.task}")
+        print(f"ID:      {t.id}")
+        print(f"Name:    {t.name()}")
+        print(f"Type:    {t.type.value}")
+        print(f"State:   {t.state().state.value}")
+        print(f"Outcome: {t.outcome().value}")
+        if t.error:
+            print(f"Error:   {t.error}")
+        if args.extended:
+            import json
+
+            print(json.dumps(t.to_dict(), indent=2))
+        return 0
+    finally:
+        engine.stop()
+
+
+def register_logs(sub) -> None:
+    p = sub.add_parser("logs", help="print task logs")
+    p.add_argument("-t", "--task", required=True)
+    p.add_argument("-f", "--follow", action="store_true")
+    p.set_defaults(func=logs_cmd)
+
+
+def logs_cmd(args) -> int:
+    engine = _engine(args)
+    try:
+        for line in engine.logs(args.task, follow=args.follow):
+            _print_chunk_line(line)
+        return 0
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------- collect
+
+
+def register_collect(sub) -> None:
+    p = sub.add_parser("collect", help="collect run outputs into a tgz")
+    p.add_argument("run_id")
+    p.add_argument("--runner", default="local:exec")
+    p.add_argument("-o", "--output", default="")
+    p.set_defaults(func=collect_cmd)
+
+
+def collect_cmd(args) -> int:
+    engine = _engine(args)
+    try:
+        dest = args.output or f"{args.run_id}.tgz"
+        _collect_to_file(engine, args.runner, args.run_id, dest)
+        return 0
+    finally:
+        engine.stop()
+
+
+# ------------------------------------------- healthcheck / terminate / misc
+
+
+def register_healthcheck(sub) -> None:
+    p = sub.add_parser("healthcheck", help="check a runner's environment")
+    p.add_argument("--runner", required=True)
+    p.add_argument("--fix", action="store_true")
+    p.set_defaults(func=healthcheck_cmd)
+
+
+def healthcheck_cmd(args) -> int:
+    engine = _engine(args)
+    try:
+        ow = OutputWriter(sink=None, echo=sys.stdout)
+        report = engine.do_healthcheck(args.runner, args.fix, ow)
+        print(report)
+        return 0 if report.ok() else 1
+    finally:
+        engine.stop()
+
+
+def register_terminate(sub) -> None:
+    p = sub.add_parser("terminate", help="terminate a runner's resources")
+    p.add_argument("--runner", required=True)
+    p.set_defaults(func=terminate_cmd)
+
+
+def terminate_cmd(args) -> int:
+    engine = _engine(args)
+    try:
+        ow = OutputWriter(sink=None, echo=sys.stdout)
+        engine.do_terminate(args.runner, ow)
+        return 0
+    finally:
+        engine.stop()
+
+
+def register_daemon(sub) -> None:
+    p = sub.add_parser("daemon", help="run the testground daemon")
+    p.set_defaults(func=daemon_cmd)
+
+
+def daemon_cmd(args) -> int:
+    from testground_tpu.daemon.server import serve
+
+    return serve()
+
+
+def register_version(sub) -> None:
+    sub.add_parser("version", help="print version")

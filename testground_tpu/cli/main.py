@@ -1,25 +1,80 @@
-"""``tg`` CLI entry point. Command surface mirrors the reference's
-``pkg/cmd/root.go:10-24`` verbs; commands land with the engine layer."""
+"""``tg`` CLI entry point.
+
+Command surface mirrors the reference's ``pkg/cmd/root.go:10-24``: run,
+build, plan, describe, daemon, collect, terminate, healthcheck, tasks,
+status, logs, version. The engine runs in-process unless ``--endpoint``
+points at a daemon (the reference's client↔daemon hop is transport, not
+semantics).
+"""
 
 from __future__ import annotations
 
+import argparse
 import sys
 
 from testground_tpu import __version__
+from testground_tpu.logging_ import set_level
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tg",
+        description=(
+            "testground-tpu: a TPU-native platform for testing, benchmarking "
+            "and simulating distributed and p2p systems at scale"
+        ),
+    )
+    p.add_argument("-v", "--verbose", action="store_true", help="verbose logging")
+    p.add_argument(
+        "--endpoint",
+        default="",
+        help="daemon endpoint (default: in-process engine)",
+    )
+    sub = p.add_subparsers(dest="command")
+
+    from . import commands
+
+    commands.register_run(sub)
+    commands.register_build(sub)
+    commands.register_plan(sub)
+    commands.register_describe(sub)
+    commands.register_tasks(sub)
+    commands.register_status(sub)
+    commands.register_logs(sub)
+    commands.register_collect(sub)
+    commands.register_healthcheck(sub)
+    commands.register_terminate(sub)
+    commands.register_daemon(sub)
+    commands.register_version(sub)
+    return p
 
 
 def main(argv: list[str] | None = None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] in ("version", "--version"):
+    args = build_parser().parse_args(argv)
+    if args.verbose:
+        set_level("debug")
+    if args.command is None:
+        build_parser().print_help()
+        return 0
+    if args.command == "version":
         print(f"testground-tpu {__version__}")
         return 0
-    print(
-        "testground-tpu: TPU-native distributed-systems test platform\n"
-        "commands: run build plan describe daemon collect terminate "
-        "healthcheck tasks status logs version",
-        file=sys.stderr,
-    )
-    return 0 if not argv else 2
+    try:
+        return args.func(args) or 0
+    except KeyboardInterrupt:
+        return 130
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe; exit quietly
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return 0
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: {e}", file=sys.stderr)
+        if args.verbose:
+            raise
+        return 1
 
 
 if __name__ == "__main__":

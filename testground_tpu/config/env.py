@@ -61,6 +61,10 @@ class EnvConfig:
     daemon: DaemonConfig = field(default_factory=DaemonConfig)
     client: ClientConfig = field(default_factory=ClientConfig)
     dirs: Directories = field(default_factory=lambda: Directories(""))
+    # whether .env.toml explicitly chose a task repo type; the in-process
+    # CLI upgrades the "memory" default to "disk" so task state survives
+    # across invocations (the reference's daemon is long-lived, ours isn't)
+    task_repo_explicit: bool = False
 
     @classmethod
     def load(cls, home: str | None = None) -> "EnvConfig":
@@ -110,6 +114,8 @@ class EnvConfig:
         self.daemon.scheduler.queue_size = int(sch.get("queue_size", 0))
         self.daemon.scheduler.task_repo_type = sch.get("task_repo_type", "")
         self.daemon.scheduler.task_timeout_min = int(sch.get("task_timeout_min", 0))
+        if sch.get("task_repo_type"):
+            self.task_repo_explicit = True
         cl = d.get("client", {})
         self.client.endpoint = cl.get("endpoint", self.client.endpoint)
         self.client.token = cl.get("token", self.client.token)

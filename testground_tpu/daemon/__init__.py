@@ -1,0 +1,1 @@
+"""HTTP daemon exposing the engine. Twin of the reference's ``pkg/daemon``."""

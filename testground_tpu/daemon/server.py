@@ -1,0 +1,8 @@
+"""Daemon HTTP server (placeholder; full routes land with the daemon
+milestone)."""
+
+from __future__ import annotations
+
+
+def serve() -> int:
+    raise NotImplementedError("daemon HTTP server lands with the daemon milestone")

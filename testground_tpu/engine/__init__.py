@@ -1,0 +1,31 @@
+"""The scheduler core: task model, persistent priority queue, worker
+supervisor, and the engine facade tying builders/runners together.
+
+Twin of the reference's ``pkg/engine`` + ``pkg/task``.
+"""
+
+from .task import (
+    CreatedBy,
+    DatedState,
+    Outcome,
+    State,
+    Task,
+    TaskType,
+)
+from .storage import TaskStorage
+from .queue import QueueFullError, TaskQueue
+from .engine import Engine, EngineConfig
+
+__all__ = [
+    "CreatedBy",
+    "DatedState",
+    "Engine",
+    "EngineConfig",
+    "Outcome",
+    "QueueFullError",
+    "State",
+    "Task",
+    "TaskQueue",
+    "TaskStorage",
+    "TaskType",
+]

@@ -1,0 +1,300 @@
+"""The engine: builder/runner registries, task queue, worker pool, and the
+task APIs the daemon exposes.
+
+Twin of the reference's ``pkg/engine/engine.go`` (registries, storage/queue
+init, worker goroutines, queue/kill/logs) with the supervisor loop in
+``supervisor.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+from testground_tpu.api import (
+    Composition,
+    TestPlanManifest,
+    validate_for_run,
+)
+from testground_tpu.config import EnvConfig
+from testground_tpu.logging_ import S
+
+from .queue import TaskQueue
+from .storage import TaskStorage
+from .task import CreatedBy, DatedState, State, Task, TaskType, new_task_id
+
+__all__ = ["Engine", "EngineConfig"]
+
+
+@dataclass
+class EngineConfig:
+    """(``pkg/engine/engine.go:65-77`` EngineConfig)."""
+
+    env: EnvConfig
+    builders: list = field(default_factory=list)
+    runners: list = field(default_factory=list)
+
+
+class Engine:
+    """Singleton scheduler (``engine.go:41-63``)."""
+
+    def __init__(self, cfg: EngineConfig):
+        self.env = cfg.env
+        self._builders = {b.id(): b for b in cfg.builders}
+        self._runners = {r.id(): r for r in cfg.runners}
+
+        sch = self.env.daemon.scheduler
+        if sch.task_repo_type == "disk":
+            db_path = os.path.join(self.env.dirs.home, "tasks.db")
+        else:
+            db_path = ":memory:"
+        self.storage = TaskStorage(db_path)
+        self.queue = TaskQueue(self.storage, sch.queue_size)
+
+        # per-task cancel signals (``engine.go:59-62``)
+        self._cancel_lock = threading.Lock()
+        self._cancels: dict[str, threading.Event] = {}
+
+        self._stop = threading.Event()
+        self._queue_kick = threading.Event()
+        self._workers: list[threading.Thread] = []
+
+    # ---------------------------------------------------------------- wiring
+
+    @classmethod
+    def new_default(cls, env: EnvConfig | None = None) -> "Engine":
+        """Default engine with all first-party builders/runners registered
+        (``engine.go:127-160`` NewDefaultEngine)."""
+        from testground_tpu.builders.exec_py import ExecPyBuilder
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.runners.local_exec import LocalExecRunner
+        from testground_tpu.sim.runner import SimJaxRunner
+
+        env = env or EnvConfig.load()
+        return cls(
+            EngineConfig(
+                env=env,
+                builders=[ExecPyBuilder(), SimPlanBuilder()],
+                runners=[LocalExecRunner(), SimJaxRunner()],
+            )
+        )
+
+    def start_workers(self) -> None:
+        """(``engine.go:120-122``)."""
+        from .supervisor import worker
+
+        n = self.env.daemon.scheduler.workers
+        for i in range(n):
+            t = threading.Thread(
+                target=worker, args=(self, i), daemon=True, name=f"tg-worker-{i}"
+            )
+            t.start()
+            self._workers.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue_kick.set()
+        for t in self._workers:
+            t.join(timeout=5)
+
+    # ------------------------------------------------------------- registries
+
+    def builder_by_name(self, name: str):
+        return self._builders.get(name)
+
+    def runner_by_name(self, name: str):
+        return self._runners.get(name)
+
+    def list_builders(self) -> list[str]:
+        return sorted(self._builders)
+
+    def list_runners(self) -> list[str]:
+        return sorted(self._runners)
+
+    # -------------------------------------------------------------- queueing
+
+    def _check_run_compat(self, comp: Composition, manifest: TestPlanManifest):
+        """Runner exists + every group's builder is compatible with it
+        (``engine.go:216-219``)."""
+        runner = self.runner_by_name(comp.global_.runner)
+        if runner is None:
+            raise ValueError(f"unknown runner: {comp.global_.runner}")
+        compatible = set(runner.compatible_builders())
+        for b in comp.list_builders():
+            if b and b not in compatible:
+                raise ValueError(
+                    f"builder {b} is incompatible with runner "
+                    f"{comp.global_.runner} (compatible: {sorted(compatible)})"
+                )
+
+    def queue_run(
+        self,
+        comp: Composition,
+        manifest: TestPlanManifest,
+        sources_dir: str = "",
+        priority: int = 0,
+        created_by: CreatedBy | None = None,
+    ) -> str:
+        """Queue a run task (``engine.go:203-249`` QueueRun)."""
+        validate_for_run(comp)
+        self._check_run_compat(comp, manifest)
+        return self._queue_task(
+            TaskType.RUN, comp, manifest, sources_dir, priority, created_by
+        )
+
+    def queue_build(
+        self,
+        comp: Composition,
+        manifest: TestPlanManifest,
+        sources_dir: str = "",
+        priority: int = 0,
+        created_by: CreatedBy | None = None,
+    ) -> str:
+        """Queue a build task (``engine.go:162-201`` QueueBuild)."""
+        return self._queue_task(
+            TaskType.BUILD, comp, manifest, sources_dir, priority, created_by
+        )
+
+    def _queue_task(
+        self,
+        typ: TaskType,
+        comp: Composition,
+        manifest: TestPlanManifest,
+        sources_dir: str,
+        priority: int,
+        created_by: CreatedBy | None,
+    ) -> str:
+        tsk = Task(
+            id=new_task_id(),
+            type=typ,
+            priority=priority,
+            plan=comp.global_.plan,
+            case=comp.global_.case,
+            runner=comp.global_.runner,
+            composition=comp.to_dict(),
+            input={
+                "manifest": manifest.to_dict(),
+                "sources_dir": sources_dir,
+            },
+            states=[DatedState(state=State.SCHEDULED, created=time.time())],
+            created_by=created_by or CreatedBy(),
+        )
+        if tsk.created_by_ci():
+            self.queue.push_unique_by_branch(tsk)
+        else:
+            self.queue.push(tsk)
+        self._queue_kick.set()
+        S().info("queued task %s (%s)", tsk.id, tsk.name())
+        return tsk.id
+
+    # ------------------------------------------------------------ cancel/kill
+
+    def register_cancel(self, task_id: str) -> threading.Event:
+        ev = threading.Event()
+        with self._cancel_lock:
+            self._cancels[task_id] = ev
+        return ev
+
+    def drop_cancel(self, task_id: str) -> None:
+        with self._cancel_lock:
+            self._cancels.pop(task_id, None)
+
+    def kill(self, task_id: str) -> bool:
+        """Cancel a queued or running task (``engine.go:419-427`` Kill)."""
+        if self.queue.cancel_queued(task_id):
+            S().info("canceled queued task %s", task_id)
+            return True
+        with self._cancel_lock:
+            ev = self._cancels.get(task_id)
+        if ev is not None:
+            ev.set()
+            return True
+        return False
+
+    # ------------------------------------------------------------------ info
+
+    def get_task(self, task_id: str) -> Task | None:
+        return self.storage.get(task_id)
+
+    def tasks(self, **filters: Any) -> list[Task]:
+        return self.storage.filter(**filters)
+
+    def task_log_path(self, task_id: str) -> str:
+        """Per-task output file (``engine.go:461-558`` Logs tails
+        ``<daemon-dir>/<task-id>.out``)."""
+        return os.path.join(self.env.dirs.daemon(), f"{task_id}.out")
+
+    def logs(
+        self, task_id: str, follow: bool = False, cancel: threading.Event | None = None
+    ) -> Iterator[str]:
+        """Stream a task's log file; with ``follow``, tail until the task
+        completes (``engine.go:461-558``)."""
+        path = self.task_log_path(task_id)
+        # wait for the file to appear if the task is still queued
+        while not os.path.exists(path):
+            tsk = self.get_task(task_id)
+            if tsk is None:
+                raise FileNotFoundError(f"unknown task {task_id}")
+            if not follow or tsk.state().state in (State.COMPLETE, State.CANCELED):
+                return
+            if cancel is not None and cancel.is_set():
+                return
+            time.sleep(0.1)
+        with open(path, "r") as f:
+            while True:
+                line = f.readline()
+                if line:
+                    yield line
+                    continue
+                tsk = self.get_task(task_id)
+                done = tsk is None or tsk.state().state in (
+                    State.COMPLETE,
+                    State.CANCELED,
+                )
+                if not follow or done:
+                    return
+                if cancel is not None and cancel.is_set():
+                    return
+                time.sleep(0.1)
+
+    # -------------------------------------------------------------- actions
+
+    def do_collect_outputs(self, runner_id: str, run_id: str, w, ow) -> None:
+        """(``engine.go:251-`` DoCollectOutputs)."""
+        from testground_tpu.api import CollectionInput
+
+        runner = self.runner_by_name(runner_id)
+        if runner is None:
+            raise ValueError(f"unknown runner: {runner_id}")
+        runner.collect_outputs(
+            CollectionInput(run_id=run_id, runner_id=runner_id, env=self.env), w, ow
+        )
+
+    def do_terminate(self, runner_id: str, ow) -> None:
+        from testground_tpu.runners.base import Terminatable
+
+        runner = self.runner_by_name(runner_id)
+        if runner is None:
+            raise ValueError(f"unknown runner: {runner_id}")
+        if not isinstance(runner, Terminatable):
+            raise ValueError(f"runner {runner_id} is not terminatable")
+        runner.terminate_all(ow)
+
+    def do_healthcheck(self, runner_id: str, fix: bool, ow):
+        from testground_tpu.runners.base import HealthcheckedRunner
+
+        runner = self.runner_by_name(runner_id)
+        if runner is None:
+            raise ValueError(f"unknown runner: {runner_id}")
+        if not isinstance(runner, HealthcheckedRunner):
+            raise ValueError(f"runner {runner_id} does not support healthchecks")
+        return runner.healthcheck(fix, ow)
+
+    def do_build_purge(self, builder_id: str, testplan: str, ow) -> None:
+        builder = self.builder_by_name(builder_id)
+        if builder is None:
+            raise ValueError(f"unknown builder: {builder_id}")
+        builder.purge(testplan, ow)

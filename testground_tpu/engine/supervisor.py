@@ -1,0 +1,282 @@
+"""The worker loop: pops tasks off the queue and executes builds and runs.
+
+Twin of the reference's ``pkg/engine/supervisor.go``: state transitions are
+persisted at each step, builds are deduplicated by ``Group.build_key()``,
+config coalesces with precedence composition > .env.toml > manifest, runs are
+dispatched to the runner, and the result is archived.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import time
+import traceback
+
+from testground_tpu.api import (
+    Composition,
+    RunGroup,
+    RunInput,
+    TestPlanManifest,
+    prepare_for_build,
+    prepare_for_run,
+    validate_for_build,
+    validate_for_run,
+    BuildInput,
+)
+from testground_tpu.config import CoalescedConfig
+from testground_tpu.logging_ import S
+from testground_tpu.rpc import OutputWriter
+
+from .engine import Engine
+from .queue import QueueEmptyError
+from .task import DatedState, Outcome, State, Task, TaskType
+
+__all__ = ["worker", "do_build", "do_run"]
+
+DEFAULT_TASK_TIMEOUT_SECS = 10 * 60  # supervisor.go:49-52
+
+
+def worker(engine: Engine, idx: int) -> None:
+    """One worker loop (``supervisor.go:47-190``)."""
+    S().debug("supervisor worker %d started", idx)
+    while not engine._stop.is_set():
+        try:
+            tsk = engine.queue.pop()
+        except QueueEmptyError:
+            engine._queue_kick.wait(timeout=0.2)
+            engine._queue_kick.clear()
+            continue
+        process_task(engine, tsk)
+
+
+def process_task(engine: Engine, tsk: Task) -> None:
+    """Execute one task end-to-end, with timeout and cancellation."""
+    timeout = engine.env.daemon.scheduler.task_timeout_min * 60 or (
+        DEFAULT_TASK_TIMEOUT_SECS
+    )
+    cancel = engine.register_cancel(tsk.id)
+    timer = threading.Timer(timeout, cancel.set)
+    timer.daemon = True
+    timer.start()
+
+    log_path = engine.task_log_path(tsk.id)
+    try:
+        with open(log_path, "w") as log_file:
+            ow = OutputWriter(sink=log_file)
+            try:
+                engine.storage.update_current(tsk)
+                if tsk.type == TaskType.RUN:
+                    result = do_run(engine, tsk, ow, cancel)
+                elif tsk.type == TaskType.BUILD:
+                    result = do_build_task(engine, tsk, ow, cancel)
+                else:
+                    raise ValueError(f"unsupported task type {tsk.type}")
+                tsk.result = result
+            except Exception as e:  # noqa: BLE001 — task errors become results
+                S().error("task %s failed: %s", tsk.id, e)
+                ow.write_error(str(e))
+                tsk.error = str(e)
+                tsk.result = {
+                    "outcome": (
+                        Outcome.CANCELED.value
+                        if cancel.is_set()
+                        else Outcome.FAILURE.value
+                    )
+                }
+                S().debug("%s", traceback.format_exc())
+            else:
+                ow.write_result(tsk.result)
+    finally:
+        timer.cancel()
+        engine.drop_cancel(tsk.id)
+        final = State.CANCELED if cancel.is_set() and tsk.error else State.COMPLETE
+        tsk.states.append(DatedState(state=final, created=time.time()))
+        engine.storage.archive(tsk)
+        S().info("task %s finished: %s", tsk.id, tsk.outcome().value)
+
+
+# ----------------------------------------------------------------- builds
+
+
+def do_build(
+    engine: Engine,
+    comp: Composition,
+    manifest: TestPlanManifest,
+    sources_dir: str,
+    build_id: str,
+    ow: OutputWriter,
+    cancel: threading.Event,
+) -> Composition:
+    """Build all groups, deduplicating by build key; returns a clone with
+    per-group ``run.artifact`` filled in (``supervisor.go:298-493``)."""
+    comp = prepare_for_build(comp, manifest)
+    validate_for_build(comp)
+
+    # dedup groups by BuildKey (supervisor.go:359-364)
+    by_key: dict[str, list[int]] = {}
+    for i, g in enumerate(comp.groups):
+        if g.run.artifact:
+            continue  # reuse previously built artifact
+        by_key.setdefault(g.build_key(), []).append(i)
+
+    limit = comp.global_.concurrent_builds or 4
+    results: dict[str, str] = {}
+
+    def build_one(key: str, group_idx: int) -> tuple[str, str]:
+        g = comp.groups[group_idx]
+        builder = engine.builder_by_name(g.builder)
+        if builder is None:
+            raise ValueError(f"unknown builder: {g.builder}")
+        cfg = (
+            CoalescedConfig()
+            .append(engine.env.builders.get(g.builder))
+            .append(g.build_config)
+        )
+        inp = BuildInput(
+            build_id=f"{build_id}-{group_idx}",
+            test_plan=comp.global_.plan,
+            unpacked_plan_dir=sources_dir,
+            selectors=list(g.build.selectors),
+            dependencies={
+                d.module: (d.target, d.version) for d in g.build.dependencies
+            },
+            build_config=cfg.flatten(),
+            env=engine.env,
+        )
+        out = builder.build(inp, ow, cancel)
+        return key, out.artifact_path
+
+    if by_key:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=limit) as pool:
+            futs = [
+                pool.submit(build_one, key, idxs[0]) for key, idxs in by_key.items()
+            ]
+            for fut in concurrent.futures.as_completed(futs):
+                key, artifact = fut.result()
+                results[key] = artifact
+
+    for g in comp.groups:
+        if not g.run.artifact:
+            g.run.artifact = results[g.build_key()]
+            ow.infof("group %s built: artifact %s", g.id, g.run.artifact)
+    return comp
+
+
+def do_build_task(
+    engine: Engine, tsk: Task, ow: OutputWriter, cancel: threading.Event
+) -> dict:
+    comp = Composition.from_dict(tsk.composition)
+    manifest = TestPlanManifest.from_dict(tsk.input["manifest"])
+    built = do_build(
+        engine, comp, manifest, tsk.input.get("sources_dir", ""), tsk.id, ow, cancel
+    )
+    return {
+        "outcome": Outcome.SUCCESS.value,
+        "artifacts": {g.id: g.run.artifact for g in built.groups},
+        "composition": built.to_dict(),
+    }
+
+
+# ------------------------------------------------------------------- runs
+
+
+def do_run(
+    engine: Engine, tsk: Task, ow: OutputWriter, cancel: threading.Event
+) -> dict:
+    """(``supervisor.go:494-656``)."""
+    comp = Composition.from_dict(tsk.composition)
+    manifest = TestPlanManifest.from_dict(tsk.input["manifest"])
+    sources_dir = tsk.input.get("sources_dir", "")
+
+    # refuse disabled runners (supervisor.go:568-571)
+    runner_id = comp.global_.runner
+    if engine.env.runner_is_disabled(runner_id):
+        raise ValueError(f"runner {runner_id} is disabled in .env.toml")
+    runner = engine.runner_by_name(runner_id)
+    if runner is None:
+        raise ValueError(f"unknown runner: {runner_id}")
+
+    # build any groups missing artifacts (supervisor.go:495-518)
+    needs_build = any(not g.run.artifact for g in comp.groups)
+    if needs_build:
+        comp = do_build(engine, comp, manifest, sources_dir, tsk.id, ow, cancel)
+        tsk.composition = comp.to_dict()
+        engine.storage.update_current(tsk)
+
+    comp = prepare_for_run(comp, manifest)
+    validate_for_run(comp)
+
+    # healthcheck with fix (supervisor.go:541-553)
+    from testground_tpu.runners.base import HealthcheckedRunner
+
+    if isinstance(runner, HealthcheckedRunner):
+        report = runner.healthcheck(fix=True, ow=ow)
+        if report is not None and not report.ok():
+            raise RuntimeError(f"runner {runner_id} failed healthcheck: {report}")
+
+    # coalesce runner config: composition > .env.toml > manifest-applied
+    # defaults already in run_config (supervisor.go:563-581)
+    runner_cfg = (
+        CoalescedConfig()
+        .append(engine.env.runners.get(runner_id))
+        .append(comp.global_.run_config)
+        .flatten()
+    )
+
+    # Execute each run in the composition sequentially; the task result
+    # aggregates per-run results (multi-run [[runs]] support).
+    run_results: dict[str, dict] = {}
+    outcome = Outcome.SUCCESS
+    artifacts_by_group = {g.id: g.run.artifact for g in comp.groups}
+
+    for run in comp.runs:
+        if cancel.is_set():
+            raise RuntimeError("task canceled")
+        run_id = tsk.id if len(comp.runs) == 1 else f"{tsk.id}-{run.id}"
+        groups = []
+        for rg in run.groups:
+            backing = comp.get_group(rg.effective_group_id())
+            groups.append(
+                RunGroup(
+                    id=rg.id,
+                    instances=rg.calculated_instance_count,
+                    artifact_path=artifacts_by_group[backing.id],
+                    parameters=dict(rg.test_params),
+                    profiles=dict(rg.profiles),
+                    resources=rg.resources,
+                )
+            )
+        rinput = RunInput(
+            run_id=run_id,
+            test_plan=comp.global_.plan,
+            test_case=comp.global_.case,
+            total_instances=run.total_instances,
+            groups=groups,
+            runner_config=runner_cfg,
+            disable_metrics=comp.global_.disable_metrics,
+            env=engine.env,
+        )
+        ow.infof(
+            "executing run %s: plan=%s case=%s instances=%d runner=%s",
+            run_id,
+            comp.global_.plan,
+            comp.global_.case,
+            run.total_instances,
+            runner_id,
+        )
+        out = runner.run(rinput, ow, cancel)
+        result = out.result if out is not None else None
+        result_dict = (
+            result.to_dict() if hasattr(result, "to_dict") else (result or {})
+        )
+        run_results[run.id] = result_dict
+        if result_dict.get("outcome") != Outcome.SUCCESS.value:
+            outcome = Outcome.FAILURE
+
+    base = (
+        run_results[comp.runs[0].id]
+        if len(comp.runs) == 1
+        else {"runs": run_results}
+    )
+    return {**base, "outcome": outcome.value, "composition": comp.to_dict()}

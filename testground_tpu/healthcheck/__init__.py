@@ -1,0 +1,12 @@
+"""Declarative healthcheck framework: check/fix pairs.
+
+Twin of the reference's ``pkg/healthcheck``: a Helper enlists named
+(checker, fixer) pairs; ``run_checks(fix=...)`` evaluates them and produces a
+report (``helper.go:55-65``, report types ``pkg/api/healthcheck.go:17-56``).
+"""
+
+from .helper import Helper
+from .report import CheckResult, Report
+from . import checkers, fixers
+
+__all__ = ["CheckResult", "Helper", "Report", "checkers", "fixers"]

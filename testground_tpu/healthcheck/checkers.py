@@ -1,0 +1,118 @@
+"""Reusable checkers (``pkg/healthcheck/checkers.go:20-190``).
+
+Checkers return ``(ok, message)``. Combinators ``all_of``/``any_of``/
+``not_`` mirror the reference's All/Any/Not.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+from typing import Callable
+
+Checker = Callable[[], tuple[bool, str]]
+
+__all__ = [
+    "all_of",
+    "any_of",
+    "check_command_status",
+    "check_dialable",
+    "check_dir_exists",
+    "check_file_exists",
+    "check_executable_on_path",
+    "not_",
+]
+
+
+def check_dir_exists(path: str) -> Checker:
+    """(``checkers.go`` DirExistsChecker)."""
+
+    def check() -> tuple[bool, str]:
+        if os.path.isdir(path):
+            return True, f"directory exists: {path}"
+        return False, f"directory missing: {path}"
+
+    return check
+
+
+def check_file_exists(path: str) -> Checker:
+    def check() -> tuple[bool, str]:
+        if os.path.isfile(path):
+            return True, f"file exists: {path}"
+        return False, f"file missing: {path}"
+
+    return check
+
+
+def check_dialable(host: str, port: int, timeout: float = 2.0) -> Checker:
+    """(``checkers.go`` DialableChecker)."""
+
+    def check() -> tuple[bool, str]:
+        try:
+            with socket.create_connection((host, port), timeout=timeout):
+                return True, f"{host}:{port} is dialable"
+        except OSError as e:
+            return False, f"{host}:{port} not dialable: {e}"
+
+    return check
+
+
+def check_command_status(*argv: str) -> Checker:
+    """(``checkers.go`` CommandStartedChecker/exit-status)."""
+
+    def check() -> tuple[bool, str]:
+        try:
+            rc = subprocess.run(
+                argv, capture_output=True, timeout=30
+            ).returncode
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return False, f"command failed: {e}"
+        return rc == 0, f"exit status {rc}"
+
+    return check
+
+
+def check_executable_on_path(name: str) -> Checker:
+    def check() -> tuple[bool, str]:
+        path = shutil.which(name)
+        if path:
+            return True, f"{name} found at {path}"
+        return False, f"{name} not on PATH"
+
+    return check
+
+
+def all_of(*checkers: Checker) -> Checker:
+    def check() -> tuple[bool, str]:
+        msgs = []
+        for c in checkers:
+            ok, msg = c()
+            msgs.append(msg)
+            if not ok:
+                return False, "; ".join(msgs)
+        return True, "; ".join(msgs)
+
+    return check
+
+
+def any_of(*checkers: Checker) -> Checker:
+    def check() -> tuple[bool, str]:
+        msgs = []
+        for c in checkers:
+            ok, msg = c()
+            msgs.append(msg)
+            if ok:
+                return True, msg
+        return False, "; ".join(msgs)
+
+    return check
+
+
+def not_(checker: Checker) -> Checker:
+    def check() -> tuple[bool, str]:
+        ok, msg = checker()
+        return not ok, msg
+
+    return check

@@ -1,0 +1,83 @@
+"""Reusable fixers (``pkg/healthcheck/fixers.go:19-114``).
+
+Fixers return a message on success and raise on failure. Combinators
+``and_then``/``or_else`` mirror And/Or; ``not_implemented`` and
+``requires_manual_fixing`` mirror the sentinel fixers.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Callable
+
+Fixer = Callable[[], str]
+
+__all__ = [
+    "and_then",
+    "create_directory",
+    "not_implemented",
+    "or_else",
+    "requires_manual_fixing",
+    "start_command",
+]
+
+
+def create_directory(path: str) -> Fixer:
+    def fix() -> str:
+        os.makedirs(path, exist_ok=True)
+        return f"created directory {path}"
+
+    return fix
+
+
+def start_command(*argv: str, cwd: str | None = None) -> Fixer:
+    """Start a background process (``fixers.go`` StartCommand)."""
+
+    def fix() -> str:
+        subprocess.Popen(
+            argv,
+            cwd=cwd,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        return f"started: {' '.join(argv)}"
+
+    return fix
+
+
+def not_implemented() -> Fixer:
+    def fix() -> str:
+        raise NotImplementedError("no automatic fix for this check")
+
+    return fix
+
+
+def requires_manual_fixing(hint: str = "") -> Fixer:
+    def fix() -> str:
+        raise RuntimeError(f"requires manual fixing: {hint}" if hint else
+                           "requires manual fixing")
+
+    return fix
+
+
+def and_then(*fixers: Fixer) -> Fixer:
+    def fix() -> str:
+        msgs = [f() for f in fixers]
+        return "; ".join(msgs)
+
+    return fix
+
+
+def or_else(*fixers: Fixer) -> Fixer:
+    def fix() -> str:
+        last: Exception | None = None
+        for f in fixers:
+            try:
+                return f()
+            except Exception as e:  # noqa: BLE001
+                last = e
+        raise last if last else RuntimeError("no fixers provided")
+
+    return fix

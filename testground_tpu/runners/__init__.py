@@ -1,0 +1,18 @@
+"""Runner plugin registry. Twin of the reference's ``pkg/runner``.
+
+Runners registered here (mirroring ``pkg/engine/engine.go:33-38``):
+- ``local:exec`` — one OS process per instance on this host.
+- ``sim:jax``   — vectorized discrete-event simulation on TPU/CPU devices.
+"""
+
+from .base import HealthcheckedRunner, Runner, RunnerOutcomeError, Terminatable
+from .result import GroupOutcome, Result
+
+__all__ = [
+    "GroupOutcome",
+    "HealthcheckedRunner",
+    "Result",
+    "Runner",
+    "RunnerOutcomeError",
+    "Terminatable",
+]

@@ -1,0 +1,232 @@
+"""``local:exec`` runner: one OS process per instance on this host.
+
+Twin of the reference's ``pkg/runner/local_exec.go``: spawns one process per
+instance with the RunParams env-var contract, no network dataplane
+(``TestSidecar=false``, ``local_exec.go:89``), subnet ``127.1.0.0/16``
+(``local_exec.go:32``), stdout parsed by the PrettyPrinter, outcomes
+collected from sync-service events. The sync-service "infra container"
+(``local_common.go:77-104``) is an in-process TCP server started per run.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+
+from testground_tpu.api import RunInput, RunOutput
+from testground_tpu.engine.task import Outcome
+from testground_tpu.rpc import OutputWriter
+from testground_tpu.sdk.runparams import RunParams
+from testground_tpu.sync import RUN_EVENTS_TOPIC, SyncServiceServer
+
+from .base import HealthcheckedRunner, Runner
+from .outputs import instance_output_dir
+from .pretty import PrettyPrinter
+from .result import Result
+
+__all__ = ["LocalExecRunner"]
+
+DEFAULT_SUBNET = "127.1.0.0/16"  # local_exec.go:32
+OUTCOME_COLLECTION_TIMEOUT = 45.0  # local_docker.go:94
+START_CONCURRENCY = 16  # local_docker.go:512
+
+
+@dataclass
+class LocalExecConfig:
+    """Runner config (coalesced from manifest/.env.toml/composition)."""
+
+    keep_outputs: bool = True
+    run_timeout_secs: int = 0  # 0 ⇒ rely on task timeout
+
+
+class LocalExecRunner(Runner, HealthcheckedRunner):
+    def id(self) -> str:
+        return "local:exec"
+
+    def compatible_builders(self) -> list[str]:
+        return ["exec:py"]  # local_exec.go:197 (exec:go in the reference)
+
+    def config_type(self) -> type:
+        return LocalExecConfig
+
+    def healthcheck(self, fix: bool, ow: OutputWriter):
+        """The only infra is in-process (sync service per run); always
+        healthy. Mirrors the check/fix report shape."""
+        from testground_tpu.healthcheck.report import Report
+
+        return Report.all_ok(["local-outputs-dir", "sync-service(in-process)"])
+
+    # ------------------------------------------------------------------ run
+
+    def run(
+        self, job: RunInput, ow: OutputWriter, cancel: threading.Event
+    ) -> RunOutput:
+        cfg = job.runner_config or {}
+        run_timeout = float(cfg.get("run_timeout_secs", 0) or 0)
+
+        result = Result.for_input(job)
+        pretty = PrettyPrinter(ow)
+
+        sync_server = SyncServiceServer().start()
+        host, port = sync_server.address
+
+        # runner-side outcome collection: subscribe to the run's lifecycle
+        # events before instances start (local_docker.go:217-256)
+        outcomes: dict[tuple[str, int], str] = {}
+        outcomes_lock = threading.Lock()
+        expected = sum(g.instances for g in job.groups)
+        all_outcomes_in = threading.Event()
+        collector_stop = threading.Event()
+
+        def collect() -> None:
+            topic = f"run:{job.run_id}:{RUN_EVENTS_TOPIC}"
+            try:
+                for evt in sync_server.service.subscribe(
+                    topic, cancel=collector_stop
+                ):
+                    with outcomes_lock:
+                        key = (evt.get("group", ""), int(evt.get("instance", -1)))
+                        outcomes[key] = evt.get("type", "")
+                        if len(outcomes) >= expected:
+                            all_outcomes_in.set()
+            except TimeoutError:
+                pass
+
+        collector = threading.Thread(target=collect, daemon=True)
+        collector.start()
+
+        procs: list[tuple[str, int, subprocess.Popen]] = []
+        start_sem = threading.Semaphore(START_CONCURRENCY)
+        start_time = time.time()
+
+        try:
+            global_seq = 0
+            for g in job.groups:
+                for i in range(g.instances):
+                    iid = f"{g.id}[{i:03d}]"
+                    out_dir = instance_output_dir(
+                        job.env.dirs.outputs(),
+                        job.test_plan,
+                        job.run_id,
+                        g.id,
+                        i,
+                    )
+                    os.makedirs(out_dir, exist_ok=True)
+                    tmp_dir = os.path.join(
+                        job.env.dirs.work(), job.run_id, g.id, str(i)
+                    )
+                    os.makedirs(tmp_dir, exist_ok=True)
+
+                    params = RunParams(
+                        test_plan=job.test_plan,
+                        test_case=job.test_case,
+                        test_run=job.run_id,
+                        test_instance_count=job.total_instances,
+                        test_group_id=g.id,
+                        test_group_instance_count=g.instances,
+                        test_instance_params=dict(g.parameters),
+                        test_subnet=DEFAULT_SUBNET,
+                        test_sidecar=False,
+                        test_outputs_path=out_dir,
+                        test_temp_path=tmp_dir,
+                        test_start_time=start_time,
+                        test_capture_profiles=dict(g.profiles),
+                        test_disable_metrics=job.disable_metrics,
+                        test_instance_seq=global_seq,
+                        test_group_seq=i,
+                        sync_service_host=host,
+                        sync_service_port=port,
+                    )
+                    env = {**os.environ, **params.to_env()}
+                    # Instances are plain CPU processes; drop accelerator
+                    # hooks (a sitecustomize kegged on PALLAS_AXON_POOL_IPS
+                    # imports jax+PJRT into every child, ~4s and ~120MB per
+                    # instance — fatal for instance-count scaling).
+                    for accel_var in (
+                        "PALLAS_AXON_POOL_IPS",
+                        "JAX_PLATFORMS",
+                        "XLA_FLAGS",
+                    ):
+                        env.pop(accel_var, None)
+                    # plans import the SDK from this checkout
+                    pkg_root = os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))
+                    )
+                    env["PYTHONPATH"] = (
+                        os.path.dirname(pkg_root)
+                        + os.pathsep
+                        + env.get("PYTHONPATH", "")
+                    )
+                    with start_sem:
+                        if cancel.is_set():
+                            raise RuntimeError("run canceled during start")
+                        try:
+                            proc = subprocess.Popen(
+                                [sys.executable, g.artifact_path],
+                                env=env,
+                                cwd=os.path.dirname(g.artifact_path),
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE,
+                                text=True,
+                                bufsize=1,
+                            )
+                        except OSError as e:
+                            pretty.fail_start(iid, str(e))
+                            global_seq += 1
+                            continue
+                    pretty.manage(iid, proc.stdout, proc.stderr)
+                    procs.append((g.id, i, proc))
+                    global_seq += 1
+
+            ow.infof(
+                "started %d instances for run %s", len(procs), job.run_id
+            )
+
+            # wait for all processes (ContainerWait analog,
+            # local_docker.go:618-641)
+            deadline = (
+                time.time() + run_timeout if run_timeout else None
+            )
+            for _, _, proc in procs:
+                while True:
+                    if cancel.is_set():
+                        raise RuntimeError("run canceled")
+                    if deadline is not None and time.time() > deadline:
+                        raise RuntimeError("run timed out")
+                    try:
+                        proc.wait(timeout=0.2)
+                        break
+                    except subprocess.TimeoutExpired:
+                        continue
+
+            # bounded post-exit outcome collection (local_docker.go:657-682)
+            all_outcomes_in.wait(timeout=OUTCOME_COLLECTION_TIMEOUT)
+            pretty.wait(timeout=10.0)
+
+        finally:
+            for _, _, proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            collector_stop.set()
+            sync_server.stop()
+
+        with outcomes_lock:
+            for (group, _), outcome in outcomes.items():
+                if group in result.outcomes and outcome == "success":
+                    result.add_outcome(group, Outcome.SUCCESS)
+        result.update_outcome()
+        ow.infof(
+            "run %s finished: %s (%s)",
+            job.run_id,
+            result.outcome.value,
+            {k: f"{v.ok}/{v.total}" for k, v in result.outcomes.items()},
+        )
+        return RunOutput(run_id=job.run_id, result=result)
+
+    def terminate_all(self, ow: OutputWriter) -> None:
+        """Processes die with the task's cancel event; nothing persists."""
+        ow.infof("local:exec: no persistent resources to terminate")

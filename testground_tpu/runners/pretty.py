@@ -1,0 +1,133 @@
+"""PrettyPrinter: renders instance event streams to the console and counts
+failures.
+
+Twin of the reference's ``pkg/runner/pretty.go:113-180``: structured stdout
+lines become classified console events (START/OK/FAIL/CRASH/MESSAGE/METRIC/
+OTHER); stderr lines print as ERROR; instances that end without a terminal
+event are marked INCOMPLETE and count as failed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import IO
+
+from testground_tpu.rpc import OutputWriter
+from testground_tpu.sdk.events import parse_event_line
+
+__all__ = ["PrettyPrinter"]
+
+_CLASS = {
+    "error": "ERROR",
+    "start": "START",
+    "success": "OK",
+    "failure": "FAIL",
+    "crash": "CRASH",
+    "incomplete": "INCOMPLETE",
+    "message": "MESSAGE",
+    "metric": "METRIC",
+    "other": "OTHER",
+    "internal_err": "INTERNAL_ERR",
+}
+
+
+class PrettyPrinter:
+    def __init__(self, ow: OutputWriter):
+        self._ow = ow
+        self._start = time.time()
+        self._lock = threading.Lock()
+        self._failed = 0
+        self._count = 0
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------- internal
+
+    def _print(self, idx: int, iid: str, cls: str, msg: str = "") -> None:
+        elapsed = max(0.0, time.time() - self._start)
+        self._ow.infof(
+            "%9.4fs %12s << %s >> %s", elapsed, _CLASS.get(cls, "OTHER"), iid, msg
+        )
+
+    def _process_stdout(self, idx: int, iid: str, stream: IO[str]) -> None:
+        ok = failed = False
+        try:
+            for line in stream:
+                parsed = parse_event_line(line)
+                if parsed is None:
+                    if line.strip():
+                        self._print(idx, iid, "other", line.rstrip())
+                    continue
+                _, evt = parsed
+                typ = evt.get("type")
+                if typ == "success":
+                    ok = True
+                    self._print(idx, iid, "success")
+                elif typ == "failure":
+                    failed = True
+                    self._print(idx, iid, "failure", evt.get("error", ""))
+                elif typ == "crash":
+                    failed = True
+                    self._print(
+                        idx,
+                        iid,
+                        "crash",
+                        f"{evt.get('error', '')} {evt.get('stacktrace', '')}",
+                    )
+                elif typ == "message":
+                    self._print(idx, iid, "message", evt.get("message", ""))
+                elif typ == "start":
+                    self._print(idx, iid, "start", str(evt.get("runenv", "")))
+                elif typ == "metric":
+                    self._print(idx, iid, "metric", str(evt.get("metric", "")))
+                elif typ in ("stage_start", "stage_end"):
+                    pass
+                else:
+                    self._print(idx, iid, "internal_err", f"unknown event: {evt}")
+        finally:
+            if not ok and not failed:
+                self._print(idx, iid, "incomplete")
+            with self._lock:
+                if not ok or failed:
+                    self._failed += 1
+
+    def _process_stderr(self, idx: int, iid: str, stream: IO[str]) -> None:
+        for line in stream:
+            if line.strip():
+                self._print(idx, iid, "error", line.rstrip())
+
+    # ------------------------------------------------------------------ API
+
+    def fail_start(self, iid: str, message: str) -> None:
+        """Report an instance that failed to start (``pretty.go:92-97``)."""
+        with self._lock:
+            self._count += 1
+            idx = self._count - 1
+            self._failed += 1
+        self._print(idx, iid, "incomplete", f"failed to start: {message}")
+
+    def manage(self, iid: str, stdout: IO[str], stderr: IO[str]) -> None:
+        """Consume an instance's streams in the background."""
+        with self._lock:
+            self._count += 1
+            idx = self._count - 1
+        for target, stream in (
+            (self._process_stdout, stdout),
+            (self._process_stderr, stderr),
+        ):
+            t = threading.Thread(
+                target=target, args=(idx, iid, stream), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def wait(self, timeout: float | None = None) -> int:
+        """Wait for all streams to end; returns the failed count
+        (``pretty.go:75-88``)."""
+        deadline = None if timeout is None else time.time() + timeout
+        for t in self._threads:
+            t.join(
+                timeout=None if deadline is None else max(0.0, deadline - time.time())
+            )
+        with self._lock:
+            return self._failed

@@ -1,0 +1,41 @@
+"""Test-plan SDK: the runtime test plans program against.
+
+Twin of the reference's external sdk-go (``run.InvokeMap``,
+``runtime.RunEnv``/``RunParams`` env-var contract, ``network.Client``,
+``sync.Client`` — SURVEY.md §1 L1). A plan is a Python module calling
+:func:`invoke_map` with its testcases; instances receive their parameters via
+``TEST_*`` environment variables and report lifecycle events as JSON lines
+on stdout plus sync-service events.
+"""
+
+from .events import EventEmitter
+from .invoke import invoke_map
+from .network import (
+    FILTER_ACCEPT,
+    FILTER_DROP,
+    FILTER_REJECT,
+    ALLOW_ALL,
+    DENY_ALL,
+    LinkRule,
+    LinkShape,
+    NetworkClient,
+    NetworkConfig,
+)
+from .runenv import RunEnv
+from .runparams import RunParams
+
+__all__ = [
+    "ALLOW_ALL",
+    "DENY_ALL",
+    "EventEmitter",
+    "FILTER_ACCEPT",
+    "FILTER_DROP",
+    "FILTER_REJECT",
+    "LinkRule",
+    "LinkShape",
+    "NetworkClient",
+    "NetworkConfig",
+    "RunEnv",
+    "RunParams",
+    "invoke_map",
+]

@@ -1,0 +1,75 @@
+"""Structured lifecycle events emitted by instances on stdout.
+
+The reference emits zap-JSON lines parsed by the runner's PrettyPrinter
+(``pkg/runner/pretty.go:113-180``: success/failure/crash/message/start/metric
+events under an ``event`` key with a nanosecond ``ts``). This framework uses
+the same envelope with an explicit ``type`` discriminator:
+
+    {"ts": <ns>, "event": {"type": "success"}}
+    {"ts": <ns>, "event": {"type": "failure", "error": "..."}}
+    {"ts": <ns>, "event": {"type": "crash", "error": "...", "stacktrace": "..."}}
+    {"ts": <ns>, "event": {"type": "message", "message": "..."}}
+    {"ts": <ns>, "event": {"type": "start", "runenv": {...}}}
+    {"ts": <ns>, "event": {"type": "metric", "metric": {...}}}
+    {"ts": <ns>, "event": {"type": "stage_start"|"stage_end", "stage": "..."}}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, TextIO
+
+__all__ = ["EventEmitter", "parse_event_line"]
+
+
+class EventEmitter:
+    """Writes event lines to a stream (instance stdout and/or run.out)."""
+
+    def __init__(self, *sinks: TextIO | None):
+        self._sinks = [s for s in sinks if s is not None]
+
+    def emit(self, event: dict[str, Any]) -> None:
+        line = json.dumps({"ts": time.time_ns(), "event": event})
+        for s in self._sinks:
+            s.write(line + "\n")
+            s.flush()
+
+    def success(self) -> None:
+        self.emit({"type": "success"})
+
+    def failure(self, error: str) -> None:
+        self.emit({"type": "failure", "error": error})
+
+    def crash(self, error: str, stacktrace: str = "") -> None:
+        self.emit({"type": "crash", "error": error, "stacktrace": stacktrace})
+
+    def message(self, msg: str) -> None:
+        self.emit({"type": "message", "message": msg})
+
+    def start(self, runenv: dict) -> None:
+        self.emit({"type": "start", "runenv": runenv})
+
+    def metric(self, metric: dict) -> None:
+        self.emit({"type": "metric", "metric": metric})
+
+    def stage_start(self, name: str) -> None:
+        self.emit({"type": "stage_start", "stage": name})
+
+    def stage_end(self, name: str) -> None:
+        self.emit({"type": "stage_end", "stage": name})
+
+
+def parse_event_line(line: str) -> tuple[float, dict] | None:
+    """Parse one stdout line into (unix_seconds, event) or None if the line
+    is not a structured event (the PrettyPrinter prints those as Other)."""
+    try:
+        d = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        return None
+    if not isinstance(d, dict) or "event" not in d:
+        return None
+    evt = d["event"]
+    if not isinstance(evt, dict) or "type" not in evt:
+        return None
+    return float(d.get("ts", 0)) / 1e9, evt

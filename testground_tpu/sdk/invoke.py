@@ -1,0 +1,91 @@
+"""Test-case invocation: the plan's ``main()``.
+
+Twin of sdk-go's ``run.InvokeMap`` (``plans/example/main.go:7-9``): look up
+the testcase named by ``TEST_CASE``, build the RunEnv, bind the sync client,
+run the function, and record the terminal event (success / failure on error
+return / crash on exception).
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+from typing import Callable
+
+from .network import NetworkClient
+from .runenv import RunEnv
+
+__all__ = ["invoke_map", "InitContext"]
+
+
+class InitContext:
+    """(sdk-go run.InitContext: holds SyncClient + NetClient)."""
+
+    def __init__(self, sync_client, net_client):
+        self.sync_client = sync_client
+        self.net_client = net_client
+
+
+def _connect_sync(env: RunEnv):
+    from testground_tpu.sync.client import SyncClient
+
+    if env.params.sync_service_port == 0:
+        return None
+    return SyncClient(
+        env.params.sync_service_host,
+        env.params.sync_service_port,
+        namespace=f"run:{env.params.test_run}:",
+    )
+
+
+def invoke_map(testcases: dict[str, Callable]) -> None:
+    """Run the testcase selected by the environment and exit.
+
+    Testcase signatures supported (mirroring run.TestCaseFn and
+    run.InitializedTestCaseFn):
+        fn(runenv) -> None | error-string
+        fn(runenv, init_ctx) -> None | error-string
+    Raising marks the instance crashed; returning a truthy value or calling
+    ``record_failure`` marks it failed; otherwise success.
+    """
+    env = RunEnv()
+    case = env.test_case
+    fn = testcases.get(case)
+    if fn is None:
+        print(f"unknown test case: {case}", file=sys.stderr)
+        sys.exit(2)
+
+    sync_client = _connect_sync(env)
+    if sync_client is not None:
+        env.attach_sync_client(sync_client)
+    net_client = NetworkClient(sync_client, env)
+    init_ctx = InitContext(sync_client, net_client)
+
+    env.record_start()
+    try:
+        # initialized testcases (2-arg) wait for the network first, like
+        # run.InitializedTestCaseFn does via MustWaitNetworkInitialized.
+        import inspect
+
+        nparams = len(inspect.signature(fn).parameters)
+        if nparams >= 2:
+            net_client.wait_network_initialized()
+            err = fn(env, init_ctx)
+        else:
+            err = fn(env)
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — crash semantics
+        env.record_crash(e)
+        print(traceback.format_exc(), file=sys.stderr)
+        env.close()
+        sys.exit(1)
+
+    if err:
+        env.record_failure(str(err))
+        env.close()
+        sys.exit(1)
+
+    env.record_success()
+    env.close()
+    sys.exit(0)

@@ -1,0 +1,164 @@
+"""Metrics API: ``runenv.R()`` (results) and ``runenv.D()`` (diagnostics).
+
+Twin of sdk-go's runtime metrics (usage: ``plans/example/metrics.go:15-19``,
+``plans/benchmarks/benchmarks.go:23,47``): counters, gauges, histograms,
+timers, points. Values batch to ``metrics.out`` as JSON lines in the
+instance's outputs dir (the reference's file sink; the InfluxDB batcher's
+analog is the run-level aggregation in ``testground_tpu.metrics``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import TextIO
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram", "Timer", "Point"]
+
+
+class _Metric:
+    def __init__(self, registry: "MetricsRegistry", name: str):
+        self._reg = registry
+        self.name = name
+
+
+class Counter(_Metric):
+    def __init__(self, registry, name):
+        super().__init__(registry, name)
+        self.count = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.count += n
+        self._reg.record(self.name, "counter", {"count": self.count})
+
+
+class Gauge(_Metric):
+    def __init__(self, registry, name):
+        super().__init__(registry, name)
+        self.value = 0.0
+
+    def update(self, v: float) -> None:
+        self.value = v
+        self._reg.record(self.name, "gauge", {"value": v})
+
+
+class Histogram(_Metric):
+    """Streaming histogram keeping count/sum/min/max/mean/variance."""
+
+    def __init__(self, registry, name):
+        super().__init__(registry, name)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._m2 = 0.0
+        self._mean = 0.0
+
+    def update(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        delta = v - self._mean
+        self._mean += delta / self.count
+        self._m2 += delta * (v - self._mean)
+        self._reg.record(self.name, "histogram", self.snapshot())
+
+    def snapshot(self) -> dict:
+        var = self._m2 / self.count if self.count > 1 else 0.0
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else 0.0,
+            "max": self.max if self.count else 0.0,
+            "mean": self._mean,
+            "stddev": math.sqrt(var),
+        }
+
+
+class Timer(_Metric):
+    """Duration histogram in seconds."""
+
+    def __init__(self, registry, name):
+        super().__init__(registry, name)
+        self._h = Histogram.__new__(Histogram)
+        Histogram.__init__(self._h, registry, name)
+
+    def update(self, seconds: float) -> None:
+        self._reg.record(self.name, "timer", {"secs": seconds})
+
+    def update_since(self, start: float) -> None:
+        self.update(time.time() - start)
+
+    def time(self):
+        """Context manager measuring a block."""
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.start = time.time()
+                return self
+
+            def __exit__(self, *exc):
+                timer.update_since(self.start)
+                return False
+
+        return _Ctx()
+
+
+class Point(_Metric):
+    def record(self, value: float) -> None:
+        self._reg.record(self.name, "point", {"value": value})
+
+
+class MetricsRegistry:
+    """One registry per kind ('results' for R(), 'diagnostics' for D())."""
+
+    def __init__(self, kind: str, sink: TextIO | None, disabled: bool = False):
+        self.kind = kind
+        self._sink = sink
+        self._disabled = disabled
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def record(self, name: str, typ: str, data: dict) -> None:
+        if self._disabled or self._sink is None:
+            return
+        line = json.dumps(
+            {"ts": time.time_ns(), "kind": self.kind, "type": typ, "name": name, **data}
+        )
+        with self._lock:
+            self._sink.write(line + "\n")
+            self._sink.flush()
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or not isinstance(m, cls):
+                m = cls(self, name)
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def resetting_histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def record_point(self, name: str, value: float) -> None:
+        self.record(name, "point", {"value": value})
+
+    # sample constructors kept for sdk-go surface parity
+    def new_uniform_sample(self, size: int = 1028):
+        return size

@@ -1,0 +1,182 @@
+"""Network client: link shaping and routing policy requests.
+
+Twin of sdk-go's ``network.Client`` + ``network.Config``/``LinkShape`` as
+consumed by ``plans/network/pingpong.go:29-42`` and the sidecar handler
+(``pkg/sidecar/sidecar_handler.go:49-82``):
+
+- ``wait_network_initialized``: barrier on the ``network-initialized`` state
+  signalled by the dataplane for every instance.
+- ``configure_network(cfg)``: publish the config to the per-instance topic
+  ``network:<hostname>`` and wait on ``cfg.callback_state`` until the
+  dataplane applies it.
+
+Under ``local:exec`` there is no sidecar (``TestSidecar=false``,
+``local_exec.go:89``) and shaping requests fail, matching the reference.
+Under ``sim:jax`` the "dataplane" is the simulator itself: configs lower to
+per-instance link-state tensor updates (``testground_tpu.sim.links``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = [
+    "ALLOW_ALL",
+    "DENY_ALL",
+    "FILTER_ACCEPT",
+    "FILTER_DROP",
+    "FILTER_REJECT",
+    "LinkRule",
+    "LinkShape",
+    "NetworkClient",
+    "NetworkConfig",
+]
+
+# Filter actions (reference network.FilterAction: accept/reject/drop)
+FILTER_ACCEPT = 0
+FILTER_REJECT = 1
+FILTER_DROP = 2
+
+# Routing policies (reference network.RoutingPolicyType)
+ALLOW_ALL = "allow_all"
+DENY_ALL = "deny_all"
+
+NETWORK_INITIALIZED_STATE = "network-initialized"
+
+
+@dataclass
+class LinkShape:
+    """(sdk-go network.LinkShape; applied by ``pkg/sidecar/link.go:155-183``).
+
+    latency/jitter in seconds, bandwidth in bits per second, loss/corrupt/
+    reorder/duplicate as percentages [0,100] with optional correlations.
+    """
+
+    latency: float = 0.0
+    jitter: float = 0.0
+    bandwidth: float = 0.0
+    filter: int = FILTER_ACCEPT
+    loss: float = 0.0
+    corrupt: float = 0.0
+    corrupt_corr: float = 0.0
+    reorder: float = 0.0
+    reorder_corr: float = 0.0
+    duplicate: float = 0.0
+    duplicate_corr: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "latency": self.latency,
+            "jitter": self.jitter,
+            "bandwidth": self.bandwidth,
+            "filter": self.filter,
+            "loss": self.loss,
+            "corrupt": self.corrupt,
+            "corrupt_corr": self.corrupt_corr,
+            "reorder": self.reorder,
+            "reorder_corr": self.reorder_corr,
+            "duplicate": self.duplicate,
+            "duplicate_corr": self.duplicate_corr,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LinkShape":
+        return cls(**{k: d[k] for k in cls().to_dict() if k in d})
+
+
+@dataclass
+class LinkRule:
+    """Per-subnet override (sdk-go network.LinkRule; splitbrain usage
+    ``plans/splitbrain/main.go:117-126``)."""
+
+    subnet: str  # CIDR
+    shape: LinkShape = field(default_factory=LinkShape)
+
+    def to_dict(self) -> dict:
+        return {"subnet": self.subnet, "shape": self.shape.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LinkRule":
+        return cls(subnet=d["subnet"], shape=LinkShape.from_dict(d.get("shape", {})))
+
+
+@dataclass
+class NetworkConfig:
+    """(sdk-go network.Config; handled at ``sidecar_handler.go:49-82``)."""
+
+    network: str = "default"
+    enable: bool = True
+    default: LinkShape = field(default_factory=LinkShape)
+    rules: list[LinkRule] = field(default_factory=list)
+    ipv4: str = ""  # requested CIDR address, e.g. "16.0.0.2/16"
+    routing_policy: str = ALLOW_ALL
+    callback_state: str = ""
+    callback_target: int = 0  # 0 ⇒ all instances
+
+    def to_dict(self) -> dict:
+        return {
+            "network": self.network,
+            "enable": self.enable,
+            "default": self.default.to_dict(),
+            "rules": [r.to_dict() for r in self.rules],
+            "ipv4": self.ipv4,
+            "routing_policy": self.routing_policy,
+            "callback_state": self.callback_state,
+            "callback_target": self.callback_target,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetworkConfig":
+        return cls(
+            network=d.get("network", "default"),
+            enable=d.get("enable", True),
+            default=LinkShape.from_dict(d.get("default", {})),
+            rules=[LinkRule.from_dict(r) for r in d.get("rules", [])],
+            ipv4=d.get("ipv4", ""),
+            routing_policy=d.get("routing_policy", ALLOW_ALL),
+            callback_state=d.get("callback_state", ""),
+            callback_target=int(d.get("callback_target", 0)),
+        )
+
+
+class NetworkClient:
+    def __init__(self, sync_client, runenv):
+        self._sync = sync_client
+        self._env = runenv
+
+    def wait_network_initialized(self, timeout: float | None = 60.0) -> None:
+        """Barrier until the dataplane initialized every instance's network
+        (``sidecar_handler.go:40-44``)."""
+        if not self._env.test_sidecar:
+            # no dataplane; nothing will signal (local:exec semantics)
+            return
+        self._sync.barrier(
+            NETWORK_INITIALIZED_STATE,
+            self._env.test_instance_count,
+            timeout=timeout,
+        )
+
+    def configure_network(
+        self, cfg: NetworkConfig, timeout: float | None = 60.0
+    ) -> None:
+        """Publish the config to this instance's topic and await the callback
+        state (``sidecar_handler.go:49-82``)."""
+        if not self._env.test_sidecar:
+            raise RuntimeError(
+                "this runner does not support network configuration "
+                "(TestSidecar=false)"
+            )
+        if not cfg.callback_state:
+            raise ValueError("network config requires a callback_state")
+        hostname = f"instance-{self._env.params.test_instance_seq}"
+        self._sync.publish(f"network:{hostname}", cfg.to_dict())
+        target = cfg.callback_target or self._env.test_instance_count
+        self._sync.barrier(cfg.callback_state, target, timeout=timeout)
+
+    def get_data_network_ip(self) -> str:
+        """This instance's data-network address. In simulation and local:exec
+        it derives deterministically from the subnet + instance seq."""
+        import ipaddress
+
+        net = ipaddress.ip_network(self._env.test_subnet, strict=False)
+        return str(net.network_address + 2 + self._env.params.test_instance_seq)

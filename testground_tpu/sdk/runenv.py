@@ -1,0 +1,195 @@
+"""RunEnv: the environment handed to a test-case function.
+
+Twin of sdk-go's ``runtime.RunEnv``: typed param access, message/failure/
+crash recording (stdout events + ``run.out``), metrics registries, and the
+bound sync client (attached by :func:`testground_tpu.sdk.invoke.invoke_map`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+from typing import Any
+
+from .events import EventEmitter
+from .metrics_api import MetricsRegistry
+from .runparams import RunParams
+
+__all__ = ["RunEnv"]
+
+
+class RunEnv:
+    def __init__(self, params: RunParams | None = None):
+        self.params = params or RunParams.from_env()
+
+        out_dir = self.params.test_outputs_path
+        self._run_out = None
+        self._run_err = None
+        self._metrics_out = None
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            self._run_out = open(os.path.join(out_dir, "run.out"), "a")
+            self._run_err = open(os.path.join(out_dir, "run.err"), "a")
+            self._metrics_out = open(os.path.join(out_dir, "metrics.out"), "a")
+
+        self.events = EventEmitter(sys.stdout, self._run_out)
+        self._r = MetricsRegistry(
+            "results", self._metrics_out, disabled=False
+        )
+        self._d = MetricsRegistry(
+            "diagnostics",
+            self._metrics_out,
+            disabled=self.params.test_disable_metrics,
+        )
+        self.sync_client = None  # attached by invoke (AttachSyncClient analog)
+
+    # convenience accessors mirroring sdk-go names
+    @property
+    def test_plan(self) -> str:
+        return self.params.test_plan
+
+    @property
+    def test_case(self) -> str:
+        return self.params.test_case
+
+    @property
+    def test_run(self) -> str:
+        return self.params.test_run
+
+    @property
+    def test_instance_count(self) -> int:
+        return self.params.test_instance_count
+
+    @property
+    def test_group_id(self) -> str:
+        return self.params.test_group_id
+
+    @property
+    def test_group_instance_count(self) -> int:
+        return self.params.test_group_instance_count
+
+    @property
+    def test_instance_params(self) -> dict[str, str]:
+        return self.params.test_instance_params
+
+    @property
+    def test_sidecar(self) -> bool:
+        return self.params.test_sidecar
+
+    @property
+    def test_subnet(self) -> str:
+        return self.params.test_subnet
+
+    @property
+    def test_start_time(self) -> float:
+        return self.params.test_start_time
+
+    @property
+    def test_outputs_path(self) -> str:
+        return self.params.test_outputs_path
+
+    @property
+    def test_temp_path(self) -> str:
+        return self.params.test_temp_path
+
+    # ------------------------------------------------------------- params
+
+    def string_param(self, name: str) -> str:
+        v = self.params.test_instance_params.get(name)
+        if v is None:
+            raise KeyError(f"missing param: {name}")
+        return v
+
+    def int_param(self, name: str) -> int:
+        return int(self.string_param(name))
+
+    def float_param(self, name: str) -> float:
+        return float(self.string_param(name))
+
+    def bool_param(self, name: str) -> bool:
+        return self.string_param(name).lower() in ("true", "1", "yes")
+
+    def json_param(self, name: str) -> Any:
+        return json.loads(self.string_param(name))
+
+    def string_array_param(self, name: str) -> list[str]:
+        v = self.json_param(name)
+        if not isinstance(v, list):
+            raise ValueError(f"param {name} is not an array")
+        return [str(x) for x in v]
+
+    # ------------------------------------------------------------- recording
+
+    def record_message(self, msg: str, *args: Any) -> None:
+        self.events.message((msg % args) if args else msg)
+
+    def record_start(self) -> None:
+        self.events.start(
+            {
+                "plan": self.test_plan,
+                "case": self.test_case,
+                "run": self.test_run,
+                "instances": self.test_instance_count,
+                "group": self.test_group_id,
+            }
+        )
+
+    def record_success(self) -> None:
+        self.events.success()
+        self._publish_event("success", "")
+
+    def record_failure(self, err: Exception | str) -> None:
+        self.events.failure(str(err))
+        self._publish_event("failure", str(err))
+
+    def record_crash(self, err: Exception | str) -> None:
+        self.events.crash(str(err), traceback.format_exc())
+        self._publish_event("crash", str(err))
+
+    def _publish_event(self, outcome: str, error: str) -> None:
+        """Mirror the lifecycle event onto the sync service so the runner's
+        outcome collector sees it (``local_docker.go:217-256``
+        SubscribeEvents semantics)."""
+        if self.sync_client is None:
+            return
+        from testground_tpu.sync import RUN_EVENTS_TOPIC
+
+        try:
+            self.sync_client.publish(
+                RUN_EVENTS_TOPIC,
+                {
+                    "type": outcome,
+                    "group": self.test_group_id,
+                    "instance": self.params.test_instance_seq,
+                    "error": error,
+                },
+            )
+        except Exception:  # noqa: BLE001 — events are best-effort
+            pass
+
+    # -------------------------------------------------------------- metrics
+
+    def R(self) -> MetricsRegistry:  # noqa: N802 — sdk-go surface parity
+        return self._r
+
+    def D(self) -> MetricsRegistry:  # noqa: N802
+        return self._d
+
+    # -------------------------------------------------------------- plumbing
+
+    def attach_sync_client(self, client) -> None:
+        """(``plans/placebo/main.go`` AttachSyncClient analog)."""
+        self.sync_client = client
+
+    def to_dict(self) -> dict:
+        return self.params.to_env()
+
+    def close(self) -> None:
+        for f in (self._run_out, self._run_err, self._metrics_out):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass

@@ -1,0 +1,103 @@
+"""The RunParams env-var contract between runners and instances.
+
+Field-for-field twin of the env enumerated at the reference's
+``pkg/runner/local_docker.go:325-336`` (TestPlan, TestCase, TestRun,
+TestInstanceCount, TestGroupID, TestGroupInstanceCount, TestInstanceParams,
+TestSubnet, TestSidecar, TestOutputsPath, TestTempPath, TestStartTime,
+TestCaptureProfiles, TestDisableMetrics), plus the sync-service endpoint
+(injected via ``SYNC_SERVICE_HOST`` in the reference,
+``local_docker.go:153``) and this framework's instance sequence numbers.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["RunParams"]
+
+
+def _encode_params(params: dict[str, str]) -> str:
+    return "|".join(f"{k}={v}" for k, v in params.items())
+
+
+def _decode_params(s: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    if not s:
+        return out
+    for kv in s.split("|"):
+        if kv:
+            k, _, v = kv.partition("=")
+            out[k] = v
+    return out
+
+
+@dataclass
+class RunParams:
+    test_plan: str = ""
+    test_case: str = ""
+    test_run: str = ""
+    test_instance_count: int = 0
+    test_group_id: str = ""
+    test_group_instance_count: int = 0
+    test_instance_params: dict[str, str] = field(default_factory=dict)
+    test_subnet: str = "127.1.0.0/16"
+    test_sidecar: bool = False
+    test_outputs_path: str = ""
+    test_temp_path: str = ""
+    test_start_time: float = 0.0
+    test_capture_profiles: dict[str, str] = field(default_factory=dict)
+    test_disable_metrics: bool = False
+    # framework extensions
+    test_instance_seq: int = 0  # global 0-based index of this instance
+    test_group_seq: int = 0  # 0-based index within the group
+    sync_service_host: str = "127.0.0.1"
+    sync_service_port: int = 0
+
+    def to_env(self) -> dict[str, str]:
+        return {
+            "TEST_PLAN": self.test_plan,
+            "TEST_CASE": self.test_case,
+            "TEST_RUN": self.test_run,
+            "TEST_INSTANCE_COUNT": str(self.test_instance_count),
+            "TEST_GROUP_ID": self.test_group_id,
+            "TEST_GROUP_INSTANCE_COUNT": str(self.test_group_instance_count),
+            "TEST_INSTANCE_PARAMS": _encode_params(self.test_instance_params),
+            "TEST_SUBNET": self.test_subnet,
+            "TEST_SIDECAR": "true" if self.test_sidecar else "false",
+            "TEST_OUTPUTS_PATH": self.test_outputs_path,
+            "TEST_TEMP_PATH": self.test_temp_path,
+            "TEST_START_TIME": str(self.test_start_time),
+            "TEST_CAPTURE_PROFILES": _encode_params(self.test_capture_profiles),
+            "TEST_DISABLE_METRICS": "true" if self.test_disable_metrics else "false",
+            "TEST_INSTANCE_SEQ": str(self.test_instance_seq),
+            "TEST_GROUP_SEQ": str(self.test_group_seq),
+            "SYNC_SERVICE_HOST": self.sync_service_host,
+            "SYNC_SERVICE_PORT": str(self.sync_service_port),
+        }
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "RunParams":
+        e = os.environ if env is None else env
+        return cls(
+            test_plan=e.get("TEST_PLAN", ""),
+            test_case=e.get("TEST_CASE", ""),
+            test_run=e.get("TEST_RUN", ""),
+            test_instance_count=int(e.get("TEST_INSTANCE_COUNT", "0")),
+            test_group_id=e.get("TEST_GROUP_ID", ""),
+            test_group_instance_count=int(e.get("TEST_GROUP_INSTANCE_COUNT", "0")),
+            test_instance_params=_decode_params(e.get("TEST_INSTANCE_PARAMS", "")),
+            test_subnet=e.get("TEST_SUBNET", "127.1.0.0/16"),
+            test_sidecar=e.get("TEST_SIDECAR", "false") == "true",
+            test_outputs_path=e.get("TEST_OUTPUTS_PATH", ""),
+            test_temp_path=e.get("TEST_TEMP_PATH", ""),
+            test_start_time=float(e.get("TEST_START_TIME", "0") or 0),
+            test_capture_profiles=_decode_params(
+                e.get("TEST_CAPTURE_PROFILES", "")
+            ),
+            test_disable_metrics=e.get("TEST_DISABLE_METRICS", "false") == "true",
+            test_instance_seq=int(e.get("TEST_INSTANCE_SEQ", "0")),
+            test_group_seq=int(e.get("TEST_GROUP_SEQ", "0")),
+            sync_service_host=e.get("SYNC_SERVICE_HOST", "127.0.0.1"),
+            sync_service_port=int(e.get("SYNC_SERVICE_PORT", "0")),
+        )

@@ -1,0 +1,12 @@
+"""The ``sim:jax`` execution substrate: a vectorized discrete-event network
+simulation on TPU.
+
+This package replaces the reference's runner/sidecar data plane (real
+containers + tc/netem shaping, SURVEY.md §2.5) with a single compiled
+program: each instance's main loop is a traceable state machine lifted over
+the instance axis with ``jax.vmap``; sync primitives (Signal/Barrier/
+Publish) lower to counter tensors updated with ``psum``/``cumsum``; link
+shaping (latency/jitter/bandwidth/loss + subnet filters) is arithmetic on
+per-instance egress state and bounded rule tables; and the whole tick loop
+runs under ``jit`` sharded over a ``jax.sharding.Mesh``.
+"""

@@ -1,0 +1,15 @@
+"""Host-side driver for a sim run (placeholder; filled in with the sim
+kernel milestone)."""
+
+from __future__ import annotations
+
+import threading
+
+from testground_tpu.api import RunInput, RunOutput
+from testground_tpu.rpc import OutputWriter
+
+
+def execute_sim_run(
+    job: RunInput, ow: OutputWriter, cancel: threading.Event
+) -> RunOutput:
+    raise NotImplementedError("sim:jax executor lands with the sim kernel")

@@ -1,0 +1,43 @@
+"""``sim:jax`` runner: executes a composition as a TPU simulation.
+
+The north-star replacement for the reference's ``local:docker``/
+``cluster:k8s`` runners: instead of one container per instance, one jitted
+program hosts every instance (BASELINE.md targets 100k instances on a v4-8).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from testground_tpu.api import RunInput, RunOutput
+from testground_tpu.rpc import OutputWriter
+
+from testground_tpu.runners.base import HealthcheckedRunner, Runner
+
+__all__ = ["SimJaxRunner"]
+
+
+class SimJaxRunner(Runner, HealthcheckedRunner):
+    def id(self) -> str:
+        return "sim:jax"
+
+    def compatible_builders(self) -> list[str]:
+        return ["sim:plan"]
+
+    def healthcheck(self, fix: bool, ow: OutputWriter):
+        from testground_tpu.healthcheck.report import Report
+
+        try:
+            import jax  # noqa: F401
+        except ImportError:
+            from testground_tpu.healthcheck.report import CheckResult, FAILED
+
+            return Report(checks=[CheckResult("jax-importable", FAILED)])
+        return Report.all_ok(["jax-importable"])
+
+    def run(
+        self, job: RunInput, ow: OutputWriter, cancel: threading.Event
+    ) -> RunOutput:
+        from .executor import execute_sim_run
+
+        return execute_sim_run(job, ow, cancel)

@@ -1,0 +1,131 @@
+"""Blocking sync-service client used by the SDK inside instances.
+
+The analog of sdk-go's ``sync.Client`` (``SignalEntry``, ``SignalAndWait``,
+``Barrier``, ``Publish``, ``Subscribe``, ``PublishSubscribe`` — usage:
+``plans/network/pingpong.go:54,180,225``). Speaks the JSON-lines protocol of
+:mod:`testground_tpu.sync.server`.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+from typing import Any, Iterator
+
+__all__ = ["SyncClient"]
+
+
+class SyncClient:
+    def __init__(self, host: str, port: int, namespace: str = ""):
+        """``namespace`` scopes all states/topics, normally
+        ``run:<run_id>:`` (the reference scopes keys by run)."""
+        self._ns = namespace
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self._sock.settimeout(None)
+        self._wfile = self._sock.makefile("w", encoding="utf-8")
+        self._rfile = self._sock.makefile("r", encoding="utf-8")
+        self._wlock = threading.Lock()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._queues: dict[int, queue.Queue] = {}
+        self._closed = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name="tg-sync-client"
+        )
+        self._reader.start()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._rfile:
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                q = self._queues.get(msg.get("id"))
+                if q is not None:
+                    q.put(msg)
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._closed.set()
+            for q in list(self._queues.values()):
+                q.put({"error": "connection closed"})
+
+    def _call(self, op: str, stream: bool = False, **args: Any) -> queue.Queue:
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        q: queue.Queue = queue.Queue()
+        self._queues[rid] = q
+        req = {"id": rid, "op": op, **args}
+        with self._wlock:
+            self._wfile.write(json.dumps(req) + "\n")
+            self._wfile.flush()
+        return q
+
+    def _call_one(self, op: str, timeout: float | None = None, **args: Any) -> dict:
+        q = self._call(op, **args)
+        try:
+            msg = q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(f"sync op {op} timed out") from None
+        if "error" in msg:
+            raise RuntimeError(f"sync op {op} failed: {msg['error']}")
+        return msg
+
+    def _key(self, name: str) -> str:
+        return self._ns + name
+
+    # ------------------------------------------------------------------ API
+
+    def signal_entry(self, state: str) -> int:
+        return self._call_one("signal_entry", state=self._key(state))["seq"]
+
+    def counter(self, state: str) -> int:
+        return self._call_one("counter", state=self._key(state))["count"]
+
+    def barrier(self, state: str, target: int, timeout: float | None = None) -> None:
+        self._call_one(
+            "barrier", state=self._key(state), target=target, timeout=timeout
+        )
+
+    def signal_and_wait(
+        self, state: str, target: int, timeout: float | None = None
+    ) -> int:
+        return self._call_one(
+            "signal_and_wait", state=self._key(state), target=target, timeout=timeout
+        )["seq"]
+
+    def publish(self, topic: str, payload: Any) -> int:
+        return self._call_one("publish", topic=self._key(topic), payload=payload)[
+            "seq"
+        ]
+
+    def subscribe(self, topic: str, timeout: float | None = None) -> Iterator[Any]:
+        """Yield every entry of the topic in order (all entries from the
+        beginning, like the reference's Subscribe)."""
+        q = self._call("subscribe", topic=self._key(topic))
+        while True:
+            try:
+                msg = q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(f"subscribe {topic} timed out") from None
+            if "error" in msg:
+                return
+            yield msg["entry"]
+
+    def publish_subscribe(
+        self, topic: str, payload: Any, timeout: float | None = None
+    ) -> tuple[int, Iterator[Any]]:
+        seq = self.publish(topic, payload)
+        return seq, self.subscribe(topic, timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

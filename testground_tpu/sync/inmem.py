@@ -1,0 +1,137 @@
+"""In-memory sync service.
+
+Semantics (matching the reference sync service as used by
+``plans/network/pingpong.go``, ``plans/example/sync.go``,
+``plans/benchmarks/benchmarks.go``):
+
+- ``signal_entry(state) -> seq``: atomic counter increment returning the
+  1-based sequence number of this signaller.
+- ``barrier(state, target)``: block until the state's counter >= target.
+- ``signal_and_wait(state, target)``: both, returning the seq.
+- ``publish(topic, payload) -> seq``: append to an ordered topic stream.
+- ``subscribe(topic)``: iterator over ALL entries of the topic from the
+  beginning — every subscriber sees every entry, in order.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterator
+
+__all__ = ["InMemSyncService"]
+
+
+class InMemSyncService:
+    """Thread-safe coordination state for one or more runs.
+
+    Keys are namespaced by run id by callers (the SDK prefixes
+    ``run:<run_id>:``), matching the reference's key scoping.
+    """
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._counters: dict[str, int] = {}
+        self._topics: dict[str, list[Any]] = {}
+
+    # ------------------------------------------------------------- signals
+
+    def signal_entry(self, state: str) -> int:
+        with self._lock:
+            self._counters[state] = self._counters.get(state, 0) + 1
+            seq = self._counters[state]
+            self._lock.notify_all()
+            return seq
+
+    def counter(self, state: str) -> int:
+        with self._lock:
+            return self._counters.get(state, 0)
+
+    def barrier(
+        self,
+        state: str,
+        target: int,
+        timeout: float | None = None,
+        cancel: threading.Event | None = None,
+    ) -> None:
+        """Block until ``counter(state) >= target``."""
+        with self._lock:
+            ok = self._lock.wait_for(
+                lambda: self._counters.get(state, 0) >= target
+                or (cancel is not None and cancel.is_set()),
+                timeout=timeout,
+            )
+        if cancel is not None and cancel.is_set():
+            raise InterruptedError(f"barrier {state} canceled")
+        if not ok:
+            raise TimeoutError(f"barrier {state} (target {target}) timed out")
+
+    def signal_and_wait(
+        self,
+        state: str,
+        target: int,
+        timeout: float | None = None,
+        cancel: threading.Event | None = None,
+    ) -> int:
+        seq = self.signal_entry(state)
+        self.barrier(state, target, timeout=timeout, cancel=cancel)
+        return seq
+
+    # -------------------------------------------------------------- pub/sub
+
+    def publish(self, topic: str, payload: Any) -> int:
+        with self._lock:
+            entries = self._topics.setdefault(topic, [])
+            entries.append(payload)
+            self._lock.notify_all()
+            return len(entries)
+
+    def topic_len(self, topic: str) -> int:
+        with self._lock:
+            return len(self._topics.get(topic, []))
+
+    def get_entries(self, topic: str, start: int = 0) -> list[Any]:
+        with self._lock:
+            return list(self._topics.get(topic, [])[start:])
+
+    def subscribe(
+        self,
+        topic: str,
+        timeout: float | None = None,
+        cancel: threading.Event | None = None,
+    ) -> Iterator[Any]:
+        """Yield every entry of the topic from the beginning, then block for
+        new ones. Terminates when ``cancel`` is set (or ``timeout`` elapses
+        between entries)."""
+        cursor = 0
+        while True:
+            with self._lock:
+                ok = self._lock.wait_for(
+                    lambda: len(self._topics.get(topic, [])) > cursor
+                    or (cancel is not None and cancel.is_set()),
+                    timeout=timeout,
+                )
+                if cancel is not None and cancel.is_set():
+                    return
+                if not ok:
+                    raise TimeoutError(f"subscribe {topic} timed out")
+                entries = self._topics[topic][cursor:]
+                cursor = len(self._topics[topic])
+            yield from entries
+
+    def publish_subscribe(
+        self,
+        topic: str,
+        payload: Any,
+        timeout: float | None = None,
+        cancel: threading.Event | None = None,
+    ) -> tuple[int, Iterator[Any]]:
+        seq = self.publish(topic, payload)
+        return seq, self.subscribe(topic, timeout=timeout, cancel=cancel)
+
+    # --------------------------------------------------------------- admin
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._topics.clear()
+            self._lock.notify_all()

@@ -1,0 +1,167 @@
+"""TCP sync service.
+
+The ``local:exec`` runner's infra piece: the analog of the reference's
+Redis-backed sync-service container (``pkg/runner/local_common.go:77-104``),
+implemented as a newline-delimited-JSON TCP server over
+:class:`InMemSyncService`.
+
+Wire protocol (one JSON object per line):
+
+    request:  {"id": N, "op": <op>, ...args}
+    reply:    {"id": N, ...result}            exactly one, except:
+    subscribe streams {"id": N, "entry": payload, "seq": i} frames until the
+    connection closes.
+
+Ops: ``signal_entry(state)``, ``barrier(state, target)``,
+``signal_and_wait(state, target)``, ``publish(topic, payload)``,
+``subscribe(topic)``, ``counter(state)``.
+
+A C++ epoll implementation with the same wire protocol lives in
+``native/sync_service`` (built on demand); this Python server is the always-
+available fallback and the behavioral spec.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Any
+
+from testground_tpu.logging_ import S
+
+from .inmem import InMemSyncService
+
+__all__ = ["SyncServiceServer"]
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    daemon_threads = True
+
+    def handle(self) -> None:
+        svc: InMemSyncService = self.server.service  # type: ignore[attr-defined]
+        stop: threading.Event = self.server.stop_event  # type: ignore[attr-defined]
+        write_lock = threading.Lock()
+        pending: list[threading.Thread] = []
+
+        def reply(obj: dict) -> None:
+            data = (json.dumps(obj) + "\n").encode("utf-8")
+            try:
+                with write_lock:
+                    self.wfile.write(data)
+                    self.wfile.flush()
+            except (BrokenPipeError, OSError):
+                pass
+
+        def run_async(fn, req_id: int) -> None:
+            def runner():
+                try:
+                    fn()
+                except TimeoutError as e:
+                    reply({"id": req_id, "error": str(e)})
+                except InterruptedError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    reply({"id": req_id, "error": str(e)})
+
+            t = threading.Thread(target=runner, daemon=True)
+            t.start()
+            pending.append(t)
+
+        try:
+            for raw in self.rfile:
+                try:
+                    req = json.loads(raw)
+                except json.JSONDecodeError:
+                    reply({"id": -1, "error": "malformed request"})
+                    continue
+                rid = req.get("id", -1)
+                op = req.get("op")
+                try:
+                    if op == "signal_entry":
+                        reply({"id": rid, "seq": svc.signal_entry(req["state"])})
+                    elif op == "counter":
+                        reply({"id": rid, "count": svc.counter(req["state"])})
+                    elif op == "publish":
+                        reply(
+                            {"id": rid, "seq": svc.publish(req["topic"], req["payload"])}
+                        )
+                    elif op == "barrier":
+
+                        def do_barrier(rid=rid, req=req):
+                            svc.barrier(
+                                req["state"],
+                                int(req["target"]),
+                                timeout=req.get("timeout"),
+                                cancel=stop,
+                            )
+                            reply({"id": rid, "ok": True})
+
+                        run_async(do_barrier, rid)
+                    elif op == "signal_and_wait":
+
+                        def do_sw(rid=rid, req=req):
+                            seq = svc.signal_entry(req["state"])
+                            svc.barrier(
+                                req["state"],
+                                int(req["target"]),
+                                timeout=req.get("timeout"),
+                                cancel=stop,
+                            )
+                            reply({"id": rid, "seq": seq, "ok": True})
+
+                        run_async(do_sw, rid)
+                    elif op == "subscribe":
+
+                        def do_sub(rid=rid, req=req):
+                            for i, entry in enumerate(
+                                svc.subscribe(req["topic"], cancel=stop)
+                            ):
+                                reply({"id": rid, "entry": entry, "seq": i + 1})
+
+                        run_async(do_sub, rid)
+                    else:
+                        reply({"id": rid, "error": f"unknown op {op!r}"})
+                except KeyError as e:
+                    reply({"id": rid, "error": f"missing field {e}"})
+        except (ConnectionResetError, OSError):
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class SyncServiceServer:
+    """Lifecycle wrapper; bind to an ephemeral port with ``port=0``."""
+
+    def __init__(self, service: InMemSyncService | None = None, port: int = 0):
+        self.service = service or InMemSyncService()
+        self._server = _Server(("127.0.0.1", port), _Handler)
+        self._server.service = self.service  # type: ignore[attr-defined]
+        self._server.stop_event = threading.Event()  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.server_address  # type: ignore[return-value]
+
+    def start(self) -> "SyncServiceServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="tg-sync-service"
+        )
+        self._thread.start()
+        S().debug("sync service listening on %s:%d", *self.address)
+        return self
+
+    def stop(self) -> None:
+        self._server.stop_event.set()  # type: ignore[attr-defined]
+        # wake blocked barriers/subscribers so handler threads exit
+        with self.service._lock:
+            self.service._lock.notify_all()
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)

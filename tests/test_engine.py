@@ -1,0 +1,410 @@
+"""Engine/task/queue/storage tests, mirroring the reference's
+``pkg/task/{queue,storage,task}_test.go`` + supervisor behaviors."""
+
+import threading
+import time
+
+import pytest
+
+from testground_tpu.api import (
+    BuildOutput,
+    Composition,
+    Global,
+    Group,
+    InstanceConstraints,
+    Instances,
+    RunOutput,
+    TestCase,
+    TestPlanManifest,
+)
+from testground_tpu.builders.base import Builder
+from testground_tpu.config import EnvConfig
+from testground_tpu.engine import (
+    CreatedBy,
+    Engine,
+    EngineConfig,
+    Outcome,
+    QueueFullError,
+    State,
+    Task,
+    TaskQueue,
+    TaskStorage,
+    TaskType,
+)
+from testground_tpu.engine.queue import QueueEmptyError
+from testground_tpu.engine.task import DatedState, new_task_id
+from testground_tpu.runners.base import Runner
+from testground_tpu.runners.result import Result
+
+
+def mktask(tid=None, priority=0, created=None, **kw):
+    return Task(
+        id=tid or new_task_id(),
+        type=TaskType.RUN,
+        priority=priority,
+        states=[
+            DatedState(state=State.SCHEDULED, created=created or time.time())
+        ],
+        **kw,
+    )
+
+
+class TestTaskModel:
+    def test_ids_are_20_chars_and_sortable(self):
+        """integration_tests/header.sh asserts run-id length == 20."""
+        ids = [new_task_id() for _ in range(100)]
+        assert all(len(i) == 20 for i in ids)
+        assert len(set(ids)) == 100
+
+    def test_state_machine(self):
+        t = mktask()
+        assert t.state().state == State.SCHEDULED
+        t.states.append(DatedState(state=State.PROCESSING, created=time.time()))
+        assert t.state().state == State.PROCESSING
+        assert not t.is_canceled()
+        assert t.outcome() == Outcome.UNKNOWN
+
+    def test_outcome_mapping(self):
+        """pkg/data/result.go:17-51 semantics."""
+        t = mktask()
+        t.states.append(DatedState(state=State.COMPLETE, created=time.time()))
+        t.result = {"outcome": "success"}
+        assert t.outcome() == Outcome.SUCCESS
+        t.error = "boom"
+        assert t.outcome() == Outcome.FAILURE
+        t2 = mktask()
+        t2.states.append(DatedState(state=State.CANCELED, created=time.time()))
+        assert t2.outcome() == Outcome.CANCELED
+
+    def test_round_trip(self):
+        t = mktask(plan="p", case="c", composition={"global": {"plan": "p"}})
+        t2 = Task.from_dict(t.to_dict())
+        assert t2.to_dict() == t.to_dict()
+
+
+class TestQueue:
+    def test_priority_then_fifo(self):
+        """queue.go:178-189: priority desc, then creation asc."""
+        st = TaskStorage()
+        q = TaskQueue(st, max_size=10)
+        now = time.time()
+        q.push(mktask("a" * 20, priority=0, created=now))
+        q.push(mktask("b" * 20, priority=5, created=now + 1))
+        q.push(mktask("c" * 20, priority=0, created=now + 2))
+        assert q.pop().id == "b" * 20
+        assert q.pop().id == "a" * 20
+        assert q.pop().id == "c" * 20
+        with pytest.raises(QueueEmptyError):
+            q.pop()
+
+    def test_bounded(self):
+        st = TaskStorage()
+        q = TaskQueue(st, max_size=2)
+        q.push(mktask())
+        q.push(mktask())
+        with pytest.raises(QueueFullError):
+            q.push(mktask())
+
+    def test_rehydrates_from_storage(self, tmp_path):
+        """queue.go:18-31: queue rebuilt from disk on restart, including
+        tasks that were mid-processing."""
+        db = str(tmp_path / "tasks.db")
+        st = TaskStorage(db)
+        q = TaskQueue(st, max_size=10)
+        q.push(mktask("q" * 20))
+        q.push(mktask("r" * 20))
+        popped = q.pop()  # now in 'current' bucket
+        st.close()
+
+        st2 = TaskStorage(db)
+        q2 = TaskQueue(st2, max_size=10)
+        ids = {q2.pop().id, q2.pop().id}
+        assert ids == {"q" * 20, "r" * 20}
+        assert popped.id in ids
+
+    def test_push_unique_by_branch(self):
+        """queue.go:79-96: same repo+branch tasks are canceled on re-push."""
+        st = TaskStorage()
+        q = TaskQueue(st, max_size=10)
+        cb = CreatedBy(user="ci", repo="org/repo", branch="main", commit="abc")
+        old = mktask("o" * 20, created_by=cb)
+        q.push_unique_by_branch(old)
+        new = mktask("n" * 20, created_by=cb)
+        q.push_unique_by_branch(new)
+        assert len(q) == 1
+        assert q.pop().id == "n" * 20
+        archived = st.get("o" * 20)
+        assert archived.state().state == State.CANCELED
+
+    def test_cancel_queued(self):
+        st = TaskStorage()
+        q = TaskQueue(st, max_size=10)
+        q.push(mktask("x" * 20))
+        assert q.cancel_queued("x" * 20)
+        assert not q.cancel_queued("x" * 20)
+        assert st.get("x" * 20).state().state == State.CANCELED
+
+
+class TestStorage:
+    def test_lifecycle_buckets(self):
+        st = TaskStorage()
+        t = mktask("t" * 20)
+        st.persist_scheduled(t)
+        assert st.scheduled()[0].id == t.id
+        st.persist_processing(t)
+        assert st.scheduled() == []
+        assert st.processing()[0].id == t.id
+        st.archive(t)
+        assert st.processing() == []
+        assert st.archived()[0].id == t.id
+        assert st.get(t.id).id == t.id
+
+    def test_filter(self):
+        st = TaskStorage()
+        now = time.time()
+        a = mktask("a" * 20, created=now - 100)
+        b = mktask("b" * 20, created=now)
+        st.persist_scheduled(a)
+        st.archive(b)
+        got = st.filter(states=["scheduled"])
+        assert [t.id for t in got] == ["a" * 20]
+        got = st.filter(before=now - 50)
+        assert [t.id for t in got] == ["a" * 20]
+        got = st.filter(limit=1)
+        assert len(got) == 1
+
+
+# ---------------------------------------------------------------- engine
+
+
+class FakeBuilder(Builder):
+    def __init__(self, bid="fake:builder"):
+        self._id = bid
+        self.builds = 0
+
+    def id(self):
+        return self._id
+
+    def build(self, inp, ow, cancel):
+        self.builds += 1
+        return BuildOutput(
+            builder_id=self._id, artifact_path=f"artifact-{self.builds}"
+        )
+
+
+class FakeRunner(Runner):
+    def __init__(self, rid="fake:runner", outcome="success", delay=0.0):
+        self._id = rid
+        self._outcome = outcome
+        self._delay = delay
+        self.jobs = []
+
+    def id(self):
+        return self._id
+
+    def compatible_builders(self):
+        return ["fake:builder"]
+
+    def run(self, job, ow, cancel):
+        self.jobs.append(job)
+        deadline = time.time() + self._delay
+        while time.time() < deadline:
+            if cancel.is_set():
+                raise RuntimeError("canceled")
+            time.sleep(0.01)
+        r = Result.for_input(job)
+        for g in job.groups:
+            for _ in range(g.instances):
+                g_outcome = Outcome(self._outcome)
+                r.add_outcome(g.id, g_outcome)
+        r.update_outcome()
+        return RunOutput(run_id=job.run_id, result=r)
+
+
+def make_engine(tg_home, runner=None, builder=None):
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env,
+            builders=[builder or FakeBuilder()],
+            runners=[runner or FakeRunner()],
+        )
+    )
+    return engine
+
+
+def simple_composition(n=2):
+    return Composition(
+        global_=Global(
+            plan="testplan",
+            case="ok",
+            builder="fake:builder",
+            runner="fake:runner",
+        ),
+        groups=[Group(id="all", instances=Instances(count=n))],
+    )
+
+
+def simple_manifest():
+    return TestPlanManifest(
+        name="testplan",
+        builders={"fake:builder": {}},
+        runners={"fake:runner": {}},
+        testcases=[
+            TestCase(
+                name="ok", instances=InstanceConstraints(minimum=1, maximum=100)
+            )
+        ],
+    )
+
+
+def wait_complete(engine, task_id, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = engine.get_task(task_id)
+        if t is not None and t.state().state in (State.COMPLETE, State.CANCELED):
+            return t
+        time.sleep(0.02)
+    raise TimeoutError(f"task {task_id} did not complete")
+
+
+class TestEngineEndToEnd:
+    def test_queue_run_processes_to_success(self, tg_home):
+        from testground_tpu.api import generate_default_run
+
+        engine = make_engine(tg_home)
+        engine.start_workers()
+        try:
+            comp = generate_default_run(simple_composition())
+            tid = engine.queue_run(comp, simple_manifest())
+            t = wait_complete(engine, tid)
+            assert t.outcome() == Outcome.SUCCESS
+            assert t.result["outcomes"]["all"] == {"total": 2, "ok": 2}
+            # artifact was built and recorded in the prepared composition
+            comp_out = t.result["composition"]
+            assert comp_out["groups"][0]["run"]["artifact"] == "artifact-1"
+        finally:
+            engine.stop()
+
+    def test_failure_outcome(self, tg_home):
+        from testground_tpu.api import generate_default_run
+
+        engine = make_engine(tg_home, runner=FakeRunner(outcome="failure"))
+        engine.start_workers()
+        try:
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()), simple_manifest()
+            )
+            t = wait_complete(engine, tid)
+            assert t.outcome() == Outcome.FAILURE
+        finally:
+            engine.stop()
+
+    def test_incompatible_builder_rejected(self, tg_home):
+        """engine.go:216-219 compat check at queue time (itest
+        run_test.go: incompatible builder/runner must be rejected)."""
+        from testground_tpu.api import generate_default_run
+
+        engine = make_engine(tg_home)
+        comp = generate_default_run(simple_composition())
+        comp.global_.builder = "docker:other"
+        comp.groups[0].builder = "docker:other"
+        with pytest.raises(ValueError, match="incompatible"):
+            engine.queue_run(comp, simple_manifest())
+
+    def test_kill_running_task(self, tg_home):
+        from testground_tpu.api import generate_default_run
+
+        engine = make_engine(tg_home, runner=FakeRunner(delay=30))
+        engine.start_workers()
+        try:
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()), simple_manifest()
+            )
+            # wait until it starts processing
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                t = engine.get_task(tid)
+                if t and t.state().state == State.PROCESSING:
+                    break
+                time.sleep(0.02)
+            assert engine.kill(tid)
+            t = wait_complete(engine, tid)
+            assert t.outcome() in (Outcome.CANCELED, Outcome.FAILURE)
+        finally:
+            engine.stop()
+
+    def test_build_dedup_across_identical_groups(self, tg_home):
+        """supervisor.go:359-364: two groups with the same build key build
+        once."""
+        from testground_tpu.api import generate_default_run
+
+        builder = FakeBuilder()
+        engine = make_engine(tg_home, builder=builder)
+        engine.start_workers()
+        try:
+            comp = simple_composition()
+            comp.groups = [
+                Group(id="g1", instances=Instances(count=1)),
+                Group(id="g2", instances=Instances(count=1)),
+            ]
+            comp = generate_default_run(comp)
+            tid = engine.queue_run(comp, simple_manifest())
+            t = wait_complete(engine, tid)
+            assert t.outcome() == Outcome.SUCCESS
+            assert builder.builds == 1
+        finally:
+            engine.stop()
+
+    def test_disabled_runner_refused(self, tg_home):
+        """supervisor.go:568-571 + integration test 18."""
+        from testground_tpu.api import generate_default_run
+
+        (tg_home / ".env.toml").write_text(
+            '[runners."fake:runner"]\ndisabled = true\n'
+        )
+        engine = make_engine(tg_home)
+        engine.start_workers()
+        try:
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()), simple_manifest()
+            )
+            t = wait_complete(engine, tid)
+            assert t.outcome() == Outcome.FAILURE
+            assert "disabled" in t.error
+        finally:
+            engine.stop()
+
+    def test_logs_capture_run_output(self, tg_home):
+        from testground_tpu.api import generate_default_run
+
+        engine = make_engine(tg_home)
+        engine.start_workers()
+        try:
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()), simple_manifest()
+            )
+            wait_complete(engine, tid)
+            lines = list(engine.logs(tid))
+            assert any('"t": "r"' in l or '"t":"r"' in l for l in lines)
+        finally:
+            engine.stop()
+
+    def test_multi_run_composition(self, tg_home):
+        """[[runs]] multi-run support (integration 1493_*)."""
+        from testground_tpu.api import CompositionRunGroup, Run
+
+        engine = make_engine(tg_home)
+        engine.start_workers()
+        try:
+            comp = simple_composition()
+            comp.runs = [
+                Run(id="r1", groups=[CompositionRunGroup(id="all")]),
+                Run(id="r2", groups=[CompositionRunGroup(id="all")]),
+            ]
+            tid = engine.queue_run(comp, simple_manifest())
+            t = wait_complete(engine, tid)
+            assert t.outcome() == Outcome.SUCCESS
+            assert set(t.result["runs"].keys()) == {"r1", "r2"}
+        finally:
+            engine.stop()

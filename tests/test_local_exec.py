@@ -1,0 +1,145 @@
+"""Integration tests: in-process engine + real plan processes via
+``local:exec`` (SURVEY.md §4 tier 3 — the analog of
+``pkg/integration/local_exec_test.go`` + ``integration_tests/03-05,14``)."""
+
+import io
+import os
+import tarfile
+import time
+
+import pytest
+
+from testground_tpu.api import (
+    Composition,
+    Global,
+    Group,
+    Instances,
+    TestPlanManifest,
+    generate_default_run,
+)
+from testground_tpu.engine import Engine, EngineConfig, Outcome, State
+from testground_tpu.builders.exec_py import ExecPyBuilder
+from testground_tpu.config import EnvConfig
+from testground_tpu.runners.local_exec import LocalExecRunner
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+@pytest.fixture()
+def engine(tg_home):
+    env = EnvConfig.load()
+    e = Engine(
+        EngineConfig(
+            env=env, builders=[ExecPyBuilder()], runners=[LocalExecRunner()]
+        )
+    )
+    e.start_workers()
+    yield e
+    e.stop()
+
+
+def run_plan(engine, plan, case, instances=1, params=None, timeout=60):
+    comp = generate_default_run(
+        Composition(
+            global_=Global(
+                plan=plan, case=case, builder="exec:py", runner="local:exec"
+            ),
+            groups=[Group(id="all", instances=Instances(count=instances))],
+        )
+    )
+    if params:
+        comp.runs[0].groups[0].test_params.update(params)
+    manifest = TestPlanManifest.load_file(
+        os.path.join(PLANS, plan, "manifest.toml")
+    )
+    tid = engine.queue_run(comp, manifest, sources_dir=os.path.join(PLANS, plan))
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = engine.get_task(tid)
+        if t is not None and t.state().state in (State.COMPLETE, State.CANCELED):
+            return t
+        time.sleep(0.05)
+    raise TimeoutError(f"task {tid} did not finish")
+
+
+class TestPlacebo:
+    def test_ok(self, engine):
+        t = run_plan(engine, "placebo", "ok", instances=2)
+        assert t.outcome() == Outcome.SUCCESS
+        assert t.result["outcomes"]["all"] == {"total": 2, "ok": 2}
+
+    def test_abort_fails(self, engine):
+        t = run_plan(engine, "placebo", "abort")
+        assert t.outcome() == Outcome.FAILURE
+
+    def test_panic_fails(self, engine):
+        t = run_plan(engine, "placebo", "panic")
+        assert t.outcome() == Outcome.FAILURE
+
+    def test_outputs_layout_and_collection(self, engine):
+        """assert_run_output_is_correct semantics: run.out non-empty,
+        run.err empty, layout <plan>/<run>/<group>/<instance>
+        (header.sh:110-160, local_docker.go:258-267)."""
+        t = run_plan(engine, "placebo", "ok", instances=2)
+        out_root = engine.env.dirs.outputs()
+        inst_dir = os.path.join(out_root, "placebo", t.id, "all", "0")
+        assert os.path.isdir(inst_dir)
+        assert os.path.getsize(os.path.join(inst_dir, "run.out")) > 0
+        assert os.path.getsize(os.path.join(inst_dir, "run.err")) == 0
+
+        buf = io.BytesIO()
+        from testground_tpu.rpc import discard_writer
+
+        engine.do_collect_outputs("local:exec", t.id, buf, discard_writer())
+        buf.seek(0)
+        with tarfile.open(fileobj=buf, mode="r:gz") as tar:
+            names = tar.getnames()
+        assert f"{t.id}/all/0/run.out" in names
+        assert f"{t.id}/all/1/run.out" in names
+
+    def test_metrics_written(self, engine):
+        t = run_plan(engine, "placebo", "metrics")
+        metrics = os.path.join(
+            engine.env.dirs.outputs(), "placebo", t.id, "all", "0", "metrics.out"
+        )
+        assert os.path.getsize(metrics) > 0
+
+
+class TestExample:
+    def test_output(self, engine):
+        t = run_plan(engine, "example", "output")
+        assert t.outcome() == Outcome.SUCCESS
+
+    def test_params_defaults_from_manifest(self, engine):
+        t = run_plan(engine, "example", "params")
+        assert t.outcome() == Outcome.SUCCESS
+        run_out = os.path.join(
+            engine.env.dirs.outputs(), "example", t.id, "all", "0", "run.out"
+        )
+        content = open(run_out).read()
+        assert "default-2" in content  # manifest default applied
+
+    def test_params_override(self, engine):
+        t = run_plan(engine, "example", "params", params={"param2": "overridden"})
+        content = open(
+            os.path.join(
+                engine.env.dirs.outputs(), "example", t.id, "all", "0", "run.out"
+            )
+        ).read()
+        assert "overridden" in content
+
+    def test_sync_leader_followers(self, engine):
+        """Real multi-process coordination over the TCP sync service
+        (plans/example/sync.go semantics)."""
+        t = run_plan(engine, "example", "sync", instances=4, timeout=90)
+        assert t.outcome() == Outcome.SUCCESS
+        assert t.result["outcomes"]["all"] == {"total": 4, "ok": 4}
+
+    def test_failure(self, engine):
+        t = run_plan(engine, "example", "failure")
+        assert t.outcome() == Outcome.FAILURE
+
+    def test_artifact(self, engine):
+        t = run_plan(engine, "example", "artifact")
+        assert t.outcome() == Outcome.SUCCESS

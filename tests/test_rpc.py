@@ -1,0 +1,64 @@
+"""RPC chunk protocol tests (``pkg/rpc/rpc_test.go`` semantics)."""
+
+import base64
+import io
+
+from testground_tpu.rpc import (
+    CHUNK_BINARY,
+    CHUNK_ERROR,
+    CHUNK_PROGRESS,
+    CHUNK_RESULT,
+    Chunk,
+    OutputWriter,
+    discard_writer,
+    parse_chunks,
+)
+
+
+def test_progress_result_stream():
+    sink = io.StringIO()
+    ow = OutputWriter(sink=sink)
+    ow.infof("hello %s", "world")
+    ow.write_result({"outcome": "success"})
+
+    chunks = list(parse_chunks(io.StringIO(sink.getvalue())))
+    assert [c.type for c in chunks] == [CHUNK_PROGRESS, CHUNK_RESULT]
+    assert "hello world" in chunks[0].payload
+    assert chunks[1].payload == {"outcome": "success"}
+
+
+def test_error_chunk():
+    sink = io.StringIO()
+    ow = OutputWriter(sink=sink)
+    ow.write_error("boom")
+    (c,) = parse_chunks(io.StringIO(sink.getvalue()))
+    assert c.type == CHUNK_ERROR
+    assert c.error == "boom"
+
+
+def test_binary_chunks_round_trip():
+    sink = io.StringIO()
+    ow = OutputWriter(sink=sink)
+    data = bytes(range(256)) * 300
+    ow.write_binary(io.BytesIO(data), chunk_size=1000)
+    chunks = list(parse_chunks(io.StringIO(sink.getvalue())))
+    assert all(c.type == CHUNK_BINARY for c in chunks)
+    assert len(chunks) > 1
+    recovered = b"".join(base64.b64decode(c.payload) for c in chunks)
+    assert recovered == data
+
+
+def test_chunk_json_round_trip():
+    for c in (
+        Chunk(type=CHUNK_PROGRESS, payload="text\n"),
+        Chunk(type=CHUNK_RESULT, payload={"k": [1, 2]}),
+        Chunk(type=CHUNK_ERROR, error="msg"),
+    ):
+        c2 = Chunk.from_json(c.to_json())
+        assert (c2.type, c2.payload, c2.error) == (c.type, c.payload, c.error)
+
+
+def test_discard_writer_is_silent():
+    ow = discard_writer()
+    ow.infof("nothing")
+    ow.write_result(1)  # must not raise

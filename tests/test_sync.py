@@ -1,0 +1,134 @@
+"""Sync service tests: in-memory semantics + TCP server/client
+(the reference's sync-service contract, SURVEY.md §2.6)."""
+
+import threading
+import time
+
+import pytest
+
+from testground_tpu.sync import InMemSyncService, SyncClient, SyncServiceServer
+
+
+class TestInMem:
+    def test_signal_entry_sequences(self):
+        s = InMemSyncService()
+        assert s.signal_entry("state") == 1
+        assert s.signal_entry("state") == 2
+        assert s.signal_entry("other") == 1
+
+    def test_barrier_blocks_until_target(self):
+        s = InMemSyncService()
+        done = threading.Event()
+
+        def waiter():
+            s.barrier("go", 3, timeout=5)
+            done.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        s.signal_entry("go")
+        s.signal_entry("go")
+        assert not done.wait(timeout=0.2)
+        s.signal_entry("go")
+        assert done.wait(timeout=5)
+
+    def test_barrier_timeout(self):
+        s = InMemSyncService()
+        with pytest.raises(TimeoutError):
+            s.barrier("never", 1, timeout=0.1)
+
+    def test_subscribe_sees_all_entries_in_order(self):
+        """Every subscriber sees every entry (pingpong.go:219-244)."""
+        s = InMemSyncService()
+        s.publish("t", "a")
+        s.publish("t", "b")
+        got = []
+        it = s.subscribe("t", timeout=1)
+        got.append(next(it))
+        got.append(next(it))
+        s.publish("t", "c")
+        got.append(next(it))
+        assert got == ["a", "b", "c"]
+
+    def test_signal_and_wait(self):
+        s = InMemSyncService()
+        results = []
+
+        def party(i):
+            results.append(s.signal_and_wait("sw", 3, timeout=5))
+
+        threads = [threading.Thread(target=party, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert sorted(results) == [1, 2, 3]
+
+
+class TestTCP:
+    @pytest.fixture()
+    def server(self):
+        srv = SyncServiceServer().start()
+        yield srv
+        srv.stop()
+
+    def test_client_roundtrip(self, server):
+        host, port = server.address
+        c1 = SyncClient(host, port, namespace="run:r1:")
+        c2 = SyncClient(host, port, namespace="run:r1:")
+        try:
+            assert c1.signal_entry("s") == 1
+            assert c2.signal_entry("s") == 2
+            assert c1.counter("s") == 2
+
+            c1.publish("topic", {"v": 1})
+            c2.publish("topic", {"v": 2})
+            it = c1.subscribe("topic", timeout=5)
+            assert next(it) == {"v": 1}
+            assert next(it) == {"v": 2}
+        finally:
+            c1.close()
+            c2.close()
+
+    def test_namespace_isolation(self, server):
+        host, port = server.address
+        a = SyncClient(host, port, namespace="run:a:")
+        b = SyncClient(host, port, namespace="run:b:")
+        try:
+            a.signal_entry("s")
+            assert b.counter("s") == 0
+        finally:
+            a.close()
+            b.close()
+
+    def test_signal_and_wait_across_clients(self, server):
+        host, port = server.address
+        clients = [
+            SyncClient(host, port, namespace="run:x:") for _ in range(3)
+        ]
+        results = []
+
+        def party(c):
+            results.append(c.signal_and_wait("sw", 3, timeout=5))
+
+        try:
+            threads = [
+                threading.Thread(target=party, args=(c,)) for c in clients
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=5)
+            assert sorted(results) == [1, 2, 3]
+        finally:
+            for c in clients:
+                c.close()
+
+    def test_barrier_timeout_propagates(self, server):
+        host, port = server.address
+        c = SyncClient(host, port)
+        try:
+            with pytest.raises((RuntimeError, TimeoutError)):
+                c.barrier("never", 1, timeout=0.1)
+        finally:
+            c.close()
