@@ -1,0 +1,118 @@
+"""Headline benchmark: simulated peers × ticks per second.
+
+Runs the ``benchmarks/pingpong-flood`` sim plan — every instance sustaining
+shaped round-trip traffic — at BASELINE.md's north-star scale (100k
+simulated instances, 10k ticks) on the available accelerator and reports
+
+    {"metric": "sim_peer_ticks_per_sec", "value": ..., "unit": ...,
+     "vs_baseline": ...}
+
+vs_baseline is measured throughput over the north-star requirement
+(100_000 peers × 10_000 ticks / 60 s): ≥1.0 means the <60 s target is met.
+The reference's own envelope for a single host is 2–300 real instances
+(README.md:136-139); every instance here exchanges real (simulated-network)
+messages with link shaping, sync counters live, at 100k instances.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_PEER_TICKS_PER_SEC = 100_000 * 10_000 / 60.0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--instances", type=int, default=100_000)
+    p.add_argument("--ticks", type=int, default=10_000)
+    p.add_argument("--chunk", type=int, default=500)
+    p.add_argument("--latency-ms", type=int, default=4)
+    args = p.parse_args()
+
+    import jax
+
+    from testground_tpu.api import RunGroup
+    from testground_tpu.sim.engine import SimProgram, build_groups
+    from testground_tpu.sim.executor import load_sim_testcases
+
+    n, ticks = args.instances, args.ticks
+    tc = load_sim_testcases(os.path.join(REPO, "plans", "benchmarks"))[
+        "pingpong-flood"
+    ]()
+    groups = build_groups(
+        [
+            RunGroup(
+                id="all",
+                instances=n,
+                parameters={
+                    "duration_ticks": str(ticks + args.chunk + 1),
+                    "latency_ms": str(args.latency_ms),
+                },
+            )
+        ]
+    )
+    devs = jax.devices()
+    mesh = None
+    if len(devs) > 1:
+        import numpy as np
+
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+    prog = SimProgram(
+        tc,
+        groups,
+        test_plan="benchmarks",
+        test_case="pingpong-flood",
+        tick_ms=1.0,
+        mesh=mesh,
+        chunk=args.chunk,
+    )
+
+    print(
+        f"# bench: {n} instances × {ticks} ticks on "
+        f"{jax.default_backend()} ({len(devs)} device(s))",
+        file=sys.stderr,
+    )
+    import numpy as np_
+
+    carry = jax.jit(lambda: prog.init_carry(0))()
+    fn = prog.compiled_chunk()
+    carry, done = fn(carry)  # compile + warm one chunk
+    _ = np_.asarray(carry.t)  # hard sync: D2H forces completion
+    print("# warmup chunk done; timing...", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    run_ticks = 0
+    while run_ticks < ticks:
+        carry, done = fn(carry)
+        run_ticks += args.chunk
+    _ = np_.asarray(carry.t)  # hard sync (block_until_ready may not block
+    # on remotely-tunneled backends)
+    wall = time.perf_counter() - t0
+
+    value = n * run_ticks / wall
+    print(
+        f"# {run_ticks} ticks in {wall:.2f}s wall "
+        f"({run_ticks / wall:.1f} ticks/s)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "sim_peer_ticks_per_sec",
+                "value": round(value, 1),
+                "unit": "peer*ticks/s (pingpong-flood @ %dk peers)"
+                % (n // 1000),
+                "vs_baseline": round(value / BASELINE_PEER_TICKS_PER_SEC, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,122 @@
+"""benchmarks plan, sim edition.
+
+Sim twin of the reference's ``plans/benchmarks`` (``benchmarks.go``): the
+framework-limits workloads. The reference measures wall-clock seconds for
+barriers/pubsub against Redis at up to 50k instances; here the same shapes
+measure the simulator's throughput on the device mesh. ``pingpong-flood``
+is the headline BASELINE.md workload: every instance sustains shaped
+round-trip traffic for a fixed simulated duration (the vectorized analog of
+``plans/network`` ping-pong, run at 100k instances).
+"""
+
+import jax.numpy as jnp
+
+from testground_tpu.sim.api import (
+    RUNNING,
+    SUCCESS,
+    Outbox,
+    SimTestcase,
+)
+
+PING = 1
+PONG = 2
+
+
+class Barrier(SimTestcase):
+    """All instances signal one state and wait for the full count
+    (``benchmarks.go:100-146`` barrier testcase, manifest-bounded at 50k).
+    Measures ticks-to-release via finished_at."""
+
+    STATES = ["barrier"]
+    OUT_MSGS = 1
+    IN_MSGS = 1
+    MSG_WIDTH = 1
+    MAX_LINK_TICKS = 4
+
+    def step(self, env, state, inbox, sync, t):
+        n = env.test_instance_count
+        released = sync.counts[self.state_id("barrier")] >= n
+        return self.out(
+            state,
+            status=jnp.where(released, SUCCESS, RUNNING),
+            signals=self.signal("barrier") * (t == 0),
+        )
+
+
+class PingPongFlood(SimTestcase):
+    """Continuous paired ping-pong under link shaping for a fixed simulated
+    duration — sustained per-tick message transport at full instance count.
+
+    Tuned with the fast-path knobs: pairwise traffic means exactly one
+    sender per receiver per tick, so ``SLOT_MODE="direct"`` (sort-free slot
+    assignment) is valid, provenance is unused (``TRACK_SRC=False``), and
+    the calendar horizon only needs to cover the shaped latency.
+    """
+
+    MSG_WIDTH = 2
+    OUT_MSGS = 1
+    IN_MSGS = 1
+    MAX_LINK_TICKS = 8
+    TRACK_SRC = False
+    SLOT_MODE = "direct"
+    SHAPING = ("latency",)
+
+    def init(self, env):
+        return {"rounds": jnp.int32(0)}
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        duration = (
+            env.int_param("duration_ticks")
+            if "duration_ticks" in env.group.params
+            else 1000
+        )
+        lat = (
+            env.float_param("latency_ms")
+            if "latency_ms" in env.group.params
+            else 4.0
+        )
+        partner = env.global_seq ^ 1
+
+        kind = inbox.payload[0]
+        got_ping = jnp.any(inbox.valid & (kind == PING))
+        got_pong = jnp.any(inbox.valid & (kind == PONG))
+
+        rounds = state["rounds"] + got_pong.astype(jnp.int32)
+        # t==0: open with a ping; then reply pong to pings, new ping on pongs
+        send = (t == 0) | got_ping | got_pong
+        out_kind = jnp.where(got_ping, PONG, PING).astype(jnp.int32)
+
+        done = t >= duration
+        return self.out(
+            {"rounds": rounds},
+            status=jnp.where(done, SUCCESS, RUNNING),
+            outbox=Outbox.single(
+                partner,
+                jnp.stack([out_kind, rounds]),
+                send & ~done,
+                cls.OUT_MSGS,
+                cls.MSG_WIDTH,
+            ),
+            net_shape=self.link_shape(latency_ms=lat),
+            net_shape_valid=t == 0,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {"flood.rounds": final_state["rounds"]}
+
+
+class Startup(SimTestcase):
+    """time-to-start analog (``benchmarks.go:23``): succeed on the first
+    tick; finished_at gives the framework's per-instance startup cost (a
+    constant one tick — the containerless win)."""
+
+    def step(self, env, state, inbox, sync, t):
+        return self.out(state, status=SUCCESS)
+
+
+sim_testcases = {
+    "barrier": Barrier,
+    "pingpong-flood": PingPongFlood,
+    "startup": Startup,
+}

@@ -1,0 +1,243 @@
+"""network plan, sim edition.
+
+Sim twin of the reference's ``plans/network`` testcases:
+
+- ``ping-pong`` (``pingpong.go``): pairs shape their egress latency, barrier,
+  exchange ping/pong, assert the measured RTT lands in the shaped window,
+  reconfigure to a lower latency mid-run and assert again — the canonical
+  proof that link shaping + dynamic reconfiguration behave. RTTs here are in
+  **simulated** time, so the windows are exact up to tick quantization.
+- ``traffic-allowed`` / ``traffic-blocked`` (``traffic.go:16-46``): every
+  instance sends to its ring successor under an Accept vs Drop filter and
+  asserts traffic did / did not flow.
+
+Instances pair/chain by global sequence number; all control flow is
+``jnp.where`` over int32 state so the whole case vmaps and jits.
+"""
+
+import jax.numpy as jnp
+
+from testground_tpu.sim.api import (
+    FAILURE,
+    FILTER_ACCEPT,
+    FILTER_DROP,
+    RUNNING,
+    SUCCESS,
+    Outbox,
+    SimTestcase,
+)
+
+PING = 1
+PONG = 2
+
+
+class PingPong(SimTestcase):
+    STATES = ["ready", "half-done"]
+    MSG_WIDTH = 4
+    OUT_MSGS = 2  # slot 0: pong replies, slot 1: our own pings
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 512
+
+    def init(self, env):
+        z = jnp.int32(0)
+        f = jnp.asarray(False)
+        return {
+            "phase": z,
+            "start": z,
+            "start2": z,
+            "rtt1": jnp.int32(-1),
+            "rtt2": jnp.int32(-1),
+            "answered1": f,
+            "got1": f,
+            "answered2": f,
+            "got2": f,
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        lat1 = env.float_param("latency_ms") if "latency_ms" in env.group.params else 100.0
+        lat2 = env.float_param("latency2_ms") if "latency2_ms" in env.group.params else 10.0
+        tol = env.float_param("tolerance_ms") if "tolerance_ms" in env.group.params else 15.0
+        partner = env.global_seq ^ 1
+
+        kind = inbox.payload[0]
+        rnd = inbox.payload[1]
+        v = inbox.valid
+
+        def got(k, r):
+            return jnp.any(v & (kind == k) & (rnd == r))
+
+        phase = state["phase"]
+        ready = sync.counts[self.state_id("ready")] >= n
+        half = sync.counts[self.state_id("half-done")] >= n
+
+        p0 = phase == 0
+        send_ping1 = (phase == 1) & ready
+        reply1 = got(PING, 1)  # always answer pings, whatever our phase
+        reply2 = got(PING, 2)
+        gp1 = (phase == 2) & got(PONG, 1)
+        gp2 = (phase == 4) & got(PONG, 2)
+
+        answered1 = state["answered1"] | reply1
+        got1 = state["got1"] | gp1
+        answered2 = state["answered2"] | reply2
+        got2 = state["got2"] | gp2
+        rtt1 = jnp.where(gp1, t - state["start"], state["rtt1"])
+        rtt2 = jnp.where(gp2, t - state["start2"], state["rtt2"])
+        fin1 = (phase == 2) & answered1 & got1
+        send_ping2 = (phase == 3) & half
+        fin2 = (phase == 4) & answered2 & got2
+
+        new_phase = jnp.where(
+            p0,
+            1,
+            jnp.where(
+                send_ping1,
+                2,
+                jnp.where(
+                    fin1, 3, jnp.where(send_ping2, 4, jnp.where(fin2, 5, phase))
+                ),
+            ),
+        ).astype(jnp.int32)
+
+        # --- RTT assertions (pingpong.go:185-195 windows, in sim time)
+        rtt1_ms = rtt1.astype(jnp.float32) * env.tick_ms
+        rtt2_ms = rtt2.astype(jnp.float32) * env.tick_ms
+        ok = (
+            (rtt1_ms >= 2 * lat1)
+            & (rtt1_ms <= 2 * lat1 + tol)
+            & (rtt2_ms >= 2 * lat2)
+            & (rtt2_ms <= 2 * lat2 + tol)
+        )
+        status = jnp.where(
+            fin2, jnp.where(ok, SUCCESS, FAILURE), RUNNING
+        ).astype(jnp.int32)
+
+        # --- sends
+        send_pong = reply1 | reply2
+        pong_round = jnp.where(reply2, 2, 1).astype(jnp.int32)
+        send_ping = send_ping1 | send_ping2
+        ping_round = jnp.where(send_ping2, 2, 1).astype(jnp.int32)
+        ob = Outbox.empty(cls.OUT_MSGS, cls.MSG_WIDTH)
+        ob = Outbox(
+            dst=ob.dst.at[0].set(partner).at[1].set(partner),
+            payload=ob.payload.at[0, 0]
+            .set(PONG)
+            .at[0, 1]
+            .set(pong_round)
+            .at[1, 0]
+            .set(PING)
+            .at[1, 1]
+            .set(ping_round),
+            valid=ob.valid.at[0].set(send_pong).at[1].set(send_ping),
+        )
+
+        # --- network (re)configuration: shaped at start, reshaped at fin1
+        shape1 = self.link_shape(latency_ms=lat1)
+        shape2 = self.link_shape(latency_ms=lat2)
+
+        return self.out(
+            {
+                "phase": new_phase,
+                "start": jnp.where(send_ping1, t, state["start"]),
+                "start2": jnp.where(send_ping2, t, state["start2"]),
+                "rtt1": rtt1,
+                "rtt2": rtt2,
+                "answered1": answered1,
+                "got1": got1,
+                "answered2": answered2,
+                "got2": got2,
+            },
+            status=status,
+            outbox=ob,
+            signals=self.signal("ready") * p0
+            + self.signal("half-done") * fin1,
+            net_shape=jnp.where(fin1, shape2, shape1),
+            net_shape_valid=p0 | fin1,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {
+            "pingpong.rtt1_ticks": final_state["rtt1"],
+            "pingpong.rtt2_ticks": final_state["rtt2"],
+        }
+
+
+class _Traffic(SimTestcase):
+    """Ring traffic under an Accept (allowed) or Drop (blocked) filter."""
+
+    STATES = ["net-ready"]
+    BLOCKED = False
+    MSG_WIDTH = 2
+    OUT_MSGS = 1
+    IN_MSGS = 4
+
+    def init(self, env):
+        return {
+            "phase": jnp.int32(0),
+            "deadline": jnp.int32(0),
+            "received": jnp.int32(0),
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        wait = (
+            env.int_param("wait_ticks")
+            if "wait_ticks" in env.group.params
+            else 50
+        )
+        succ = jnp.mod(env.global_seq + 1, n)
+
+        phase = state["phase"]
+        ready = sync.counts[self.state_id("net-ready")] >= n
+        p0 = phase == 0
+        send = (phase == 1) & ready
+
+        received = state["received"] + inbox.count
+        deadline = jnp.where(send, t + wait, state["deadline"])
+        judge = (phase == 2) & (t >= deadline)
+        flowed = received > 0
+        ok = flowed != cls.BLOCKED
+        status = jnp.where(
+            judge, jnp.where(ok, SUCCESS, FAILURE), RUNNING
+        ).astype(jnp.int32)
+
+        action = FILTER_DROP if cls.BLOCKED else FILTER_ACCEPT
+        n_groups = len(env.groups)
+
+        return self.out(
+            {
+                "phase": jnp.where(p0, 1, jnp.where(send, 2, phase)).astype(
+                    jnp.int32
+                ),
+                "deadline": deadline,
+                "received": received,
+            },
+            status=status,
+            outbox=Outbox.single(
+                succ, jnp.asarray([1, 0]), send, cls.OUT_MSGS, cls.MSG_WIDTH
+            ),
+            signals=self.signal("net-ready") * p0,
+            net_filters=jnp.full((n_groups,), action, jnp.int32),
+            net_filters_valid=p0,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {"traffic.received": final_state["received"]}
+
+
+class TrafficAllowed(_Traffic):
+    BLOCKED = False
+
+
+class TrafficBlocked(_Traffic):
+    BLOCKED = True
+
+
+sim_testcases = {
+    "ping-pong": PingPong,
+    "traffic-allowed": TrafficAllowed,
+    "traffic-blocked": TrafficBlocked,
+}

@@ -1,0 +1,70 @@
+"""placebo, sim edition: the do-nothing fixtures as vmappable state machines.
+
+Sim twin of ``plans/placebo/main.go`` (ok / abort / panic / stall /
+metrics): the smallest possible testcases, used to validate the ``sim:jax``
+runner's outcome plumbing the way the reference's integration scripts 03-05
+use placebo against local runners.
+"""
+
+import jax.numpy as jnp
+
+from testground_tpu.sim.api import (
+    CRASH,
+    FAILURE,
+    RUNNING,
+    SUCCESS,
+    SimTestcase,
+)
+
+
+class Ok(SimTestcase):
+    def step(self, env, state, inbox, sync, t):
+        return self.out(state, status=SUCCESS)
+
+
+class Abort(SimTestcase):
+    """record_failure + error return (integration test 14 semantics)."""
+
+    def step(self, env, state, inbox, sync, t):
+        return self.out(state, status=FAILURE)
+
+
+class Panic(SimTestcase):
+    def step(self, env, state, inbox, sync, t):
+        return self.out(state, status=CRASH)
+
+
+class Stall(SimTestcase):
+    """Never terminates — exercises the max_ticks budget the way the
+    reference's 24h sleep exercises the 10-min task timeout."""
+
+    def step(self, env, state, inbox, sync, t):
+        return self.out(state, status=RUNNING)
+
+
+class Metrics(SimTestcase):
+    """Counts to 10 across ticks, then succeeds; the counter lands in each
+    instance's metrics.out via collect_metrics."""
+
+    def init(self, env):
+        return {"counter": jnp.int32(0)}
+
+    def step(self, env, state, inbox, sync, t):
+        counter = state["counter"] + 1
+        done = counter >= 10
+        return self.out(
+            {"counter": counter},
+            status=jnp.where(done, SUCCESS, RUNNING),
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {"placebo.counter": final_state["counter"]}
+
+
+sim_testcases = {
+    "ok": Ok,
+    "abort": Abort,
+    "panic": Panic,
+    "stall": Stall,
+    "metrics": Metrics,
+}

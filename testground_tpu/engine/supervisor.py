@@ -217,11 +217,14 @@ def do_run(
 
     # coalesce runner config: composition > .env.toml > manifest-applied
     # defaults already in run_config (supervisor.go:563-581)
+    coalesced = CoalescedConfig().append(engine.env.runners.get(runner_id)).append(
+        comp.global_.run_config
+    )
+    cfg_type = runner.config_type()
     runner_cfg = (
-        CoalescedConfig()
-        .append(engine.env.runners.get(runner_id))
-        .append(comp.global_.run_config)
-        .flatten()
+        coalesced.coalesce_into(cfg_type)
+        if cfg_type is not None
+        else coalesced.flatten()
     )
 
     # Execute each run in the composition sequentially; the task result

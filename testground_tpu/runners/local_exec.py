@@ -65,8 +65,8 @@ class LocalExecRunner(Runner, HealthcheckedRunner):
     def run(
         self, job: RunInput, ow: OutputWriter, cancel: threading.Event
     ) -> RunOutput:
-        cfg = job.runner_config or {}
-        run_timeout = float(cfg.get("run_timeout_secs", 0) or 0)
+        cfg = job.runner_config or LocalExecConfig()
+        run_timeout = float(cfg.run_timeout_secs or 0)
 
         result = Result.for_input(job)
         pretty = PrettyPrinter(ow)

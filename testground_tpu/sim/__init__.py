@@ -9,4 +9,16 @@ Publish) lower to counter tensors updated with ``psum``/``cumsum``; link
 shaping (latency/jitter/bandwidth/loss + subnet filters) is arithmetic on
 per-instance egress state and bounded rule tables; and the whole tick loop
 runs under ``jit`` sharded over a ``jax.sharding.Mesh``.
+
+Import layering: this package's submodules import jax; the package root and
+``runner`` stay import-light so the control plane can load without jax.
 """
+
+__all__ = [
+    "api",
+    "net",
+    "sync_kernel",
+    "engine",
+    "executor",
+    "runner",
+]

@@ -1,0 +1,409 @@
+"""The sim-plan API: test plans as traceable, vmappable state machines.
+
+This is the TPU-native re-expression of the reference's SDK contract
+(sdk-go ``run.InvokeMap`` + ``runtime.RunEnv`` + ``sync.Client`` +
+``network.Client`` — SURVEY.md §2.6, §3.3). The reference lets a plan run
+arbitrary blocking Go with real sockets; one chip here hosts every instance
+inside a single jitted program, so a sim plan is written as a **cooperative
+state machine**: a per-instance ``init`` and a per-tick ``step``, both
+lifted over the instance axis with ``jax.vmap`` and stepped by the engine
+(:mod:`testground_tpu.sim.engine`) inside ``lax.scan``.
+
+Correspondence with the reference contract:
+
+- blocking test body                → ``step(...)`` called once per simulated
+  tick; "blocking" = remaining in a waiting phase until a condition holds
+- ``SignalEntry(state)``            → set ``StepOut.signals[state_id] = 1``;
+  the 1-based sequence number arrives next tick in ``SyncView.last_seq``
+  (``pkg/sidecar`` ↔ Redis round-trip latency becomes one tick)
+- ``Barrier(state, target)``        → read ``SyncView.counts[state_id] >= target``
+- ``Publish/Subscribe(topic)``      → ``StepOut.pub_valid/pub_payload`` and the
+  ordered ``SyncView.sub_*`` window + ``StepOut.sub_consume`` cursor advance
+- ``network.Client.ConfigureNetwork`` → ``StepOut.net_shape/net_filters`` (+
+  ``*_valid``), applied to the link tensors before the next tick's delivery
+- real sockets on the data network  → bounded outbox/inbox message tensors
+  routed through the link model (:mod:`testground_tpu.sim.net`)
+- ``RecordSuccess/Failure/Crash``   → ``StepOut.status`` ∈ {RUNNING, SUCCESS,
+  FAILURE, CRASH}; first terminal status wins, later steps are masked out
+
+A plan exposes ``sim_testcases: dict[str, type[SimTestcase]]`` from its
+``sim.py`` (or ``main.py``) module; the ``sim:plan`` builder validates the
+entry point and the ``sim:jax`` runner executes it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "RUNNING",
+    "SUCCESS",
+    "FAILURE",
+    "CRASH",
+    "GroupSpec",
+    "SimEnv",
+    "Inbox",
+    "Outbox",
+    "SyncView",
+    "StepOut",
+    "SimTestcase",
+    "FILTER_ACCEPT",
+    "FILTER_REJECT",
+    "FILTER_DROP",
+]
+
+# Instance status codes (reference lifecycle events Success/Failure/Crash,
+# ``pkg/runner/pretty.go:163-175``; RUNNING without a terminal event by run
+# end maps to the PrettyPrinter's "Incomplete").
+RUNNING = 0
+SUCCESS = 1
+FAILURE = 2
+CRASH = 3
+
+# Per-(src instance, dst group) routing filter actions — the tensor analog of
+# the sidecar's per-subnet Accept / Reject(PROHIBIT) / Drop(BLACKHOLE) routing
+# rules (``pkg/sidecar/link.go:187-217``). Both reject and drop suppress
+# delivery; reject additionally surfaces in the sender's ``rejected`` count.
+FILTER_ACCEPT = 0
+FILTER_REJECT = 1
+FILTER_DROP = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupSpec:
+    """Static layout of one group on the instance axis."""
+
+    id: str
+    index: int
+    offset: int  # first global instance index
+    count: int
+    params: dict[str, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class SimEnv:
+    """Per-instance view handed to ``init``/``step`` (under vmap).
+
+    Static (python) fields are identical across the group; array fields are
+    per-instance scalars. The twin of ``runtime.RunEnv`` / RunParams
+    (``pkg/runner/local_docker.go:325-336`` env contract).
+    """
+
+    # --- static, per-run
+    test_plan: str
+    test_case: str
+    test_run: str
+    test_instance_count: int
+    tick_ms: float  # simulated milliseconds per tick
+    groups: tuple[GroupSpec, ...]
+    # --- static, per-group (this instance's group)
+    group: GroupSpec
+    # --- traced, per-instance scalars
+    global_seq: jax.Array  # int32 ∈ [0, N)
+    group_seq: jax.Array  # int32 ∈ [0, group.count)
+    key: jax.Array  # per-instance PRNG key
+
+    # -- typed param accessors (RunEnv.StringParam/IntParam/... parity);
+    # params are static so these resolve at trace time.
+    def string_param(self, name: str) -> str:
+        v = self.group.params.get(name)
+        if v is None:
+            raise KeyError(f"missing param: {name}")
+        return v
+
+    def int_param(self, name: str) -> int:
+        return int(self.string_param(name))
+
+    def float_param(self, name: str) -> float:
+        return float(self.string_param(name))
+
+    def bool_param(self, name: str) -> bool:
+        return self.string_param(name).lower() in ("true", "1", "yes")
+
+    def group_index_of(self, group_id: str) -> int:
+        for g in self.groups:
+            if g.id == group_id:
+                return g.index
+        raise KeyError(f"unknown group: {group_id}")
+
+    def group_offset_of(self, group_id: str) -> int:
+        return self.groups[self.group_index_of(group_id)].offset
+
+    def ms_to_ticks(self, ms: float) -> int:
+        """Convert simulated milliseconds to whole ticks (≥1)."""
+        return max(1, round(ms / self.tick_ms))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Inbox:
+    """Messages arriving at this instance this tick (fixed shape).
+
+    Per instance (inside vmap): ``payload [MSG_WIDTH, IN_MSGS] int32``
+    (word-major: ``payload[w]`` is word w of every slot — the layout keeps
+    the big instance axis minor on-device, see ``net.py``),
+    ``src [IN_MSGS] int32``, ``valid [IN_MSGS] bool``.
+    """
+
+    payload: jax.Array
+    src: jax.Array
+    valid: jax.Array
+
+    def word(self, w: int) -> jax.Array:
+        """Payload word ``w`` across slots: ``[IN_MSGS] int32``."""
+        return self.payload[w]
+
+    @property
+    def count(self) -> jax.Array:
+        return jnp.sum(self.valid.astype(jnp.int32), axis=-1)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Outbox:
+    """Messages this instance emits this tick (fixed shape).
+
+    Per instance: ``dst [OUT_MSGS] int32`` (global instance index),
+    ``payload [OUT_MSGS, MSG_WIDTH] int32``, ``valid [OUT_MSGS] bool``.
+    """
+
+    dst: jax.Array
+    payload: jax.Array
+    valid: jax.Array
+
+    @staticmethod
+    def empty(out_msgs: int, msg_width: int) -> "Outbox":
+        return Outbox(
+            dst=jnp.zeros((out_msgs,), jnp.int32),
+            payload=jnp.zeros((out_msgs, msg_width), jnp.int32),
+            valid=jnp.zeros((out_msgs,), bool),
+        )
+
+    @staticmethod
+    def single(dst, payload, valid, out_msgs: int, msg_width: int) -> "Outbox":
+        """Convenience: an outbox whose slot 0 carries one message."""
+        ob = Outbox.empty(out_msgs, msg_width)
+        pay = jnp.asarray(payload, jnp.int32)
+        pay = jnp.concatenate(
+            [pay, jnp.zeros((msg_width - pay.shape[0],), jnp.int32)]
+        )
+        return Outbox(
+            dst=ob.dst.at[0].set(jnp.asarray(dst, jnp.int32)),
+            payload=ob.payload.at[0].set(pay),
+            valid=ob.valid.at[0].set(jnp.asarray(valid, bool)),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SyncView:
+    """Global coordination state visible to an instance at tick start.
+
+    Per instance (inside vmap):
+    - ``counts [S] int32`` — value of each declared state counter
+      (``sync.Client.Barrier`` reads these; S = len(STATES))
+    - ``last_seq [S] int32`` — 1-based sequence number returned for this
+      instance's most recent signal on each state, 0 if it never signalled
+      (``SignalEntry`` return value, one tick delayed)
+    - ``sub_payload [T, SUB_K, PUB_WIDTH] int32`` / ``sub_valid [T, SUB_K]``
+      — the next SUB_K entries of each topic stream past this instance's
+      read cursor, in publish order (``Subscribe`` window)
+    - ``rejected int32`` — count of this instance's messages suppressed by a
+      REJECT filter last tick (the PROHIBIT-route "connection refused"
+      signal a reference sender observes)
+    """
+
+    counts: jax.Array
+    last_seq: jax.Array
+    sub_payload: jax.Array
+    sub_valid: jax.Array
+    rejected: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StepOut:
+    """Everything a step may do. Use :meth:`SimTestcase.out` to build one
+    with defaults."""
+
+    state: Any
+    status: jax.Array  # int32 scalar ∈ {RUNNING, SUCCESS, FAILURE, CRASH}
+    outbox: Outbox
+    signals: jax.Array  # [S] int32 0/1 — SignalEntry per declared state
+    pub_payload: jax.Array  # [T, PUB_WIDTH] int32
+    pub_valid: jax.Array  # [T] bool
+    sub_consume: jax.Array  # [T] int32 — advance read cursor by k ≤ SUB_K
+    net_shape: jax.Array  # [7] float32 — new egress LinkShape
+    net_shape_valid: jax.Array  # bool — apply net_shape this tick
+    net_filters: jax.Array  # [G] int32 — per-dst-group filter actions
+    net_filters_valid: jax.Array  # bool
+
+
+class SimTestcase:
+    """Base class for sim testcases.
+
+    Class attributes size every tensor (all static at trace time):
+    - ``STATES``: sync state names usable in signals/counts
+    - ``TOPICS``: pubsub topic names
+    - ``MSG_WIDTH`` / ``OUT_MSGS`` / ``IN_MSGS``: point-to-point message shape
+    - ``PUB_WIDTH`` / ``SUB_K`` / ``TOPIC_CAP``: pubsub stream shape
+    - ``MAX_LINK_TICKS``: calendar-queue horizon — the max deliverable
+      latency+jitter in ticks (messages beyond it clamp to the horizon)
+    """
+
+    STATES: ClassVar[list[str]] = []
+    TOPICS: ClassVar[list[str]] = []
+    MSG_WIDTH: ClassVar[int] = 4
+    OUT_MSGS: ClassVar[int] = 1
+    IN_MSGS: ClassVar[int] = 4
+    PUB_WIDTH: ClassVar[int] = 4
+    SUB_K: ClassVar[int] = 4
+    TOPIC_CAP: ClassVar[int] = 256
+    MAX_LINK_TICKS: ClassVar[int] = 256
+    # TRACK_SRC=False drops the sender-id plane from the calendar (the
+    # inbox's ``src`` reads as 0) — one less O(L·N·SLOTS) store per tick
+    # for plans that never look at message provenance.
+    TRACK_SRC: ClassVar[bool] = True
+    # SLOT_MODE picks how same-tick messages to one receiver share inbox
+    # slots:
+    # - "sorted" (default, fully general): messages are sorted by
+    #   (arrival, dst) and ranked, so any fan-in up to IN_MSGS works and
+    #   overflow drops deterministically.
+    # - "direct": slot = the sender's outbox index; skips the sort
+    #   entirely (the dominant per-tick cost at 100k instances). Only
+    #   valid when the traffic pattern guarantees at most ONE sender per
+    #   (receiver, outbox-slot, tick) — pairwise or ring topologies —
+    #   and ignores duplicate-shaping. Colliding sends are undefined.
+    SLOT_MODE: ClassVar[str] = "sorted"
+    # Which LinkShape features this plan's network configs may exercise.
+    # Features not declared are compiled out of the transport (their RNG
+    # draws and gathers disappear): a latency-only plan pays nothing for
+    # loss/corrupt/reorder/duplicate machinery. "filters" covers the
+    # Accept/Reject/Drop table.
+    SHAPING: ClassVar[tuple] = (
+        "latency",
+        "jitter",
+        "bandwidth",
+        "loss",
+        "corrupt",
+        "reorder",
+        "duplicate",
+        "filters",
+    )
+    DEFAULT_LINK: ClassVar[tuple[float, ...]] = (
+        1.0,  # latency ms (a real bridge hop is ~O(0.05ms); 1 tick floor)
+        0.0,  # jitter ms
+        0.0,  # bandwidth, bytes/s (0 = unlimited)
+        0.0,  # loss %
+        0.0,  # corrupt %
+        0.0,  # reorder %
+        0.0,  # duplicate %
+    )
+
+    def state_id(self, name: str) -> int:
+        return type(self).STATES.index(name)
+
+    def topic_id(self, name: str) -> int:
+        return type(self).TOPICS.index(name)
+
+    # ------------------------------------------------------------ plan hooks
+
+    def init(self, env: SimEnv) -> Any:
+        """Per-instance initial state pytree (vmapped)."""
+        return {}
+
+    def step(
+        self,
+        env: SimEnv,
+        state: Any,
+        inbox: Inbox,
+        sync: SyncView,
+        t: jax.Array,
+    ) -> StepOut:
+        """One simulated tick for one instance (vmapped). Must be traceable:
+        no data-dependent python control flow — use jnp.where / lax.cond."""
+        raise NotImplementedError
+
+    # --------------------------------------------------------------- helpers
+
+    def out(
+        self,
+        state: Any,
+        status=RUNNING,
+        outbox: Outbox | None = None,
+        signals: jax.Array | None = None,
+        pub_payload=None,
+        pub_valid=None,
+        sub_consume=None,
+        net_shape=None,
+        net_shape_valid=False,
+        net_filters=None,
+        net_filters_valid=False,
+    ) -> StepOut:
+        cls = type(self)
+        s, tt, g = len(cls.STATES), len(cls.TOPICS), None
+        return StepOut(
+            state=state,
+            status=jnp.asarray(status, jnp.int32),
+            outbox=outbox
+            if outbox is not None
+            else Outbox.empty(cls.OUT_MSGS, cls.MSG_WIDTH),
+            signals=jnp.zeros((s,), jnp.int32)
+            if signals is None
+            else jnp.asarray(signals, jnp.int32),
+            pub_payload=jnp.zeros((tt, cls.PUB_WIDTH), jnp.int32)
+            if pub_payload is None
+            else jnp.asarray(pub_payload, jnp.int32),
+            pub_valid=jnp.zeros((tt,), bool)
+            if pub_valid is None
+            else jnp.asarray(pub_valid, bool),
+            sub_consume=jnp.zeros((tt,), jnp.int32)
+            if sub_consume is None
+            else jnp.asarray(sub_consume, jnp.int32),
+            net_shape=jnp.zeros((7,), jnp.float32)
+            if net_shape is None
+            else jnp.asarray(net_shape, jnp.float32),
+            net_shape_valid=jnp.asarray(net_shape_valid, bool),
+            net_filters=jnp.zeros((0,), jnp.int32)
+            if net_filters is None
+            else jnp.asarray(net_filters, jnp.int32),
+            net_filters_valid=jnp.asarray(net_filters_valid, bool),
+        )
+
+    def signal(self, *names: str) -> jax.Array:
+        """One-hot(ish) signals vector for the named states."""
+        sig = jnp.zeros((len(type(self).STATES),), jnp.int32)
+        for n in names:
+            sig = sig.at[self.state_id(n)].set(1)
+        return sig
+
+    def link_shape(
+        self,
+        latency_ms=0.0,
+        jitter_ms=0.0,
+        bandwidth=0.0,
+        loss=0.0,
+        corrupt=0.0,
+        reorder=0.0,
+        duplicate=0.0,
+    ) -> jax.Array:
+        """Build a LinkShape vector (``network.LinkShape`` field order,
+        ``pkg/sidecar/link.go:155-183``)."""
+        return jnp.stack(
+            [
+                jnp.asarray(x, jnp.float32)
+                for x in (
+                    latency_ms,
+                    jitter_ms,
+                    bandwidth,
+                    loss,
+                    corrupt,
+                    reorder,
+                    duplicate,
+                )
+            ]
+        )

@@ -1,0 +1,446 @@
+"""The sim engine: compiles a (testcase × groups) configuration into one
+jitted tick program and steps it to completion.
+
+This replaces the reference's entire execution substrate — container
+scheduling, sidecar shaping, Redis sync (SURVEY.md §1 L1/L2) — with a
+single SPMD program:
+
+- every instance's ``step`` is lifted with ``jax.vmap`` (one vmap per
+  group, so per-group params stay static and state pytrees may differ in
+  shape across groups);
+- a tick = deliver messages → vmapped steps → enqueue sends → fold sync
+  counters/streams → apply network reconfigs; ``lax.scan`` runs CHUNK
+  ticks per dispatch and the host polls a scalar ``done`` flag between
+  chunks (no per-tick host sync);
+- the instance axis shards over a ``jax.sharding.Mesh`` axis ``"i"``:
+  states/status/link rows shard by instance, the calendar by destination,
+  sync counters/streams stay replicated. XLA inserts the cross-shard
+  collectives for message scatter — the ICI analog of the reference's
+  data-network traffic.
+
+Terminal instances are frozen: their state stops updating and their sends/
+signals/publishes are masked, mirroring a container that has exited.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .api import (
+    RUNNING,
+    GroupSpec,
+    Inbox,
+    Outbox,
+    SimEnv,
+    SimTestcase,
+    StepOut,
+    SyncView,
+)
+from .net import (
+    Calendar,
+    LinkState,
+    apply_net_updates,
+    deliver,
+    enqueue,
+    make_link_state,
+)
+from .sync_kernel import (
+    SyncState,
+    make_sub_window,
+    make_sync_state,
+    update_sync,
+)
+
+__all__ = ["SimCarry", "SimProgram", "build_groups"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SimCarry:
+    """Everything that evolves across ticks (donated between chunks)."""
+
+    states: tuple  # per-group state pytrees, leading axis = group count
+    status: jax.Array  # [N] int32
+    finished_at: jax.Array  # [N] int32 — tick of terminal status (-1 if none)
+    cal: Calendar
+    link: LinkState
+    sync: SyncState
+    rejected: jax.Array  # [N] int32 — REJECT feedback from last tick
+    keys: jax.Array  # [N] per-instance PRNG keys
+    net_key: jax.Array  # link-model PRNG key
+    t: jax.Array  # int32 current tick
+
+
+def build_groups(run_groups, parameters_of=None) -> tuple[GroupSpec, ...]:
+    """Lay groups out contiguously on the instance axis (the sim analog of
+    the per-group container batches, ``local_docker.go:375-463``)."""
+    specs = []
+    off = 0
+    for i, g in enumerate(run_groups):
+        params = dict(g.parameters) if parameters_of is None else parameters_of(g)
+        specs.append(
+            GroupSpec(
+                id=g.id, index=i, offset=off, count=g.instances, params=params
+            )
+        )
+        off += g.instances
+    return tuple(specs)
+
+
+class SimProgram:
+    def __init__(
+        self,
+        testcase: SimTestcase,
+        groups: tuple[GroupSpec, ...],
+        *,
+        test_plan: str = "plan",
+        test_case: str = "case",
+        test_run: str = "run",
+        tick_ms: float = 1.0,
+        mesh: jax.sharding.Mesh | None = None,
+        chunk: int = 128,
+    ):
+        self.tc = testcase
+        self.groups = groups
+        self.n = sum(g.count for g in groups)
+        self.tick_ms = float(tick_ms)
+        self.mesh = mesh
+        self.chunk = int(chunk)
+        self.meta = dict(
+            test_plan=test_plan, test_case=test_case, test_run=test_run
+        )
+        cls = type(testcase)
+        self.n_states = len(cls.STATES)
+        self.n_topics = len(cls.TOPICS)
+        self._group_of = jnp.asarray(
+            np.repeat(
+                np.arange(len(groups), dtype=np.int32),
+                [g.count for g in groups],
+            )
+        )
+        self._chunk_fn: Callable | None = None
+
+    # ------------------------------------------------------------ sharding
+
+    def _ishard(self, axis: int = 0):
+        """NamedSharding placing the instance axis on mesh axis 'i'."""
+        if self.mesh is None:
+            return None
+        spec = [None] * (axis + 1)
+        spec[axis] = "i"
+        return jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(*spec)
+        )
+
+    def _constrain(self, carry: SimCarry) -> SimCarry:
+        if self.mesh is None:
+            return carry
+        wsc = jax.lax.with_sharding_constraint
+        return dataclasses.replace(
+            carry,
+            status=wsc(carry.status, self._ishard(0)),
+            finished_at=wsc(carry.finished_at, self._ishard(0)),
+            cal=Calendar(
+                payload=tuple(
+                    wsc(p, self._ishard(1)) for p in carry.cal.payload
+                ),
+                src=wsc(carry.cal.src, self._ishard(1))
+                if carry.cal.src is not None
+                else None,
+                valid=wsc(carry.cal.valid, self._ishard(1)),
+                slots=carry.cal.slots,
+            ),
+            link=LinkState(
+                egress=wsc(carry.link.egress, self._ishard(1)),
+                filters=wsc(carry.link.filters, self._ishard(1)),
+            ),
+            rejected=wsc(carry.rejected, self._ishard(0)),
+        )
+
+    # ---------------------------------------------------------------- init
+
+    def _env_for(self, gspec: GroupSpec, gs, gseq, key) -> SimEnv:
+        return SimEnv(
+            test_plan=self.meta["test_plan"],
+            test_case=self.meta["test_case"],
+            test_run=self.meta["test_run"],
+            test_instance_count=self.n,
+            tick_ms=self.tick_ms,
+            groups=self.groups,
+            group=gspec,
+            global_seq=gs,
+            group_seq=gseq,
+            key=key,
+        )
+
+    def init_carry(self, seed: int = 0) -> SimCarry:
+        cls = type(self.tc)
+        root = jax.random.key(seed)
+        net_key, inst_root = jax.random.split(root)
+        keys = jax.random.split(inst_root, self.n)
+
+        states = []
+        for g in self.groups:
+            gs = jnp.arange(g.offset, g.offset + g.count, dtype=jnp.int32)
+            gseq = jnp.arange(g.count, dtype=jnp.int32)
+            gkeys = keys[g.offset : g.offset + g.count]
+
+            def init_one(gs_, gseq_, k_, _g=g):
+                return self.tc.init(self._env_for(_g, gs_, gseq_, k_))
+
+            states.append(jax.vmap(init_one)(gs, gseq, gkeys))
+
+        carry = SimCarry(
+            states=tuple(states),
+            status=jnp.full((self.n,), RUNNING, jnp.int32),
+            finished_at=jnp.full((self.n,), -1, jnp.int32),
+            cal=Calendar.empty(
+                cls.MAX_LINK_TICKS,
+                self.n,
+                cls.IN_MSGS,
+                cls.MSG_WIDTH,
+                track_src=cls.TRACK_SRC,
+            ),
+            link=make_link_state(self.n, len(self.groups), cls.DEFAULT_LINK),
+            sync=make_sync_state(
+                self.n, self.n_states, self.n_topics, cls.TOPIC_CAP, cls.PUB_WIDTH
+            ),
+            rejected=jnp.zeros((self.n,), jnp.int32),
+            keys=keys,
+            net_key=net_key,
+            t=jnp.int32(0),
+        )
+        if self.mesh is not None:
+            carry = jax.jit(self._constrain)(carry)
+        return carry
+
+    # ---------------------------------------------------------------- tick
+
+    def _tick(self, carry: SimCarry) -> SimCarry:
+        cls = type(self.tc)
+        t = carry.t
+        cal, inbox_all = deliver(carry.cal, t)
+        sub_payload, sub_valid = make_sub_window(carry.sync, cls.SUB_K)
+        env_keys = jax.vmap(jax.random.fold_in)(
+            carry.keys, jnp.broadcast_to(t, (self.n,))
+        )
+
+        outs: list[StepOut] = []
+        for gi, g in enumerate(self.groups):
+            lo, hi = g.offset, g.offset + g.count
+            gs = jnp.arange(lo, hi, dtype=jnp.int32)
+            gseq = jnp.arange(g.count, dtype=jnp.int32)
+            inbox_g = Inbox(
+                payload=inbox_all.payload[:, :, lo:hi],
+                src=inbox_all.src[:, lo:hi],
+                valid=inbox_all.valid[:, lo:hi],
+            )
+            sync_g = SyncView(
+                counts=carry.sync.counts,
+                last_seq=carry.sync.last_seq[:, lo:hi],
+                sub_payload=sub_payload[lo:hi],
+                sub_valid=sub_valid[lo:hi],
+                rejected=carry.rejected[lo:hi],
+            )
+
+            def step_one(gs_, gseq_, k_, state_, inbox_, syncv_, _g=g):
+                env = self._env_for(_g, gs_, gseq_, k_)
+                return self.tc.step(env, state_, inbox_, syncv_, t)
+
+            # Outputs come back in plane layout (instance axis LAST via
+            # out_axes=-1) so downstream kernels never touch an array whose
+            # minor dim is a small message axis (see net.py layout rule).
+            out = jax.vmap(
+                step_one,
+                in_axes=(
+                    0,
+                    0,
+                    0,
+                    0,
+                    Inbox(payload=2, src=1, valid=1),
+                    SyncView(
+                        counts=None,
+                        last_seq=1,  # stored [S, N]: instance axis is 1
+                        sub_payload=0,
+                        sub_valid=0,
+                        rejected=0,
+                    ),
+                ),
+                out_axes=StepOut(
+                    state=0,
+                    status=0,
+                    outbox=Outbox(dst=-1, payload=-1, valid=-1),
+                    signals=-1,
+                    pub_payload=-1,
+                    pub_valid=-1,
+                    sub_consume=-1,
+                    net_shape=-1,
+                    net_shape_valid=0,
+                    net_filters=-1,
+                    net_filters_valid=0,
+                ),
+            )(gs, gseq, env_keys[lo:hi], carry.states[gi], inbox_g, sync_g)
+            outs.append(out)
+
+        # --- merge per-group outputs along the instance axis, masking
+        # instances that already terminated (frozen like exited containers).
+        active = carry.status == RUNNING  # [N]
+
+        def freeze(old_leaf, new_leaf, lo, hi):
+            a = active[lo:hi]
+            a = a.reshape(a.shape + (1,) * (new_leaf.ndim - 1))
+            return jnp.where(a, new_leaf, old_leaf)
+
+        new_states = tuple(
+            jax.tree.map(
+                partial(freeze, lo=g.offset, hi=g.offset + g.count),
+                carry.states[gi],
+                outs[gi].state,
+            )
+            for gi, g in enumerate(self.groups)
+        )
+
+        def cat0(getter):
+            return jnp.concatenate([getter(o) for o in outs], axis=0)
+
+        def catl(getter):  # plane fields: instance axis is last
+            return jnp.concatenate([getter(o) for o in outs], axis=-1)
+
+        status_new = cat0(lambda o: o.status)
+        status = jnp.where(active, status_new, carry.status)
+        finished_at = jnp.where(
+            active & (status_new != RUNNING), t, carry.finished_at
+        )
+
+        dst = catl(lambda o: o.outbox.dst)  # [O, N]
+        payload = catl(lambda o: o.outbox.payload)  # [O, W, N]
+        valid = catl(lambda o: o.outbox.valid) & active[None, :]
+
+        active_row = active[None, :]
+        signals = catl(lambda o: o.signals) * active_row.astype(jnp.int32)
+        pub_payload = catl(lambda o: o.pub_payload)  # [T, PW, N]
+        pub_valid = catl(lambda o: o.pub_valid) & active_row
+        sub_consume = catl(lambda o: o.sub_consume) * active_row.astype(
+            jnp.int32
+        )
+
+        net_key, k_msg = jax.random.split(carry.net_key)
+        cal, rejected = enqueue(
+            cal,
+            carry.link,
+            self._group_of,
+            dst,
+            payload,
+            valid,
+            t,
+            self.tick_ms,
+            k_msg,
+            slot_mode=type(self.tc).SLOT_MODE,
+            features=tuple(type(self.tc).SHAPING),
+        )
+        sync = update_sync(
+            carry.sync, signals, pub_payload, pub_valid, sub_consume
+        )
+
+        net_shape = catl(lambda o: o.net_shape)  # [7, N]
+        net_shape_valid = cat0(lambda o: o.net_shape_valid) & active
+        n_groups = len(self.groups)
+        if any(o.net_filters.shape[0] == n_groups for o in outs):
+            # Groups may differ: ones emitting the 0-width sentinel get a
+            # zero plane with valid=False so the concat stays rectangular.
+            planes, valids = [], []
+            for gi, o in enumerate(outs):
+                count = self.groups[gi].count
+                if o.net_filters.shape[0] == n_groups:
+                    planes.append(o.net_filters)
+                    valids.append(o.net_filters_valid)
+                else:
+                    planes.append(jnp.zeros((n_groups, count), jnp.int32))
+                    valids.append(jnp.zeros((count,), bool))
+            net_filters = jnp.concatenate(planes, axis=-1)  # [G, N]
+            net_filters_valid = jnp.concatenate(valids, axis=0) & active
+        else:  # no group drives filters (0-width sentinel)
+            net_filters = jnp.zeros((n_groups, self.n), jnp.int32)
+            net_filters_valid = jnp.zeros((self.n,), bool)
+        link = apply_net_updates(
+            carry.link, net_shape, net_shape_valid, net_filters, net_filters_valid
+        )
+
+        return self._constrain(
+            SimCarry(
+                states=new_states,
+                status=status,
+                finished_at=finished_at,
+                cal=cal,
+                link=link,
+                sync=sync,
+                rejected=rejected,
+                keys=carry.keys,
+                net_key=net_key,
+                t=t + 1,
+            )
+        )
+
+    # ----------------------------------------------------------- execution
+
+    def _chunk_step(self, carry: SimCarry):
+        """Run up to `chunk` ticks; ticks after global completion no-op."""
+
+        def body(c, _):
+            done = jnp.all(c.status != RUNNING)
+            c = jax.lax.cond(done, lambda x: x, self._tick, c)
+            return c, None
+
+        carry, _ = jax.lax.scan(body, carry, None, length=self.chunk)
+        return carry, jnp.all(carry.status != RUNNING)
+
+    def compiled_chunk(self):
+        if self._chunk_fn is None:
+            self._chunk_fn = jax.jit(self._chunk_step, donate_argnums=0)
+        return self._chunk_fn
+
+    def run(
+        self,
+        seed: int = 0,
+        max_ticks: int = 10_000,
+        cancel=None,
+        on_chunk: Callable[[int], None] | None = None,
+    ) -> dict[str, Any]:
+        """Step to completion. Returns host-side results:
+
+        status [N], finished_at [N], ticks run, final per-group states,
+        sync counters and journal counters.
+        """
+        # init is traceable; jit it so construction is one dispatch rather
+        # than hundreds of eager ops (matters on remote-tunneled devices).
+        carry = jax.jit(lambda: self.init_carry(seed))()
+        fn = self.compiled_chunk()
+        ticks = 0
+        while ticks < max_ticks:
+            carry, done = fn(carry)
+            ticks += self.chunk
+            if on_chunk is not None:
+                on_chunk(ticks)
+            if bool(done):  # one scalar device→host sync per chunk
+                break
+            if cancel is not None and cancel.is_set():
+                break
+        return self.results(carry, ticks)
+
+    def results(self, carry: SimCarry, ticks: int) -> dict[str, Any]:
+        return {
+            "status": np.asarray(carry.status),
+            "finished_at": np.asarray(carry.finished_at),
+            "ticks": ticks,
+            "tick_ms": self.tick_ms,
+            "states": jax.tree.map(np.asarray, carry.states),
+            "sync_counts": np.asarray(carry.sync.counts),
+            "pub_dropped": np.asarray(carry.sync.dropped),
+            "groups": self.groups,
+        }

@@ -1,15 +1,263 @@
-"""Host-side driver for a sim run (placeholder; filled in with the sim
-kernel milestone)."""
+"""Host-side driver for a ``sim:jax`` run.
+
+The sim analog of ``LocalDockerRunner.Run`` (``pkg/runner/local_docker.go:
+280-683``): where the reference creates a data network, boots one container
+per instance, tails logs and collects sync events, this driver loads the
+plan's sim module, compiles a :class:`~testground_tpu.sim.engine.SimProgram`
+for the composition's groups, steps it to completion on the device mesh,
+then writes the same outputs layout and Result the control plane expects.
+"""
 
 from __future__ import annotations
 
+import dataclasses
+import importlib.util
+import json
+import os
+import sys
 import threading
+import time
+import uuid
+
+import numpy as np
 
 from testground_tpu.api import RunInput, RunOutput
+from testground_tpu.engine.task import Outcome
 from testground_tpu.rpc import OutputWriter
+
+from testground_tpu.runners.outputs import instance_output_dir
+from testground_tpu.runners.result import Result
+
+__all__ = ["SimJaxConfig", "execute_sim_run", "load_sim_testcases"]
+
+# Map sim status codes → lifecycle event names (pretty.go:163-175).
+_STATUS_NAME = {0: "incomplete", 1: "success", 2: "failure", 3: "crash"}
+
+
+@dataclasses.dataclass
+class SimJaxConfig:
+    """Runner config for ``sim:jax`` (coalesced like LocalDockerConfig)."""
+
+    tick_ms: float = 1.0  # simulated ms per tick
+    max_ticks: int = 100_000  # sim-time budget (the 10-min task timeout analog)
+    chunk: int = 128  # ticks per device dispatch
+    seed: int = 0
+    shard: bool = True  # shard instance axis over available devices
+    write_outputs_max: int = 2048  # cap on per-instance output dirs
+    keep_outputs: bool = True
+
+
+def load_sim_testcases(artifact_path: str) -> dict:
+    """Import the plan's sim module and return its ``sim_testcases`` map."""
+    entry = None
+    for name in ("sim.py", "main.py"):
+        cand = os.path.join(artifact_path, name)
+        if os.path.isfile(cand):
+            entry = cand
+            break
+    if entry is None:
+        raise FileNotFoundError(
+            f"no sim.py/main.py entry point in {artifact_path}"
+        )
+    modname = f"tg_sim_plan_{uuid.uuid4().hex[:8]}"
+    spec = importlib.util.spec_from_file_location(modname, entry)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(modname, None)
+    cases = getattr(mod, "sim_testcases", None)
+    if not isinstance(cases, dict) or not cases:
+        raise ValueError(
+            f"plan module {entry} does not export a non-empty "
+            "`sim_testcases` dict"
+        )
+    return cases
+
+
+def _make_mesh(shard: bool):
+    import jax
+
+    devs = jax.devices()
+    if not shard or len(devs) <= 1:
+        return None
+    return jax.sharding.Mesh(np.asarray(devs), ("i",))
 
 
 def execute_sim_run(
     job: RunInput, ow: OutputWriter, cancel: threading.Event
 ) -> RunOutput:
-    raise NotImplementedError("sim:jax executor lands with the sim kernel")
+    from .engine import SimProgram, build_groups
+
+    cfg = job.runner_config or SimJaxConfig()
+
+    artifact = job.groups[0].artifact_path
+    cases = load_sim_testcases(artifact)
+    factory = cases.get(job.test_case)
+    if factory is None:
+        raise ValueError(
+            f"unknown sim test case {job.test_case!r}; plan exposes "
+            f"{sorted(cases)}"
+        )
+    testcase = factory() if isinstance(factory, type) else factory
+
+    groups = build_groups(job.groups)
+    n = sum(g.count for g in groups)
+    mesh = _make_mesh(cfg.shard)
+    ow.infof(
+        "sim:jax run %s: plan=%s case=%s instances=%d groups=%d "
+        "tick=%.3fms devices=%s",
+        job.run_id,
+        job.test_plan,
+        job.test_case,
+        n,
+        len(groups),
+        cfg.tick_ms,
+        mesh.devices.size if mesh is not None else 1,
+    )
+
+    prog = SimProgram(
+        testcase,
+        groups,
+        test_plan=job.test_plan,
+        test_case=job.test_case,
+        test_run=job.run_id,
+        tick_ms=cfg.tick_ms,
+        mesh=mesh,
+        chunk=cfg.chunk,
+    )
+
+    t0 = time.time()
+    last_report = [t0]
+
+    def on_chunk(ticks: int) -> None:
+        now = time.time()
+        if now - last_report[0] >= 5.0:
+            last_report[0] = now
+            ow.infof(
+                "sim:jax %s: %d ticks (%.1f sim-s) in %.1fs wall",
+                job.run_id,
+                ticks,
+                ticks * cfg.tick_ms / 1000.0,
+                now - t0,
+            )
+
+    res = prog.run(
+        seed=cfg.seed, max_ticks=cfg.max_ticks, cancel=cancel, on_chunk=on_chunk
+    )
+    wall = time.time() - t0
+    status = res["status"]
+    ow.infof(
+        "sim:jax %s: done — %d ticks in %.2fs wall (%.0f instance·ticks/s)",
+        job.run_id,
+        res["ticks"],
+        wall,
+        n * res["ticks"] / max(wall, 1e-9),
+    )
+
+    # ------------------------------------------------ outcomes + outputs
+    result = Result.for_input(job)
+    result.journal["events"] = {}
+    outputs_root = job.env.dirs.outputs() if job.env is not None else None
+    write_outputs = (
+        outputs_root is not None and n <= cfg.write_outputs_max
+    )
+
+    metrics = {}
+    collect = getattr(testcase, "collect_metrics", None)
+    if callable(collect):
+        for gi, g in enumerate(groups):
+            try:
+                metrics[g.id] = collect(
+                    g,
+                    _tree_slice(res["states"][gi]),
+                    status[g.offset : g.offset + g.count],
+                )
+            except Exception as e:  # noqa: BLE001 — metrics are best-effort
+                ow.warn("collect_metrics failed for group %s: %s", g.id, e)
+
+    for gi, g in enumerate(groups):
+        st = status[g.offset : g.offset + g.count]
+        ok = int(np.sum(st == 1))
+        result.outcomes[g.id].ok = ok
+        counts = {
+            name: int(np.sum(st == code)) for code, name in _STATUS_NAME.items()
+        }
+        result.journal["events"][g.id] = counts
+        ow.infof(
+            "group %s: %d/%d ok (%s)",
+            g.id,
+            ok,
+            g.count,
+            ", ".join(f"{k}={v}" for k, v in counts.items() if v),
+        )
+        if write_outputs:
+            _write_instance_outputs(
+                outputs_root, job, g, st, res, metrics.get(g.id)
+            )
+
+    result.journal["sim"] = {
+        "ticks": res["ticks"],
+        "tick_ms": cfg.tick_ms,
+        "wall_secs": wall,
+        "devices": int(mesh.devices.size) if mesh is not None else 1,
+        "pub_dropped": res["pub_dropped"].tolist(),
+    }
+    result.update_outcome()
+    if cancel.is_set():
+        result.outcome = Outcome.CANCELED
+    return RunOutput(run_id=job.run_id, result=result)
+
+
+def _tree_slice(state_group):
+    """Per-group states are already host numpy pytrees; identity hook kept
+    for future lazy device slicing."""
+    return state_group
+
+
+def _write_instance_outputs(
+    outputs_root, job, g, st, res, group_metrics
+) -> None:
+    """Write the reference's outputs layout (``local_docker.go:258-267``):
+    one dir per instance with run.out / metrics.out."""
+    for i in range(g.count):
+        d = instance_output_dir(
+            outputs_root, job.test_plan, job.run_id, g.id, i
+        )
+        os.makedirs(d, exist_ok=True)
+        name = _STATUS_NAME.get(int(st[i]), "incomplete")
+        fin = int(res["finished_at"][g.offset + i])
+        with open(os.path.join(d, "run.out"), "w") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "ts": time.time_ns(),
+                        "event": {
+                            "type": name if name != "incomplete" else "message",
+                            **(
+                                {"message": "incomplete (max_ticks reached)"}
+                                if name == "incomplete"
+                                else {}
+                            ),
+                        },
+                        "group_id": g.id,
+                        "finished_at_tick": fin,
+                    }
+                )
+                + "\n"
+            )
+        if group_metrics:
+            with open(os.path.join(d, "metrics.out"), "w") as f:
+                for mname, arr in group_metrics.items():
+                    f.write(
+                        json.dumps(
+                            {
+                                "ts": time.time_ns(),
+                                "name": mname,
+                                "value": float(np.asarray(arr)[i]),
+                                "type": "point",
+                            }
+                        )
+                        + "\n"
+                    )

@@ -1,0 +1,381 @@
+"""The simulated data network: link shaping + message transport as tensors.
+
+TPU-native re-expression of the sidecar's kernel dataplane
+(``pkg/sidecar/link.go`` HTB+netem tree, ``route.go`` policies — SURVEY.md
+§2.4.1/§2.5): instead of shaping real packets with tc, every in-flight
+message lives in a fixed-shape **calendar queue** indexed by arrival tick,
+and the ``LinkShape`` knobs become arithmetic applied at send time:
+
+- latency/jitter  → arrival bucket = (t + ticks(latency + U·jitter)) % L
+- bandwidth       → per-src cap on messages admitted per tick
+- loss%           → Bernoulli drop mask
+- corrupt%        → XOR a random bit into payload word 0
+- reorder%        → message skips the latency queue (netem's gap semantics)
+- duplicate%      → second copy enqueued one tick later
+- subnet filters  → per-(src, dst-group) Accept/Reject/Drop table
+  (``link.go:187-217`` PROHIBIT/BLACKHOLE routes); Reject feeds back into
+  the sender's ``rejected`` count next tick
+
+Everything is static-shape: delivery is one dynamic-index row gather; sends
+are sort + segmented-rank + scatter over the N·OUT_MSGS(·2 for duplicates)
+flattened message axis. The instance axis shards over the device mesh; XLA
+turns the cross-shard scatter into collective traffic on ICI.
+
+**Layout rule** (the perf-critical design decision): every big tensor keeps
+its LARGE axis (N or N·SLOTS) minor/last, and multi-word payloads are
+stored as separate 2-D planes rather than a trailing word axis. TPU tiled
+layouts pad the two minor dims to (8, 128), so a [..., W=4]-shaped array is
+physically ~32× its logical size and every touch of it moves gigabytes;
+vmapping a scatter over a leading plane axis also inserts whole-array
+layout-conversion copies. Positions on the N·SLOTS axis are encoded
+``slot·N + dst`` so a bucket row reshapes to [SLOTS, N] with N still minor.
+Measured effect at 100k instances: ~83 ms/tick → sub-ms with this layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .api import FILTER_ACCEPT, FILTER_REJECT, Inbox
+
+__all__ = ["LinkState", "Calendar", "deliver", "enqueue", "make_link_state"]
+
+# LinkShape plane indices (order of network.LinkShape fields,
+# ``pkg/sidecar/link.go:155-183``).
+LATENCY, JITTER, BANDWIDTH, LOSS, CORRUPT, REORDER, DUPLICATE = range(7)
+
+# Assumed wire size per message for bandwidth accounting (bytes). The
+# reference shapes bits/s on real frames; messages here are fixed-width
+# records, so bandwidth B bytes/s admits B·tick_s/MSG_BYTES msgs per tick.
+MSG_BYTES = 256.0
+
+# Every LinkShape feature (``SimTestcase.SHAPING`` defaults to all).
+FULL_SHAPING = (
+    "latency",
+    "jitter",
+    "bandwidth",
+    "loss",
+    "corrupt",
+    "reorder",
+    "duplicate",
+    "filters",
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LinkState:
+    """Per-instance egress shaping + per-(instance, dst-group) filters.
+
+    egress:  [7, N] float32 — one plane per LinkShape component
+    filters: [G, N] int32 — filter action of instance n toward group g
+    """
+
+    egress: jax.Array
+    filters: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Calendar:
+    """The in-flight message store, bucketed by arrival tick mod L.
+
+    payload: tuple of W planes, each [L, N·SLOTS] int32
+    src:     [L, N·SLOTS] int32
+    valid:   [L, N·SLOTS] bool
+
+    The N·SLOTS axis is ordered slot-major (``pos = slot·N + dst``) so a
+    row reshapes to [SLOTS, N]. ``slots`` is static structure, not data.
+    """
+
+    payload: tuple
+    src: jax.Array | None  # None when the plan opted out (TRACK_SRC=False)
+    valid: jax.Array
+    slots: int = dataclasses.field(metadata=dict(static=True), default=4)
+
+    @staticmethod
+    def empty(
+        horizon: int, n: int, slots: int, width: int, track_src: bool = True
+    ) -> "Calendar":
+        ns = n * slots
+        return Calendar(
+            payload=tuple(
+                jnp.zeros((horizon, ns), jnp.int32) for _ in range(width)
+            ),
+            src=jnp.zeros((horizon, ns), jnp.int32) if track_src else None,
+            valid=jnp.zeros((horizon, ns), bool),
+            slots=slots,
+        )
+
+    @property
+    def width(self) -> int:
+        return len(self.payload)
+
+
+def make_link_state(n: int, n_groups: int, default_shape) -> LinkState:
+    egress = jnp.tile(
+        jnp.asarray(default_shape, jnp.float32)[:, None], (1, n)
+    )
+    filters = jnp.full((n_groups, n), FILTER_ACCEPT, jnp.int32)
+    return LinkState(egress=egress, filters=filters)
+
+
+def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
+    """Pop the bucket arriving at tick ``t`` → inboxes in plane layout
+    (payload [W, SLOTS, N], src/valid [SLOTS, N]); the bucket's valid row
+    is cleared for reuse at t+L (stale payload/src stay, masked)."""
+    horizon, ns = cal.valid.shape
+    slots = cal.slots
+    n = ns // slots
+    b = jnp.mod(t, horizon)
+    rows = [
+        jax.lax.dynamic_index_in_dim(p, b, axis=0, keepdims=False)
+        for p in cal.payload
+    ]
+    row_s = (
+        jax.lax.dynamic_index_in_dim(cal.src, b, axis=0, keepdims=False)
+        if cal.src is not None
+        else jnp.zeros((ns,), jnp.int32)
+    )
+    row_v = jax.lax.dynamic_index_in_dim(cal.valid, b, axis=0, keepdims=False)
+    inbox = Inbox(
+        payload=jnp.stack([r.reshape(slots, n) for r in rows]),
+        src=row_s.reshape(slots, n),
+        valid=row_v.reshape(slots, n),
+    )
+    cal = dataclasses.replace(
+        cal,
+        valid=jax.lax.dynamic_update_index_in_dim(
+            cal.valid, jnp.zeros((ns,), bool), b, axis=0
+        ),
+    )
+    return cal, inbox
+
+
+def enqueue(
+    cal: Calendar,
+    link: LinkState,
+    group_of: jax.Array,  # [N] int32 — dst instance → group index
+    dst: jax.Array,  # [O, N] int32
+    payload: jax.Array,  # [O, W, N] int32
+    valid: jax.Array,  # [O, N] bool
+    t: jax.Array,
+    tick_ms: float,
+    key: jax.Array,
+    slot_mode: str = "sorted",
+    features: tuple = FULL_SHAPING,
+) -> tuple[Calendar, jax.Array]:
+    """Shape + schedule this tick's sends (inputs in plane layout, message
+    m = o·N + src). Returns (cal', rejected[N]).
+
+    rejected[i] counts instance i's messages suppressed by a REJECT filter
+    (surfaced to the sender next tick, mirroring a PROHIBIT route's
+    immediate "connection refused" — ``link.go:196-205``).
+
+    ``slot_mode`` — see ``SimTestcase.SLOT_MODE``: "sorted" (general,
+    sort-based slot ranking) or "direct" (slot = outbox index; no sort, no
+    duplicate-shaping; only for fan-in-free traffic patterns).
+
+    ``features`` — static set of LinkShape features compiled in
+    (``SimTestcase.SHAPING``); undeclared features cost nothing.
+    """
+    horizon, ns = cal.valid.shape
+    slots = cal.slots
+    width = cal.width
+    n = ns // slots
+    o, n_src = valid.shape
+    assert n_src == n
+
+    midx = jnp.arange(o * n, dtype=jnp.int32)
+    src_f = midx if o == 1 else jnp.mod(midx, n)
+    slot_in_src = midx // n  # o index: which of the src's O slots
+    dst_f = dst.reshape(-1)
+    pay_w = [payload[:, w, :].reshape(-1) for w in range(width)]  # W× [M]
+    val_f = valid.reshape(-1)
+    m = val_f.shape[0]
+
+    def eg(plane):  # per-message egress attribute; no gather when O == 1
+        return link.egress[plane] if o == 1 else link.egress[plane][src_f]
+
+    rng_feats = [
+        f
+        for f in ("loss", "jitter", "corrupt", "reorder", "duplicate")
+        if f in features
+    ]
+    ukeys = dict(
+        zip(rng_feats + ["_bit"], jax.random.split(key, len(rng_feats) + 1))
+    )
+
+    def u(feat):
+        return jax.random.uniform(ukeys[feat], (m,))
+
+    dst_safe = jnp.clip(dst_f, 0, n - 1)
+    val_f = val_f & (dst_f >= 0) & (dst_f < n)
+
+    # --- filters: Accept / Reject / Drop per (src, dst group)
+    if "filters" in features:
+        action = link.filters.reshape(-1)[group_of[dst_safe] * n + src_f]
+        rejected_msg = val_f & (action == FILTER_REJECT)
+        val_f = val_f & (action == FILTER_ACCEPT)
+        rejected = jnp.sum(
+            rejected_msg.reshape(o, n).astype(jnp.int32), axis=0
+        )
+    else:
+        rejected = jnp.zeros((n,), jnp.int32)
+
+    # --- bandwidth: admit the first floor(B·tick/MSG_BYTES) msgs per src
+    if "bandwidth" in features:
+        bw = eg(BANDWIDTH)
+        cap = jnp.where(
+            bw <= 0.0,
+            jnp.float32(o),
+            jnp.floor(bw * (tick_ms / 1000.0) / MSG_BYTES),
+        )
+        val_f = val_f & (slot_in_src.astype(jnp.float32) < cap)
+
+    # --- loss
+    if "loss" in features:
+        val_f = val_f & (u("loss") * 100.0 >= eg(LOSS))
+
+    # --- corrupt: flip one random bit of payload word 0
+    if "corrupt" in features:
+        corrupt = u("corrupt") * 100.0 < eg(CORRUPT)
+        bit = jax.random.randint(ukeys["_bit"], (m,), 0, 31)
+        pay_w[0] = jnp.where(
+            corrupt, pay_w[0] ^ (jnp.int32(1) << bit), pay_w[0]
+        )
+
+    # --- latency + jitter → delay in ticks; reorder = skip the queue
+    delay_ms = eg(LATENCY)
+    if "jitter" in features:
+        delay_ms = delay_ms + eg(JITTER) * u("jitter")
+    delay = jnp.ceil(delay_ms / tick_ms).astype(jnp.int32)
+    delay = jnp.clip(delay, 1, horizon - 1)
+    if "reorder" in features:
+        reorder = u("reorder") * 100.0 < eg(REORDER)
+        delay = jnp.where(reorder, 1, delay)
+
+    if slot_mode == "direct":
+        # slot = the sender's outbox index: one scatter index per message
+        # with no sort and no duplicate pass. Unique under the mode's
+        # contract (≤1 sender per (receiver, slot, tick)).
+        if o > slots:
+            raise ValueError(
+                f"direct slot mode needs OUT_MSGS ({o}) <= IN_MSGS ({slots})"
+            )
+        buck_i = jnp.where(val_f, jnp.mod(t + delay, horizon), jnp.int32(horizon))
+        pos_i = jnp.where(val_f, slot_in_src * n + dst_safe, midx)
+        new_payload = tuple(
+            p.at[buck_i, pos_i].set(pw, mode="drop", unique_indices=True)
+            for p, pw in zip(cal.payload, pay_w)
+        )
+        new_src = (
+            cal.src.at[buck_i, pos_i].set(
+                src_f, mode="drop", unique_indices=True
+            )
+            if cal.src is not None
+            else None
+        )
+        new_valid = cal.valid.at[buck_i, pos_i].set(
+            True, mode="drop", unique_indices=True
+        )
+        return (
+            dataclasses.replace(
+                cal, payload=new_payload, src=new_src, valid=new_valid
+            ),
+            rejected,
+        )
+
+    # --- duplicate: second copy, one tick later
+    if "duplicate" in features:
+        dup = val_f & (u("duplicate") * 100.0 < eg(DUPLICATE))
+        dst2 = jnp.concatenate([dst_safe, dst_safe])
+        pay2 = [jnp.concatenate([p, p]) for p in pay_w]
+        src2 = jnp.concatenate([src_f, src_f])
+        val2 = jnp.concatenate([val_f, dup])
+        delay2 = jnp.concatenate(
+            [delay, jnp.clip(delay + 1, 1, horizon - 1)]
+        )
+        m2 = 2 * m
+    else:
+        dst2, pay2, src2, val2, delay2, m2 = (
+            dst_safe,
+            pay_w,
+            src_f,
+            val_f,
+            delay,
+            m,
+        )
+
+    bucket = jnp.mod(t + delay2, horizon)
+
+    # --- slot assignment: sort by (bucket, dst), rank within equal key
+    # runs via a prefix-max of run starts (one cummax — no binary-search
+    # while-loop). Invalid messages sort to the end.
+    big = jnp.int32(horizon * n)
+    sort_key = jnp.where(val2, bucket * n + dst2, big)
+    order = jnp.argsort(sort_key)
+    sk = sort_key[order]
+    pos = jnp.arange(m2, dtype=jnp.int32)
+    is_start = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    rank = pos - jax.lax.cummax(jnp.where(is_start, pos, 0))
+
+    dst_s = dst2[order]
+    src_s = src2[order]
+    val_s = val2[order] & (rank < slots)  # per-dst-per-tick inbox overflow
+    buck_s = bucket[order]
+
+    # Scatter into the [L, N·SLOTS] planes at (bucket, slot·N + dst).
+    # Indices are unique by construction (rank is unique within each
+    # (bucket, dst) run); dropped messages get an out-of-range bucket with
+    # a unique position so the scatter keeps its no-duplicate path
+    # (duplicate indices force XLA into a sort-based dedup lowering).
+    buck_i = jnp.where(val_s, buck_s, jnp.int32(horizon))
+    pos_i = jnp.where(val_s, rank * n + dst_s, pos)
+
+    new_payload = tuple(
+        p.at[buck_i, pos_i].set(
+            pw[order], mode="drop", unique_indices=True
+        )
+        for p, pw in zip(cal.payload, pay2)
+    )
+    new_src = (
+        cal.src.at[buck_i, pos_i].set(
+            src_s, mode="drop", unique_indices=True
+        )
+        if cal.src is not None
+        else None
+    )
+    new_valid = cal.valid.at[buck_i, pos_i].set(
+        True, mode="drop", unique_indices=True
+    )
+
+    return (
+        dataclasses.replace(
+            cal, payload=new_payload, src=new_src, valid=new_valid
+        ),
+        rejected,
+    )
+
+
+def apply_net_updates(
+    link: LinkState,
+    net_shape: jax.Array,  # [7, N] plane layout (from step out_axes=-1)
+    net_shape_valid: jax.Array,  # [N]
+    net_filters: jax.Array,  # [G, N]
+    net_filters_valid: jax.Array,  # [N]
+) -> LinkState:
+    """Apply per-instance network reconfigurations emitted by steps — the
+    sidecar handler's "apply each network.Config received" loop
+    (``pkg/sidecar/sidecar_handler.go:49-82``) with one-tick turnaround."""
+    egress = jnp.where(net_shape_valid[None, :], net_shape, link.egress)
+    if link.filters.shape[0] > 0 and net_filters.shape[0] > 0:
+        filters = jnp.where(
+            net_filters_valid[None, :], net_filters, link.filters
+        )
+    else:
+        filters = link.filters
+    return LinkState(egress=egress, filters=filters)

@@ -24,6 +24,11 @@ class SimJaxRunner(Runner, HealthcheckedRunner):
     def compatible_builders(self) -> list[str]:
         return ["sim:plan"]
 
+    def config_type(self) -> type | None:
+        from .executor import SimJaxConfig
+
+        return SimJaxConfig
+
     def healthcheck(self, fix: bool, ow: OutputWriter):
         from testground_tpu.healthcheck.report import Report
 

@@ -1,0 +1,160 @@
+"""In-sim coordination: the sync service as on-device tensors.
+
+TPU-native twin of the Redis-backed sync service (SURVEY.md §2.6): state
+counters and topic streams are device arrays updated once per tick from the
+vmapped step outputs — a barrier round-trip that costs a Redis RTT in the
+reference costs one reduction here.
+
+- ``SignalEntry(state)``  → counter += Σ signals; the 1-based sequence is the
+  pre-tick count plus this instance's rank among same-tick signallers
+  (``jnp.cumsum`` prefix over the instance axis — deterministic, matching
+  the reference's atomic-increment ordering up to same-instant ties)
+- ``Barrier/SignalAndWait`` → plans compare ``counts[state] >= target``
+- ``Publish``             → append to a bounded per-topic stream in instance
+  order (every subscriber sees every entry, in one global order)
+- ``Subscribe``           → per-instance read cursors; the engine serves a
+  SUB_K-entry window past the cursor each tick
+
+Layout note (see ``net.py``): per-instance arrays keep the N axis minor —
+``last_seq`` is [S, N], and [N, S]-shaped step outputs are transposed once
+before the arithmetic so the hot reductions run on unpadded tiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SyncState", "make_sync_state", "update_sync", "make_sub_window"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SyncState:
+    """counts:    [S] int32 — per-state counter values
+    last_seq:    [S, N] int32 — per-instance latest SignalEntry result
+    stream:      [T, CAP, PW] int32 — per-topic append-only payload log
+    stream_len:  [T] int32
+    cursors:     [T, N] int32 — per-instance per-topic read positions
+    dropped:     [T] int32 — publishes lost to a full stream (surfaced in
+                 the run journal; the reference would instead grow Redis)
+    """
+
+    counts: jax.Array
+    last_seq: jax.Array
+    stream: jax.Array
+    stream_len: jax.Array
+    cursors: jax.Array
+    dropped: jax.Array
+
+
+def make_sync_state(
+    n: int, n_states: int, n_topics: int, cap: int, pub_width: int
+) -> SyncState:
+    return SyncState(
+        counts=jnp.zeros((n_states,), jnp.int32),
+        last_seq=jnp.zeros((n_states, n), jnp.int32),
+        stream=jnp.zeros((n_topics, cap, pub_width), jnp.int32),
+        stream_len=jnp.zeros((n_topics,), jnp.int32),
+        cursors=jnp.zeros((n_topics, n), jnp.int32),
+        dropped=jnp.zeros((n_topics,), jnp.int32),
+    )
+
+
+def update_sync(
+    sync: SyncState,
+    signals: jax.Array,  # [S, N] int32 0/1 (plane layout)
+    pub_payload: jax.Array,  # [T, PW, N] int32
+    pub_valid: jax.Array,  # [T, N] bool
+    sub_consume: jax.Array,  # [T, N] int32
+) -> SyncState:
+    n = signals.shape[1]
+    n_topics, cap, pw = sync.stream.shape
+
+    # --- SignalEntry: counters + per-signaller sequence numbers; prefix
+    # scans run along the unpadded minor (instance) axis.
+    sig = signals
+    prefix = jnp.cumsum(sig, axis=1)  # inclusive prefix per state
+    seq = sync.counts[:, None] + prefix  # 1-based rank for signallers
+    last_seq = jnp.where(sig > 0, seq, sync.last_seq)
+    counts = sync.counts + jnp.sum(sig, axis=1)
+
+    # --- Publish: stable append in instance order
+    if n_topics > 0:
+        pv = pub_valid.astype(jnp.int32)  # [T, N]
+        offsets = sync.stream_len[:, None] + jnp.cumsum(pv, axis=1) - pv
+        # Flat scatter into [T·CAP, PW]; overflow/invalid entries get unique
+        # out-of-range indices (duplicate scatter indices would force XLA's
+        # slow sort-based lowering — see net.enqueue).
+        in_range = pub_valid & (offsets < cap)
+        oob = jnp.int32(n_topics * cap) + jnp.arange(
+            n_topics * n, dtype=jnp.int32
+        ).reshape(n_topics, n)
+        flat_idx = jnp.where(
+            in_range,
+            jnp.arange(n_topics, dtype=jnp.int32)[:, None] * cap + offsets,
+            oob,
+        )
+        # updates in publish order: [T, PW, N] → [T·N, PW]
+        upd = jnp.transpose(pub_payload, (0, 2, 1)).reshape(-1, pw)
+        stream = (
+            sync.stream.reshape(-1, pw)
+            .at[flat_idx.reshape(-1)]
+            .set(upd, mode="drop", unique_indices=True)
+            .reshape(n_topics, cap, pw)
+        )
+        published = jnp.sum(pv, axis=1)
+        stored = jnp.sum(in_range.astype(jnp.int32), axis=1)
+        stream_len = jnp.minimum(sync.stream_len + published, cap)
+        dropped = sync.dropped + (published - stored)
+        # --- Subscribe: advance cursors (clamped to what exists)
+        cursors = jnp.minimum(
+            sync.cursors + jnp.maximum(sub_consume, 0),
+            stream_len[:, None],
+        )
+    else:
+        stream, stream_len, dropped, cursors = (
+            sync.stream,
+            sync.stream_len,
+            sync.dropped,
+            sync.cursors,
+        )
+
+    return SyncState(
+        counts=counts,
+        last_seq=last_seq,
+        stream=stream,
+        stream_len=stream_len,
+        cursors=cursors,
+        dropped=dropped,
+    )
+
+
+def make_sub_window(
+    sync: SyncState, sub_k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Build each instance's next-SUB_K window into every topic stream.
+
+    Returns (sub_payload [N, T, K, PW], sub_valid [N, T, K]).
+    """
+    n_topics, n = sync.cursors.shape
+    _, cap, pw = sync.stream.shape
+    if n_topics == 0:
+        return (
+            jnp.zeros((n, 0, sub_k, pw), jnp.int32),
+            jnp.zeros((n, 0, sub_k), bool),
+        )
+    # idx [T, N, K]
+    idx = sync.cursors[:, :, None] + jnp.arange(sub_k, dtype=jnp.int32)
+    valid = idx < sync.stream_len[:, None, None]
+    idx_c = jnp.clip(idx, 0, cap - 1)
+    # gather stream[t, idx[t,n,k]] → [T, N, K, PW]
+    payload = sync.stream[
+        jnp.arange(n_topics, dtype=jnp.int32)[:, None, None], idx_c
+    ]
+    return (
+        jnp.transpose(payload, (1, 0, 2, 3)),
+        jnp.transpose(valid, (1, 0, 2)),
+    )

@@ -14,6 +14,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The environment may force-register an accelerator backend from
+# sitecustomize (overriding JAX_PLATFORMS); pin the config explicitly so
+# tests never dispatch eagerly over a device tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,229 @@
+"""SimProgram tests: whole-plan state machines stepped by the jitted tick
+loop, on the 8-device CPU mesh and unsharded (SURVEY.md §4 — the sim:jax
+runner on CPU is the "kind cluster" equivalent)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from testground_tpu.api import RunGroup
+from testground_tpu.sim.api import (
+    CRASH,
+    FAILURE,
+    RUNNING,
+    SUCCESS,
+    SimTestcase,
+)
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import load_sim_testcases
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def make_groups(*counts, params=None):
+    return build_groups(
+        [
+            RunGroup(id=f"g{i}", instances=c, parameters=dict(params or {}))
+            for i, c in enumerate(counts)
+        ]
+    )
+
+
+def plan_case(plan, case):
+    return load_sim_testcases(os.path.join(PLANS, plan))[case]()
+
+
+def mesh8():
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must provide 8 virtual CPU devices"
+    return jax.sharding.Mesh(np.asarray(devs), ("i",))
+
+
+class TestPlacebo:
+    def test_ok_all_success(self):
+        prog = SimProgram(plan_case("placebo", "ok"), make_groups(4))
+        res = prog.run(max_ticks=64)
+        assert (res["status"] == SUCCESS).all()
+        assert (res["finished_at"] == 0).all()
+
+    def test_abort_and_panic(self):
+        for case, code in (("abort", FAILURE), ("panic", CRASH)):
+            prog = SimProgram(plan_case("placebo", case), make_groups(3))
+            res = prog.run(max_ticks=64)
+            assert (res["status"] == code).all()
+
+    def test_stall_hits_max_ticks(self):
+        prog = SimProgram(plan_case("placebo", "stall"), make_groups(2), chunk=8)
+        res = prog.run(max_ticks=32)
+        assert (res["status"] == RUNNING).all()
+        assert res["ticks"] >= 32
+
+    def test_metrics_counts_to_ten(self):
+        tc = plan_case("placebo", "metrics")
+        prog = SimProgram(tc, make_groups(5))
+        res = prog.run(max_ticks=64)
+        assert (res["status"] == SUCCESS).all()
+        assert (res["states"][0]["counter"] == 10).all()
+        m = tc.collect_metrics(res["groups"][0], res["states"][0], res["status"])
+        assert (np.asarray(m["placebo.counter"]) == 10).all()
+
+    def test_sharded_matches_unsharded(self):
+        """vmap-vs-ground-truth (BASELINE config 2 spirit): the mesh must
+        not change results."""
+        res1 = SimProgram(plan_case("placebo", "metrics"), make_groups(16)).run(
+            max_ticks=64
+        )
+        res8 = SimProgram(
+            plan_case("placebo", "metrics"), make_groups(16), mesh=mesh8()
+        ).run(max_ticks=64)
+        np.testing.assert_array_equal(res1["status"], res8["status"])
+        np.testing.assert_array_equal(
+            res1["states"][0]["counter"], res8["states"][0]["counter"]
+        )
+
+
+class TestPingPong:
+    def test_two_instance_rtt_windows(self):
+        """pingpong.go:185-195: RTT ∈ [200,215]ms shaped at 100ms, then
+        ∈ [20,35]ms after reconfiguring to 10ms — exact in sim time."""
+        prog = SimProgram(
+            plan_case("network", "ping-pong"),
+            make_groups(
+                2,
+                params={
+                    "latency_ms": "100",
+                    "latency2_ms": "10",
+                    "tolerance_ms": "15",
+                },
+            ),
+            tick_ms=1.0,
+            chunk=64,
+        )
+        res = prog.run(max_ticks=2048)
+        assert (res["status"] == SUCCESS).all(), res["states"][0]
+        rtt1 = np.asarray(res["states"][0]["rtt1"])
+        rtt2 = np.asarray(res["states"][0]["rtt2"])
+        assert ((rtt1 >= 200) & (rtt1 <= 215)).all(), rtt1
+        assert ((rtt2 >= 20) & (rtt2 <= 35)).all(), rtt2
+
+    def test_many_pairs_sharded(self):
+        """16 independent pairs across the 8-device mesh."""
+        prog = SimProgram(
+            plan_case("network", "ping-pong"),
+            make_groups(32),
+            mesh=mesh8(),
+            chunk=64,
+        )
+        res = prog.run(max_ticks=2048)
+        assert (res["status"] == SUCCESS).all()
+
+    def test_wrong_window_fails(self):
+        """Tight tolerance ⇒ the assertion must fail (placebo for the
+        RTT check itself)."""
+        prog = SimProgram(
+            plan_case("network", "ping-pong"),
+            make_groups(2, params={"tolerance_ms": "-1"}),
+            chunk=64,
+        )
+        res = prog.run(max_ticks=2048)
+        assert (res["status"] == FAILURE).all()
+
+
+class TestTraffic:
+    def test_allowed_flows(self):
+        prog = SimProgram(
+            plan_case("network", "traffic-allowed"), make_groups(4), chunk=16
+        )
+        res = prog.run(max_ticks=256)
+        assert (res["status"] == SUCCESS).all()
+        assert (np.asarray(res["states"][0]["received"]) > 0).all()
+
+    def test_blocked_does_not_flow(self):
+        """splitbrain-style drop filter: no traffic crosses (09-11
+        integration scripts' assertion)."""
+        prog = SimProgram(
+            plan_case("network", "traffic-blocked"), make_groups(4), chunk=16
+        )
+        res = prog.run(max_ticks=256)
+        assert (res["status"] == SUCCESS).all()
+        assert (np.asarray(res["states"][0]["received"]) == 0).all()
+
+
+class TestMultiGroup:
+    def test_heterogeneous_group_params(self):
+        """Groups carry different static params — the trickle-down group
+        merge surface (composition_preparation.go:232-281) feeding per-group
+        vmaps."""
+
+        class ParamEcho(SimTestcase):
+            def init(self, env):
+                return {"x": jnp.int32(env.int_param("x"))}
+
+            def step(self, env, state, inbox, sync, t):
+                return self.out(state, status=SUCCESS)
+
+        groups = build_groups(
+            [
+                RunGroup(id="a", instances=2, parameters={"x": "7"}),
+                RunGroup(id="b", instances=3, parameters={"x": "9"}),
+            ]
+        )
+        res = SimProgram(ParamEcho(), groups).run(max_ticks=8)
+        assert (np.asarray(res["states"][0]["x"]) == 7).all()
+        assert (np.asarray(res["states"][1]["x"]) == 9).all()
+        assert (res["status"] == SUCCESS).all()
+
+    def test_cross_group_messaging(self):
+        """Group a sends to group b via global indices; b succeeds on
+        receipt, a on send."""
+
+        class Sender(SimTestcase):
+            MSG_WIDTH = 2
+
+            def step(self, env, state, inbox, sync, t):
+                dst = env.group_offset_of("b") + env.group_seq
+                from testground_tpu.sim.api import Outbox
+
+                return self.out(
+                    state,
+                    status=jnp.where(t >= 1, SUCCESS, RUNNING),
+                    outbox=Outbox.single(
+                        dst, jnp.asarray([5, 0]), t == 0, 1, 2
+                    ),
+                )
+
+        class Receiver(SimTestcase):
+            MSG_WIDTH = 2
+
+            def step(self, env, state, inbox, sync, t):
+                got = jnp.any(inbox.valid & (inbox.payload[0] == 5))
+                return self.out(
+                    state, status=jnp.where(got, SUCCESS, RUNNING)
+                )
+
+        class Dispatch(SimTestcase):
+            MSG_WIDTH = 2
+
+            def __init__(self):
+                self._s, self._r = Sender(), Receiver()
+
+            def init(self, env):
+                return {}
+
+            def step(self, env, state, inbox, sync, t):
+                if env.group.id == "a":  # static per-group dispatch
+                    return self._s.step(env, state, inbox, sync, t)
+                return self._r.step(env, state, inbox, sync, t)
+
+        groups = build_groups(
+            [
+                RunGroup(id="a", instances=3, parameters={}),
+                RunGroup(id="b", instances=3, parameters={}),
+            ]
+        )
+        res = SimProgram(Dispatch(), groups, chunk=8).run(max_ticks=64)
+        assert (res["status"] == SUCCESS).all()

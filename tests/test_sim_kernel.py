@@ -1,0 +1,254 @@
+"""Unit tests for the sim kernels: link model, calendar transport, sync
+tensors (SURVEY.md §4 tier 2 — the mock-reactor tier, except the "mock" is
+the real simulator on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from testground_tpu.sim import net
+from testground_tpu.sim.api import FILTER_ACCEPT, FILTER_DROP, FILTER_REJECT
+from testground_tpu.sim.net import Calendar, LinkState, deliver, enqueue
+from testground_tpu.sim.sync_kernel import (
+    make_sub_window,
+    make_sync_state,
+    update_sync,
+)
+
+
+def _cal(horizon=8, n=4, slots=2, width=2):
+    return Calendar.empty(horizon, n, slots, width)
+
+
+def _link(n=4, groups=1, latency=1.0, **kw):
+    shape = [latency, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0]
+    keys = ["jitter", "bandwidth", "loss", "corrupt", "reorder", "duplicate"]
+    for i, k in enumerate(keys, start=1):
+        if k in kw:
+            shape[i] = kw[k]
+    return net.make_link_state(n, groups, shape)
+
+
+def _send_one(cal, link, src, dst, word, t=0, tick_ms=1.0, n=4, seed=0):
+    """Enqueue a single message from src→dst."""
+    dsts = jnp.zeros((n, 1), jnp.int32).at[src, 0].set(dst)
+    pay = jnp.zeros((n, 1, cal.width), jnp.int32).at[src, 0, 0].set(word)
+    valid = jnp.zeros((n, 1), bool).at[src, 0].set(True)
+    group_of = jnp.zeros((n,), jnp.int32)
+    return enqueue(
+        cal,
+        link,
+        group_of,
+        jnp.transpose(dsts),            # [O, N]
+        jnp.transpose(pay, (1, 2, 0)),  # [O, W, N]
+        jnp.transpose(valid),           # [O, N]
+        jnp.int32(t),
+        tick_ms,
+        jax.random.key(seed),
+    )
+
+
+class TestTransport:
+    def test_latency_delivery_timing(self):
+        """A message shaped with L ms latency arrives exactly ceil(L/tick)
+        ticks later (link.go netem delay semantics, in sim time)."""
+        cal = _cal()
+        link = _link(latency=3.0)
+        cal, rej = _send_one(cal, link, src=0, dst=2, word=42, t=0)
+        assert int(rej.sum()) == 0
+        for t in range(1, 3):
+            cal, inbox = deliver(cal, jnp.int32(t))
+            assert not bool(inbox.valid.any()), f"early delivery at {t}"
+        cal, inbox = deliver(cal, jnp.int32(3))
+        assert bool(inbox.valid[0, 2])
+        assert int(inbox.payload[0, 0, 2]) == 42
+        assert int(inbox.src[0, 2]) == 0
+        # nothing else got a copy
+        assert int(inbox.valid.sum()) == 1
+
+    def test_bucket_cleared_after_delivery(self):
+        cal = _cal()
+        link = _link(latency=2.0)
+        cal, _ = _send_one(cal, link, 0, 1, 7, t=0)
+        cal, inbox = deliver(cal, jnp.int32(2))
+        assert bool(inbox.valid[0, 1])
+        cal, inbox2 = deliver(cal, jnp.int32(2 + 8))  # same bucket, next lap
+        assert not bool(inbox2.valid.any())
+
+    def test_full_loss_drops(self):
+        cal = _cal()
+        link = _link(latency=1.0, loss=100.0)
+        cal, _ = _send_one(cal, link, 0, 1, 7, t=0)
+        total = 0
+        for t in range(1, 8):
+            cal, inbox = deliver(cal, jnp.int32(t))
+            total += int(inbox.valid.sum())
+        assert total == 0
+
+    def test_duplicate_delivers_two_copies(self):
+        cal = _cal()
+        link = _link(latency=1.0, duplicate=100.0)
+        cal, _ = _send_one(cal, link, 0, 1, 7, t=0)
+        total = 0
+        for t in range(1, 8):
+            cal, inbox = deliver(cal, jnp.int32(t))
+            total += int(inbox.valid[:, 1].sum())
+        assert total == 2
+
+    def test_corrupt_flips_a_bit(self):
+        cal = _cal()
+        link = _link(latency=1.0, corrupt=100.0)
+        cal, _ = _send_one(cal, link, 0, 1, 0b1010, t=0)
+        cal, inbox = deliver(cal, jnp.int32(1))
+        got = int(inbox.payload[0, 0, 1])
+        assert got != 0b1010
+        assert bin(got ^ 0b1010).count("1") == 1
+
+    def test_drop_filter_blackholes(self):
+        cal = _cal()
+        link = LinkState(
+            egress=_link().egress,
+            filters=jnp.full((1, 4), FILTER_DROP, jnp.int32),
+        )
+        cal, rej = _send_one(cal, link, 0, 1, 7, t=0)
+        assert int(rej.sum()) == 0  # DROP is silent (BLACKHOLE route)
+        cal, inbox = deliver(cal, jnp.int32(1))
+        assert not bool(inbox.valid.any())
+
+    def test_reject_filter_feeds_back_to_sender(self):
+        cal = _cal()
+        link = LinkState(
+            egress=_link().egress,
+            filters=jnp.full((1, 4), FILTER_REJECT, jnp.int32),
+        )
+        cal, rej = _send_one(cal, link, 0, 1, 7, t=0)
+        assert int(rej[0]) == 1  # PROHIBIT route: sender sees the refusal
+        cal, inbox = deliver(cal, jnp.int32(1))
+        assert not bool(inbox.valid.any())
+
+    def test_bandwidth_caps_messages_per_tick(self):
+        """B bytes/s admits floor(B·tick/MSG_BYTES) messages per tick."""
+        n, o = 2, 4
+        cal = Calendar.empty(8, n, 8, 1)
+        # 2 msgs/tick at 1ms ticks: B = 2 * 256 * 1000
+        link = _link(n=n, latency=1.0, bandwidth=2 * net.MSG_BYTES * 1000.0)
+        dsts = jnp.zeros((o, n), jnp.int32).at[:, 0].set(1)
+        pay = jnp.ones((o, 1, n), jnp.int32)
+        valid = jnp.zeros((o, n), bool).at[:, 0].set(True)
+        cal, _ = enqueue(
+            cal,
+            link,
+            jnp.zeros((n,), jnp.int32),
+            dsts,
+            pay,
+            valid,
+            jnp.int32(0),
+            1.0,
+            jax.random.key(0),
+        )
+        cal, inbox = deliver(cal, jnp.int32(1))
+        assert int(inbox.valid[:, 1].sum()) == 2
+
+    def test_inbox_overflow_drops_excess(self):
+        """More same-tick senders than IN_MSGS slots: the surplus drops
+        (a full accept queue in the reference)."""
+        n = 8
+        cal = Calendar.empty(8, n, 2, 1)  # 2 inbox slots
+        link = _link(n=n, latency=1.0)
+        dsts = jnp.zeros((1, n), jnp.int32)  # everyone → instance 0
+        pay = jnp.ones((1, 1, n), jnp.int32)
+        valid = jnp.ones((1, n), bool).at[0, 0].set(False)
+        cal, _ = enqueue(
+            cal,
+            link,
+            jnp.zeros((n,), jnp.int32),
+            dsts,
+            pay,
+            valid,
+            jnp.int32(0),
+            1.0,
+            jax.random.key(0),
+        )
+        cal, inbox = deliver(cal, jnp.int32(1))
+        assert int(inbox.valid[:, 0].sum()) == 2
+        assert int(inbox.valid[:, 1:].sum()) == 0
+
+
+class TestSyncKernel:
+    def test_signal_entry_counts_and_ranks(self):
+        """SignalEntry returns 1-based, dense, deterministic sequence
+        numbers (sync service atomic-increment semantics)."""
+        n, s = 5, 2
+        sync = make_sync_state(n, s, 0, 0, 1)
+        signals = jnp.zeros((s, n), jnp.int32).at[0, 1].set(1).at[0, 3].set(1)
+        sync = update_sync(
+            sync,
+            signals,
+            jnp.zeros((0, 1, n), jnp.int32),
+            jnp.zeros((0, n), bool),
+            jnp.zeros((0, n), jnp.int32),
+        )
+        assert int(sync.counts[0]) == 2 and int(sync.counts[1]) == 0
+        assert int(sync.last_seq[0, 1]) == 1
+        assert int(sync.last_seq[0, 3]) == 2
+        # next tick: one more signaller continues the sequence
+        signals2 = jnp.zeros((s, n), jnp.int32).at[0, 0].set(1)
+        sync = update_sync(
+            sync,
+            signals2,
+            jnp.zeros((0, 1, n), jnp.int32),
+            jnp.zeros((0, n), bool),
+            jnp.zeros((0, n), jnp.int32),
+        )
+        assert int(sync.counts[0]) == 3
+        assert int(sync.last_seq[0, 0]) == 3
+        assert int(sync.last_seq[0, 1]) == 1  # unchanged
+
+    def test_publish_order_and_subscribe_window(self):
+        """Every subscriber sees every entry, in one global order
+        (PublishSubscribe semantics, benchmarks.go:150-200)."""
+        n, t_, cap, pw, k = 4, 1, 8, 2, 3
+        sync = make_sync_state(n, 0, t_, cap, pw)
+        pub = jnp.zeros((t_, pw, n), jnp.int32)
+        pv = jnp.zeros((t_, n), bool)
+        for i in (2, 0, 3):  # instance order defines stream order: 0,2,3
+            pub = pub.at[0, 0, i].set(100 + i)
+            pv = pv.at[0, i].set(True)
+        sync = update_sync(sync, jnp.zeros((0, n), jnp.int32), pub, pv,
+                           jnp.zeros((t_, n), jnp.int32))
+        assert int(sync.stream_len[0]) == 3
+        payload, valid = make_sub_window(sync, k)
+        got = [int(payload[1, 0, j, 0]) for j in range(3)]
+        assert got == [100, 102, 103]
+        assert bool(valid[1, 0, :3].all()) and not bool(valid[1, 0, 3:].any())
+
+    def test_subscribe_cursor_advance(self):
+        n, t_, cap, pw = 2, 1, 8, 1
+        sync = make_sync_state(n, 0, t_, cap, pw)
+        pub = jnp.arange(n * pw, dtype=jnp.int32).reshape(t_, pw, n)
+        pv = jnp.ones((t_, n), bool)
+        sync = update_sync(sync, jnp.zeros((0, n), jnp.int32), pub, pv,
+                           jnp.zeros((t_, n), jnp.int32))
+        # instance 0 consumes 1 entry
+        consume = jnp.zeros((t_, n), jnp.int32).at[0, 0].set(1)
+        sync = update_sync(
+            sync,
+            jnp.zeros((0, n), jnp.int32),
+            jnp.zeros((t_, pw, n), jnp.int32),
+            jnp.zeros((t_, n), bool),
+            consume,
+        )
+        payload, valid = make_sub_window(sync, 2)
+        assert int(payload[0, 0, 0, 0]) == 1  # window starts past consumed
+        assert int(payload[1, 0, 0, 0]) == 0  # other cursor unmoved
+
+    def test_stream_overflow_counts_dropped(self):
+        n, t_, cap, pw = 4, 1, 2, 1
+        sync = make_sync_state(n, 0, t_, cap, pw)
+        pub = jnp.ones((t_, pw, n), jnp.int32)
+        pv = jnp.ones((t_, n), bool)
+        sync = update_sync(sync, jnp.zeros((0, n), jnp.int32), pub, pv,
+                           jnp.zeros((t_, n), jnp.int32))
+        assert int(sync.stream_len[0]) == cap
+        assert int(sync.dropped[0]) == n - cap

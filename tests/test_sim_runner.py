@@ -1,0 +1,119 @@
+"""End-to-end: composition → engine → sim:plan build → sim:jax run →
+outcomes + outputs + collection (the integration-script tier of SURVEY.md §4
+with the simulator as the substrate)."""
+
+import io
+import os
+import tarfile
+import time
+
+import pytest
+
+from testground_tpu.api import (
+    Composition,
+    Global,
+    Group,
+    Instances,
+    TestPlanManifest,
+    generate_default_run,
+)
+from testground_tpu.builders.sim_plan import SimPlanBuilder
+from testground_tpu.config import EnvConfig
+from testground_tpu.engine import Engine, EngineConfig, Outcome, State
+from testground_tpu.sim.runner import SimJaxRunner
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+@pytest.fixture()
+def engine(tg_home):
+    env = EnvConfig.load()
+    e = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    e.start_workers()
+    yield e
+    e.stop()
+
+
+def run_sim(
+    engine, plan, case, instances=2, params=None, run_params=None, timeout=180
+):
+    comp = generate_default_run(
+        Composition(
+            global_=Global(
+                plan=plan, case=case, builder="sim:plan", runner="sim:jax"
+            ),
+            groups=[Group(id="all", instances=Instances(count=instances))],
+        )
+    )
+    if params:
+        comp.runs[0].groups[0].test_params.update(params)
+    if run_params:
+        comp.global_.run_config.update(run_params)
+    manifest = TestPlanManifest.load_file(
+        os.path.join(PLANS, plan, "manifest.toml")
+    )
+    tid = engine.queue_run(comp, manifest, sources_dir=os.path.join(PLANS, plan))
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = engine.get_task(tid)
+        if t is not None and t.state().state in (State.COMPLETE, State.CANCELED):
+            return t
+        time.sleep(0.05)
+    raise TimeoutError(f"task {tid} did not finish")
+
+
+class TestSimPlacebo:
+    def test_ok(self, engine):
+        t = run_sim(engine, "placebo", "ok", instances=8)
+        assert t.outcome() == Outcome.SUCCESS
+        assert t.result["outcomes"]["all"] == {"total": 8, "ok": 8}
+
+    def test_abort_fails(self, engine):
+        t = run_sim(engine, "placebo", "abort", instances=2)
+        assert t.outcome() == Outcome.FAILURE
+
+    def test_stall_bounded_by_max_ticks(self, engine):
+        t = run_sim(
+            engine,
+            "placebo",
+            "stall",
+            instances=2,
+            run_params={"max_ticks": 64, "chunk": 16},
+        )
+        assert t.outcome() == Outcome.FAILURE
+        assert t.result["journal"]["events"]["all"]["incomplete"] == 2
+
+    def test_outputs_and_collection(self, engine):
+        t = run_sim(engine, "placebo", "metrics", instances=2)
+        out_root = engine.env.dirs.outputs()
+        inst = os.path.join(out_root, "placebo", t.id, "all", "0")
+        assert os.path.getsize(os.path.join(inst, "run.out")) > 0
+        assert os.path.getsize(os.path.join(inst, "metrics.out")) > 0
+
+        buf = io.BytesIO()
+        from testground_tpu.rpc import discard_writer
+
+        engine.do_collect_outputs("sim:jax", t.id, buf, discard_writer())
+        buf.seek(0)
+        with tarfile.open(fileobj=buf, mode="r:gz") as tar:
+            names = tar.getnames()
+        assert f"{t.id}/all/0/run.out" in names
+        assert f"{t.id}/all/1/run.out" in names
+
+
+class TestSimNetwork:
+    def test_ping_pong_end_to_end(self, engine):
+        t = run_sim(engine, "network", "ping-pong", instances=2)
+        assert t.outcome() == Outcome.SUCCESS
+        assert t.result["outcomes"]["all"] == {"total": 2, "ok": 2}
+        sim = t.result["journal"]["sim"]
+        assert sim["ticks"] > 0 and sim["tick_ms"] == 1.0
+
+    def test_traffic_blocked(self, engine):
+        t = run_sim(engine, "network", "traffic-blocked", instances=4)
+        assert t.outcome() == Outcome.SUCCESS
