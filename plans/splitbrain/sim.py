@@ -1,0 +1,282 @@
+"""splitbrain plan, sim edition.
+
+Sim twin of the reference's ``plans/splitbrain/main.go``: nodes land in
+three "regions" by racing a SignalEntry (``main.go:85-88`` — region =
+seq % 3), region A then applies a routing filter toward every region-B
+node (``main.go:107-130``), and everyone probes everyone. Region C must
+reach the whole network; A↔B traffic must fail for the ``drop``/``reject``
+testcases and flow for ``accept`` (``expectErrors``, ``main.go:50-59``).
+
+TPU-native mechanics: the region assignment is a dynamic repartition of
+the link-filter tensor (``StepOut.region`` reassigns this instance's
+partition; ``net_filters`` is per-dst-region — ``sim/net.py``). The HTTP
+probe mesh becomes a pipelined probe schedule: at probe step k, instance
+i probes peer (i + 1 + k) mod N, so every (receiver, tick) pair sees at
+most one probe and one reply — fixed fan-in with no sort pressure.
+
+Beyond the reference, the run ends with a **heal phase**: region A
+restores ACCEPT filters and re-probes its nearest region-B peer, proving
+the partition is dynamic both ways (the mid-run reconfiguration
+semantics of ``pkg/sidecar/sidecar_handler.go:49-82``). The sim's
+SignalEntry ordering is the deterministic instance order (cumsum —
+``sim/sync_kernel.py``), so seq == global_seq + 1 and peers' regions are
+locally computable: region(p) = (p+1) % 3. A region-A instance i has
+(i+1) % 3 == 0, hence its nearest B peer ((p+1) % 3 == 1) is exactly
+p = i − 2 ≥ 0 — giving the heal sweep fan-in 1.
+
+Outcome accounting (vs ``expectErrors``):
+- replies received must equal (N−1) − expected_failures;
+- ``reject`` additionally asserts the sender-visible REJECT feedback:
+  each region-A instance must see exactly 2·|B| rejected messages (its
+  |B| probes + its |B| replies toward B), while ``drop`` must see
+  zero — the PROHIBIT-vs-BLACKHOLE distinction of ``link.go:187-217``.
+"""
+
+import jax.numpy as jnp
+
+from testground_tpu.sim.api import (
+    FAILURE,
+    FILTER_ACCEPT,
+    FILTER_DROP,
+    FILTER_REJECT,
+    RUNNING,
+    SUCCESS,
+    Outbox,
+    SimTestcase,
+)
+
+PROBE = 1
+REPLY = 2
+
+REGION_A = 0
+REGION_B = 1
+REGION_C = 2
+
+# phases
+P_SIGNAL = 0  # t==0: race the region-select signal
+P_REGION = 1  # read back seq → region; region A installs filters
+P_ROUNDUP = 2  # wait for everyone to be partitioned ("nodeRoundup")
+P_PROBE = 3  # pipelined probe sweep
+P_JUDGE = 4  # all probes sent + drain window elapsed → verdict
+P_HEAL = 5  # region A restores ACCEPT and re-probes a B peer
+P_DONE = 6
+
+
+class _SplitBrain(SimTestcase):
+    ACTION = FILTER_ACCEPT  # overridden per testcase
+
+    STATES = ["region-select", "nodeRoundup", "healed"]
+    N_REGIONS = 3
+    MSG_WIDTH = 2  # word0: kind, word1: probe id
+    OUT_MSGS = 2  # slot 0: replies, slot 1: own probes
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 16
+    SHAPING = ("latency", "filters")
+
+    def init(self, env):
+        z = jnp.int32(0)
+        return {
+            "phase": z,
+            "region": jnp.int32(-1),
+            "k": z,  # next probe index
+            "replies": z,  # probe replies received
+            "heal_got": jnp.asarray(False),
+            "rejected_total": z,
+            "deadline": z,
+        }
+
+    @staticmethod
+    def _region_counts(n):
+        # SignalEntry seqs are 1..N; region = seq % 3 (main.go:85-88)
+        return [
+            sum(1 for x in range(1, n + 1) if x % 3 == r) for r in range(3)
+        ]
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        drain = (
+            env.int_param("drain_ticks")
+            if "drain_ticks" in env.group.params
+            else 8
+        )
+        counts = self._region_counts(n)
+        n_a, n_b = counts[REGION_A], counts[REGION_B]
+        phase = state["phase"]
+        rejected_total = state["rejected_total"] + sync.rejected
+
+        # --- always answer probes, whatever the phase (the reference's
+        # HTTP server serves for the whole test body). The schedule
+        # guarantees at most one probe per (receiver, tick).
+        kind = inbox.payload[0]
+        pid = inbox.payload[1]
+        v = inbox.valid
+        is_probe = v & (kind == PROBE)
+        got_reply = v & (kind == REPLY)
+        probe_slot = jnp.argmax(is_probe)
+        reply_to = inbox.src[probe_slot]
+        reply_id = pid[probe_slot]
+        send_reply = jnp.any(is_probe)
+
+        # --- region assignment from the signal race readback
+        p_signal = phase == P_SIGNAL
+        p_region = phase == P_REGION
+        seq = sync.last_seq[self.state_id("region-select")]
+        region = jnp.where(
+            p_region, jnp.mod(seq, 3), state["region"]
+        ).astype(jnp.int32)
+        is_a = region == REGION_A
+
+        roundup_done = sync.counts[self.state_id("nodeRoundup")] >= n
+        p_roundup = phase == P_ROUNDUP
+
+        # --- probe sweep: at step k probe peer (self + 1 + k) mod n
+        p_probe = phase == P_PROBE
+        k = state["k"]
+        probing = p_probe & (k < n - 1)
+        target = jnp.mod(env.global_seq + 1 + k, n)
+        replies = state["replies"] + jnp.sum(got_reply.astype(jnp.int32))
+        k_next = jnp.where(probing, k + 1, k)
+        sweep_done = p_probe & (k >= n - 1)
+        deadline = jnp.where(sweep_done, t + drain, state["deadline"])
+
+        # --- verdict (expectErrors, main.go:50-59)
+        p_judge = phase == P_JUDGE
+        judge = p_judge & (t >= state["deadline"])
+        blocked = cls.ACTION != FILTER_ACCEPT
+        expected_failures = jnp.where(
+            region == REGION_A,
+            n_b if blocked else 0,
+            jnp.where(region == REGION_B, n_a if blocked else 0, 0),
+        )
+        replies_ok = replies == (n - 1) - expected_failures
+        if cls.ACTION == FILTER_REJECT:
+            expected_rejects = jnp.where(is_a, 2 * n_b, 0)
+        else:
+            expected_rejects = jnp.zeros((), jnp.int32)
+        verdict_ok = replies_ok & (rejected_total == expected_rejects)
+
+        # --- heal: region A restores ACCEPT, then probes its nearest B
+        # peer (global_seq − 2, see module docstring) until answered;
+        # every heal reply received proves that sender's A→B egress is
+        # open again. Non-A instances keep serving replies and wait for
+        # all |A| heal attestations on the "healed" counter.
+        p_heal = phase == P_HEAL
+        heal_enter = judge & verdict_ok
+        heal_probe = p_heal & is_a & ~state["heal_got"]
+        heal_target = jnp.maximum(env.global_seq - 2, 0)
+        heal_got = state["heal_got"] | (
+            p_heal & is_a & jnp.any(got_reply & (pid == n))
+        )
+        all_healed = sync.counts[self.state_id("healed")] >= n_a
+        finish = p_heal & all_healed & jnp.where(is_a, heal_got, True)
+
+        new_phase = jnp.where(
+            p_signal,
+            P_REGION,
+            jnp.where(
+                p_region,
+                P_ROUNDUP,
+                jnp.where(
+                    p_roundup & roundup_done,
+                    P_PROBE,
+                    jnp.where(
+                        sweep_done,
+                        P_JUDGE,
+                        jnp.where(
+                            heal_enter,
+                            P_HEAL,
+                            jnp.where(finish, P_DONE, phase),
+                        ),
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+
+        status = jnp.where(
+            judge & ~verdict_ok,
+            FAILURE,
+            jnp.where(finish, SUCCESS, RUNNING),
+        ).astype(jnp.int32)
+
+        # --- sends: slot 0 = reply, slot 1 = probe (sweep or heal)
+        send_probe = probing | heal_probe
+        probe_dst = jnp.where(heal_probe, heal_target, target)
+        probe_id = jnp.where(heal_probe, jnp.int32(n), k)
+        ob = Outbox.empty(cls.OUT_MSGS, cls.MSG_WIDTH)
+        ob = Outbox(
+            dst=ob.dst.at[0].set(reply_to).at[1].set(probe_dst),
+            payload=ob.payload.at[0, 0]
+            .set(REPLY)
+            .at[0, 1]
+            .set(reply_id)
+            .at[1, 0]
+            .set(PROBE)
+            .at[1, 1]
+            .set(probe_id),
+            valid=ob.valid.at[0].set(send_reply).at[1].set(send_probe),
+        )
+
+        # --- network config: region A applies ACTION toward region B on
+        # partition entry, restores ACCEPT on heal entry (both take
+        # effect for the next tick's sends — sidecar_handler semantics)
+        filters_part = (
+            jnp.full((3,), FILTER_ACCEPT, jnp.int32)
+            .at[REGION_B]
+            .set(cls.ACTION)
+        )
+        filters_heal = jnp.full((3,), FILTER_ACCEPT, jnp.int32)
+        apply_part = p_region & is_a
+        apply_heal = heal_enter & is_a
+
+        sig_healed = heal_got & ~state["heal_got"]
+        signals = (
+            self.signal("region-select") * p_signal
+            + self.signal("nodeRoundup") * p_region
+            + self.signal("healed") * sig_healed
+        )
+
+        return self.out(
+            {
+                "phase": new_phase,
+                "region": region,
+                "k": k_next,
+                "replies": replies,
+                "heal_got": heal_got,
+                "rejected_total": rejected_total,
+                "deadline": deadline,
+            },
+            status=status,
+            outbox=ob,
+            signals=signals,
+            net_filters=jnp.where(apply_heal, filters_heal, filters_part),
+            net_filters_valid=apply_part | apply_heal,
+            region=region,
+            region_valid=p_region,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {
+            "splitbrain.region": final_state["region"],
+            "splitbrain.replies": final_state["replies"],
+            "splitbrain.rejected": final_state["rejected_total"],
+        }
+
+
+class SplitBrainAccept(_SplitBrain):
+    ACTION = FILTER_ACCEPT
+
+
+class SplitBrainReject(_SplitBrain):
+    ACTION = FILTER_REJECT
+
+
+class SplitBrainDrop(_SplitBrain):
+    ACTION = FILTER_DROP
+
+
+sim_testcases = {
+    "accept": SplitBrainAccept,
+    "reject": SplitBrainReject,
+    "drop": SplitBrainDrop,
+}

@@ -239,8 +239,10 @@ class StepOut:
     sub_consume: jax.Array  # [T] int32 — advance read cursor by k ≤ SUB_K
     net_shape: jax.Array  # [7] float32 — new egress LinkShape
     net_shape_valid: jax.Array  # bool — apply net_shape this tick
-    net_filters: jax.Array  # [G] int32 — per-dst-group filter actions
+    net_filters: jax.Array  # [R] int32 — per-dst-region filter actions
     net_filters_valid: jax.Array  # bool
+    region: jax.Array  # int32 — this instance's new region id
+    region_valid: jax.Array  # bool — apply region this tick
 
 
 class SimTestcase:
@@ -257,6 +259,12 @@ class SimTestcase:
 
     STATES: ClassVar[list[str]] = []
     TOPICS: ClassVar[list[str]] = []
+    # Filter partition granularity: 0 → one region per group (the default
+    # — ``net_filters[g]`` is the action toward group g). A positive value
+    # declares that many regions; instances start in region = their group
+    # index and may reassign themselves mid-run via ``StepOut.region``
+    # (splitbrain's dynamic seq%3 partitioning).
+    N_REGIONS: ClassVar[int] = 0
     MSG_WIDTH: ClassVar[int] = 4
     OUT_MSGS: ClassVar[int] = 1
     IN_MSGS: ClassVar[int] = 4
@@ -343,9 +351,11 @@ class SimTestcase:
         net_shape_valid=False,
         net_filters=None,
         net_filters_valid=False,
+        region=None,
+        region_valid=False,
     ) -> StepOut:
         cls = type(self)
-        s, tt, g = len(cls.STATES), len(cls.TOPICS), None
+        s, tt = len(cls.STATES), len(cls.TOPICS)
         return StepOut(
             state=state,
             status=jnp.asarray(status, jnp.int32),
@@ -372,6 +382,10 @@ class SimTestcase:
             if net_filters is None
             else jnp.asarray(net_filters, jnp.int32),
             net_filters_valid=jnp.asarray(net_filters_valid, bool),
+            region=jnp.int32(0)
+            if region is None
+            else jnp.asarray(region, jnp.int32),
+            region_valid=jnp.asarray(region_valid, bool),
         )
 
     def signal(self, *names: str) -> jax.Array:

@@ -118,6 +118,7 @@ class SimProgram:
         cls = type(testcase)
         self.n_states = len(cls.STATES)
         self.n_topics = len(cls.TOPICS)
+        self.n_regions = cls.N_REGIONS if cls.N_REGIONS > 0 else len(groups)
         self._group_of = jnp.asarray(
             np.repeat(
                 np.arange(len(groups), dtype=np.int32),
@@ -159,6 +160,7 @@ class SimProgram:
             link=LinkState(
                 egress=wsc(carry.link.egress, self._ishard(1)),
                 filters=wsc(carry.link.filters, self._ishard(1)),
+                region_of=wsc(carry.link.region_of, self._ishard(0)),
             ),
             rejected=wsc(carry.rejected, self._ishard(0)),
         )
@@ -207,7 +209,16 @@ class SimProgram:
                 cls.MSG_WIDTH,
                 track_src=cls.TRACK_SRC,
             ),
-            link=make_link_state(self.n, len(self.groups), cls.DEFAULT_LINK),
+            link=make_link_state(
+                self.n,
+                self.n_regions,
+                cls.DEFAULT_LINK,
+                # instances start in region = group index; plans with
+                # N_REGIONS > len(groups) reassign via StepOut.region
+                region_of=jnp.minimum(
+                    self._group_of, self.n_regions - 1
+                ),
+            ),
             sync=make_sync_state(
                 self.n, self.n_states, self.n_topics, cls.TOPIC_CAP, cls.PUB_WIDTH
             ),
@@ -284,6 +295,8 @@ class SimProgram:
                     net_shape_valid=0,
                     net_filters=-1,
                     net_filters_valid=0,
+                    region=0,
+                    region_valid=0,
                 ),
             )(gs, gseq, env_keys[lo:hi], carry.states[gi], inbox_g, sync_g)
             outs.append(out)
@@ -334,7 +347,6 @@ class SimProgram:
         cal, rejected = enqueue(
             cal,
             carry.link,
-            self._group_of,
             dst,
             payload,
             valid,
@@ -350,26 +362,34 @@ class SimProgram:
 
         net_shape = catl(lambda o: o.net_shape)  # [7, N]
         net_shape_valid = cat0(lambda o: o.net_shape_valid) & active
-        n_groups = len(self.groups)
-        if any(o.net_filters.shape[0] == n_groups for o in outs):
+        n_regions = self.n_regions
+        if any(o.net_filters.shape[0] == n_regions for o in outs):
             # Groups may differ: ones emitting the 0-width sentinel get a
             # zero plane with valid=False so the concat stays rectangular.
             planes, valids = [], []
             for gi, o in enumerate(outs):
                 count = self.groups[gi].count
-                if o.net_filters.shape[0] == n_groups:
+                if o.net_filters.shape[0] == n_regions:
                     planes.append(o.net_filters)
                     valids.append(o.net_filters_valid)
                 else:
-                    planes.append(jnp.zeros((n_groups, count), jnp.int32))
+                    planes.append(jnp.zeros((n_regions, count), jnp.int32))
                     valids.append(jnp.zeros((count,), bool))
-            net_filters = jnp.concatenate(planes, axis=-1)  # [G, N]
+            net_filters = jnp.concatenate(planes, axis=-1)  # [R, N]
             net_filters_valid = jnp.concatenate(valids, axis=0) & active
         else:  # no group drives filters (0-width sentinel)
-            net_filters = jnp.zeros((n_groups, self.n), jnp.int32)
+            net_filters = jnp.zeros((n_regions, self.n), jnp.int32)
             net_filters_valid = jnp.zeros((self.n,), bool)
+        net_region = cat0(lambda o: o.region)
+        net_region_valid = cat0(lambda o: o.region_valid) & active
         link = apply_net_updates(
-            carry.link, net_shape, net_shape_valid, net_filters, net_filters_valid
+            carry.link,
+            net_shape,
+            net_shape_valid,
+            net_filters,
+            net_filters_valid,
+            net_region,
+            net_region_valid,
         )
 
         return self._constrain(

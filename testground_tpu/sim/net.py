@@ -68,14 +68,23 @@ FULL_SHAPING = (
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class LinkState:
-    """Per-instance egress shaping + per-(instance, dst-group) filters.
+    """Per-instance egress shaping + per-(instance, dst-region) filters.
 
-    egress:  [7, N] float32 — one plane per LinkShape component
-    filters: [G, N] int32 — filter action of instance n toward group g
+    egress:    [7, N] float32 — one plane per LinkShape component
+    filters:   [R, N] int32 — filter action of instance n toward region r
+    region_of: [N] int32 — dst instance → region index
+
+    Regions default to groups (``region_of`` starts as the group index),
+    reproducing per-dst-group filtering; plans that partition *within* a
+    group (splitbrain's seq%3 regions, ``plans/splitbrain/main.go:85-88``)
+    declare ``N_REGIONS`` and reassign ``region_of`` dynamically via
+    ``StepOut.region`` — the tensor analog of the reference's arbitrary
+    per-subnet rules (``link.go:187-217``) at region granularity.
     """
 
     egress: jax.Array
     filters: jax.Array
+    region_of: jax.Array
 
 
 @jax.tree_util.register_dataclass
@@ -115,12 +124,20 @@ class Calendar:
         return len(self.payload)
 
 
-def make_link_state(n: int, n_groups: int, default_shape) -> LinkState:
+def make_link_state(
+    n: int, n_regions: int, default_shape, region_of=None
+) -> LinkState:
     egress = jnp.tile(
         jnp.asarray(default_shape, jnp.float32)[:, None], (1, n)
     )
-    filters = jnp.full((n_groups, n), FILTER_ACCEPT, jnp.int32)
-    return LinkState(egress=egress, filters=filters)
+    filters = jnp.full((n_regions, n), FILTER_ACCEPT, jnp.int32)
+    if region_of is None:
+        region_of = jnp.zeros((n,), jnp.int32)
+    return LinkState(
+        egress=egress,
+        filters=filters,
+        region_of=jnp.asarray(region_of, jnp.int32),
+    )
 
 
 def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
@@ -158,7 +175,6 @@ def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
 def enqueue(
     cal: Calendar,
     link: LinkState,
-    group_of: jax.Array,  # [N] int32 — dst instance → group index
     dst: jax.Array,  # [O, N] int32
     payload: jax.Array,  # [O, W, N] int32
     valid: jax.Array,  # [O, N] bool
@@ -215,9 +231,11 @@ def enqueue(
     dst_safe = jnp.clip(dst_f, 0, n - 1)
     val_f = val_f & (dst_f >= 0) & (dst_f < n)
 
-    # --- filters: Accept / Reject / Drop per (src, dst group)
+    # --- filters: Accept / Reject / Drop per (src, dst region)
     if "filters" in features:
-        action = link.filters.reshape(-1)[group_of[dst_safe] * n + src_f]
+        action = link.filters.reshape(-1)[
+            link.region_of[dst_safe] * n + src_f
+        ]
         rejected_msg = val_f & (action == FILTER_REJECT)
         val_f = val_f & (action == FILTER_ACCEPT)
         rejected = jnp.sum(
@@ -365,8 +383,10 @@ def apply_net_updates(
     link: LinkState,
     net_shape: jax.Array,  # [7, N] plane layout (from step out_axes=-1)
     net_shape_valid: jax.Array,  # [N]
-    net_filters: jax.Array,  # [G, N]
+    net_filters: jax.Array,  # [R, N]
     net_filters_valid: jax.Array,  # [N]
+    net_region: jax.Array | None = None,  # [N] int32
+    net_region_valid: jax.Array | None = None,  # [N]
 ) -> LinkState:
     """Apply per-instance network reconfigurations emitted by steps — the
     sidecar handler's "apply each network.Config received" loop
@@ -378,4 +398,7 @@ def apply_net_updates(
         )
     else:
         filters = link.filters
-    return LinkState(egress=egress, filters=filters)
+    region_of = link.region_of
+    if net_region is not None and net_region_valid is not None:
+        region_of = jnp.where(net_region_valid, net_region, region_of)
+    return LinkState(egress=egress, filters=filters, region_of=region_of)

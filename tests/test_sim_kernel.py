@@ -35,11 +35,9 @@ def _send_one(cal, link, src, dst, word, t=0, tick_ms=1.0, n=4, seed=0):
     dsts = jnp.zeros((n, 1), jnp.int32).at[src, 0].set(dst)
     pay = jnp.zeros((n, 1, cal.width), jnp.int32).at[src, 0, 0].set(word)
     valid = jnp.zeros((n, 1), bool).at[src, 0].set(True)
-    group_of = jnp.zeros((n,), jnp.int32)
     return enqueue(
         cal,
         link,
-        group_of,
         jnp.transpose(dsts),            # [O, N]
         jnp.transpose(pay, (1, 2, 0)),  # [O, W, N]
         jnp.transpose(valid),           # [O, N]
@@ -110,6 +108,7 @@ class TestTransport:
         link = LinkState(
             egress=_link().egress,
             filters=jnp.full((1, 4), FILTER_DROP, jnp.int32),
+            region_of=jnp.zeros((4,), jnp.int32),
         )
         cal, rej = _send_one(cal, link, 0, 1, 7, t=0)
         assert int(rej.sum()) == 0  # DROP is silent (BLACKHOLE route)
@@ -121,6 +120,7 @@ class TestTransport:
         link = LinkState(
             egress=_link().egress,
             filters=jnp.full((1, 4), FILTER_REJECT, jnp.int32),
+            region_of=jnp.zeros((4,), jnp.int32),
         )
         cal, rej = _send_one(cal, link, 0, 1, 7, t=0)
         assert int(rej[0]) == 1  # PROHIBIT route: sender sees the refusal
@@ -139,7 +139,6 @@ class TestTransport:
         cal, _ = enqueue(
             cal,
             link,
-            jnp.zeros((n,), jnp.int32),
             dsts,
             pay,
             valid,
@@ -162,7 +161,6 @@ class TestTransport:
         cal, _ = enqueue(
             cal,
             link,
-            jnp.zeros((n,), jnp.int32),
             dsts,
             pay,
             valid,

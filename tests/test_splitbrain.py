@@ -1,0 +1,99 @@
+"""splitbrain plan tests: dynamic region partitioning + accept/reject/drop
+filters + heal (the sim analog of integration_tests/09-11 and the protocol
+of /root/reference/plans/splitbrain/main.go)."""
+
+import numpy as np
+import pytest
+
+from testground_tpu.sim.api import FAILURE, SUCCESS
+from testground_tpu.sim.engine import SimProgram
+
+from test_sim_engine import make_groups, mesh8, plan_case
+
+
+def region_counts(n):
+    return [sum(1 for x in range(1, n + 1) if x % 3 == r) for r in range(3)]
+
+
+def regions(n):
+    return np.asarray([(i + 1) % 3 for i in range(n)])
+
+
+def run_case(case, n, mesh=None, **kw):
+    prog = SimProgram(
+        plan_case("splitbrain", case),
+        make_groups(n),
+        test_plan="splitbrain",
+        test_case=case,
+        mesh=mesh,
+        chunk=32,
+    )
+    return prog.run(max_ticks=4096, **kw)
+
+
+class TestSplitBrain:
+    @pytest.mark.parametrize("case", ["accept", "reject", "drop"])
+    def test_verdicts_all_success(self, case):
+        res = run_case(case, 9)
+        assert (res["status"] == SUCCESS).all(), res["status"]
+
+    def test_reply_counts_respect_partition(self):
+        n = 9
+        res = run_case("drop", n)
+        st = res["states"][0]
+        reg = regions(n)
+        n_a, n_b, _ = region_counts(n)
+        np.testing.assert_array_equal(np.asarray(st["region"]), reg)
+        replies = np.asarray(st["replies"])
+        # A misses B's replies; B misses A's; C hears everyone. (The heal
+        # phase adds replies after the verdict, so compare with >=.)
+        expected = np.where(
+            reg == 0, n - 1 - n_b, np.where(reg == 1, n - 1 - n_a, n - 1)
+        )
+        assert (replies >= expected).all()
+
+    def test_reject_feedback_counts(self):
+        """Region A sees exactly 2·|B| REJECTs (probes + replies toward B);
+        drop sees none — PROHIBIT vs BLACKHOLE (link.go:187-217)."""
+        n = 9
+        n_b = region_counts(n)[1]
+        rej = np.asarray(run_case("reject", n)["states"][0]["rejected_total"])
+        drp = np.asarray(run_case("drop", n)["states"][0]["rejected_total"])
+        reg = regions(n)
+        np.testing.assert_array_equal(rej[reg == 0], 2 * n_b)
+        np.testing.assert_array_equal(rej[reg != 0], 0)
+        np.testing.assert_array_equal(drp, 0)
+
+    def test_blocks_then_heals(self):
+        """Drop-case SUCCESS is itself the block proof: the judge demands
+        replies == (n−1) − |B| for region A, which an unblocked network
+        would overshoot (n−1 ≠ n−1−|B| when |B| > 0) → FAILURE. The heal
+        proof is every region-A instance's latched heal reply, which can
+        only arrive after its filters were restored to ACCEPT."""
+        n = 6
+        res = run_case("drop", n)
+        assert (res["status"] == SUCCESS).all()
+        st = res["states"][0]
+        reg = regions(n)
+        assert region_counts(n)[0] > 0  # region A nonempty at this n
+        assert np.asarray(st["heal_got"])[reg == 0].all()
+        assert (np.asarray(st["phase"]) == 6).all()  # P_DONE
+
+    def test_sharded_mesh_matches_single(self):
+        n = 12
+        res_m = run_case("reject", n, mesh=mesh8())
+        res_s = run_case("reject", n)
+        assert (res_m["status"] == SUCCESS).all()
+        for key in ("region", "replies", "rejected_total", "heal_got"):
+            np.testing.assert_array_equal(
+                np.asarray(res_m["states"][0][key]),
+                np.asarray(res_s["states"][0][key]),
+                err_msg=key,
+            )
+
+    def test_4k_scale_smoke(self):
+        """BASELINE config 4 shape at reduced-but-nontrivial scale in CI;
+        the full 4k single-chip run happens in bench/TPU sessions."""
+        n = 192
+        res = run_case("drop", n)
+        assert (res["status"] == SUCCESS).all()
