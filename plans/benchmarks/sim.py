@@ -9,6 +9,7 @@ round-trip traffic for a fixed simulated duration (the vectorized analog of
 ``plans/network`` ping-pong, run at 100k instances).
 """
 
+import jax
 import jax.numpy as jnp
 
 from testground_tpu.sim.api import (
@@ -106,6 +107,157 @@ class PingPongFlood(SimTestcase):
         return {"flood.rounds": final_state["rounds"]}
 
 
+class Storm(SimTestcase):
+    """Gossip-storm flood over a random connection graph — the sim twin of
+    ``plans/benchmarks/storm.go:66-120`` (BASELINE config 5 @ 100k).
+
+    Reference protocol: every instance opens listeners, publishes its
+    addresses, barriers on "listening", dials ``conn_outgoing`` random
+    peers after a random delay, then pushes ``data_size_kb`` KiB down
+    each connection in 4 KiB chunks while receivers count bytes read.
+
+    Sim mechanics: the random graph is drawn from each instance's PRNG
+    key at init (dials = picking dst indices; the publish/subscribe
+    address exchange is unnecessary because instance indices are the
+    addresses). Each tick every live connection carries one 4 KiB chunk
+    message — multi-message fan-out with Poisson(K) fan-in at the
+    receivers, which forces the general "sorted" slot path the flood
+    bench avoids. Random per-connection start delays mirror
+    ``conn_delay_ms``. Completion: all chunks written → signal
+    "done-writing" → barrier on the full count (storm.go's final
+    SignalAndWait). The reference's per-dial "outgoing-dials-done"
+    barrier (target N·outgoing) collapses to one signal per instance
+    when its last connection opens (sync signals are per-tick 0/1).
+
+    Metrics: bytes.sent / bytes.read per instance (storm.go's counters).
+    Inbox overflow (fan-in beyond IN_MSGS in one tick) drops chunks like
+    a full accept queue; receivers surface it as read<sent totals.
+    """
+
+    STATES = ["listening", "dials-done", "done-writing"]
+    MSG_WIDTH = 2  # word0: kind, word1: chunk seq
+    OUT_MSGS = 8  # upper bound on conn_outgoing
+    IN_MSGS = 16  # covers the Poisson(K≤8) per-tick fan-in tail
+    MAX_LINK_TICKS = 8
+    TRACK_SRC = False
+    SHAPING = ("latency",)
+    CHUNK_BYTES = 4096  # storm.go buffersize
+
+    def init(self, env):
+        cls = type(self)
+        n = env.test_instance_count
+        k_targets, k_delay = jax.random.split(env.key)
+        # conn_outgoing random peers, self-index skipped by shifting
+        targets = jax.random.randint(
+            k_targets, (cls.OUT_MSGS,), 0, max(n - 1, 1)
+        )
+        targets = targets + (targets >= env.global_seq)
+        delay_max = (
+            env.int_param("conn_delay_ticks")
+            if "conn_delay_ticks" in env.group.params
+            else 32
+        )
+        delays = jax.random.randint(
+            k_delay, (cls.OUT_MSGS,), 0, max(delay_max, 1)
+        )
+        return {
+            "targets": targets.astype(jnp.int32),
+            "delays": delays.astype(jnp.int32),
+            "sent_chunks": jnp.zeros((cls.OUT_MSGS,), jnp.int32),
+            "bytes_read": jnp.int32(0),
+            "start": jnp.int32(-1),
+            "dialed": jnp.asarray(False),
+            "written": jnp.asarray(False),
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        outgoing = min(
+            env.int_param("conn_outgoing")
+            if "conn_outgoing" in env.group.params
+            else 5,
+            cls.OUT_MSGS,
+        )
+        chunks = (
+            env.int_param("data_size_kb")
+            if "data_size_kb" in env.group.params
+            else 128
+        ) * 1024 // cls.CHUNK_BYTES
+
+        conn = jnp.arange(cls.OUT_MSGS, dtype=jnp.int32)
+        live_conn = conn < outgoing
+
+        listening = sync.counts[self.state_id("listening")] >= n
+        start = jnp.where(
+            (state["start"] < 0) & listening, t, state["start"]
+        )
+        started = start >= 0
+
+        # connection c opens at start + delays[c] (conn_delay_ms jitter);
+        # writes begin only after the global dials barrier, like the
+        # per-connection SignalAndWait("outgoing-dials-done") gate in
+        # storm.go — every instance then floods all K connections at once
+        opened = started & (t >= start + state["delays"]) & live_conn
+        all_dialed = started & jnp.all(
+            (t >= start + state["delays"]) | ~live_conn
+        )
+        sig_dialed = all_dialed & ~state["dialed"]
+        writes_open = sync.counts[self.state_id("dials-done")] >= n
+        sending = opened & writes_open & (state["sent_chunks"] < chunks)
+        sent_chunks = state["sent_chunks"] + sending.astype(jnp.int32)
+
+        all_written = started & jnp.all(
+            (sent_chunks >= chunks) | ~live_conn
+        )
+        sig_written = all_written & ~state["written"]
+
+        kind = inbox.payload[0]
+        got = inbox.valid & (kind == PING)  # chunk messages reuse kind=1
+        bytes_read = state["bytes_read"] + cls.CHUNK_BYTES * jnp.sum(
+            got.astype(jnp.int32)
+        )
+
+        done = sync.counts[self.state_id("done-writing")] >= n
+
+        ob = Outbox(
+            dst=state["targets"],
+            payload=jnp.stack(
+                [
+                    jnp.full((cls.OUT_MSGS,), PING, jnp.int32),
+                    state["sent_chunks"],
+                ],
+                axis=-1,
+            ),
+            valid=sending,
+        )
+
+        return self.out(
+            {
+                "targets": state["targets"],
+                "delays": state["delays"],
+                "sent_chunks": sent_chunks,
+                "bytes_read": bytes_read,
+                "start": start,
+                "dialed": state["dialed"] | sig_dialed,
+                "written": state["written"] | sig_written,
+            },
+            status=jnp.where(done, SUCCESS, RUNNING),
+            outbox=ob,
+            signals=self.signal("listening") * (t == 0)
+            + self.signal("dials-done") * sig_dialed
+            + self.signal("done-writing") * sig_written,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        cls = type(self)
+        return {
+            "storm.bytes_sent": cls.CHUNK_BYTES
+            * final_state["sent_chunks"].sum(axis=-1),
+            "storm.bytes_read": final_state["bytes_read"],
+        }
+
+
 class Startup(SimTestcase):
     """time-to-start analog (``benchmarks.go:23``): succeed on the first
     tick; finished_at gives the framework's per-instance startup cost (a
@@ -119,4 +271,5 @@ sim_testcases = {
     "barrier": Barrier,
     "pingpong-flood": PingPongFlood,
     "startup": Startup,
+    "storm": Storm,
 }

@@ -164,6 +164,126 @@ class PingPong(SimTestcase):
         }
 
 
+class PingPongSustained(SimTestcase):
+    """The headline full-path workload: paired ping-pong sustained for a
+    fixed simulated duration with NONE of the fast-path shortcuts —
+    general sorted slot assignment, sender provenance tracked, every
+    LinkShape feature compiled in (zero rates, full machinery), live sync
+    counters (each completed round signals "round"), and a periodic
+    mid-run latency reshape through the dynamic net-config path.
+
+    This is what BENCH reports as the primary number: the same transport
+    semantics `plans/network` ping-pong exercises, held at full load for
+    the whole run instead of finishing after two rounds (the plain
+    ``ping-pong`` case at 100k is run alongside it as the correctness
+    checkpoint). Reference behavior: ``pingpong.go`` + the reshape at
+    ``pingpong.go:185-195``.
+    """
+
+    STATES = ["ready", "round"]
+    MSG_WIDTH = 1  # kind and round packed: word0 = kind | round << 2
+    OUT_MSGS = 2  # slot 0: pong replies, slot 1: own pings
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 8  # covers the 4ms/2ms shaped latencies at 1ms ticks
+    # deliberately general: sorted slot path, src plane on, and every
+    # shaping feature except duplicate (whose second-copy pass doubles
+    # the message axis; plans that shape duplicates declare it — none of
+    # the reference network plans do)
+    SHAPING = (
+        "latency",
+        "jitter",
+        "bandwidth",
+        "loss",
+        "corrupt",
+        "reorder",
+        "filters",
+    )
+
+    def init(self, env):
+        z = jnp.int32(0)
+        return {"rounds": z, "started": jnp.asarray(False), "shape_hi": z}
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        duration = (
+            env.int_param("duration_ticks")
+            if "duration_ticks" in env.group.params
+            else 1000
+        )
+        lat1 = (
+            env.float_param("latency_ms")
+            if "latency_ms" in env.group.params
+            else 4.0
+        )
+        lat2 = (
+            env.float_param("latency2_ms")
+            if "latency2_ms" in env.group.params
+            else 2.0
+        )
+        reshape_every = (
+            env.int_param("reshape_every")
+            if "reshape_every" in env.group.params
+            else 1000
+        )
+        partner = env.global_seq ^ 1
+
+        # only count messages from the partner (provenance check — the
+        # reason this path keeps the src plane); word0 packs kind in the
+        # low 2 bits and the round number above
+        from_partner = inbox.valid & (inbox.src == partner)
+        kind = inbox.payload[0] & 3
+        got_ping = jnp.any(from_partner & (kind == PING))
+        got_pong = jnp.any(from_partner & (kind == PONG))
+
+        ready = sync.counts[self.state_id("ready")] >= n
+        started = state["started"] | ready
+        open_ping = ready & ~state["started"]
+
+        rounds = state["rounds"] + got_pong.astype(jnp.int32)
+        send_ping = open_ping | got_pong
+        send_pong = got_ping
+
+        done = t >= duration
+        ok = rounds > 0
+        status = jnp.where(
+            done, jnp.where(ok, SUCCESS, FAILURE), RUNNING
+        ).astype(jnp.int32)
+
+        ob = Outbox.empty(cls.OUT_MSGS, cls.MSG_WIDTH)
+        ob = Outbox(
+            dst=ob.dst.at[0].set(partner).at[1].set(partner),
+            payload=ob.payload.at[0, 0]
+            .set(PONG | (rounds << 2))
+            .at[1, 0]
+            .set(PING | (rounds << 2)),
+            valid=ob.valid.at[0]
+            .set(send_pong & ~done)
+            .at[1]
+            .set(send_ping & ~done),
+        )
+
+        # periodic reshape through the dynamic net-config path
+        at_reshape = started & (jnp.mod(t, reshape_every) == 0) & (t > 0)
+        shape_hi = jnp.where(
+            at_reshape, 1 - state["shape_hi"], state["shape_hi"]
+        )
+        lat = jnp.where(shape_hi == 0, lat1, lat2)
+
+        return self.out(
+            {"rounds": rounds, "started": started, "shape_hi": shape_hi},
+            status=status,
+            outbox=ob,
+            signals=self.signal("ready") * (t == 0)
+            + self.signal("round") * got_pong,
+            net_shape=self.link_shape(latency_ms=lat),
+            net_shape_valid=(t == 0) | at_reshape,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {"sustained.rounds": final_state["rounds"]}
+
+
 class _Traffic(SimTestcase):
     """Ring traffic under an Accept (allowed) or Drop (blocked) filter."""
 
@@ -238,6 +358,7 @@ class TrafficBlocked(_Traffic):
 
 sim_testcases = {
     "ping-pong": PingPong,
+    "pingpong-sustained": PingPongSustained,
     "traffic-allowed": TrafficAllowed,
     "traffic-blocked": TrafficBlocked,
 }

@@ -155,6 +155,7 @@ class SimProgram:
                 if carry.cal.src is not None
                 else None,
                 valid=wsc(carry.cal.valid, self._ishard(1)),
+                occ=wsc(carry.cal.occ, self._ishard(1)),
                 slots=carry.cal.slots,
             ),
             link=LinkState(

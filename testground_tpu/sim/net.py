@@ -38,6 +38,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .api import FILTER_ACCEPT, FILTER_REJECT, Inbox
 
@@ -95,6 +96,10 @@ class Calendar:
     payload: tuple of W planes, each [L, N·SLOTS] int32
     src:     [L, N·SLOTS] int32
     valid:   [L, N·SLOTS] bool
+    occ:     [L, N] int32 — slots already filled per (bucket, dst), so
+             messages enqueued on LATER ticks into the same bucket stack
+             into the next free slots instead of overwriting (a TCP accept
+             queue keeps earlier connections; only overflow drops)
 
     The N·SLOTS axis is ordered slot-major (``pos = slot·N + dst``) so a
     row reshapes to [SLOTS, N]. ``slots`` is static structure, not data.
@@ -103,6 +108,7 @@ class Calendar:
     payload: tuple
     src: jax.Array | None  # None when the plan opted out (TRACK_SRC=False)
     valid: jax.Array
+    occ: jax.Array
     slots: int = dataclasses.field(metadata=dict(static=True), default=4)
 
     @staticmethod
@@ -116,6 +122,7 @@ class Calendar:
             ),
             src=jnp.zeros((horizon, ns), jnp.int32) if track_src else None,
             valid=jnp.zeros((horizon, ns), bool),
+            occ=jnp.zeros((horizon, n), jnp.int32),
             slots=slots,
         )
 
@@ -168,6 +175,9 @@ def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
         valid=jax.lax.dynamic_update_index_in_dim(
             cal.valid, jnp.zeros((ns,), bool), b, axis=0
         ),
+        occ=jax.lax.dynamic_update_index_in_dim(
+            cal.occ, jnp.zeros((n,), jnp.int32), b, axis=0
+        ),
     )
     return cal, inbox
 
@@ -213,20 +223,47 @@ def enqueue(
     val_f = valid.reshape(-1)
     m = val_f.shape[0]
 
-    def eg(plane):  # per-message egress attribute; no gather when O == 1
-        return link.egress[plane] if o == 1 else link.egress[plane][src_f]
+    def eg(plane):
+        # per-message egress attribute: src_f == midx % n, so the gather
+        # is exactly an o-fold tile of the per-instance plane — a
+        # broadcast, never a random-access gather
+        if o == 1:
+            return link.egress[plane]
+        return jnp.tile(link.egress[plane], o)
 
-    rng_feats = [
-        f
-        for f in ("loss", "jitter", "corrupt", "reorder", "duplicate")
-        if f in features
-    ]
-    ukeys = dict(
-        zip(rng_feats + ["_bit"], jax.random.split(key, len(rng_feats) + 1))
-    )
+    # Per-feature uniforms come from a murmur3-finalizer hash of
+    # (message index, per-tick key salt, feature id) rather than threefry
+    # (~3× cheaper on the VPU at these sizes; full-avalanche mixing is
+    # plenty for shaping decisions — this is a simulator's netem dice,
+    # not cryptography).
+    # int32-native (wrapping multiplies are two's-complement, logical
+    # shifts via lax) — no dtype conversions to break XLA fusion
+    shr = jax.lax.shift_right_logical
+    kd = jax.random.key_data(key).astype(jnp.int32).reshape(-1)
+    salt = kd[0] ^ (kd[-1] * np.int32(-1640531527))  # 0x9E3779B9
+    iota_m = jnp.arange(m, dtype=jnp.int32)
+
+    def uhash(feat):
+        # fid·0x9E3779B9 folded on the host (int32 wraparound)
+        fid_mix = jnp.int32(
+            np.multiply(
+                np.int32(1 + FULL_SHAPING.index(feat)),
+                np.int32(-1640531527),
+                dtype=np.int32,
+                casting="unsafe",
+            )
+        )
+        x = iota_m * np.int32(-1640531535) + salt + fid_mix
+        x = x ^ shr(x, 16)
+        x = x * np.int32(-2048144789)  # 0x85EBCA6B
+        x = x ^ shr(x, 13)
+        x = x * np.int32(-1028477387)  # 0xC2B2AE35
+        return x ^ shr(x, 16)
 
     def u(feat):
-        return jax.random.uniform(ukeys[feat], (m,))
+        return shr(uhash(feat), 8).astype(jnp.float32) * jnp.float32(
+            2**-24
+        )
 
     dst_safe = jnp.clip(dst_f, 0, n - 1)
     val_f = val_f & (dst_f >= 0) & (dst_f < n)
@@ -258,10 +295,14 @@ def enqueue(
     if "loss" in features:
         val_f = val_f & (u("loss") * 100.0 >= eg(LOSS))
 
-    # --- corrupt: flip one random bit of payload word 0
+    # --- corrupt: flip one random bit of payload word 0 (the decision
+    # uses the hash's high bits, the bit index its low byte)
     if "corrupt" in features:
-        corrupt = u("corrupt") * 100.0 < eg(CORRUPT)
-        bit = jax.random.randint(ukeys["_bit"], (m,), 0, 31)
+        hc = uhash("corrupt")
+        corrupt = shr(hc, 8).astype(jnp.float32) * jnp.float32(
+            2**-24
+        ) * 100.0 < eg(CORRUPT)
+        bit = jnp.mod(hc & 0xFF, 31)
         pay_w[0] = jnp.where(
             corrupt, pay_w[0] ^ (jnp.int32(1) << bit), pay_w[0]
         )
@@ -330,21 +371,47 @@ def enqueue(
 
     bucket = jnp.mod(t + delay2, horizon)
 
-    # --- slot assignment: sort by (bucket, dst), rank within equal key
-    # runs via a prefix-max of run starts (one cummax — no binary-search
-    # while-loop). Invalid messages sort to the end.
+    # --- slot assignment: one stable multi-operand sort by (bucket, dst)
+    # carries every message attribute in the same pass (cheaper than
+    # argsort + per-attribute gathers), then rank within equal-key runs
+    # via a prefix-max of run starts (one cummax — no binary-search
+    # while-loop). The key encodes everything positional — bucket, dst,
+    # AND validity (invalid ⇒ key = big, sorting to the end) — so only
+    # src and the payload words ride along as sort values; bucket/dst/
+    # valid are re-derived from the sorted key instead of sorted.
     big = jnp.int32(horizon * n)
     sort_key = jnp.where(val2, bucket * n + dst2, big)
-    order = jnp.argsort(sort_key)
-    sk = sort_key[order]
+    sorted_ops = jax.lax.sort(
+        [sort_key, src2] + list(pay2), num_keys=1, is_stable=True
+    )
+    sk, src_s = sorted_ops[:2]
+    pay_s = sorted_ops[2:]
+    val_sorted = sk < big
+    buck_s = jnp.where(val_sorted, sk // n, horizon)
+    dst_s = jnp.mod(sk, n)
     pos = jnp.arange(m2, dtype=jnp.int32)
     is_start = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
     rank = pos - jax.lax.cummax(jnp.where(is_start, pos, 0))
 
-    dst_s = dst2[order]
-    src_s = src2[order]
-    val_s = val2[order] & (rank < slots)  # per-dst-per-tick inbox overflow
-    buck_s = bucket[order]
+    # --- cross-tick stacking: ranks start at the bucket's current fill
+    # so messages landing in a bucket over several ticks occupy
+    # successive slots instead of overwriting earlier arrivals; the last
+    # message of each (bucket, dst) run writes the new fill level back.
+    # The occupancy plane's flat index IS the sort key.
+    occ_flat = cal.occ.reshape(-1)
+    base = occ_flat[jnp.minimum(sk, big - 1)]
+    rank = rank + jnp.where(val_sorted, base, 0)
+    val_s = val_sorted & (rank < slots)  # per-dst inbox overflow
+    is_end = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones((1,), bool)])
+    occ_upd = val_sorted & is_end
+    # dropped updates get unique out-of-range flat indices ≥ big so the
+    # scatter keeps its no-dedup lowering
+    occ_idx = jnp.where(occ_upd, sk, big + pos)
+    new_occ = (
+        occ_flat.at[occ_idx]
+        .set(jnp.minimum(rank + 1, slots), mode="drop", unique_indices=True)
+        .reshape(cal.occ.shape)
+    )
 
     # Scatter into the [L, N·SLOTS] planes at (bucket, slot·N + dst).
     # Indices are unique by construction (rank is unique within each
@@ -355,10 +422,8 @@ def enqueue(
     pos_i = jnp.where(val_s, rank * n + dst_s, pos)
 
     new_payload = tuple(
-        p.at[buck_i, pos_i].set(
-            pw[order], mode="drop", unique_indices=True
-        )
-        for p, pw in zip(cal.payload, pay2)
+        p.at[buck_i, pos_i].set(pw, mode="drop", unique_indices=True)
+        for p, pw in zip(cal.payload, pay_s)
     )
     new_src = (
         cal.src.at[buck_i, pos_i].set(
@@ -373,7 +438,11 @@ def enqueue(
 
     return (
         dataclasses.replace(
-            cal, payload=new_payload, src=new_src, valid=new_valid
+            cal,
+            payload=new_payload,
+            src=new_src,
+            valid=new_valid,
+            occ=new_occ,
         ),
         rejected,
     )

@@ -1,0 +1,91 @@
+"""benchmarks plan tests: barrier, pingpong-flood, and the storm gossip
+flood (sim twins of /root/reference/plans/benchmarks — benchmarks.go
+barrier/startup, storm.go)."""
+
+import numpy as np
+
+from testground_tpu.sim.api import SUCCESS
+from testground_tpu.sim.engine import SimProgram
+
+from test_sim_engine import make_groups, mesh8, plan_case
+
+
+def run_case(case, n, params=None, mesh=None, max_ticks=4096, chunk=64):
+    prog = SimProgram(
+        plan_case("benchmarks", case),
+        make_groups(n, params=params),
+        test_plan="benchmarks",
+        test_case=case,
+        mesh=mesh,
+        chunk=chunk,
+    )
+    return prog.run(max_ticks=max_ticks)
+
+
+class TestBarrier:
+    def test_releases_all(self):
+        res = run_case("barrier", 64, chunk=8)
+        assert (res["status"] == SUCCESS).all()
+        # everyone releases the tick after the counter fills
+        assert (res["finished_at"] == res["finished_at"][0]).all()
+
+
+class TestStorm:
+    def test_all_bytes_flow(self):
+        """Conservation: with IN_MSGS covering the fan-in, every chunk
+        written lands at a receiver (storm.go's bytes.sent/bytes.read
+        counters; TCP would deliver exactly as many)."""
+        n = 24
+        res = run_case(
+            "storm",
+            n,
+            params={
+                "conn_outgoing": "3",
+                "conn_delay_ticks": "8",
+                "data_size_kb": "16",
+            },
+        )
+        assert (res["status"] == SUCCESS).all()
+        st = res["states"][0]
+        sent = 4096 * np.asarray(st["sent_chunks"]).sum()
+        read = np.asarray(st["bytes_read"]).sum()
+        assert sent == n * 3 * 4 * 4096  # 3 conns × 4 chunks × 4 KiB each
+        assert read == sent
+
+    def test_writes_gated_on_dials_barrier(self):
+        """No chunk may arrive before every instance finished dialing
+        (the outgoing-dials-done gate in storm.go): with a long dial
+        jitter window, early connections must idle until the barrier."""
+        n = 8
+        res = run_case(
+            "storm",
+            n,
+            params={
+                "conn_outgoing": "2",
+                "conn_delay_ticks": "64",
+                "data_size_kb": "4",
+            },
+        )
+        assert (res["status"] == SUCCESS).all()
+        st = res["states"][0]
+        # all writes happen after every delay elapsed → finished_at is
+        # at least the max dial delay plus the chunk count
+        delays = np.asarray(st["delays"])[:, :2]
+        assert res["finished_at"].min() >= delays.max()
+
+    def test_sharded_matches_single(self):
+        n = 16
+        params = {
+            "conn_outgoing": "2",
+            "conn_delay_ticks": "4",
+            "data_size_kb": "8",
+        }
+        res_m = run_case("storm", n, params=params, mesh=mesh8())
+        res_s = run_case("storm", n, params=params)
+        assert (res_m["status"] == SUCCESS).all()
+        for key in ("sent_chunks", "bytes_read", "targets"):
+            np.testing.assert_array_equal(
+                np.asarray(res_m["states"][0][key]),
+                np.asarray(res_s["states"][0][key]),
+                err_msg=key,
+            )

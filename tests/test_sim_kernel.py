@@ -250,3 +250,42 @@ class TestSyncKernel:
                            jnp.zeros((t_, n), jnp.int32))
         assert int(sync.stream_len[0]) == cap
         assert int(sync.dropped[0]) == n - cap
+
+
+class TestCrossTickStacking:
+    def test_two_ticks_same_bucket_stack_into_slots(self):
+        """Messages enqueued on DIFFERENT ticks that land in the same
+        arrival bucket must occupy successive inbox slots, not overwrite
+        (a TCP accept queue keeps earlier connections). Sender 0 sends at
+        t=0 with 2-tick latency, sender 1 at t=1 with 1-tick latency —
+        both arrive at t=2."""
+        n = 4
+        cal = _cal(horizon=8, n=n, slots=2, width=2)
+        link_fast = _link(n=n, latency=1.0)
+        link_slow = _link(n=n, latency=2.0)
+        cal, _ = _send_one(cal, link_slow, src=0, dst=3, word=111, t=0)
+        cal, inbox = deliver(cal, jnp.int32(1))
+        assert not bool(inbox.valid.any())
+        cal, _ = _send_one(cal, link_fast, src=1, dst=3, word=222, t=1)
+        cal, inbox = deliver(cal, jnp.int32(2))
+        got = set(
+            int(inbox.payload[0, s, 3])
+            for s in range(2)
+            if bool(inbox.valid[s, 3])
+        )
+        assert got == {111, 222}
+
+    def test_occupancy_clears_after_delivery(self):
+        """A delivered bucket's fill level resets, so its reuse at
+        t + horizon starts from slot 0."""
+        n = 4
+        cal = _cal(horizon=4, n=n, slots=1, width=2)
+        link = _link(n=n, latency=1.0)
+        cal, _ = _send_one(cal, link, src=0, dst=2, word=5, t=0)
+        cal, inbox = deliver(cal, jnp.int32(1))
+        assert bool(inbox.valid[0, 2])
+        # one full horizon later, the same bucket accepts a new message
+        cal, _ = _send_one(cal, link, src=0, dst=2, word=6, t=4)
+        cal, inbox = deliver(cal, jnp.int32(5))
+        assert bool(inbox.valid[0, 2])
+        assert int(inbox.payload[0, 0, 2]) == 6
