@@ -30,18 +30,21 @@ from testground_tpu.utils.conv import parse_key_values
 # --------------------------------------------------------------- plumbing
 
 
-def _engine(args) -> Engine:
-    """In-process engine (daemon transport arrives with the daemon layer).
+def _engine(args):
+    """The engine behind every verb: in-process by default, or an
+    Engine-shaped HTTP client when ``--endpoint`` (or the .env.toml
+    ``[client] endpoint``) points at a daemon — the client↔daemon hop is
+    transport, not semantics (``pkg/client/client.go:43-513``).
 
-    Task state must survive across CLI invocations (status/logs/tasks run in
-    fresh processes), so the memory default upgrades to disk unless
-    .env.toml explicitly chose memory."""
-    if getattr(args, "endpoint", ""):
-        raise NotImplementedError(
-            "--endpoint (remote daemon) is not wired up yet; "
-            "commands run against the in-process engine"
-        )
+    In-process task state must survive across CLI invocations
+    (status/logs/tasks run in fresh processes), so the memory default
+    upgrades to disk unless .env.toml explicitly chose memory."""
     env = EnvConfig.load()
+    endpoint = getattr(args, "endpoint", "") or env.client.endpoint
+    if endpoint:
+        from testground_tpu.client import Client, RemoteEngine
+
+        return RemoteEngine(Client(endpoint, token=env.client.token), env)
     if not env.task_repo_explicit:
         env.daemon.scheduler.task_repo_type = "disk"
     engine = Engine.new_default(env)
@@ -204,11 +207,16 @@ def run_single_cmd(args) -> int:
 
 
 def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
+    from testground_tpu.client import RemoteEngine
+
     engine = _engine(args)
     try:
-        env = engine.env
-        src_dir, manifest = _resolve_plan(env, comp.global_.plan)
-        task_id = engine.queue_run(comp, manifest, sources_dir=src_dir)
+        if isinstance(engine, RemoteEngine):
+            # the daemon resolves the plan from ITS $TESTGROUND_HOME/plans
+            task_id = engine.queue_run(comp)
+        else:
+            src_dir, manifest = _resolve_plan(engine.env, comp.global_.plan)
+            task_id = engine.queue_run(comp, manifest, sources_dir=src_dir)
         print(f"run is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
         outcome = t.outcome()
@@ -259,11 +267,16 @@ def register_build(sub) -> None:
 
 
 def build_composition_cmd(args) -> int:
+    from testground_tpu.client import RemoteEngine
+
     comp = load_composition(args.file)
     engine = _engine(args)
     try:
-        src_dir, manifest = _resolve_plan(engine.env, comp.global_.plan)
-        task_id = engine.queue_build(comp, manifest, sources_dir=src_dir)
+        if isinstance(engine, RemoteEngine):
+            task_id = engine.queue_build(comp)
+        else:
+            src_dir, manifest = _resolve_plan(engine.env, comp.global_.plan)
+            task_id = engine.queue_build(comp, manifest, sources_dir=src_dir)
         print(f"build is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
         print(f"finished build with ID: {task_id} (outcome: {t.outcome().value})")
@@ -344,6 +357,15 @@ def plan_import_cmd(args) -> int:
     src = os.path.abspath(args.source)
     if not os.path.isfile(os.path.join(src, "manifest.toml")):
         raise FileNotFoundError(f"{src} has no manifest.toml")
+    endpoint = getattr(args, "endpoint", "") or env.client.endpoint
+    if endpoint:
+        from testground_tpu.client import Client
+
+        name = Client(endpoint, token=env.client.token).import_plan(
+            src, name=args.name
+        )
+        print(f"imported plan {name} into daemon at {endpoint}")
+        return 0
     name = args.name or os.path.basename(src.rstrip("/"))
     dest = os.path.join(env.dirs.plans(), name)
     if os.path.exists(dest):
@@ -570,13 +592,19 @@ def terminate_cmd(args) -> int:
 
 def register_daemon(sub) -> None:
     p = sub.add_parser("daemon", help="run the testground daemon")
+    p.add_argument(
+        "--listen",
+        default="",
+        help="listen address host:port (default: .env.toml daemon.listen "
+        "or localhost:8042)",
+    )
     p.set_defaults(func=daemon_cmd)
 
 
 def daemon_cmd(args) -> int:
     from testground_tpu.daemon.server import serve
 
-    return serve()
+    return serve(listen=args.listen)
 
 
 def register_version(sub) -> None:

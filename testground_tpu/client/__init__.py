@@ -1,0 +1,5 @@
+"""HTTP client for the testground-tpu daemon (``pkg/client``)."""
+
+from .client import Client, DaemonError, RemoteEngine
+
+__all__ = ["Client", "DaemonError", "RemoteEngine"]

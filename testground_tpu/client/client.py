@@ -1,0 +1,261 @@
+"""HTTP client for the daemon — the L6 tier (``pkg/client/client.go``).
+
+Two layers:
+
+- :class:`Client` — thin typed wrappers over the daemon routes
+  (``Client.Run/Build/Tasks/Status/Logs/CollectOutputs/Terminate/
+  Healthcheck``, ``client.go:43-513``), stdlib ``http.client`` only, with
+  bearer-token auth and streaming reads for /logs and /outputs.
+- :class:`RemoteEngine` — an adapter exposing the subset of the Engine
+  surface the CLI uses, so every ``tg`` verb works identically against
+  ``--endpoint`` (the reference's client↔daemon hop is transport, not
+  semantics — SURVEY.md §7 M2).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+from typing import Iterator
+from urllib.parse import urlparse
+
+from testground_tpu.engine import Task
+from testground_tpu.healthcheck.report import CheckResult, Report
+
+__all__ = ["Client", "RemoteEngine"]
+
+
+class DaemonError(RuntimeError):
+    pass
+
+
+class Client:
+    def __init__(self, endpoint: str, token: str = ""):
+        if "//" not in endpoint:
+            endpoint = "http://" + endpoint
+        u = urlparse(endpoint)
+        self.host = u.hostname or "localhost"
+        self.port = u.port or 8042
+        self.token = token
+
+    # ------------------------------------------------------------ transport
+
+    def _conn(self):
+        import http.client
+
+        return http.client.HTTPConnection(self.host, self.port, timeout=600)
+
+    def _headers(self, content_type="application/json"):
+        h = {"Content-Type": content_type}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def _post(self, route: str, body: dict):
+        """POST a JSON body; return the http response (caller reads)."""
+        conn = self._conn()
+        conn.request("POST", route, json.dumps(body), self._headers())
+        resp = conn.getresponse()
+        return conn, resp
+
+    def _post_json(self, route: str, body: dict) -> dict:
+        conn, resp = self._post(route, body)
+        try:
+            data = resp.read()
+            obj = json.loads(data or b"{}")
+            if resp.status >= 400:
+                raise DaemonError(obj.get("error") or f"HTTP {resp.status}")
+            return obj
+        finally:
+            conn.close()
+
+    def _post_stream(self, route: str, body: dict) -> Iterator[str]:
+        """POST; yield response lines (chunked ndjson streams)."""
+        conn, resp = self._post(route, body)
+        try:
+            if resp.status >= 400:
+                data = resp.read()
+                try:
+                    msg = json.loads(data).get("error")
+                except Exception:  # noqa: BLE001
+                    msg = data.decode(errors="replace")
+                raise DaemonError(msg or f"HTTP {resp.status}")
+            buf = b""
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    yield line.decode(errors="replace") + "\n"
+            if buf:
+                yield buf.decode(errors="replace")
+        finally:
+            conn.close()
+
+    # -------------------------------------------------------------- verbs
+
+    def _queue(self, route: str, composition: dict, priority: int = 0) -> str:
+        """POST /run or /build; parse the chunked rpc response for the
+        task id (``ParseRunResponse``, ``client.go:402``)."""
+        from testground_tpu.rpc import Chunk
+
+        task_id = ""
+        for line in self._post_stream(route, {
+            "composition": composition,
+            "priority": priority,
+        }):
+            try:
+                c = Chunk.from_json(line)
+            except Exception:  # noqa: BLE001 — ignore non-chunk noise
+                continue
+            if c.type == "e" and c.error:
+                raise DaemonError(c.error)
+            if c.type == "r" and isinstance(c.payload, dict):
+                task_id = c.payload.get("task_id", "")
+        if not task_id:
+            raise DaemonError(f"daemon {route} returned no task id")
+        return task_id
+
+    def run(self, composition: dict, priority: int = 0) -> str:
+        return self._queue("/run", composition, priority)
+
+    def build(self, composition: dict, priority: int = 0) -> str:
+        return self._queue("/build", composition, priority)
+
+    def tasks(self, states=None, types=None, limit=0) -> list[dict]:
+        return self._post_json(
+            "/tasks", {"states": states, "types": types, "limit": limit}
+        )["tasks"]
+
+    def status(self, task_id: str) -> dict:
+        return self._post_json("/status", {"task_id": task_id})["task"]
+
+    def logs(self, task_id: str, follow: bool = False) -> Iterator[str]:
+        return self._post_stream(
+            "/logs", {"task_id": task_id, "follow": follow}
+        )
+
+    def collect_outputs(self, runner: str, run_id: str, sink) -> None:
+        conn, resp = self._post("/outputs", {"runner": runner, "run_id": run_id})
+        try:
+            if resp.status >= 400:
+                data = resp.read()
+                try:
+                    msg = json.loads(data).get("error")
+                except Exception:  # noqa: BLE001
+                    msg = data.decode(errors="replace")
+                raise DaemonError(msg or f"HTTP {resp.status}")
+            while True:
+                chunk = resp.read1(1 << 16)
+                if not chunk:
+                    break
+                sink.write(chunk)
+        finally:
+            conn.close()
+
+    def terminate(self, runner: str) -> str:
+        return self._post_json("/terminate", {"runner": runner})["output"]
+
+    def healthcheck(self, runner: str, fix: bool = False) -> tuple[Report, str]:
+        obj = self._post_json("/healthcheck", {"runner": runner, "fix": fix})
+        rep = Report(
+            checks=[CheckResult(**c) for c in obj["report"].get("checks", [])],
+            fixes=[CheckResult(**f) for f in obj["report"].get("fixes", [])],
+        )
+        return rep, obj.get("output", "")
+
+    def kill(self, task_id: str) -> bool:
+        return bool(self._post_json("/kill", {"task_id": task_id})["killed"])
+
+    def build_purge(self, builder: str, testplan: str = "") -> str:
+        return self._post_json(
+            "/build/purge", {"builder": builder, "testplan": testplan}
+        )["output"]
+
+    def import_plan(self, source_dir: str, name: str = "") -> str:
+        """Tar.gz the plan dir and POST it (the reference ships sources
+        as tars inside /run requests, ``client.go:84-228``)."""
+        buf = io.BytesIO()
+        base = os.path.basename(os.path.abspath(source_dir).rstrip("/"))
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            tar.add(
+                source_dir,
+                arcname=base,
+                filter=lambda ti: None
+                if "__pycache__" in ti.name or "/.git" in ti.name
+                else ti,
+            )
+        conn = self._conn()
+        route = "/plan/import" + (f"?name={name}" if name else "")
+        conn.request(
+            "POST",
+            route,
+            buf.getvalue(),
+            self._headers("application/gzip"),
+        )
+        resp = conn.getresponse()
+        try:
+            obj = json.loads(resp.read() or b"{}")
+            if resp.status >= 400:
+                raise DaemonError(obj.get("error") or f"HTTP {resp.status}")
+            return obj["imported"]
+        finally:
+            conn.close()
+
+
+class _RemoteReport(Report):
+    pass
+
+
+class RemoteEngine:
+    """Engine-shaped facade over :class:`Client` for the CLI."""
+
+    def __init__(self, client: Client, env):
+        self.client = client
+        self.env = env
+
+    # -- queueing: manifest/sources resolve on the daemon side
+    def queue_run(self, comp, manifest=None, sources_dir="", priority=0, **_):
+        return self.client.run(comp.to_dict(), priority)
+
+    def queue_build(self, comp, manifest=None, sources_dir="", priority=0, **_):
+        return self.client.build(comp.to_dict(), priority)
+
+    def get_task(self, task_id: str) -> Task | None:
+        try:
+            return Task.from_dict(self.client.status(task_id))
+        except DaemonError:
+            return None
+
+    def tasks(self, states=None, types=None, limit=0, **_) -> list[Task]:
+        return [
+            Task.from_dict(d)
+            for d in self.client.tasks(states=states, types=types, limit=limit)
+        ]
+
+    def logs(self, task_id: str, follow: bool = False, **_) -> Iterator[str]:
+        return self.client.logs(task_id, follow=follow)
+
+    def do_collect_outputs(self, runner_id, run_id, w, ow) -> None:
+        self.client.collect_outputs(runner_id, run_id, w)
+
+    def do_terminate(self, runner_id, ow) -> None:
+        out = self.client.terminate(runner_id)
+        if out:
+            print(out, end="")
+
+    def do_healthcheck(self, runner_id, fix, ow):
+        report, out = self.client.healthcheck(runner_id, fix)
+        if out:
+            print(out, end="")
+        return report
+
+    def kill(self, task_id: str) -> bool:
+        return self.client.kill(task_id)
+
+    def stop(self) -> None:  # no engine owned client-side
+        pass

@@ -1,1 +1,5 @@
 """HTTP daemon exposing the engine. Twin of the reference's ``pkg/daemon``."""
+
+from .server import Daemon, serve
+
+__all__ = ["Daemon", "serve"]

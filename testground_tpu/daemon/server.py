@@ -1,8 +1,334 @@
-"""Daemon HTTP server (placeholder; full routes land with the daemon
-milestone)."""
+"""Daemon HTTP server — the L5 tier (``pkg/daemon/daemon.go``).
+
+A long-lived process owning ONE engine (worker pool + task store) that any
+number of CLI clients talk to over HTTP, mirroring the reference's route
+surface (``daemon.go:83-101``) and bearer-token auth (``daemon.go:49-70``):
+
+    POST /run /build /tasks /status /logs /outputs /terminate
+         /healthcheck /kill /build/purge /plan/import
+    GET  /tasks
+
+Transport notes (deviations are simplifications, not semantics):
+
+- requests are plain JSON bodies, not multipart tar uploads; plan sources
+  reach the daemon either via its own ``$TESTGROUND_HOME/plans`` or the
+  ``/plan/import`` endpoint, whose body is a raw ``.tar.gz`` of the plan
+  directory (the reference tars plan+sdk into the /run request itself,
+  ``client.go:84-228``);
+- ``/run`` and ``/build`` respond over the rpc chunk protocol (progress
+  chunks + a result chunk holding the task id), like the reference;
+- ``/logs`` streams the task's chunk-lines until completion when
+  ``follow`` is set (``engine.go:461-558`` semantics);
+- ``/outputs`` streams the run's tar.gz bytes directly with a gzip
+  content type (the reference wraps them in base64 binary chunks).
+
+The server is a stdlib ``ThreadingHTTPServer`` — every connection gets a
+thread; the engine's own locks make the shared state safe.
+"""
 
 from __future__ import annotations
 
+import io
+import json
+import os
+import shutil
+import tarfile
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-def serve() -> int:
-    raise NotImplementedError("daemon HTTP server lands with the daemon milestone")
+from testground_tpu.api import Composition, TestPlanManifest
+from testground_tpu.config import EnvConfig
+from testground_tpu.engine import Engine
+from testground_tpu.logging_ import S
+from testground_tpu.rpc import OutputWriter
+
+__all__ = ["Daemon", "serve"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    daemon_ref: "Daemon" = None  # bound per-daemon via a subclass
+
+    # ------------------------------------------------------------ plumbing
+
+    def log_message(self, fmt, *args):  # route http.server logs into ours
+        S().debug("daemon http: " + fmt, *args)
+
+    @property
+    def engine(self) -> Engine:
+        return self.daemon_ref.engine
+
+    def _authed(self) -> bool:
+        """Bearer-token middleware (``daemon.go:49-70``): with no tokens
+        configured the daemon is open, like the reference's default."""
+        tokens = self.daemon_ref.tokens
+        if not tokens:
+            return True
+        hdr = self.headers.get("Authorization", "")
+        return hdr.startswith("Bearer ") and hdr[len("Bearer ") :] in tokens
+
+    def _json_body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b"{}"
+        return json.loads(raw or b"{}")
+
+    def _send_json(self, obj, code: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, msg: str, code: int = 400) -> None:
+        self._send_json({"error": msg}, code)
+
+    def _start_stream(self, content_type: str = "application/x-ndjson"):
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _write_chunked(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+    def _end_chunked(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+
+    # ------------------------------------------------------------- routing
+
+    def do_GET(self):  # noqa: N802 — stdlib naming
+        if not self._authed():
+            return self._send_error_json("unauthorized", 401)
+        if self.path.split("?")[0] == "/tasks":
+            return self._tasks({})
+        return self._send_error_json("not found", 404)
+
+    def do_POST(self):  # noqa: N802
+        if not self._authed():
+            return self._send_error_json("unauthorized", 401)
+        route = self.path.split("?")[0]
+        handlers = {
+            "/run": self._run,
+            "/build": self._build,
+            "/tasks": self._tasks,
+            "/status": self._status,
+            "/logs": self._logs,
+            "/outputs": self._outputs,
+            "/terminate": self._terminate,
+            "/healthcheck": self._healthcheck,
+            "/kill": self._kill,
+            "/build/purge": self._build_purge,
+        }
+        try:
+            if route == "/plan/import":
+                return self._plan_import()
+            if route not in handlers:
+                return self._send_error_json("not found", 404)
+            return handlers[route](self._json_body())
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — HTTP boundary
+            S().warning("daemon %s failed: %s", route, e)
+            try:
+                self._send_error_json(str(e), 500)
+            except Exception:  # noqa: BLE001 — response already started
+                pass
+
+    # ------------------------------------------------------------- handlers
+
+    def _queue(self, body: dict, kind: str) -> None:
+        comp = Composition.from_dict(body["composition"])
+        plan_dir = os.path.join(
+            self.engine.env.dirs.plans(), comp.global_.plan
+        )
+        manifest_path = os.path.join(plan_dir, "manifest.toml")
+        if not os.path.isfile(manifest_path):
+            return self._send_error_json(
+                f"plan {comp.global_.plan!r} not found on the daemon; "
+                "import it with `tg plan import` against --endpoint",
+                404,
+            )
+        manifest = TestPlanManifest.load_file(manifest_path)
+        queue = (
+            self.engine.queue_run if kind == "run" else self.engine.queue_build
+        )
+        task_id = queue(
+            comp,
+            manifest,
+            sources_dir=plan_dir,
+            priority=int(body.get("priority", 0)),
+        )
+        # chunked rpc response: progress line + result chunk (the wire
+        # shape the reference's ParseRunResponse expects, client.go:402)
+        self._start_stream()
+        ow = OutputWriter(sink=_ChunkSink(self))
+        ow.infof("%s is queued with ID: %s", kind, task_id)
+        ow.write_result({"task_id": task_id})
+        self._end_chunked()
+
+    def _run(self, body: dict) -> None:
+        self._queue(body, "run")
+
+    def _build(self, body: dict) -> None:
+        self._queue(body, "build")
+
+    def _tasks(self, body: dict) -> None:
+        tasks = self.engine.tasks(
+            states=body.get("states") or None,
+            types=body.get("types") or None,
+            limit=int(body.get("limit") or 0),
+        )
+        self._send_json({"tasks": [t.to_dict() for t in tasks]})
+
+    def _status(self, body: dict) -> None:
+        t = self.engine.get_task(body["task_id"])
+        if t is None:
+            return self._send_error_json(f"unknown task {body['task_id']}", 404)
+        self._send_json({"task": t.to_dict()})
+
+    def _logs(self, body: dict) -> None:
+        task_id = body["task_id"]
+        follow = bool(body.get("follow"))
+        self._start_stream()
+        try:
+            for line in self.engine.logs(task_id, follow=follow):
+                self._write_chunked(line.encode())
+        finally:
+            self._end_chunked()
+
+    def _outputs(self, body: dict) -> None:
+        runner = body["runner"]
+        run_id = body["run_id"]
+        # spool to a temp file so HTTP status can still signal failure
+        with tempfile.TemporaryFile() as spool:
+            from testground_tpu.rpc import discard_writer
+
+            self.engine.do_collect_outputs(
+                runner, run_id, spool, discard_writer()
+            )
+            size = spool.tell()
+            spool.seek(0)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/gzip")
+            self.send_header("Content-Length", str(size))
+            self.end_headers()
+            shutil.copyfileobj(spool, self.wfile)
+
+    def _terminate(self, body: dict) -> None:
+        buf = io.StringIO()
+        self.engine.do_terminate(body["runner"], OutputWriter(sink=None, echo=buf))
+        self._send_json({"output": buf.getvalue()})
+
+    def _healthcheck(self, body: dict) -> None:
+        buf = io.StringIO()
+        report = self.engine.do_healthcheck(
+            body["runner"], bool(body.get("fix")), OutputWriter(sink=None, echo=buf)
+        )
+        self._send_json({"report": report.to_dict(), "output": buf.getvalue()})
+
+    def _kill(self, body: dict) -> None:
+        ok = self.engine.kill(body["task_id"])
+        self._send_json({"killed": bool(ok)})
+
+    def _build_purge(self, body: dict) -> None:
+        buf = io.StringIO()
+        self.engine.do_build_purge(
+            body["builder"], body.get("testplan", ""), OutputWriter(sink=None, echo=buf)
+        )
+        self._send_json({"output": buf.getvalue()})
+
+    def _plan_import(self) -> None:
+        """Body: raw tar.gz of a plan directory; ``?name=`` overrides."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n)
+        with tempfile.TemporaryDirectory() as td:
+            with tarfile.open(fileobj=io.BytesIO(raw), mode="r:gz") as tar:
+                tar.extractall(td, filter="data")
+            entries = [e for e in os.listdir(td) if not e.startswith(".")]
+            if len(entries) == 1 and os.path.isdir(os.path.join(td, entries[0])):
+                src = os.path.join(td, entries[0])
+                default_name = entries[0]
+            else:
+                src = td
+                default_name = ""
+            name = (q.get("name") or [default_name])[0]
+            if not name:
+                return self._send_error_json("plan name required", 400)
+            if not os.path.isfile(os.path.join(src, "manifest.toml")):
+                return self._send_error_json("archive has no manifest.toml", 400)
+            dest = os.path.join(self.engine.env.dirs.plans(), name)
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(src, dest)
+        self._send_json({"imported": name})
+
+
+class _ChunkSink:
+    """File-like adapter: OutputWriter lines → HTTP chunked frames."""
+
+    def __init__(self, handler: _Handler):
+        self.h = handler
+
+    def write(self, s: str) -> int:
+        self.h._write_chunked(s.encode())
+        return len(s)
+
+    def flush(self) -> None:
+        pass
+
+
+class Daemon:
+    """Owns the HTTP server + the engine (``daemon.New``,
+    ``daemon.go:34-118``)."""
+
+    def __init__(self, env: EnvConfig | None = None, listen: str = ""):
+        self.env = env or EnvConfig.load()
+        if not self.env.task_repo_explicit:
+            self.env.daemon.scheduler.task_repo_type = "disk"
+        self.engine = Engine.new_default(self.env)
+        self.tokens = list(self.env.daemon.tokens)
+        addr = listen or self.env.daemon.listen or "localhost:8042"
+        host, _, port = addr.rpartition(":")
+        handler = type("BoundHandler", (_Handler,), {"daemon_ref": self})
+        self.httpd = ThreadingHTTPServer(
+            (host or "localhost", int(port)), handler
+        )
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        h, p = self.httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self) -> None:
+        """Start workers + serve in a background thread (for tests)."""
+        self.engine.start_workers()
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.engine.start_workers()
+        S().info("daemon listening on %s", self.address)
+        try:
+            self.httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.engine.stop()
+
+
+def serve(listen: str = "") -> int:
+    Daemon(listen=listen).serve_forever()
+    return 0
