@@ -37,7 +37,11 @@ import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from testground_tpu.api import Composition, TestPlanManifest
+from testground_tpu.api import (
+    Composition,
+    TestPlanManifest,
+    generate_default_run,
+)
 from testground_tpu.config import EnvConfig
 from testground_tpu.engine import Engine
 from testground_tpu.logging_ import S
@@ -138,11 +142,33 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- handlers
 
+    def _safe_plan_dir(self, name: str) -> str:
+        """Resolve a plan name inside the daemon's plans dir, rejecting
+        anything that is not a single path component — otherwise a client
+        could point plan resolution (manifest read + sources_dir, or the
+        rmtree in /plan/import) at arbitrary daemon-writable paths."""
+        if (
+            not name
+            or name != os.path.basename(name)
+            or name in (".", "..")
+        ):
+            raise ValueError(f"invalid plan name {name!r}")
+        # a single path component cannot escape the plans dir lexically;
+        # no realpath comparison so operator-made symlinked plans keep working
+        return os.path.join(self.engine.env.dirs.plans(), name)
+
     def _queue(self, body: dict, kind: str) -> None:
         comp = Composition.from_dict(body["composition"])
-        plan_dir = os.path.join(
-            self.engine.env.dirs.plans(), comp.global_.plan
-        )
+        if kind == "run":
+            # server-side run preparation: a raw-client composition may
+            # arrive without [[runs]]; synthesize the default run like the
+            # reference daemon does during PrepareForRun
+            # (composition_preparation.go:93-110 via supervisor.go:494-518)
+            comp = generate_default_run(comp)
+        try:
+            plan_dir = self._safe_plan_dir(comp.global_.plan)
+        except ValueError as e:
+            return self._send_error_json(str(e), 400)
         manifest_path = os.path.join(plan_dir, "manifest.toml")
         if not os.path.isfile(manifest_path):
             return self._send_error_json(
@@ -191,6 +217,11 @@ class _Handler(BaseHTTPRequestHandler):
     def _logs(self, body: dict) -> None:
         task_id = body["task_id"]
         follow = bool(body.get("follow"))
+        # resolve the task BEFORE starting the chunked stream — once chunking
+        # begins, a later error response would be written onto the same
+        # keep-alive connection as protocol garbage
+        if self.engine.get_task(task_id) is None:
+            return self._send_error_json(f"unknown task {task_id}", 404)
         self._start_stream()
         try:
             for line in self.engine.logs(task_id, follow=follow):
@@ -261,7 +292,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_error_json("plan name required", 400)
             if not os.path.isfile(os.path.join(src, "manifest.toml")):
                 return self._send_error_json("archive has no manifest.toml", 400)
-            dest = os.path.join(self.engine.env.dirs.plans(), name)
+            try:
+                dest = self._safe_plan_dir(name)
+            except ValueError as e:
+                return self._send_error_json(str(e), 400)
             if os.path.exists(dest):
                 shutil.rmtree(dest)
             shutil.copytree(src, dest)

@@ -196,6 +196,7 @@ class Task:
             "input": self.input,
             "result": self.result,
             "error": self.error,
+            "outcome": self.outcome().value,
             "created_by": self.created_by.to_dict(),
         }
 

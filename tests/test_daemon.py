@@ -97,6 +97,41 @@ class TestDaemonEndToEnd:
         with pytest.raises(DaemonError):
             client.status("missing-task")
 
+    def test_logs_unknown_task_is_clean_404(self, client):
+        """The daemon must reject an unknown task id BEFORE starting the
+        chunked stream, as a single well-formed error response."""
+        with pytest.raises(DaemonError, match="unknown task"):
+            list(client.logs("missing-task"))
+
+    def test_runsless_composition_via_raw_client(self, client):
+        """A composition without [[runs]] must work through the raw Client:
+        the daemon synthesizes the default run server-side like the
+        reference's PrepareForRun (composition_preparation.go:93-110)."""
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        comp = _placebo_composition()
+        assert "runs" not in comp
+        task_id = client.run(comp)
+        t = _wait(client, task_id)
+        assert t["outcome"] == "success"
+
+
+class TestPathTraversal:
+    def test_run_rejects_traversal_plan_name(self, client):
+        comp = _placebo_composition()
+        comp["global"]["plan"] = "../outputs"
+        with pytest.raises(DaemonError, match="invalid plan name"):
+            client.run(comp)
+
+    def test_plan_import_rejects_traversal_name(self, client, tg_home):
+        victim = os.path.join(tg_home, "victim")
+        os.makedirs(victim)
+        open(os.path.join(victim, "keep.txt"), "w").close()
+        with pytest.raises(DaemonError, match="invalid plan name"):
+            client.import_plan(
+                os.path.join(PLANS, "placebo"), name="../victim"
+            )
+        assert os.path.exists(os.path.join(victim, "keep.txt"))
+
 
 class TestAuth:
     def test_token_required_when_configured(self, tg_home):
