@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from testground_tpu.sim.api import (
+    FAILURE,
     RUNNING,
     SUCCESS,
     Outbox,
@@ -22,26 +23,396 @@ from testground_tpu.sim.api import (
 PING = 1
 PONG = 2
 
+# Barrier percent sweep (``benchmarks.go:109-118``: 0.2 → 1.0 step 0.2).
+BARRIER_PCTS = (0.2, 0.4, 0.6, 0.8, 1.0)
+
 
 class Barrier(SimTestcase):
-    """All instances signal one state and wait for the full count
-    (``benchmarks.go:100-146`` barrier testcase, manifest-bounded at 50k).
-    Measures ticks-to-release via finished_at."""
+    """Partial-barrier timing sweep — the sim twin of BarrierBench
+    (``benchmarks.go:88-145``, manifest-bounded at 50k instances).
 
-    STATES = ["barrier"]
+    Per iteration and per percent p ∈ {20,40,60,80,100}: everyone
+    signals+waits a full-count "ready" gate, then signals a "test" state
+    and waits for ⌊N·p⌋ signallers; the ticks-to-release are the
+    ``barrier_time_{p}_percent`` timing metric (simulated ticks stand in
+    for the reference's wall-clock seconds against Redis).
+
+    Sync counters are monotone (no reset), so iteration i waits for the
+    *cumulative* targets: ready ≥ i·N and test ≥ (i-1)·N + ⌊N·p⌋. All
+    instances release on the same global count, so the whole cohort moves
+    through the (iteration × percent × {ready,test}) phases in lockstep —
+    ``STATES`` holds one ready/test pair per percent and the phase index
+    doubles as the state index.
+    """
+
+    STATES = [
+        s
+        for p in BARRIER_PCTS
+        for s in (f"ready_{int(p * 100)}", f"test_{int(p * 100)}")
+    ]
     OUT_MSGS = 1
     IN_MSGS = 1
     MSG_WIDTH = 1
     MAX_LINK_TICKS = 4
 
+    def init(self, env):
+        return {
+            "iter": jnp.int32(1),
+            "phase": jnp.int32(0),
+            "start": jnp.int32(0),
+            "sums": jnp.zeros((len(BARRIER_PCTS),), jnp.int32),
+        }
+
     def step(self, env, state, inbox, sync, t):
         n = env.test_instance_count
-        released = sync.counts[self.state_id("barrier")] >= n
-        return self.out(
-            state,
-            status=jnp.where(released, SUCCESS, RUNNING),
-            signals=self.signal("barrier") * (t == 0),
+        n_phases = len(self.STATES)
+        iters = (
+            env.int_param("barrier_iterations")
+            if "barrier_iterations" in env.group.params
+            else 10
         )
+        # testInstanceNum = max(1, floor(N * percent)) — benchmarks.go:126-130
+        test_counts = jnp.asarray(
+            [max(1, int(n * p)) for p in BARRIER_PCTS], jnp.int32
+        )
+
+        phase, it = state["phase"], state["iter"]
+        pct_idx = phase // 2
+        is_test = (phase % 2) == 1
+        target = jnp.where(
+            is_test,
+            (it - 1) * n + test_counts[pct_idx],
+            it * n,
+        )
+        released = jnp.take(sync.counts, phase) >= target
+
+        elapsed = t - state["start"]
+        sums = state["sums"] + (
+            jnp.arange(len(BARRIER_PCTS), dtype=jnp.int32) == pct_idx
+        ) * elapsed * (released & is_test)
+
+        nphase_raw = phase + 1
+        wrap = nphase_raw >= n_phases
+        nphase = jnp.where(wrap, 0, nphase_raw)
+        new_phase = jnp.where(released, nphase, phase)
+        new_iter = it + (released & wrap)
+        done = new_iter > iters
+        # entering a test phase starts its timer (barrierTestStart,
+        # benchmarks.go:134) — the release propagates via next tick's counts,
+        # the sim analog of the reference's Redis round-trip
+        start = jnp.where(released & ~is_test, t, state["start"])
+
+        emit = (t == 0) | (released & ~done)
+        sig_phase = jnp.where(t == 0, 0, nphase)
+        signals = (
+            jnp.arange(n_phases, dtype=jnp.int32) == sig_phase
+        ).astype(jnp.int32) * emit
+
+        return self.out(
+            {"iter": new_iter, "phase": new_phase, "start": start, "sums": sums},
+            status=jnp.where(done, SUCCESS, RUNNING),
+            signals=signals,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        iters = int(group.params.get("barrier_iterations", 10))
+        return {
+            f"barrier_time_{int(p * 100)}_percent": final_state["sums"][:, i]
+            / max(iters, 1)
+            for i, p in enumerate(BARRIER_PCTS)
+        }
+
+
+class NetInit(SimTestcase):
+    """time-to-network-init (``benchmarks.go:29-48`` NetworkInitBench):
+    ticks from start until the network-initialized barrier releases — the
+    sim twin of ``MustWaitNetworkInitialized``, whose barrier the sidecars
+    signal once per instance (``sidecar_handler.go:40-44``). In the sim
+    the link tensors exist from tick 0, so each instance signals on its
+    first step and the metric measures the full-count sync round-trip."""
+
+    STATES = ["network-initialized"]
+    OUT_MSGS = 1
+    IN_MSGS = 1
+    MSG_WIDTH = 1
+    MAX_LINK_TICKS = 2
+    TRACK_SRC = False
+    SHAPING = ("latency",)
+
+    def init(self, env):
+        return {"init_at": jnp.int32(-1)}
+
+    def step(self, env, state, inbox, sync, t):
+        n = env.test_instance_count
+        ready = sync.counts[self.state_id("network-initialized")] >= n
+        init_at = jnp.where((state["init_at"] < 0) & ready, t, state["init_at"])
+        return self.out(
+            {"init_at": init_at},
+            status=jnp.where(ready, SUCCESS, RUNNING),
+            signals=self.signal("network-initialized") * (t == 0),
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {"time_to_network_init_ticks": final_state["init_at"]}
+
+
+class NetLinkShape(SimTestcase):
+    """time-to-shape-network (``benchmarks.go:50-86`` NetworkLinkShapeBench)
+    plus an end-to-end verification the shape actually took hold.
+
+    The reference submits a 250 ms-latency config to the sidecar and times
+    the config→callback-state round-trip. Here each instance emits the
+    shape on tick 0 together with a "network-configured" signal (the
+    CallbackState analog — the engine applies egress shapes between ticks
+    exactly like the sidecar applies netem between packets); ticks until
+    the full-count callback barrier releases are ``time_to_shape_network``.
+    Each instance then pings its partner and asserts the observed one-way
+    delay equals the shaped latency in ticks — FAILURE on mismatch, so the
+    testcase actually exercises the shaping path rather than just timing a
+    barrier. With an odd instance count the last instance has no partner
+    and succeeds on the callback alone."""
+
+    STATES = ["network-configured"]
+    OUT_MSGS = 1
+    IN_MSGS = 1
+    MSG_WIDTH = 1
+    MAX_LINK_TICKS = 256
+    TRACK_SRC = False
+    SLOT_MODE = "direct"
+    SHAPING = ("latency",)
+
+    def init(self, env):
+        return {
+            "cfg_at": jnp.int32(-1),
+            "sent_at": jnp.int32(-1),
+            "got_at": jnp.int32(-1),
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        lat = (
+            env.float_param("latency_ms")
+            if "latency_ms" in env.group.params
+            else 250.0
+        )
+        lat_ticks = min(env.ms_to_ticks(lat), cls.MAX_LINK_TICKS - 1)
+        partner = env.global_seq ^ 1
+        has_partner = partner < n
+
+        configured = sync.counts[self.state_id("network-configured")] >= n
+        just_cfg = (state["cfg_at"] < 0) & configured
+        cfg_at = jnp.where(just_cfg, t, state["cfg_at"])
+
+        send = just_cfg & has_partner
+        sent_at = jnp.where(send, t, state["sent_at"])
+        got = jnp.any(inbox.valid)
+        got_at = jnp.where((state["got_at"] < 0) & got, t, state["got_at"])
+
+        delay = got_at - sent_at
+        verified = (got_at >= 0) & (delay == lat_ticks)
+        wrong = (got_at >= 0) & (delay != lat_ticks)
+        ok = jnp.where(has_partner, verified, cfg_at >= 0)
+
+        return self.out(
+            {"cfg_at": cfg_at, "sent_at": sent_at, "got_at": got_at},
+            status=jnp.where(
+                wrong, FAILURE, jnp.where(ok, SUCCESS, RUNNING)
+            ),
+            outbox=Outbox.single(
+                partner, jnp.asarray([PING]), send, cls.OUT_MSGS, cls.MSG_WIDTH
+            ),
+            signals=self.signal("network-configured") * (t == 0),
+            net_shape=self.link_shape(latency_ms=lat),
+            net_shape_valid=t == 0,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        import numpy as np
+
+        got = np.asarray(final_state["got_at"])
+        sent = np.asarray(final_state["sent_at"])
+        return {
+            "time_to_shape_network_ticks": final_state["cfg_at"],
+            "shaped_latency_ticks": np.where(
+                (got >= 0) & (sent >= 0), got - sent, np.nan
+            ),
+        }
+
+
+# Payload sizes 64 B → 4 KiB by doubling (``benchmarks.go:184``).
+SUBTREE_SIZES = (64, 128, 256, 512, 1024, 2048, 4096)
+
+
+class Subtree(SimTestcase):
+    """Pub/sub subtree benchmark (``benchmarks.go:147-276`` SubtreeBench).
+
+    Reference protocol: the first publisher on an "instances" topic
+    (seq == 1) becomes THE publisher; it publishes ``iterations`` entries
+    per size-series 64B..4KiB, signals "handoff", and subscribers then
+    consume every series, verifying each payload, all ending on a
+    full-count "end" barrier.
+
+    Sim mechanics: election uses ``SignalEntry`` rank (the same seq==1
+    rule); a size-series is a topic whose entries carry
+    ``(size ^ iteration, iteration)`` as the payload checksum — a
+    subscriber FAILUREs on any mismatch (the reference's "received
+    unexpected value"). The publisher streams one entry per tick; after
+    "handoff" subscribers drain each topic at SUB_K entries/tick through
+    their read cursors. Timing metrics are ticks per series:
+    ``subtree_time_{size}_bytes_{publish,receive}_ticks``."""
+
+    STATES = ["elected", "handoff", "end"]
+    TOPICS = [f"subtree_{s}" for s in SUBTREE_SIZES]
+    OUT_MSGS = 1
+    IN_MSGS = 1
+    MSG_WIDTH = 1
+    PUB_WIDTH = 2
+    SUB_K = 8
+    TOPIC_CAP = 128
+    MAX_LINK_TICKS = 2
+    TRACK_SRC = False
+    SHAPING = ("latency",)
+
+    def _iters(self, env) -> int:
+        iters = (
+            env.int_param("subtree_iterations")
+            if "subtree_iterations" in env.group.params
+            else 64
+        )
+        if iters > type(self).TOPIC_CAP:
+            raise ValueError(
+                f"subtree_iterations={iters} exceeds TOPIC_CAP="
+                f"{type(self).TOPIC_CAP}; raise the cap or lower iterations"
+            )
+        return iters
+
+    def init(self, env):
+        k = len(SUBTREE_SIZES)
+        return {
+            "pub_idx": jnp.int32(0),
+            "got": jnp.zeros((k,), jnp.int32),
+            "bad": jnp.asarray(False),
+            "handoff_at": jnp.int32(-1),
+            "done_at": jnp.full((k,), -1, jnp.int32),
+            "pub_done_at": jnp.full((k,), -1, jnp.int32),
+            "sig_handoff": jnp.asarray(False),
+            "sig_end": jnp.asarray(False),
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        iters = self._iters(env)
+        k = len(SUBTREE_SIZES)
+        total = k * iters
+        sizes = jnp.asarray(SUBTREE_SIZES, jnp.int32)
+        series_ax = jnp.arange(k, dtype=jnp.int32)
+
+        rank = sync.last_seq[self.state_id("elected")]
+        is_pub = rank == 1
+        is_sub = rank > 1
+
+        # ---------------------------------------------------- publisher path
+        can_pub = is_pub & (state["pub_idx"] < total)
+        ser = jnp.minimum(state["pub_idx"] // iters, k - 1)
+        itr = state["pub_idx"] % iters + 1
+        checksum = sizes[ser] ^ itr
+        pub_row = series_ax == ser
+        pub_valid = pub_row & can_pub
+        pub_payload = jnp.where(
+            pub_row[:, None],
+            jnp.stack([checksum, itr]),
+            jnp.zeros((cls.PUB_WIDTH,), jnp.int32),
+        )
+        pub_idx = state["pub_idx"] + can_pub.astype(jnp.int32)
+        pub_done_at = jnp.where(
+            pub_row & can_pub & (itr == iters), t, state["pub_done_at"]
+        )
+        sig_handoff = is_pub & (pub_idx >= total) & ~state["sig_handoff"]
+        # the publisher's SignalAndWait(end) — one tick after handoff
+        sig_end_pub = is_pub & state["sig_handoff"] & ~state["sig_end"]
+
+        # --------------------------------------------------- subscriber path
+        handoff_ok = sync.counts[self.state_id("handoff")] >= 1
+        handoff_at = jnp.where(
+            (state["handoff_at"] < 0) & handoff_ok & is_sub,
+            t,
+            state["handoff_at"],
+        )
+        done_series = state["got"] >= iters
+        rser = jnp.minimum(
+            jnp.sum(done_series.astype(jnp.int32)), k - 1
+        )  # series consumed sequentially; first unfinished
+        consuming = is_sub & handoff_ok & ~jnp.all(done_series)
+        win_pay = jnp.take(sync.sub_payload, rser, axis=0)  # [K, PW]
+        win_val = jnp.take(sync.sub_valid, rser, axis=0)  # [K]
+        got_cur = jnp.take(state["got"], rser)
+        k_idx = jnp.arange(cls.SUB_K, dtype=jnp.int32)
+        take = win_val & (k_idx < iters - got_cur) & consuming
+        exp_itr = got_cur + k_idx + 1
+        exp_sum = sizes[rser] ^ exp_itr
+        mismatch = take & (
+            (win_pay[:, 0] != exp_sum) | (win_pay[:, 1] != exp_itr)
+        )
+        bad = state["bad"] | jnp.any(mismatch)
+        ncons = jnp.sum(take.astype(jnp.int32))
+        got = state["got"] + (series_ax == rser) * ncons
+        newly_done = consuming & (jnp.take(got, rser) >= iters)
+        done_at = jnp.where(
+            (series_ax == rser) & newly_done, t, state["done_at"]
+        )
+        sub_consume = (series_ax == rser) * ncons
+        sig_end_sub = is_sub & jnp.all(got >= iters) & ~state["sig_end"]
+
+        sig_end = sig_end_pub | sig_end_sub
+        end_ok = sync.counts[self.state_id("end")] >= n
+        return self.out(
+            {
+                "pub_idx": pub_idx,
+                "got": got,
+                "bad": bad,
+                "handoff_at": handoff_at,
+                "done_at": done_at,
+                "pub_done_at": pub_done_at,
+                "sig_handoff": state["sig_handoff"] | sig_handoff,
+                "sig_end": state["sig_end"] | sig_end,
+            },
+            status=jnp.where(
+                bad, FAILURE, jnp.where(end_ok, SUCCESS, RUNNING)
+            ),
+            signals=self.signal("elected") * (t == 0)
+            + self.signal("handoff") * sig_handoff
+            + self.signal("end") * sig_end,
+            pub_payload=pub_payload,
+            pub_valid=pub_valid,
+            sub_consume=sub_consume,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        import numpy as np
+
+        iters = int(group.params.get("subtree_iterations", 64))
+        done = np.asarray(final_state["done_at"], np.float64)  # [count, k]
+        pub_done = np.asarray(final_state["pub_done_at"], np.float64)
+        handoff = np.asarray(final_state["handoff_at"], np.float64)
+        # per-series elapsed: first series counts from handoff, later ones
+        # from the previous series' completion (consumption is sequential)
+        prev = np.concatenate([handoff[:, None], done[:, :-1]], axis=1)
+        recv = np.where((done >= 0) & (prev >= 0), done - prev, np.nan)
+        pub_prev = np.concatenate(
+            [np.zeros_like(pub_done[:, :1]), pub_done[:, :-1]], axis=1
+        )
+        pub = np.where(pub_done >= 0, pub_done - pub_prev, np.nan)
+        out = {}
+        for i, size in enumerate(SUBTREE_SIZES):
+            out[f"subtree_time_{size}_bytes_receive_ticks"] = (
+                recv[:, i] / max(iters, 1)
+            )
+            out[f"subtree_time_{size}_bytes_publish_ticks"] = (
+                pub[:, i] / max(iters, 1)
+            )
+        return out
 
 
 class PingPongFlood(SimTestcase):
@@ -269,7 +640,10 @@ class Startup(SimTestcase):
 
 sim_testcases = {
     "barrier": Barrier,
+    "netinit": NetInit,
+    "netlinkshape": NetLinkShape,
     "pingpong-flood": PingPongFlood,
     "startup": Startup,
     "storm": Storm,
+    "subtree": Subtree,
 }

@@ -506,6 +506,21 @@ def status_cmd(args) -> int:
         print(f"Outcome: {t.outcome().value}")
         if t.error:
             print(f"Error:   {t.error}")
+        mj = (
+            t.result.get("journal", {}).get("metrics")
+            if isinstance(t.result, dict)
+            else None
+        )
+        if mj:
+            print("Metrics:")
+            for gid, names in mj.items():
+                for name, agg in names.items():
+                    if agg.get("count"):
+                        print(
+                            f"  {gid}/{name}: mean={agg['mean']:.3f} "
+                            f"min={agg['min']:.3f} max={agg['max']:.3f} "
+                            f"n={agg['count']}"
+                        )
         if args.extended:
             import json
 

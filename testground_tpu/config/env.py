@@ -122,9 +122,14 @@ class EnvConfig:
         self.client.user = cl.get("user", self.client.user)
 
     def _ensure_minimal(self) -> None:
-        """Apply fallback defaults (``pkg/config/loader.go:55-63``)."""
+        """Apply fallback defaults (``pkg/config/loader.go:55-63``).
+
+        Deviation: the reference defaults ``client.endpoint`` to
+        localhost:8042 because its CLI can only talk to a daemon; here the
+        CLI runs an in-process engine unless an endpoint is configured, so
+        the endpoint stays empty (``DEFAULT_CLIENT_URL`` remains the
+        suggestion printed by ``tg daemon``)."""
         self.daemon.listen = self.daemon.listen or DEFAULT_LISTEN_ADDR
-        self.client.endpoint = self.client.endpoint or DEFAULT_CLIENT_URL
         sch = self.daemon.scheduler
         sch.workers = sch.workers or DEFAULT_WORKERS
         sch.queue_size = sch.queue_size or DEFAULT_QUEUE_SIZE

@@ -163,6 +163,22 @@ def execute_sim_run(
     write_outputs = (
         outputs_root is not None and n <= cfg.write_outputs_max
     )
+    if outputs_root is not None and not write_outputs:
+        # loud, in both the task log and the journal — per-instance dirs
+        # are skipped above the cap, but the per-group aggregates below
+        # still capture every metric
+        ow.warn(
+            "sim:jax %s: %d instances > write_outputs_max=%d — skipping "
+            "per-instance output dirs (group metric aggregates are in the "
+            "journal)",
+            job.run_id,
+            n,
+            cfg.write_outputs_max,
+        )
+        result.journal["outputs_skipped"] = {
+            "instances": n,
+            "write_outputs_max": cfg.write_outputs_max,
+        }
 
     metrics = {}
     collect = getattr(testcase, "collect_metrics", None)
@@ -176,6 +192,10 @@ def execute_sim_run(
                 )
             except Exception as e:  # noqa: BLE001 — metrics are best-effort
                 ow.warn("collect_metrics failed for group %s: %s", g.id, e)
+    if metrics:
+        result.journal["metrics"] = {
+            gid: _aggregate_metrics(m) for gid, m in metrics.items()
+        }
 
     for gi, g in enumerate(groups):
         st = status[g.offset : g.offset + g.count]
@@ -214,6 +234,28 @@ def _tree_slice(state_group):
     """Per-group states are already host numpy pytrees; identity hook kept
     for future lazy device slicing."""
     return state_group
+
+
+def _aggregate_metrics(group_metrics: dict) -> dict:
+    """Per-group reductions of the per-instance metric arrays — the journal
+    analog of the InfluxDB measurement tables the reference dashboard
+    queries (``pkg/metrics/viewer.go:45-80``). NaN entries (instances for
+    which a metric does not apply, e.g. the subtree publisher's receive
+    timers) are excluded."""
+    agg = {}
+    for name, arr in group_metrics.items():
+        a = np.asarray(arr, np.float64).reshape(-1)
+        a = a[~np.isnan(a)]
+        if a.size == 0:
+            agg[name] = {"count": 0}
+            continue
+        agg[name] = {
+            "count": int(a.size),
+            "mean": float(a.mean()),
+            "min": float(a.min()),
+            "max": float(a.max()),
+        }
+    return agg
 
 
 def _write_instance_outputs(

@@ -24,10 +24,124 @@ def run_case(case, n, params=None, mesh=None, max_ticks=4096, chunk=64):
 
 class TestBarrier:
     def test_releases_all(self):
-        res = run_case("barrier", 64, chunk=8)
+        res = run_case(
+            "barrier", 64, params={"barrier_iterations": "3"}, chunk=8
+        )
         assert (res["status"] == SUCCESS).all()
         # everyone releases the tick after the counter fills
         assert (res["finished_at"] == res["finished_at"][0]).all()
+
+    def test_percent_timings(self):
+        """The percent sweep emits barrier_time_{20..100}_percent with
+        sane orderings: every percentile takes >= 1 tick (signal→count
+        propagation) and all instances agree (lockstep release)."""
+        from testground_tpu.sim.engine import build_groups
+
+        n, iters = 32, 4
+        res = run_case(
+            "barrier", n, params={"barrier_iterations": str(iters)}, chunk=8
+        )
+        assert (res["status"] == SUCCESS).all()
+        tc = plan_case("benchmarks", "barrier")
+        groups = res["groups"]
+        m = tc.collect_metrics(groups[0], res["states"][0], res["status"])
+        for pct in (20, 40, 60, 80, 100):
+            vals = np.asarray(m[f"barrier_time_{pct}_percent"])
+            assert vals.shape == (n,)
+            assert (vals == vals[0]).all(), pct
+            assert vals[0] >= 1.0, pct
+
+    def test_sharded_matches_single(self):
+        params = {"barrier_iterations": "2"}
+        res_m = run_case("barrier", 16, params=params, mesh=mesh8())
+        res_s = run_case("barrier", 16, params=params)
+        assert (res_m["status"] == SUCCESS).all()
+        np.testing.assert_array_equal(
+            np.asarray(res_m["states"][0]["sums"]),
+            np.asarray(res_s["states"][0]["sums"]),
+        )
+
+
+class TestNetInit:
+    def test_init_barrier_ticks(self):
+        res = run_case("netinit", 48, chunk=8)
+        assert (res["status"] == SUCCESS).all()
+        init_at = np.asarray(res["states"][0]["init_at"])
+        # everyone signals at t=0; counts visible at t=1 → release at 1
+        assert (init_at == 1).all()
+
+
+class TestNetLinkShape:
+    def test_shaped_latency_verified(self):
+        """SUCCESS requires the observed one-way delay to equal the shaped
+        latency — the testcase self-verifies the shaping path."""
+        res = run_case(
+            "netlinkshape", 16, params={"latency_ms": "8"}, chunk=16
+        )
+        assert (res["status"] == SUCCESS).all()
+        st = res["states"][0]
+        delay = np.asarray(st["got_at"]) - np.asarray(st["sent_at"])
+        assert (delay == 8).all()
+        assert (np.asarray(st["cfg_at"]) == 1).all()
+
+    def test_odd_count_last_instance_succeeds(self):
+        res = run_case(
+            "netlinkshape", 9, params={"latency_ms": "4"}, chunk=16
+        )
+        assert (res["status"] == SUCCESS).all()
+
+
+class TestSubtree:
+    def test_publish_receive_verified(self):
+        """One elected publisher, everyone else consumes + verifies all 7
+        size series; any checksum mismatch would FAILURE the subscriber."""
+        n, iters = 6, 16
+        res = run_case(
+            "subtree", n, params={"subtree_iterations": str(iters)}
+        )
+        assert (res["status"] == SUCCESS).all()
+        st = res["states"][0]
+        # exactly one publisher streamed 7*iters entries
+        pub_idx = np.asarray(st["pub_idx"])
+        assert (pub_idx == 7 * iters).sum() == 1
+        assert (pub_idx == 0).sum() == n - 1
+        # every subscriber consumed every series in full
+        got = np.asarray(st["got"])
+        subs = pub_idx == 0
+        assert (got[subs] == iters).all()
+        assert not np.asarray(st["bad"]).any()
+
+    def test_metrics_shape(self):
+        n, iters = 4, 8
+        res = run_case(
+            "subtree", n, params={"subtree_iterations": str(iters)}
+        )
+        tc = plan_case("benchmarks", "subtree")
+        m = tc.collect_metrics(
+            res["groups"][0], res["states"][0], res["status"]
+        )
+        for size in (64, 128, 256, 512, 1024, 2048, 4096):
+            recv = np.asarray(m[f"subtree_time_{size}_bytes_receive_ticks"])
+            pub = np.asarray(m[f"subtree_time_{size}_bytes_publish_ticks"])
+            # subscribers have receive timings, the publisher has NaN there
+            # (a series can drain in 0 ticks when SUB_K covers it whole)
+            assert np.isnan(recv).sum() == 1
+            assert (recv[~np.isnan(recv)] >= 0).all()
+            # publisher streams one entry/tick → mean publish time ~1 tick
+            assert np.isnan(pub).sum() == n - 1
+            np.testing.assert_allclose(pub[~np.isnan(pub)], 1.0)
+
+    def test_sharded_matches_single(self):
+        params = {"subtree_iterations": "8"}
+        res_m = run_case("subtree", 8, params=params, mesh=mesh8())
+        res_s = run_case("subtree", 8, params=params)
+        assert (res_m["status"] == SUCCESS).all()
+        for key in ("pub_idx", "got", "done_at"):
+            np.testing.assert_array_equal(
+                np.asarray(res_m["states"][0][key]),
+                np.asarray(res_s["states"][0][key]),
+                err_msg=key,
+            )
 
 
 class TestStorm:

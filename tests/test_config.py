@@ -11,7 +11,10 @@ def test_defaults_applied(tg_home):
     assert e.daemon.scheduler.workers == 2
     assert e.daemon.scheduler.queue_size == 100
     assert e.daemon.scheduler.task_repo_type == "memory"
-    assert e.client.endpoint == "http://localhost:8042"
+    # empty endpoint = in-process engine (the CLI's documented default);
+    # the reference forces localhost:8042 only because it has no in-process
+    # mode (loader.go:55-63)
+    assert e.client.endpoint == ""
 
 
 def test_directory_layout_created(tg_home):
