@@ -215,6 +215,12 @@ class SyncView:
     - ``rejected int32`` — count of this instance's messages suppressed by a
       REJECT filter last tick (the PROHIBIT-route "connection refused"
       signal a reference sender observes)
+    - ``dropped [T] int32`` — cumulative publishes lost to each topic's full
+      TOPIC_CAP stream (global, same value for every instance). A plan that
+      publishes into a possibly-full topic can observe the overflow instead
+      of silently losing entries; the reference's Redis stream would grow
+      unboundedly instead, so any nonzero value here flags an undersized
+      TOPIC_CAP. Also surfaced per-run in the journal (``sim.pub_dropped``).
     """
 
     counts: jax.Array
@@ -222,6 +228,7 @@ class SyncView:
     sub_payload: jax.Array
     sub_valid: jax.Array
     rejected: jax.Array
+    dropped: jax.Array
 
 
 @jax.tree_util.register_dataclass
@@ -406,7 +413,12 @@ class SimTestcase:
         duplicate=0.0,
     ) -> jax.Array:
         """Build a LinkShape vector (``network.LinkShape`` field order,
-        ``pkg/sidecar/link.go:155-183``)."""
+        ``pkg/sidecar/link.go:155-183``).
+
+        Bandwidth is drop-not-queue: messages over the per-tick admission
+        cap are dropped at send time, and a bandwidth below one message
+        per tick (MSG_BYTES/tick_s, i.e. 256 KB/s at 1 ms ticks) admits
+        nothing — see the deviation note in ``sim/net.py``."""
         return jnp.stack(
             [
                 jnp.asarray(x, jnp.float32)

@@ -259,6 +259,7 @@ class SimProgram:
                 sub_payload=sub_payload[lo:hi],
                 sub_valid=sub_valid[lo:hi],
                 rejected=carry.rejected[lo:hi],
+                dropped=carry.sync.dropped,
             )
 
             def step_one(gs_, gseq_, k_, state_, inbox_, syncv_, _g=g):
@@ -282,6 +283,7 @@ class SimProgram:
                         sub_payload=0,
                         sub_valid=0,
                         rejected=0,
+                        dropped=None,  # global per-topic totals
                     ),
                 ),
                 out_axes=StepOut(

@@ -51,6 +51,14 @@ LATENCY, JITTER, BANDWIDTH, LOSS, CORRUPT, REORDER, DUPLICATE = range(7)
 # Assumed wire size per message for bandwidth accounting (bytes). The
 # reference shapes bits/s on real frames; messages here are fixed-width
 # records, so bandwidth B bytes/s admits B·tick_s/MSG_BYTES msgs per tick.
+#
+# SEMANTICS DEVIATION (drop, not queue): HTB holds excess packets in a
+# queue and releases them as tokens accrue; this transport has no egress
+# queue, so messages past the per-tick cap are DROPPED at send time. In
+# particular a bandwidth below MSG_BYTES/tick_s (cap floor() → 0) admits
+# nothing at all — a permanent blackhole, where netem/HTB would still
+# trickle packets late. Plans must keep shaped bandwidths ≥ one message
+# per tick (at 1 ms ticks: ≥ 256 KB/s) or treat lower values as DROP.
 MSG_BYTES = 256.0
 
 # Every LinkShape feature (``SimTestcase.SHAPING`` defaults to all).

@@ -16,9 +16,9 @@ Ops: ``signal_entry(state)``, ``barrier(state, target)``,
 ``signal_and_wait(state, target)``, ``publish(topic, payload)``,
 ``subscribe(topic)``, ``counter(state)``.
 
-A C++ epoll implementation with the same wire protocol lives in
-``native/sync_service`` (built on demand); this Python server is the always-
-available fallback and the behavioral spec.
+This Python server is the behavioral spec; its throughput comfortably
+covers the local:exec envelope (2-300 real processes, ``README.md:136-139``
+— the at-scale path is the on-device sync kernel, not this server).
 """
 
 from __future__ import annotations

@@ -121,6 +121,34 @@ class TestPingPong:
         res = prog.run(max_ticks=2048)
         assert (res["status"] == SUCCESS).all()
 
+    def test_odd_instance_count_completes(self):
+        """With an odd N the unpaired last instance must self-succeed
+        instead of stalling the half-done barrier for the whole cohort
+        (its partner index n is out of range and bounds-dropped)."""
+        prog = SimProgram(
+            plan_case("network", "ping-pong"),
+            make_groups(3),
+            chunk=64,
+        )
+        res = prog.run(max_ticks=2048)
+        assert (res["status"] == SUCCESS).all(), res["status"]
+        # the real pair still measured an RTT; the solo instance did not
+        rtt1 = np.asarray(res["states"][0]["rtt1"])
+        assert (rtt1[:2] > 0).all() and rtt1[2] == -1, rtt1
+
+    def test_sustained_odd_instance_count(self):
+        """pingpong-sustained judges the unpaired instance SUCCESS at the
+        deadline rather than FAILURE with zero rounds."""
+        prog = SimProgram(
+            plan_case("network", "pingpong-sustained"),
+            make_groups(3, params={"duration_ticks": "64"}),
+            chunk=32,
+        )
+        res = prog.run(max_ticks=256)
+        assert (res["status"] == SUCCESS).all(), res["status"]
+        rounds = np.asarray(res["states"][0]["rounds"])
+        assert (rounds[:2] > 0).all() and rounds[2] == 0, rounds
+
     def test_wrong_window_fails(self):
         """Tight tolerance ⇒ the assertion must fail (placebo for the
         RTT check itself)."""
