@@ -26,6 +26,11 @@ from .preparation import (
     prepare_for_build,
     prepare_for_run,
 )
+from .template import (
+    TemplateError,
+    compile_composition_template,
+    render_template,
+)
 from .run_input import (
     BuildInput,
     BuildOutput,
@@ -60,8 +65,11 @@ __all__ = [
     "RunGroup",
     "RunInput",
     "RunParams",
+    "TemplateError",
     "TestCase",
     "TestPlanManifest",
+    "compile_composition_template",
+    "render_template",
     "validate_for_build",
     "validate_for_run",
 ]

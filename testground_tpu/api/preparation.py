@@ -15,6 +15,7 @@ from .composition import (
     apply_dependency_defaults,
 )
 from .manifest import TestPlanManifest
+from .template import compile_composition_template
 
 __all__ = [
     "generate_default_run",
@@ -25,11 +26,13 @@ __all__ = [
 
 
 def load_composition(path) -> Composition:
-    """Parse a composition file and synthesize the default run when no
-    ``[[runs]]`` are declared — the entry point CLI/load paths use, mirroring
-    ``pkg/cmd/template.go:88-107`` (parse → GenerateDefaultRun). Validation
-    requires runs to exist, so loading and validating compose cleanly."""
-    return generate_default_run(Composition.load_file(path))
+    """Render a composition file through the template engine, parse it, and
+    synthesize the default run when no ``[[runs]]`` are declared — the entry
+    point CLI/load paths use, mirroring ``pkg/cmd/template.go:88-107``
+    (template → parse → GenerateDefaultRun). Validation requires runs to
+    exist, so loading and validating compose cleanly."""
+    text = compile_composition_template(path)
+    return generate_default_run(Composition.from_toml(text))
 
 
 def prepare_for_build(
