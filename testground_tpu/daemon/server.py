@@ -6,7 +6,13 @@ surface (``daemon.go:83-101``) and bearer-token auth (``daemon.go:49-70``):
 
     POST /run /build /tasks /status /logs /outputs /terminate
          /healthcheck /kill /build/purge /plan/import
-    GET  /tasks
+    GET  /tasks /journal /data /dashboard
+
+The GET tier is the reference's web-dashboard surface (``daemon.go:83-91``,
+``dashboard.go:44-75``): ``/journal`` returns a task's result journal,
+``/data`` returns one measurement's sampled rows (the InfluxDB-table
+analog, served from the metrics viewer), and ``/dashboard`` renders the
+task list / per-task measurement tables as HTML.
 
 Transport notes (deviations are simplifications, not semantics):
 
@@ -105,9 +111,29 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — stdlib naming
         if not self._authed():
             return self._send_error_json("unauthorized", 401)
-        if self.path.split("?")[0] == "/tasks":
-            return self._tasks({})
-        return self._send_error_json("not found", 404)
+        from urllib.parse import parse_qs, urlparse
+
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        handlers = {
+            "/tasks": lambda: self._tasks({}),
+            "/journal": lambda: self._journal(q),
+            "/data": lambda: self._data(q),
+            "/dashboard": lambda: self._dashboard(q),
+        }
+        h = handlers.get(url.path)
+        if h is None:
+            return self._send_error_json("not found", 404)
+        try:
+            return h()
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — HTTP boundary
+            S().warning("daemon GET %s failed: %s", url.path, e)
+            try:
+                self._send_error_json(str(e), 500)
+            except Exception:  # noqa: BLE001 — response already started
+                pass
 
     def do_POST(self):  # noqa: N802
         if not self._authed():
@@ -270,6 +296,123 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self._send_json({"output": buf.getvalue()})
 
+    # ------------------------------------------------- dashboard tier (GET)
+
+    def _send_html(self, body: str, code: int = 200) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _journal(self, q: dict) -> None:
+        """GET /journal?task_id= — the task's result journal
+        (``daemon.go:90`` getJournalHandler)."""
+        task_id = q.get("task_id", "")
+        t = self.engine.get_task(task_id)
+        if t is None:
+            return self._send_error_json(f"unknown task {task_id}", 404)
+        journal = (
+            t.result.get("journal", {}) if isinstance(t.result, dict) else {}
+        )
+        self._send_json({"task_id": task_id, "journal": journal})
+
+    def _data(self, q: dict) -> None:
+        """GET /data?task_id=&metric= — one measurement's sampled rows
+        (``daemon.go:83`` dataHandler; rows are the InfluxDB-table analog).
+        ``metric`` accepts the bare metric name or the full
+        ``results.<plan>-<case>.<metric>`` measurement string."""
+        from testground_tpu.metrics import Viewer, measurement_name
+
+        task_id = q.get("task_id", "")
+        t = self.engine.get_task(task_id)
+        if t is None:
+            return self._send_error_json(f"unknown task {task_id}", 404)
+        metric = q.get("metric", "")
+        prefix = measurement_name(t.plan, t.case, "")
+        if metric.startswith(prefix):
+            metric = metric[len(prefix) :]
+        if not metric:
+            return self._send_error_json("metric query param required", 400)
+        rows = Viewer(self.engine.env).get_data(
+            t.plan, t.case, metric, run_id=task_id
+        )
+        self._send_json(
+            {
+                "measurement": measurement_name(t.plan, t.case, metric),
+                "rows": [r.to_dict() for r in rows],
+            }
+        )
+
+    def _dashboard(self, q: dict) -> None:
+        """GET /dashboard[?task_id=] — HTML: the task list (``tmpl/
+        tasks.html`` analog) or one task's measurement tables
+        (``dashboard.go:44-75`` + ``tmpl/measurements.html``)."""
+        import html as _html
+
+        from testground_tpu.metrics import Viewer, measurement_name
+
+        esc = _html.escape
+        task_id = q.get("task_id", "")
+        if not task_id:
+            rows = []
+            for t in self.engine.tasks(limit=100):
+                rows.append(
+                    "<tr>"
+                    f'<td><a href="/dashboard?task_id={esc(t.id)}">{esc(t.id)}</a></td>'
+                    f"<td>{esc(t.plan)}:{esc(t.case)}</td>"
+                    f"<td>{esc(t.type.value)}</td>"
+                    f"<td>{esc(t.state().state.value)}</td>"
+                    f"<td>{esc(t.outcome().value)}</td>"
+                    "</tr>"
+                )
+            return self._send_html(
+                _page(
+                    "testground tasks",
+                    "<table><tr><th>task</th><th>plan:case</th><th>type</th>"
+                    "<th>state</th><th>outcome</th></tr>"
+                    + "".join(rows)
+                    + "</table>",
+                )
+            )
+
+        t = self.engine.get_task(task_id)
+        if t is None:
+            return self._send_html(_page("not found", "Cannot get task"), 404)
+        viewer = Viewer(self.engine.env)
+        all_data = viewer.get_all_data(t.plan, t.case, run_id=task_id)
+        sections = []
+        for metric in sorted(all_data):
+            m = measurement_name(t.plan, t.case, metric)
+            rows = all_data[metric]
+            body = "".join(
+                "<tr>"
+                f"<td>{r.tick}</td><td>{esc(r.group_id)}</td>"
+                f"<td>{r.fields.get('count', '')}</td>"
+                f"<td>{_fmt(r.fields.get('mean'))}</td>"
+                f"<td>{_fmt(r.fields.get('min'))}</td>"
+                f"<td>{_fmt(r.fields.get('max'))}</td>"
+                "</tr>"
+                for r in rows
+            )
+            sections.append(
+                f"<h2>{esc(m)}</h2>"
+                "<table><tr><th>tick</th><th>group</th><th>count</th>"
+                "<th>mean</th><th>min</th><th>max</th></tr>" + body + "</table>"
+            )
+        if not sections:
+            sections = ["<p>No measurements for this test plan.</p>"]
+        header = (
+            f"<p>task <code>{esc(task_id)}</code> — "
+            f"{esc(t.plan)}:{esc(t.case)} — state {esc(t.state().state.value)}, "
+            f"outcome {esc(t.outcome().value)} — "
+            f'<a href="/journal?task_id={esc(task_id)}">journal</a></p>'
+        )
+        self._send_html(
+            _page(f"{t.plan}:{t.case}", header + "".join(sections))
+        )
+
     def _plan_import(self) -> None:
         """Body: raw tar.gz of a plan directory; ``?name=`` overrides."""
         from urllib.parse import parse_qs, urlparse
@@ -300,6 +443,29 @@ class _Handler(BaseHTTPRequestHandler):
                 shutil.rmtree(dest)
             shutil.copytree(src, dest)
         self._send_json({"imported": name})
+
+
+def _fmt(v) -> str:
+    return f"{v:.3f}" if isinstance(v, (int, float)) else ""
+
+
+def _page(title: str, body: str) -> str:
+    """Minimal self-contained page shell (the tmpl/*.html + bootstrap
+    analog, without the static asset tree). The title is escaped here (it
+    can carry client-supplied plan/case strings); the body is the caller's
+    already-escaped markup."""
+    import html as _html
+
+    title = _html.escape(title)
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{title}</title>"
+        "<style>body{font-family:sans-serif;margin:2rem}"
+        "table{border-collapse:collapse;margin:1rem 0}"
+        "td,th{border:1px solid #999;padding:.3rem .6rem;text-align:left}"
+        "th{background:#eee}</style></head>"
+        f"<body><h1>{title}</h1>{body}</body></html>"
+    )
 
 
 class _ChunkSink:

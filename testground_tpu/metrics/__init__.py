@@ -1,0 +1,7 @@
+"""Metrics query layer — the twin of the reference's ``pkg/metrics``
+(InfluxDB viewer for the daemon dashboard) over the per-run
+``timeseries.jsonl`` files the ``sim:jax`` runner writes."""
+
+from .viewer import Row, Viewer, clean, measurement_name
+
+__all__ = ["Row", "Viewer", "clean", "measurement_name"]

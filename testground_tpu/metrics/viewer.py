@@ -1,0 +1,154 @@
+"""Measurement viewer backing the daemon dashboard.
+
+Query-surface twin of the reference's ``pkg/metrics/viewer.go:35-80``
+(``GetMeasurements`` / ``GetTags`` / ``GetData`` against InfluxDB's
+``results.<plan>-<case>.*`` measurements). The storage is different by
+design: instead of an external InfluxDB the ``sim:jax`` runner reduces
+metrics per group on a tick cadence and appends rows to
+``<outputs>/<plan>/<run-id>/timeseries.jsonl``; this viewer scans those
+files. Measurement names keep the reference's ``results.<plan>-<case>.
+<metric>`` shape so dashboard URLs and labels look the same.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from testground_tpu.config import EnvConfig
+
+__all__ = ["Row", "Viewer", "clean", "measurement_name"]
+
+# Tag keys that identify rather than dimension a series — excluded from the
+# dashboard's tag pickers like the reference's tagsIgnoreList
+# (``viewer.go:13-22``).
+TAGS_IGNORE = {"plan", "case", "group_id", "run"}
+
+
+def clean(name: str) -> str:
+    """Measurement-name sanitizer (``dashboard.go:112-118``)."""
+    return name.replace("/", "-")
+
+
+def measurement_name(plan: str, case: str, metric: str) -> str:
+    return f"results.{clean(plan)}-{case}.{metric}"
+
+
+@dataclasses.dataclass
+class Row:
+    """One sampled reduction (the viewer.go ``Row`` analog: Run + Timestamp
+    + Fields, with simulated ticks standing in for wall timestamps)."""
+
+    run: str
+    tick: int
+    group_id: str
+    fields: dict  # count/mean/min/max
+
+    def to_dict(self) -> dict:
+        return {
+            "run": self.run,
+            "tick": self.tick,
+            "group_id": self.group_id,
+            **self.fields,
+        }
+
+
+class Viewer:
+    def __init__(self, env: EnvConfig | None = None):
+        self.env = env or EnvConfig.load()
+
+    # ------------------------------------------------------------- scanning
+
+    def _run_dirs(self, plan: str):
+        root = os.path.join(self.env.dirs.outputs(), plan)
+        if not os.path.isdir(root):
+            return
+        for run_id in sorted(os.listdir(root)):
+            ts = os.path.join(root, run_id, "timeseries.jsonl")
+            if os.path.isfile(ts):
+                yield run_id, ts
+
+    def _iter_rows(self, plan: str, case: str | None, run_id: str | None):
+        for rid, path in self._run_dirs(plan):
+            # a task's runs are <task-id> (single run) or <task-id>-<run-id>
+            # (multi-run [[runs]] compositions — supervisor run_id scheme),
+            # so a task-scoped query matches both
+            if (
+                run_id is not None
+                and rid != run_id
+                and not rid.startswith(run_id + "-")
+            ):
+                continue
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if case is not None and row.get("case") != case:
+                        continue
+                    yield row
+
+    # ---------------------------------------------------------------- query
+
+    def get_measurements(
+        self, plan: str, case: str, run_id: str | None = None, limit: int = 20
+    ) -> list[str]:
+        """Distinct measurement names for a plan:case — ``SHOW MEASUREMENTS
+        … =~ /results.<name>.*/ LIMIT 20`` (``viewer.go:45-55``)."""
+        names: list[str] = []
+        for row in self._iter_rows(plan, case, run_id):
+            name = row.get("name")
+            if name and name not in names:
+                names.append(name)
+                if len(names) >= limit:
+                    break
+        return [measurement_name(plan, case, n) for n in sorted(names)]
+
+    def get_tags(self, measurement: str) -> list[str]:
+        """Extra tag keys for a measurement (``viewer.go:78-107``): the
+        identity tags are filtered like the reference's ignore list, and the
+        sim pipeline produces no custom tags, so this is empty today — kept
+        for surface parity with dashboards that render tag pickers."""
+        return []
+
+    def get_data(
+        self,
+        plan: str,
+        case: str,
+        metric: str,
+        run_id: str | None = None,
+    ) -> list[Row]:
+        """All sampled rows of one metric, tick-ordered per run."""
+        return self.get_all_data(plan, case, run_id).get(metric, [])
+
+    def get_all_data(
+        self, plan: str, case: str, run_id: str | None = None
+    ) -> dict[str, list[Row]]:
+        """One pass over the run's series files: every metric's rows,
+        tick-ordered per run — what the dashboard renders tables from."""
+        out: dict[str, list[Row]] = {}
+        for row in self._iter_rows(plan, case, run_id):
+            name = row.get("name")
+            if not name:
+                continue
+            fields = {
+                k: row[k]
+                for k in ("count", "mean", "min", "max")
+                if k in row
+            }
+            out.setdefault(name, []).append(
+                Row(
+                    run=row.get("run", ""),
+                    tick=int(row.get("tick", 0)),
+                    group_id=row.get("group_id", ""),
+                    fields=fields,
+                )
+            )
+        for rows in out.values():
+            rows.sort(key=lambda r: (r.run, r.group_id, r.tick))
+        return out

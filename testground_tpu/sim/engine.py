@@ -434,11 +434,17 @@ class SimProgram:
         max_ticks: int = 10_000,
         cancel=None,
         on_chunk: Callable[[int], None] | None = None,
+        observer: Callable[[int, "SimCarry"], None] | None = None,
     ) -> dict[str, Any]:
         """Step to completion. Returns host-side results:
 
         status [N], finished_at [N], ticks run, final per-group states,
         sync counters and journal counters.
+
+        ``observer(ticks, carry)`` is called after every chunk with the live
+        device carry — the periodic metrics-sampling hook (reading the carry
+        forces a device sync, so observers should sample on a cadence, not
+        every call).
         """
         # init is traceable; jit it so construction is one dispatch rather
         # than hundreds of eager ops (matters on remote-tunneled devices).
@@ -450,6 +456,8 @@ class SimProgram:
             ticks += self.chunk
             if on_chunk is not None:
                 on_chunk(ticks)
+            if observer is not None:
+                observer(ticks, carry)
             if bool(done):  # one scalar device→host sync per chunk
                 break
             if cancel is not None and cancel.is_set():

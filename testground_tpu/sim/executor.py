@@ -45,6 +45,10 @@ class SimJaxConfig:
     shard: bool = True  # shard instance axis over available devices
     write_outputs_max: int = 2048  # cap on per-instance output dirs
     keep_outputs: bool = True
+    # metric time-series sampling cadence in ticks (0 disables) — the analog
+    # of the reference SDK's periodic InfluxDB metric batches; each sample is
+    # a device→host state read, so the cadence bounds the overhead
+    timeseries_every: int = 1024
 
 
 def load_sim_testcases(artifact_path: str) -> dict:
@@ -143,8 +147,21 @@ def execute_sim_run(
                 now - t0,
             )
 
+    outputs_root = job.env.dirs.outputs() if job.env is not None else None
+    # no outputs dir → nowhere to persist samples; disable so the hot loop
+    # never pays the per-sample device→host sync
+    recorder = _TimeSeriesRecorder(
+        testcase,
+        groups,
+        getattr(cfg, "timeseries_every", 0) if outputs_root else 0,
+        ow,
+    )
     res = prog.run(
-        seed=cfg.seed, max_ticks=cfg.max_ticks, cancel=cancel, on_chunk=on_chunk
+        seed=cfg.seed,
+        max_ticks=cfg.max_ticks,
+        cancel=cancel,
+        on_chunk=on_chunk,
+        observer=recorder.observe if recorder.enabled else None,
     )
     wall = time.time() - t0
     status = res["status"]
@@ -159,7 +176,6 @@ def execute_sim_run(
     # ------------------------------------------------ outcomes + outputs
     result = Result.for_input(job)
     result.journal["events"] = {}
-    outputs_root = job.env.dirs.outputs() if job.env is not None else None
     write_outputs = (
         outputs_root is not None and n <= cfg.write_outputs_max
     )
@@ -195,6 +211,33 @@ def execute_sim_run(
     if metrics:
         result.journal["metrics"] = {
             gid: _aggregate_metrics(m) for gid, m in metrics.items()
+        }
+
+    # ------------------------------------------------ metric time series
+    # final sample at the last tick, then persist the run's series — written
+    # even above write_outputs_max (per-group reductions stay small)
+    if recorder.enabled:
+        recorder.sample(res["ticks"], res["states"], status)
+    if outputs_root is not None and recorder.rows:
+        run_dir = os.path.join(outputs_root, job.test_plan, job.run_id)
+        os.makedirs(run_dir, exist_ok=True)
+        ts_path = os.path.join(run_dir, "timeseries.jsonl")
+        with open(ts_path, "w") as f:
+            for row in recorder.rows:
+                f.write(
+                    json.dumps(
+                        {
+                            "run": job.run_id,
+                            "plan": job.test_plan,
+                            "case": job.test_case,
+                            **row,
+                        }
+                    )
+                    + "\n"
+                )
+        result.journal["timeseries"] = {
+            "samples": len(recorder.rows),
+            "every_ticks": recorder.every,
         }
 
     for gi, g in enumerate(groups):
@@ -234,6 +277,60 @@ def _tree_slice(state_group):
     """Per-group states are already host numpy pytrees; identity hook kept
     for future lazy device slicing."""
     return state_group
+
+
+class _TimeSeriesRecorder:
+    """Periodic per-group metric reductions over the live sim carry — the
+    pipeline the reference implements as SDK metric batches flushed to
+    InfluxDB (``plans/example/metrics.go:15-19`` → viewer tables,
+    ``pkg/metrics/viewer.go:45-80``). Each sample re-runs the plan's
+    ``collect_metrics`` on the in-flight state and reduces it per group;
+    rows land in ``timeseries.jsonl`` under the run's outputs dir."""
+
+    def __init__(self, testcase, groups, every: int, ow: OutputWriter):
+        self._collect = getattr(testcase, "collect_metrics", None)
+        self.groups = groups
+        self.every = int(every or 0)
+        self._next_at = self.every
+        self._last_tick = -1
+        self.rows: list[dict] = []
+        self.ow = ow
+        self._warned: set[str] = set()
+
+    @property
+    def enabled(self) -> bool:
+        return callable(self._collect) and self.every > 0
+
+    def observe(self, ticks: int, carry) -> None:
+        if ticks < self._next_at:
+            return
+        self._next_at = ticks + self.every
+        self.sample(ticks, carry.states, np.asarray(carry.status))
+
+    def sample(self, tick: int, states, status) -> None:
+        import jax
+
+        if tick == self._last_tick:  # final sample on a cadence boundary
+            return
+        self._last_tick = tick
+        for gi, g in enumerate(self.groups):
+            try:
+                m = self._collect(
+                    g,
+                    jax.tree.map(np.asarray, states[gi]),
+                    status[g.offset : g.offset + g.count],
+                )
+            except Exception as e:  # noqa: BLE001 — sampling is best-effort
+                if g.id not in self._warned:
+                    self._warned.add(g.id)
+                    self.ow.warn(
+                        "timeseries sample failed for group %s: %s", g.id, e
+                    )
+                continue
+            for name, agg in _aggregate_metrics(m).items():
+                self.rows.append(
+                    {"tick": int(tick), "group_id": g.id, "name": name, **agg}
+                )
 
 
 def _aggregate_metrics(group_metrics: dict) -> dict:

@@ -1,0 +1,279 @@
+"""Metrics time-series pipeline + viewer + daemon dashboard routes
+(reference: ``pkg/metrics/viewer.go:35-80``, ``pkg/daemon/dashboard.go:44-75``,
+GET routes ``daemon.go:83-91``)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from testground_tpu.config import EnvConfig
+from testground_tpu.metrics import Viewer, measurement_name
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def _write_ts(env, plan, run_id, rows):
+    d = os.path.join(env.dirs.outputs(), plan, run_id)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "timeseries.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+class TestViewer:
+    def test_measurements_and_data(self, tg_home):
+        env = EnvConfig.load()
+        rows = [
+            {
+                "run": "r1",
+                "plan": "network",
+                "case": "ping-pong",
+                "tick": t,
+                "group_id": "all",
+                "name": "rtt_ticks",
+                "count": 10,
+                "mean": 5.0 + t,
+                "min": 4.0,
+                "max": 6.0,
+            }
+            for t in (128, 256)
+        ]
+        rows.append({**rows[0], "name": "other_metric", "tick": 128})
+        _write_ts(env, "network", "r1", rows)
+
+        v = Viewer(env)
+        ms = v.get_measurements("network", "ping-pong")
+        assert ms == [
+            "results.network-ping-pong.other_metric",
+            "results.network-ping-pong.rtt_ticks",
+        ]
+        data = v.get_data("network", "ping-pong", "rtt_ticks")
+        assert [r.tick for r in data] == [128, 256]
+        assert data[0].fields["mean"] == pytest.approx(133.0)
+        assert v.get_tags(ms[0]) == []
+
+    def test_case_and_run_filters(self, tg_home):
+        env = EnvConfig.load()
+        base = {
+            "plan": "p",
+            "group_id": "all",
+            "name": "m",
+            "count": 1,
+            "mean": 1.0,
+            "min": 1.0,
+            "max": 1.0,
+        }
+        _write_ts(env, "p", "r1", [{**base, "run": "r1", "case": "a", "tick": 1}])
+        _write_ts(env, "p", "r2", [{**base, "run": "r2", "case": "b", "tick": 2}])
+        v = Viewer(env)
+        assert len(v.get_data("p", "a", "m")) == 1
+        assert len(v.get_data("p", "b", "m")) == 1
+        assert v.get_data("p", "a", "m", run_id="r2") == []
+        assert v.get_measurements("p", "nope") == []
+
+    def test_missing_outputs_dir_is_empty(self, tg_home):
+        v = Viewer(EnvConfig.load())
+        assert v.get_measurements("ghost", "x") == []
+        assert v.get_data("ghost", "x", "m") == []
+
+    def test_task_scoped_query_matches_multi_run_ids(self, tg_home):
+        """Multi-run [[runs]] compositions write run dirs named
+        <task-id>-<run-id>; a task_id query must find them."""
+        env = EnvConfig.load()
+        base = {
+            "plan": "p",
+            "case": "c",
+            "group_id": "all",
+            "name": "m",
+            "count": 1,
+            "mean": 1.0,
+            "min": 1.0,
+            "max": 1.0,
+        }
+        _write_ts(env, "p", "t1-alpha", [{**base, "run": "t1-alpha", "tick": 1}])
+        _write_ts(env, "p", "t1-beta", [{**base, "run": "t1-beta", "tick": 2}])
+        _write_ts(env, "p", "t2", [{**base, "run": "t2", "tick": 3}])
+        v = Viewer(env)
+        assert len(v.get_data("p", "c", "m", run_id="t1")) == 2
+        assert len(v.get_data("p", "c", "m", run_id="t2")) == 1
+
+    def test_dotted_metric_names_survive(self, tg_home):
+        env = EnvConfig.load()
+        _write_ts(
+            env,
+            "p",
+            "r1",
+            [
+                {
+                    "run": "r1",
+                    "plan": "p",
+                    "case": "c",
+                    "tick": 4,
+                    "group_id": "all",
+                    "name": "latency.p99",
+                    "count": 2,
+                    "mean": 9.0,
+                    "min": 8.0,
+                    "max": 10.0,
+                }
+            ],
+        )
+        v = Viewer(env)
+        data = v.get_all_data("p", "c")
+        assert list(data) == ["latency.p99"]
+        assert v.get_data("p", "c", "latency.p99")[0].fields["mean"] == 9.0
+
+
+class TestTimeSeriesRecorder:
+    def test_final_sample_not_duplicated_on_cadence_boundary(self):
+        from testground_tpu.rpc import discard_writer
+        from testground_tpu.sim.executor import _TimeSeriesRecorder
+
+        import numpy as np
+
+        class TC:
+            def collect_metrics(self, group, state, status):
+                return {"m": state["x"]}
+
+        class G:
+            id = "all"
+            offset = 0
+            count = 2
+
+        rec = _TimeSeriesRecorder(TC(), [G()], 128, discard_writer())
+        states = [{"x": np.asarray([1.0, 2.0])}]
+        status = np.asarray([1, 1])
+        rec.sample(128, states, status)
+        rec.sample(128, states, status)  # the run-end resample at same tick
+        assert len(rec.rows) == 1
+        rec.sample(256, states, status)
+        assert len(rec.rows) == 2
+
+
+def test_page_escapes_title():
+    from testground_tpu.daemon.server import _page
+
+    out = _page("<script>alert(1)</script>", "<p>ok</p>")
+    assert "<script>alert(1)" not in out
+    assert "&lt;script&gt;" in out
+
+
+class TestSimTimeSeries:
+    def test_sim_run_writes_timeseries(self, tg_home):
+        """A sim:jax run of a metrics-bearing testcase persists sampled
+        rows (at minimum the final sample) to timeseries.jsonl."""
+        from tests.test_sim_runner import run_sim
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.engine import Engine, EngineConfig, Outcome
+        from testground_tpu.sim.runner import SimJaxRunner
+
+        env = EnvConfig.load()
+        e = Engine(
+            EngineConfig(
+                env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+            )
+        )
+        e.start_workers()
+        try:
+            t = run_sim(e, "benchmarks", "netinit", instances=8)
+        finally:
+            e.stop()
+        assert t.outcome() == Outcome.SUCCESS
+        assert t.result["journal"]["timeseries"]["samples"] > 0
+        v = Viewer(env)
+        ms = v.get_measurements("benchmarks", "netinit")
+        assert (
+            measurement_name("benchmarks", "netinit", "time_to_network_init_ticks")
+            in ms
+        )
+        rows = v.get_data(
+            "benchmarks", "netinit", "time_to_network_init_ticks", run_id=t.id
+        )
+        assert rows and rows[-1].fields["count"] == 8
+
+
+def _get(daemon, path):
+    req = urllib.request.Request(daemon.address + path)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
+class TestDaemonDashboardRoutes:
+    @pytest.fixture()
+    def daemon(self, tg_home):
+        from testground_tpu.daemon import Daemon
+
+        d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+        d.start()
+        yield d
+        d.stop()
+
+    @pytest.fixture()
+    def finished_sim_task(self, daemon):
+        from testground_tpu.client import Client
+
+        client = Client(daemon.address)
+        client.import_plan(os.path.join(PLANS, "benchmarks"))
+        task_id = client.run(
+            {
+                "global": {
+                    "plan": "benchmarks",
+                    "case": "netinit",
+                    "builder": "sim:plan",
+                    "runner": "sim:jax",
+                    "total_instances": 4,
+                },
+                "groups": [{"id": "all", "instances": {"count": 4}}],
+            }
+        )
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            t = client.status(task_id)
+            if t["states"][-1]["state"] in ("complete", "canceled"):
+                assert t["outcome"] == "success"
+                return task_id
+            time.sleep(0.2)
+        raise TimeoutError(task_id)
+
+    def test_dashboard_list_and_task_pages(self, daemon, finished_sim_task):
+        code, ctype, body = _get(daemon, "/dashboard")
+        assert code == 200 and "text/html" in ctype
+        assert finished_sim_task in body.decode()
+
+        code, ctype, body = _get(
+            daemon, f"/dashboard?task_id={finished_sim_task}"
+        )
+        page = body.decode()
+        assert code == 200 and "text/html" in ctype
+        assert "results.benchmarks-netinit.time_to_network_init_ticks" in page
+        assert "<table>" in page
+
+    def test_journal_route(self, daemon, finished_sim_task):
+        code, _, body = _get(daemon, f"/journal?task_id={finished_sim_task}")
+        assert code == 200
+        j = json.loads(body)
+        assert j["journal"]["sim"]["ticks"] > 0
+        assert "timeseries" in j["journal"]
+
+    def test_data_route(self, daemon, finished_sim_task):
+        code, _, body = _get(
+            daemon,
+            f"/data?task_id={finished_sim_task}"
+            "&metric=time_to_network_init_ticks",
+        )
+        assert code == 200
+        d = json.loads(body)
+        assert d["measurement"].endswith(".time_to_network_init_ticks")
+        assert d["rows"] and d["rows"][-1]["count"] == 4
+
+    def test_unknown_task_404s(self, daemon):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(daemon, "/journal?task_id=ghost")
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(daemon, "/data?task_id=ghost&metric=m")
+        assert ei.value.code == 404
