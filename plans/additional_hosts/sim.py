@@ -1,0 +1,93 @@
+"""additional_hosts plan, sim edition.
+
+Sim twin of the reference's ``plans/additional_hosts`` (``main.go:20-40``):
+the plan HTTP-GETs a service that is reachable only because the runner
+whitelists it as an additional host on the control network
+(``pkg/sidecar/docker_reactor.go:69-103`` control routes + the
+ADDITIONAL_HOSTS env, ``local_docker.go:141-142``). Here the service is an
+echo lane past the instance axis (``SimEnv.hosts``): each instance sends a
+request payload to ``env.host_index("http-echo")`` and must get it back
+verbatim from the host's lane — the "ok" body check.
+
+``additional_hosts_drop`` proves the *control-route* property: with a
+BLACKHOLE filter over every data-plane region, the echo must still answer
+— control routes bypass shaping and filters, exactly like the reference's
+whitelisted routes survive the sidecar's Drop rules.
+"""
+
+import jax.numpy as jnp
+
+from testground_tpu.sim.api import (
+    FAILURE,
+    FILTER_DROP,
+    RUNNING,
+    SUCCESS,
+    Outbox,
+    SimTestcase,
+)
+
+REQ = 7  # request marker word
+
+
+class AdditionalHosts(SimTestcase):
+    MSG_WIDTH = 2  # [kind, nonce]
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 4
+    TRACK_SRC = True
+    SHAPING = ("latency", "filters")
+    DROP_ALL = False
+
+    def init(self, env):
+        return {"bad": jnp.asarray(False)}
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        host = env.host_index("http-echo")  # static; raises if unlisted
+        nonce = env.global_seq ^ jnp.int32(0x0BAD5EED)
+
+        # request once the (possible) DROP filter is installed + applied,
+        # staggered two senders per tick so the host's IN_MSGS-slot accept
+        # queue never overflows at any instance count
+        window = max(1, -(-env.test_instance_count // 2))
+        send = t == 2 + jnp.mod(env.global_seq, window)
+        ob = Outbox.single(
+            jnp.int32(host),
+            jnp.stack([jnp.int32(REQ), nonce]),
+            send,
+            cls.OUT_MSGS,
+            cls.MSG_WIDTH,
+        )
+
+        is_echo = (
+            inbox.valid
+            & (inbox.src == host)
+            & (inbox.word(0) == REQ)
+            & (inbox.word(1) == nonce)
+        )
+        # anything else delivered here is a transport violation
+        bad = state["bad"] | jnp.any(inbox.valid & ~is_echo)
+        got = jnp.any(is_echo)
+
+        drop_filters = jnp.full((len(env.groups),), FILTER_DROP, jnp.int32)
+        return self.out(
+            {"bad": bad},
+            status=jnp.where(
+                bad, FAILURE, jnp.where(got, SUCCESS, RUNNING)
+            ),
+            outbox=ob,
+            net_filters=drop_filters if cls.DROP_ALL else None,
+            net_filters_valid=(t == 0) if cls.DROP_ALL else False,
+        )
+
+
+class AdditionalHostsDrop(AdditionalHosts):
+    """DROP-all data plane; the whitelisted control route still answers."""
+
+    DROP_ALL = True
+
+
+sim_testcases = {
+    "additional_hosts": AdditionalHosts,
+    "additional_hosts_drop": AdditionalHostsDrop,
+}

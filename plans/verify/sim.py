@@ -1,0 +1,260 @@
+"""verify plan, sim edition.
+
+Sim twin of the reference's ``plans/verify`` (``main.go:37-40``
+UsesDataNetwork): the framework-invariant plan. The reference elects one
+target instance, publishes the target's addresses on every network, then
+has the other instances ping each address — failing if the control network
+answers or the data network loses packets. Here the invariant becomes: **a
+message reaches an instance only through the shaped data-plane transport,
+checksum-exact as the link model delivered it** —
+
+- ``uses-data-network``: the target (SignalEntry rank 1 on "ready", the
+  ``MustSignalAndWait`` switch at ``main.go:63``) publishes two addresses
+  on the "addrs" topic: its data-plane address (its instance index) and a
+  control-plane address (index + N, outside the data plane — the
+  192.18.x.x analog). Pingers ping both, staggered one pinger per tick.
+  Data pings must all return as checksum-verified pongs (packet loss 0%);
+  control pings must return nothing (the control address is unreachable
+  via the transport). Every receiver validates each inbox entry's
+  checksum against its provenance — any corruption, forged sender, or
+  out-of-plane delivery is a FAILURE.
+- ``uses-data-network-drop``: the DROP-all invariant. Every pinger
+  installs a BLACKHOLE filter over all regions before pinging; the target
+  must receive ZERO messages and the pingers ZERO pongs for the whole
+  run (the sidecar's Drop route: ``link.go:187-217``). Sync traffic still
+  flows — coordination rides the control plane, exactly like the
+  reference's Redis sync on the control network.
+"""
+
+import jax.numpy as jnp
+
+from testground_tpu.sim.api import (
+    FAILURE,
+    FILTER_DROP,
+    RUNNING,
+    SUCCESS,
+    Outbox,
+    SimTestcase,
+)
+
+PING = 1
+PONG = 2
+END_OF_NETWORKS = -1  # the "endOfNetworks" sentinel (main.go:60)
+
+GOLD = -1640531527  # 0x9E3779B9 as int32 — checksum mixing constant
+
+
+def _checksum(src, seq):
+    """Payload checksum keyed on sender identity + sequence: in-flight
+    corruption or forged provenance breaks it (int32 wraparound arithmetic
+    keeps it traceable)."""
+    return (src * jnp.int32(GOLD)) ^ (seq + jnp.int32(0x5EED))
+
+
+class UsesDataNetwork(SimTestcase):
+    STATES = ["ready", "target-ready", "finished"]
+    TOPICS = ["addrs"]
+    MSG_WIDTH = 3  # [kind, checksum, seq]
+    OUT_MSGS = 4  # target echoes a full inbox; pingers use slots 0-1
+    IN_MSGS = 4
+    PUB_WIDTH = 2  # [addr, is_end]
+    SUB_K = 4
+    MAX_LINK_TICKS = 4
+    SHAPING = ("latency", "filters")
+    DROP_ALL = False  # the -drop testcase flips this
+    DRAIN_TICKS = 4  # in-flight pongs settle before the loss verdict
+
+    def init(self, env):
+        return {
+            "addr_data": jnp.int32(-1),
+            "addr_ctrl": jnp.int32(-1),
+            "addrs_seen": jnp.int32(0),
+            "pub_idx": jnp.int32(0),
+            "sent": jnp.int32(0),
+            "done_at": jnp.int32(-1),
+            "pongs_data": jnp.int32(0),
+            "recv": jnp.int32(0),
+            "bad": jnp.asarray(False),
+            "sig_finished": jnp.asarray(False),
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        pings = (
+            env.int_param("pings") if "pings" in env.group.params else 8
+        )
+
+        rank = sync.last_seq[self.state_id("ready")]
+        is_target = rank == 1
+        is_pinger = rank > 1
+        me = env.global_seq
+
+        # ------------------------------------------------- inbox validation
+        kind = inbox.word(0)
+        csum = inbox.word(1)
+        seq = inbox.word(2)
+        ok_sum = csum == _checksum(inbox.src, seq)
+        got_ping = inbox.valid & (kind == PING)
+        got_pong = inbox.valid & (kind == PONG)
+        # the core invariant: everything delivered must carry a valid
+        # checksum from its true sender — "inbox content is exactly what
+        # the link model delivered"
+        bad = state["bad"] | jnp.any(inbox.valid & ~ok_sum)
+
+        # --------------------------------------------------- target: publish
+        # addrs entries over 3 ticks: data addr, control addr, END
+        entries = jnp.stack(
+            [
+                jnp.stack([me, jnp.int32(0)]),
+                jnp.stack([me + n, jnp.int32(0)]),
+                jnp.stack([jnp.int32(END_OF_NETWORKS), jnp.int32(1)]),
+            ]
+        )
+        can_pub = is_target & (state["pub_idx"] < 3) & (t >= 1)
+        pub_payload = entries[jnp.minimum(state["pub_idx"], 2)][None, :]
+        pub_idx = state["pub_idx"] + can_pub.astype(jnp.int32)
+        sig_target_ready = is_target & (pub_idx >= 3) & (state["pub_idx"] < 3)
+
+        # target echoes every valid ping back to its sender, re-stamped
+        # with the target's own provenance (so the pinger's generic
+        # checksum validation covers the return path too)
+        echo = Outbox(
+            dst=inbox.src,
+            payload=jnp.stack(
+                [jnp.full_like(kind, PONG), _checksum(me, seq), seq],
+                axis=-1,
+            ),
+            valid=got_ping & is_target & ok_sum,
+        )
+        recv = state["recv"] + jnp.sum(got_ping.astype(jnp.int32))
+
+        # ------------------------------------------------- pinger: subscribe
+        sub_pay = sync.sub_payload[0]  # [SUB_K, PUB_WIDTH]
+        sub_val = sync.sub_valid[0]  # [SUB_K]
+        target_ready = sync.counts[self.state_id("target-ready")] >= 1
+        k_idx = jnp.arange(cls.SUB_K, dtype=jnp.int32)
+        take = sub_val & (k_idx < 3 - state["addrs_seen"]) & is_pinger
+        ent_idx = state["addrs_seen"] + k_idx
+        is_data = take & (ent_idx == 0)
+        is_ctrl = take & (ent_idx == 1)
+        addr_data = jnp.where(
+            jnp.any(is_data),
+            jnp.sum(jnp.where(is_data, sub_pay[:, 0], 0)),
+            state["addr_data"],
+        )
+        addr_ctrl = jnp.where(
+            jnp.any(is_ctrl),
+            jnp.sum(jnp.where(is_ctrl, sub_pay[:, 0], 0)),
+            state["addr_ctrl"],
+        )
+        ncons = jnp.sum(take.astype(jnp.int32))
+        addrs_seen = state["addrs_seen"] + ncons
+
+        # --------------------------------------------------- pinger: pinging
+        have_addrs = addrs_seen >= 3
+        # staggered: pinger fires on ticks ≡ its index (mod N), bounding
+        # target fan-in to ~1 ping/tick at any instance count
+        my_slot = jnp.mod(t, n) == jnp.mod(me, n)
+        send = (
+            is_pinger
+            & have_addrs
+            & my_slot
+            & (state["sent"] < pings)
+            & target_ready
+        )
+        pseq = state["sent"]
+        sent = state["sent"] + send.astype(jnp.int32)
+        done_at = jnp.where(
+            (state["done_at"] < 0) & (sent >= pings), t, state["done_at"]
+        )
+
+        ob = Outbox.empty(cls.OUT_MSGS, cls.MSG_WIDTH)
+        ping_payload = jnp.stack([jnp.int32(PING), _checksum(me, pseq), pseq])
+        # slot 0: ping the data address; slot 1: ping the control address
+        # (out-of-plane — the transport must never deliver it)
+        ob = Outbox(
+            dst=ob.dst.at[0].set(addr_data).at[1].set(addr_ctrl),
+            payload=ob.payload.at[0].set(ping_payload).at[1].set(ping_payload),
+            valid=ob.valid.at[0].set(send).at[1].set(send),
+        )
+        outbox = Outbox(
+            dst=jnp.where(is_target, echo.dst, ob.dst),
+            payload=jnp.where(is_target, echo.payload, ob.payload),
+            valid=jnp.where(is_target, echo.valid, ob.valid),
+        )
+
+        pongs_data = state["pongs_data"] + jnp.sum(
+            (got_pong & ok_sum).astype(jnp.int32)
+        )
+
+        # ------------------------------------------------------- the verdict
+        expected = jnp.int32(0 if cls.DROP_ALL else 1) * pings
+        pinger_done = (done_at >= 0) & (t >= done_at + cls.DRAIN_TICKS)
+        pinger_ok = pinger_done & (pongs_data == expected)
+        pinger_bad = pinger_done & (pongs_data != expected)
+        # a control-ping delivery would double-count into pongs_data
+        # (> expected) or surface as an unknown-provenance checksum (bad)
+
+        fin_target = jnp.int32(0 if cls.DROP_ALL else 1) * (n - 1) * pings
+        target_bad = is_target & (recv > fin_target)
+
+        sig_finished = (pinger_ok | (is_target & (t >= 1))) & ~state[
+            "sig_finished"
+        ]
+        all_done = sync.counts[self.state_id("finished")] >= n
+
+        status = jnp.where(
+            bad | pinger_bad | target_bad,
+            FAILURE,
+            jnp.where(all_done, SUCCESS, RUNNING),
+        )
+
+        # DROP-all: install a BLACKHOLE toward every region the tick rank
+        # becomes known, before any ping flies (uses-data-network-drop)
+        drop_filters = jnp.full((len(env.groups),), FILTER_DROP, jnp.int32)
+
+        return self.out(
+            {
+                "addr_data": addr_data,
+                "addr_ctrl": addr_ctrl,
+                "addrs_seen": addrs_seen,
+                "pub_idx": pub_idx,
+                "sent": sent,
+                "done_at": done_at,
+                "pongs_data": pongs_data,
+                "recv": recv,
+                "bad": bad,
+                "sig_finished": state["sig_finished"] | sig_finished,
+            },
+            status=status,
+            outbox=outbox,
+            signals=self.signal("ready") * (t == 0)
+            + self.signal("target-ready") * sig_target_ready
+            + self.signal("finished") * sig_finished,
+            pub_payload=pub_payload,
+            pub_valid=jnp.asarray([can_pub]),
+            sub_consume=jnp.asarray([ncons]),
+            net_filters=drop_filters if cls.DROP_ALL else None,
+            net_filters_valid=((t == 1) & is_pinger) if cls.DROP_ALL else False,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {
+            "pongs_received": final_state["pongs_data"],
+            "pings_delivered_to_target": final_state["recv"],
+        }
+
+
+class UsesDataNetworkDrop(UsesDataNetwork):
+    """DROP-all variant: with a BLACKHOLE over every route, the transport
+    must deliver nothing — zero pongs at pingers, zero pings at the target
+    (the DROP_ALL expectations in the verdict logic)."""
+
+    DROP_ALL = True
+
+
+sim_testcases = {
+    "uses-data-network": UsesDataNetwork,
+    "uses-data-network-drop": UsesDataNetworkDrop,
+}

@@ -136,11 +136,18 @@ class Viewer:
             name = row.get("name")
             if not name:
                 continue
-            fields = {
-                k: row[k]
-                for k in ("count", "mean", "min", "max")
-                if k in row
-            }
+            # coerce field types: the jsonl is an open format (documented
+            # for external writers), so rows must not smuggle arbitrary
+            # values into consumers like the HTML dashboard
+            try:
+                fields = {}
+                if "count" in row:
+                    fields["count"] = int(row["count"])
+                for k in ("mean", "min", "max"):
+                    if k in row:
+                        fields[k] = float(row[k])
+            except (TypeError, ValueError):
+                continue
             out.setdefault(name, []).append(
                 Row(
                     run=row.get("run", ""),

@@ -106,6 +106,11 @@ class SimEnv:
     global_seq: jax.Array  # int32 ∈ [0, N)
     group_seq: jax.Array  # int32 ∈ [0, group.count)
     key: jax.Array  # per-instance PRNG key
+    # --- static: additional service hosts (the whitelisted-control-routes
+    # analog, ``pkg/sidecar/docker_reactor.go:69-103`` + ADDITIONAL_HOSTS
+    # env) — echo lanes past the instance axis, reachable via
+    # :meth:`host_index`, whose traffic bypasses shaping and filters
+    hosts: tuple = ()
 
     # -- typed param accessors (RunEnv.StringParam/IntParam/... parity);
     # params are static so these resolve at trace time.
@@ -136,6 +141,16 @@ class SimEnv:
     def ms_to_ticks(self, ms: float) -> int:
         """Convert simulated milliseconds to whole ticks (≥1)."""
         return max(1, round(ms / self.tick_ms))
+
+    def host_index(self, name: str) -> int:
+        """Data-plane address of an additional host (static). Raises if the
+        runner config does not whitelist it — the analog of a DNS failure
+        for a host missing from ADDITIONAL_HOSTS."""
+        if name not in self.hosts:
+            raise KeyError(
+                f"host {name!r} not in additional_hosts {list(self.hosts)}"
+            )
+        return self.test_instance_count + self.hosts.index(name)
 
 
 @jax.tree_util.register_dataclass

@@ -105,6 +105,7 @@ class SimProgram:
         tick_ms: float = 1.0,
         mesh: jax.sharding.Mesh | None = None,
         chunk: int = 128,
+        hosts: tuple[str, ...] = (),
     ):
         self.tc = testcase
         self.groups = groups
@@ -116,6 +117,24 @@ class SimProgram:
             test_plan=test_plan, test_case=test_case, test_run=test_run
         )
         cls = type(testcase)
+        # Additional hosts: echo-service lanes appended past the instance
+        # axis (the whitelisted-control-routes analog — see SimEnv.hosts).
+        # Their traffic bypasses shaping/filters in the transport and they
+        # never terminate, so they are excluded from the done check and
+        # sliced out of results.
+        self.hosts = tuple(hosts)
+        self.n_lanes = self.n + len(self.hosts)
+        if self.hosts:
+            if not cls.TRACK_SRC:
+                raise ValueError(
+                    "additional_hosts need TRACK_SRC=True (the echo replies "
+                    "to the inbox src)"
+                )
+            if cls.SLOT_MODE == "direct":
+                raise ValueError(
+                    "additional_hosts need SLOT_MODE='sorted' (host fan-in "
+                    "violates the direct mode contract)"
+                )
         self.n_states = len(cls.STATES)
         self.n_topics = len(cls.TOPICS)
         self.n_regions = cls.N_REGIONS if cls.N_REGIONS > 0 else len(groups)
@@ -180,6 +199,7 @@ class SimProgram:
             global_seq=gs,
             group_seq=gseq,
             key=key,
+            hosts=self.hosts,
         )
 
     def init_carry(self, seed: int = 0) -> SimCarry:
@@ -199,31 +219,36 @@ class SimProgram:
 
             states.append(jax.vmap(init_one)(gs, gseq, gkeys))
 
+        # host lanes sit past the instance axis: region 0 (their traffic
+        # bypasses filters anyway), default egress, no sync participation
+        region_of = jnp.minimum(self._group_of, self.n_regions - 1)
+        if self.hosts:
+            region_of = jnp.concatenate(
+                [region_of, jnp.zeros((len(self.hosts),), jnp.int32)]
+            )
         carry = SimCarry(
             states=tuple(states),
-            status=jnp.full((self.n,), RUNNING, jnp.int32),
-            finished_at=jnp.full((self.n,), -1, jnp.int32),
+            status=jnp.full((self.n_lanes,), RUNNING, jnp.int32),
+            finished_at=jnp.full((self.n_lanes,), -1, jnp.int32),
             cal=Calendar.empty(
                 cls.MAX_LINK_TICKS,
-                self.n,
+                self.n_lanes,
                 cls.IN_MSGS,
                 cls.MSG_WIDTH,
                 track_src=cls.TRACK_SRC,
             ),
             link=make_link_state(
-                self.n,
+                self.n_lanes,
                 self.n_regions,
                 cls.DEFAULT_LINK,
                 # instances start in region = group index; plans with
                 # N_REGIONS > len(groups) reassign via StepOut.region
-                region_of=jnp.minimum(
-                    self._group_of, self.n_regions - 1
-                ),
+                region_of=region_of,
             ),
             sync=make_sync_state(
                 self.n, self.n_states, self.n_topics, cls.TOPIC_CAP, cls.PUB_WIDTH
             ),
-            rejected=jnp.zeros((self.n,), jnp.int32),
+            rejected=jnp.zeros((self.n_lanes,), jnp.int32),
             keys=keys,
             net_key=net_key,
             t=jnp.int32(0),
@@ -306,7 +331,8 @@ class SimProgram:
 
         # --- merge per-group outputs along the instance axis, masking
         # instances that already terminated (frozen like exited containers).
-        active = carry.status == RUNNING  # [N]
+        # (Host lanes past self.n have their own echo path below.)
+        active = carry.status[: self.n] == RUNNING  # [N]
 
         def freeze(old_leaf, new_leaf, lo, hi):
             a = active[lo:hi]
@@ -329,14 +355,42 @@ class SimProgram:
             return jnp.concatenate([getter(o) for o in outs], axis=-1)
 
         status_new = cat0(lambda o: o.status)
-        status = jnp.where(active, status_new, carry.status)
+        status = jnp.where(active, status_new, carry.status[: self.n])
         finished_at = jnp.where(
-            active & (status_new != RUNNING), t, carry.finished_at
+            active & (status_new != RUNNING), t, carry.finished_at[: self.n]
         )
+        if self.hosts:
+            status = jnp.concatenate([status, carry.status[self.n :]])
+            finished_at = jnp.concatenate(
+                [finished_at, carry.finished_at[self.n :]]
+            )
 
         dst = catl(lambda o: o.outbox.dst)  # [O, N]
         payload = catl(lambda o: o.outbox.payload)  # [O, W, N]
         valid = catl(lambda o: o.outbox.valid) & active[None, :]
+
+        if self.hosts:
+            # Echo service: every message delivered to a host lane goes
+            # straight back to its sender, payload verbatim, next tick —
+            # the http-echo container behind a whitelisted control route.
+            h_dst = inbox_all.src[:, self.n :]  # [SLOTS, H]
+            h_val = inbox_all.valid[:, self.n :]
+            h_pay = jnp.moveaxis(
+                inbox_all.payload[:, :, self.n :], 0, 1
+            )  # [SLOTS, W, H]
+            rows = max(dst.shape[0], h_dst.shape[0])
+
+            def pad_rows(x):
+                if x.shape[0] >= rows:
+                    return x
+                pad = jnp.zeros((rows - x.shape[0],) + x.shape[1:], x.dtype)
+                return jnp.concatenate([x, pad])
+
+            dst = jnp.concatenate([pad_rows(dst), pad_rows(h_dst)], axis=-1)
+            payload = jnp.concatenate(
+                [pad_rows(payload), pad_rows(h_pay)], axis=-1
+            )
+            valid = jnp.concatenate([pad_rows(valid), pad_rows(h_val)], axis=-1)
 
         active_row = active[None, :]
         signals = catl(lambda o: o.signals) * active_row.astype(jnp.int32)
@@ -358,6 +412,7 @@ class SimProgram:
             k_msg,
             slot_mode=type(self.tc).SLOT_MODE,
             features=tuple(type(self.tc).SHAPING),
+            control_start=self.n if self.hosts else None,
         )
         sync = update_sync(
             carry.sync, signals, pub_payload, pub_valid, sub_consume
@@ -385,6 +440,21 @@ class SimProgram:
             net_filters_valid = jnp.zeros((self.n,), bool)
         net_region = cat0(lambda o: o.region)
         net_region_valid = cat0(lambda o: o.region_valid) & active
+        if self.hosts:
+            # host lanes never reconfigure: pad the update planes with
+            # valid=False columns so shapes match the n_lanes link state
+            h = len(self.hosts)
+
+            def pad_cols(x, fill=0):
+                pad = jnp.full(x.shape[:-1] + (h,), fill, x.dtype)
+                return jnp.concatenate([x, pad], axis=-1)
+
+            net_shape = pad_cols(net_shape)
+            net_shape_valid = pad_cols(net_shape_valid, False)
+            net_filters = pad_cols(net_filters)
+            net_filters_valid = pad_cols(net_filters_valid, False)
+            net_region = pad_cols(net_region)
+            net_region_valid = pad_cols(net_region_valid, False)
         link = apply_net_updates(
             carry.link,
             net_shape,
@@ -416,12 +486,13 @@ class SimProgram:
         """Run up to `chunk` ticks; ticks after global completion no-op."""
 
         def body(c, _):
-            done = jnp.all(c.status != RUNNING)
+            # host lanes never terminate — only plan instances gate done
+            done = jnp.all(c.status[: self.n] != RUNNING)
             c = jax.lax.cond(done, lambda x: x, self._tick, c)
             return c, None
 
         carry, _ = jax.lax.scan(body, carry, None, length=self.chunk)
-        return carry, jnp.all(carry.status != RUNNING)
+        return carry, jnp.all(carry.status[: self.n] != RUNNING)
 
     def compiled_chunk(self):
         if self._chunk_fn is None:
@@ -466,8 +537,9 @@ class SimProgram:
 
     def results(self, carry: SimCarry, ticks: int) -> dict[str, Any]:
         return {
-            "status": np.asarray(carry.status),
-            "finished_at": np.asarray(carry.finished_at),
+            # host lanes are internal plumbing — plan instances only
+            "status": np.asarray(carry.status[: self.n]),
+            "finished_at": np.asarray(carry.finished_at[: self.n]),
             "ticks": ticks,
             "tick_ms": self.tick_ms,
             "states": jax.tree.map(np.asarray, carry.states),

@@ -49,6 +49,10 @@ class SimJaxConfig:
     # of the reference SDK's periodic InfluxDB metric batches; each sample is
     # a device→host state read, so the cadence bounds the overhead
     timeseries_every: int = 1024
+    # whitelisted control-route service hosts (echo lanes past the instance
+    # axis) — the ADDITIONAL_HOSTS analog (``local_docker.go:78``); plans
+    # address them via ``env.host_index(name)``
+    additional_hosts: list = dataclasses.field(default_factory=list)
 
 
 def load_sim_testcases(artifact_path: str) -> dict:
@@ -78,6 +82,17 @@ def load_sim_testcases(artifact_path: str) -> dict:
             "`sim_testcases` dict"
         )
     return cases
+
+
+def _parse_hosts(raw) -> tuple[str, ...]:
+    """Normalize the additional_hosts config: a TOML list, or a
+    comma-separated string like the reference's ADDITIONAL_HOSTS env var
+    (``local_docker.go:141``) — never char-split a bare string."""
+    if not raw:
+        return ()
+    if isinstance(raw, str):
+        raw = raw.split(",")
+    return tuple(s for s in (str(h).strip() for h in raw) if s)
 
 
 def _make_mesh(shard: bool):
@@ -121,6 +136,9 @@ def execute_sim_run(
         mesh.devices.size if mesh is not None else 1,
     )
 
+    hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
+    if hosts:
+        ow.infof("additional hosts: %s", ",".join(hosts))
     prog = SimProgram(
         testcase,
         groups,
@@ -130,6 +148,7 @@ def execute_sim_run(
         tick_ms=cfg.tick_ms,
         mesh=mesh,
         chunk=cfg.chunk,
+        hosts=hosts,
     )
 
     t0 = time.time()
@@ -148,12 +167,14 @@ def execute_sim_run(
             )
 
     outputs_root = job.env.dirs.outputs() if job.env is not None else None
-    # no outputs dir → nowhere to persist samples; disable so the hot loop
-    # never pays the per-sample device→host sync
+    # no outputs dir → nowhere to persist samples; disable_metrics is the
+    # composition's opt-out (the TEST_DISABLE_METRICS analog) — either way
+    # the hot loop must not pay the per-sample device→host sync
+    ts_enabled = outputs_root is not None and not job.disable_metrics
     recorder = _TimeSeriesRecorder(
         testcase,
         groups,
-        getattr(cfg, "timeseries_every", 0) if outputs_root else 0,
+        getattr(cfg, "timeseries_every", 0) if ts_enabled else 0,
         ow,
     )
     res = prog.run(

@@ -201,6 +201,7 @@ def enqueue(
     key: jax.Array,
     slot_mode: str = "sorted",
     features: tuple = FULL_SHAPING,
+    control_start: int | None = None,
 ) -> tuple[Calendar, jax.Array]:
     """Shape + schedule this tick's sends (inputs in plane layout, message
     m = o·N + src). Returns (cal', rejected[N]).
@@ -215,6 +216,12 @@ def enqueue(
 
     ``features`` — static set of LinkShape features compiled in
     (``SimTestcase.SHAPING``); undeclared features cost nothing.
+
+    ``control_start`` — lanes at indices ≥ this are control-route
+    endpoints (additional hosts): traffic to or from them bypasses
+    filters and every shaping feature and travels at the 1-tick floor,
+    the tensor analog of the sidecar's whitelisted control routes
+    (``docker_reactor.go:69-103`` — control traffic is never shaped).
     """
     horizon, ns = cal.valid.shape
     slots = cal.slots
@@ -276,13 +283,24 @@ def enqueue(
     dst_safe = jnp.clip(dst_f, 0, n - 1)
     val_f = val_f & (dst_f >= 0) & (dst_f < n)
 
+    # --- control routes: host-lane traffic is exempt from everything below
+    is_ctrl = (
+        (dst_safe >= control_start) | (src_f >= control_start)
+        if control_start is not None
+        else None
+    )
+
     # --- filters: Accept / Reject / Drop per (src, dst region)
     if "filters" in features:
         action = link.filters.reshape(-1)[
             link.region_of[dst_safe] * n + src_f
         ]
+        accept = action == FILTER_ACCEPT
         rejected_msg = val_f & (action == FILTER_REJECT)
-        val_f = val_f & (action == FILTER_ACCEPT)
+        if is_ctrl is not None:
+            accept = accept | is_ctrl
+            rejected_msg = rejected_msg & ~is_ctrl
+        val_f = val_f & accept
         rejected = jnp.sum(
             rejected_msg.reshape(o, n).astype(jnp.int32), axis=0
         )
@@ -297,11 +315,13 @@ def enqueue(
             jnp.float32(o),
             jnp.floor(bw * (tick_ms / 1000.0) / MSG_BYTES),
         )
-        val_f = val_f & (slot_in_src.astype(jnp.float32) < cap)
+        admit = slot_in_src.astype(jnp.float32) < cap
+        val_f = val_f & (admit | is_ctrl if is_ctrl is not None else admit)
 
     # --- loss
     if "loss" in features:
-        val_f = val_f & (u("loss") * 100.0 >= eg(LOSS))
+        keep = u("loss") * 100.0 >= eg(LOSS)
+        val_f = val_f & (keep | is_ctrl if is_ctrl is not None else keep)
 
     # --- corrupt: flip one random bit of payload word 0 (the decision
     # uses the hash's high bits, the bit index its low byte)
@@ -310,6 +330,8 @@ def enqueue(
         corrupt = shr(hc, 8).astype(jnp.float32) * jnp.float32(
             2**-24
         ) * 100.0 < eg(CORRUPT)
+        if is_ctrl is not None:
+            corrupt = corrupt & ~is_ctrl
         bit = jnp.mod(hc & 0xFF, 31)
         pay_w[0] = jnp.where(
             corrupt, pay_w[0] ^ (jnp.int32(1) << bit), pay_w[0]
@@ -324,6 +346,8 @@ def enqueue(
     if "reorder" in features:
         reorder = u("reorder") * 100.0 < eg(REORDER)
         delay = jnp.where(reorder, 1, delay)
+    if is_ctrl is not None:  # control routes ride at the 1-tick floor
+        delay = jnp.where(is_ctrl, 1, delay)
 
     if slot_mode == "direct":
         # slot = the sender's outbox index: one scatter index per message
@@ -359,6 +383,8 @@ def enqueue(
     # --- duplicate: second copy, one tick later
     if "duplicate" in features:
         dup = val_f & (u("duplicate") * 100.0 < eg(DUPLICATE))
+        if is_ctrl is not None:
+            dup = dup & ~is_ctrl
         dst2 = jnp.concatenate([dst_safe, dst_safe])
         pay2 = [jnp.concatenate([p, p]) for p in pay_w]
         src2 = jnp.concatenate([src_f, src_f])

@@ -1,0 +1,107 @@
+"""additional_hosts plan + control-route lane tests (sim twin of
+/root/reference/plans/additional_hosts — whitelisted control routes)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from testground_tpu.sim.api import SUCCESS
+from testground_tpu.sim.engine import SimProgram
+
+from test_sim_engine import make_groups, mesh8, plan_case
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def run_case(case, n, hosts=("http-echo",), mesh=None, max_ticks=256):
+    prog = SimProgram(
+        plan_case("additional_hosts", case),
+        make_groups(n),
+        test_plan="additional_hosts",
+        test_case=case,
+        mesh=mesh,
+        chunk=16,
+        hosts=hosts,
+    )
+    return prog.run(max_ticks=max_ticks)
+
+
+class TestAdditionalHosts:
+    def test_echo_roundtrip(self):
+        res = run_case("additional_hosts", 8)
+        assert (res["status"] == SUCCESS).all()
+        # staggered sends over ⌈n/2⌉ ticks + the 1-tick control floor
+        # each way: the last request (t=5) echoes back by t=8
+        assert int(np.asarray(res["finished_at"]).max()) <= 8
+
+    def test_drop_all_still_reaches_host(self):
+        """The control-route property: a BLACKHOLE over the whole data
+        plane must not cut off whitelisted hosts."""
+        res = run_case("additional_hosts_drop", 8)
+        assert (res["status"] == SUCCESS).all()
+
+    def test_missing_host_raises(self):
+        with pytest.raises(KeyError, match="http-echo"):
+            run_case("additional_hosts", 2, hosts=())
+
+    def test_sharded_equals_single(self):
+        res_s = run_case("additional_hosts", 16)
+        res_m = run_case("additional_hosts", 16, mesh=mesh8())
+        assert (res_s["status"] == res_m["status"]).all()
+        np.testing.assert_array_equal(
+            res_s["finished_at"], res_m["finished_at"]
+        )
+
+    def test_string_config_is_comma_split_not_char_split(self):
+        """additional_hosts = \"http-echo\" in TOML run_config must become
+        one host, not four phantom single-char lanes."""
+        from testground_tpu.sim.executor import _parse_hosts
+
+        assert _parse_hosts("http-echo, other") == ("http-echo", "other")
+        assert _parse_hosts("http-echo") == ("http-echo",)
+        assert _parse_hosts(["a", "b"]) == ("a", "b")
+        assert _parse_hosts(None) == ()
+        assert _parse_hosts("") == ()
+
+    def test_plans_without_hosts_unchanged(self):
+        """hosts=() leaves every shape exactly as before (zero-cost when
+        unused)."""
+        prog = SimProgram(
+            plan_case("placebo", "ok"), make_groups(4), chunk=8
+        )
+        assert prog.n_lanes == prog.n == 4
+        res = prog.run(max_ticks=32)
+        assert (res["status"] == SUCCESS).all()
+
+
+class TestEngineEndToEnd:
+    def test_manifest_runner_config_flows_hosts(self, tg_home):
+        """The manifest's [runners."sim:jax"] additional_hosts entry must
+        reach the executor through run-config coalescing — the e2e path a
+        user actually exercises."""
+        from tests.test_sim_runner import run_sim
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.engine import Engine, EngineConfig, Outcome
+        from testground_tpu.sim.runner import SimJaxRunner
+
+        e = Engine(
+            EngineConfig(
+                env=EnvConfig.load(),
+                builders=[SimPlanBuilder()],
+                runners=[SimJaxRunner()],
+            )
+        )
+        e.start_workers()
+        try:
+            t = run_sim(e, "additional_hosts", "additional_hosts", instances=4)
+            assert t.outcome() == Outcome.SUCCESS
+            t2 = run_sim(
+                e, "additional_hosts", "additional_hosts_drop", instances=4
+            )
+            assert t2.outcome() == Outcome.SUCCESS
+        finally:
+            e.stop()

@@ -101,6 +101,23 @@ class TestViewer:
         assert len(v.get_data("p", "c", "m", run_id="t1")) == 2
         assert len(v.get_data("p", "c", "m", run_id="t2")) == 1
 
+    def test_malformed_field_rows_are_skipped(self, tg_home):
+        """The jsonl is an open format: rows whose fields aren't numeric
+        must not reach consumers (e.g. raw HTML injection via count)."""
+        env = EnvConfig.load()
+        base = {"run": "r1", "plan": "p", "case": "c", "tick": 1,
+                "group_id": "all", "name": "m", "mean": 1.0, "min": 1.0,
+                "max": 1.0}
+        _write_ts(
+            env, "p", "r1",
+            [
+                {**base, "count": "<img src=x onerror=alert(1)>"},
+                {**base, "count": 3},
+            ],
+        )
+        rows = Viewer(env).get_data("p", "c", "m")
+        assert len(rows) == 1 and rows[0].fields["count"] == 3
+
     def test_dotted_metric_names_survive(self, tg_home):
         env = EnvConfig.load()
         _write_ts(
