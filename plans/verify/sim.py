@@ -62,7 +62,10 @@ class UsesDataNetwork(SimTestcase):
     MAX_LINK_TICKS = 4
     SHAPING = ("latency", "filters")
     DROP_ALL = False  # the -drop testcase flips this
-    DRAIN_TICKS = 4  # in-flight pongs settle before the loss verdict
+    # in-flight pongs settle before the loss verdict: a full round trip is
+    # at most 2·(MAX_LINK_TICKS-1) hops (per-hop delay clamps to the
+    # horizon), +2 for the target's processing tick and the verdict tick
+    DRAIN_TICKS = 2 * (MAX_LINK_TICKS - 1) + 2
 
     def init(self, env):
         return {

@@ -67,10 +67,14 @@ class EnvConfig:
     task_repo_explicit: bool = False
 
     @classmethod
-    def load(cls, home: str | None = None) -> "EnvConfig":
+    def load(
+        cls, home: str | None = None, ensure_dirs: bool = True
+    ) -> "EnvConfig":
         """Resolve the home dir, read ``.env.toml`` when present, apply
         defaults, and ensure the directory layout exists
-        (``pkg/config/loader.go:32-110``)."""
+        (``pkg/config/loader.go:32-110``). ``ensure_dirs=False`` skips the
+        layout creation — for healthchecks, which must observe the
+        environment rather than repair it as a side effect."""
         e = cls()
         if home is None:
             home = os.environ.get(ENV_TESTGROUND_HOME) or os.path.join(
@@ -89,8 +93,9 @@ class EnvConfig:
                 ) from err
 
         e._ensure_minimal()
-        for d in e.dirs.all():
-            os.makedirs(d, exist_ok=True)
+        if ensure_dirs:
+            for d in e.dirs.all():
+                os.makedirs(d, exist_ok=True)
         return e
 
     def _apply_toml(self, d: dict) -> None:

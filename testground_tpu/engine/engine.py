@@ -291,7 +291,7 @@ class Engine:
             raise ValueError(f"unknown runner: {runner_id}")
         if not isinstance(runner, HealthcheckedRunner):
             raise ValueError(f"runner {runner_id} does not support healthchecks")
-        return runner.healthcheck(fix, ow)
+        return runner.healthcheck(fix, ow, env=self.env)
 
     def do_build_purge(self, builder_id: str, testplan: str, ow) -> None:
         builder = self.builder_by_name(builder_id)

@@ -211,7 +211,7 @@ def do_run(
     from testground_tpu.runners.base import HealthcheckedRunner
 
     if isinstance(runner, HealthcheckedRunner):
-        report = runner.healthcheck(fix=True, ow=ow)
+        report = runner.healthcheck(fix=True, ow=ow, env=engine.env)
         if report is not None and not report.ok():
             raise RuntimeError(f"runner {runner_id} failed healthcheck: {report}")
 

@@ -10,6 +10,7 @@ import os
 import shutil
 import socket
 import subprocess
+import uuid
 from typing import Callable
 
 Checker = Callable[[], tuple[bool, str]]
@@ -20,8 +21,10 @@ __all__ = [
     "check_command_status",
     "check_dialable",
     "check_dir_exists",
+    "check_dir_writable",
     "check_file_exists",
     "check_executable_on_path",
+    "check_port_bindable",
     "not_",
 ]
 
@@ -33,6 +36,45 @@ def check_dir_exists(path: str) -> Checker:
         if os.path.isdir(path):
             return True, f"directory exists: {path}"
         return False, f"directory missing: {path}"
+
+    return check
+
+
+def check_dir_writable(path: str) -> Checker:
+    """Directory exists AND a file can actually be created in it (catches
+    read-only mounts and permission problems, not just absence)."""
+
+    def check() -> tuple[bool, str]:
+        if not os.path.isdir(path):
+            return False, f"directory missing: {path}"
+        # unique probe name: concurrent healthchecks (one per scheduler
+        # worker) must not race on the same file
+        probe = os.path.join(
+            path, f".tg-healthcheck-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        )
+        try:
+            with open(probe, "w") as f:
+                f.write("ok")
+            os.unlink(probe)
+        except OSError as e:
+            return False, f"directory not writable: {path}: {e}"
+        return True, f"directory writable: {path}"
+
+    return check
+
+
+def check_port_bindable(host: str = "127.0.0.1", port: int = 0) -> Checker:
+    """An ephemeral (or specific) TCP port can be bound — the runner's
+    in-process sync service needs one per run."""
+
+    def check() -> tuple[bool, str]:
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+                s.bind((host, port))
+                bound = s.getsockname()[1]
+            return True, f"bound {host}:{bound}"
+        except OSError as e:
+            return False, f"cannot bind {host}:{port}: {e}"
 
     return check
 

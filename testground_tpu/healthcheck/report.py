@@ -33,10 +33,6 @@ class Report:
             f.status in (OK, OMITTED) for f in self.fixes
         )
 
-    @classmethod
-    def all_ok(cls, names: list[str]) -> "Report":
-        return cls(checks=[CheckResult(name=n, status=OK) for n in names])
-
     def to_dict(self) -> dict:
         return {
             "checks": [c.to_dict() for c in self.checks],

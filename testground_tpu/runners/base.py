@@ -60,5 +60,7 @@ class HealthcheckedRunner(abc.ABC):
     """Optional runner capability (``pkg/api/engine.go`` Healthchecker)."""
 
     @abc.abstractmethod
-    def healthcheck(self, fix: bool, ow: OutputWriter):
-        """Returns a healthcheck report (``pkg/api/healthcheck.go:17-56``)."""
+    def healthcheck(self, fix: bool, ow: OutputWriter, env=None):
+        """Returns a healthcheck report (``pkg/api/healthcheck.go:17-56``).
+        ``env`` is the engine's EnvConfig — checks must validate the home
+        the runs will actually use, not re-resolve $TESTGROUND_HOME."""

@@ -53,12 +53,40 @@ class LocalExecRunner(Runner, HealthcheckedRunner):
     def config_type(self) -> type:
         return LocalExecConfig
 
-    def healthcheck(self, fix: bool, ow: OutputWriter):
-        """The only infra is in-process (sync service per run); always
-        healthy. Mirrors the check/fix report shape."""
-        from testground_tpu.healthcheck.report import Report
+    def healthcheck(self, fix: bool, ow: OutputWriter, env=None):
+        """Real environment checks with fixers — the analog of the
+        reference's infra healthcheck (``local_exec.go:49-72``), minus the
+        external containers: this runner's infra is the directory layout,
+        a bindable port for the per-run sync service, and a working
+        python to exec instances with."""
+        import sys
 
-        return Report.all_ok(["local-outputs-dir", "sync-service(in-process)"])
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.healthcheck import Helper, checkers, fixers
+
+        if env is None:  # observe the environment, don't repair it
+            env = EnvConfig.load(ensure_dirs=False)
+        h = Helper()
+        for name, d in (
+            ("outputs-dir-writable", env.dirs.outputs()),
+            ("work-dir-writable", env.dirs.work()),
+        ):
+            h.enlist(
+                name,
+                checkers.check_dir_writable(d),
+                fixers.create_directory(d),
+            )
+        h.enlist(
+            "sync-service-port-bindable",
+            checkers.check_port_bindable("127.0.0.1"),
+            fixers.requires_manual_fixing("free local TCP ports / ulimit"),
+        )
+        h.enlist(
+            "python-interpreter-runs",
+            checkers.check_command_status(sys.executable, "-c", "pass"),
+            fixers.requires_manual_fixing("reinstall the python runtime"),
+        )
+        return h.run_checks(fix, ow)
 
     # ------------------------------------------------------------------ run
 

@@ -7,6 +7,7 @@ program hosts every instance (BASELINE.md targets 100k instances on a v4-8).
 
 from __future__ import annotations
 
+import functools
 import threading
 
 from testground_tpu.api import RunInput, RunOutput
@@ -15,6 +16,25 @@ from testground_tpu.rpc import OutputWriter
 from testground_tpu.runners.base import HealthcheckedRunner, Runner
 
 __all__ = ["SimJaxRunner"]
+
+
+@functools.lru_cache(maxsize=4)
+def _mesh_check(devs_key: tuple) -> tuple[bool, str]:
+    """Compile + execute a tiny sharded program over every device, once
+    per device set per process (the supervisor healthchecks every run)."""
+    import jax
+    import numpy as np
+
+    devs = jax.devices()
+    mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("i")
+    )
+    x = jax.device_put(np.arange(8 * len(devs), dtype=np.int32), sharding)
+    y = np.asarray(jax.jit(lambda a: a + 1)(x))
+    if int(y.sum()) != int(np.arange(8 * len(devs)).sum()) + y.size:
+        return False, "mesh program computed a wrong result"
+    return True, f"{len(devs)}-device mesh compiled and executed"
 
 
 class SimJaxRunner(Runner, HealthcheckedRunner):
@@ -29,16 +49,80 @@ class SimJaxRunner(Runner, HealthcheckedRunner):
 
         return SimJaxConfig
 
-    def healthcheck(self, fix: bool, ow: OutputWriter):
-        from testground_tpu.healthcheck.report import Report
+    def healthcheck(self, fix: bool, ow: OutputWriter, env=None):
+        """Real device checks: jax imports, at least one device answers, a
+        mesh over every device compiles and executes a program, and device
+        memory is not exhausted (the sim:jax analog of the reference's
+        infra healthcheck booting redis/sidecar containers,
+        ``local_common.go:18-122``) — plus the outputs dir with a mkdir
+        fixer."""
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.healthcheck import Helper, checkers, fixers
 
-        try:
+        def jax_importable():
             import jax  # noqa: F401
-        except ImportError:
-            from testground_tpu.healthcheck.report import CheckResult, FAILED
 
-            return Report(checks=[CheckResult("jax-importable", FAILED)])
-        return Report.all_ok(["jax-importable"])
+            return True, f"jax {jax.__version__}"
+
+        def device_available():
+            import jax
+
+            devs = jax.devices()
+            if not devs:
+                return False, "no devices"
+            return True, f"{len(devs)} device(s): {devs[0].platform}"
+
+        def mesh_buildable():
+            import jax
+
+            devs = jax.devices()
+            if not devs:
+                return False, "no devices to build a mesh from"
+            # cached per device set: the supervisor healthchecks before
+            # every run and must not re-trace/compile each time
+            return _mesh_check(tuple(str(d) for d in devs))
+
+        def device_memory():
+            import jax
+
+            devs = jax.devices()
+            if not devs:
+                return False, "no devices"
+            stats = getattr(devs[0], "memory_stats", lambda: None)() or {}
+            limit = stats.get("bytes_limit")
+            in_use = stats.get("bytes_in_use")
+            if not limit:
+                return True, "memory stats unavailable on this backend"
+            frac = in_use / limit
+            if frac > 0.95:
+                return False, (
+                    f"device memory nearly exhausted: "
+                    f"{in_use}/{limit} bytes in use"
+                )
+            return True, f"{in_use}/{limit} bytes in use ({frac:.0%})"
+
+        env = EnvConfig.load(ensure_dirs=False)  # observe, don't repair
+        h = Helper()
+        h.enlist(
+            "jax-importable",
+            jax_importable,
+            fixers.requires_manual_fixing("install jax"),
+        )
+        h.enlist(
+            "device-available",
+            device_available,
+            fixers.requires_manual_fixing(
+                "check JAX_PLATFORMS / device tunnel"
+            ),
+        )
+        h.enlist("mesh-buildable", mesh_buildable)
+        h.enlist("device-memory", device_memory)
+        h.enlist(
+            "outputs-dir-writable",
+            checkers.check_dir_writable(env.dirs.outputs()),
+            fixers.create_directory(env.dirs.outputs()),
+        )
+        return h.run_checks(fix, ow)
 
     def run(
         self, job: RunInput, ow: OutputWriter, cancel: threading.Event

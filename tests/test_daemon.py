@@ -89,7 +89,10 @@ class TestDaemonEndToEnd:
     def test_healthcheck_and_kill(self, client):
         client.import_plan(os.path.join(PLANS, "placebo"))
         report, _ = client.healthcheck("local:exec", fix=True)
-        assert report.checks  # real checks enlisted, not an empty stub
+        names = {c.name for c in report.checks}
+        assert "outputs-dir-writable" in names
+        assert "sync-service-port-bindable" in names
+        assert report.ok()
         # kill an un-poppable task id → killed=False
         assert client.kill("nonexistent") is False
 

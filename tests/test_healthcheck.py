@@ -1,0 +1,87 @@
+"""Runner healthchecks: real per-check results that can actually fail
+(reference: ``pkg/healthcheck`` + runner-side enlistment,
+``local_exec.go:49-72``)."""
+
+import os
+import shutil
+
+from testground_tpu.config import EnvConfig
+from testground_tpu.rpc import discard_writer
+from testground_tpu.runners.local_exec import LocalExecRunner
+from testground_tpu.sim.runner import SimJaxRunner
+
+
+class TestLocalExecHealthcheck:
+    def test_healthy_env_all_ok(self, tg_home):
+        EnvConfig.load()  # creates the directory layout
+        report = LocalExecRunner().healthcheck(False, discard_writer())
+        assert {c.name for c in report.checks} == {
+            "outputs-dir-writable",
+            "work-dir-writable",
+            "sync-service-port-bindable",
+            "python-interpreter-runs",
+        }
+        assert report.ok(), str(report)
+
+    def test_missing_dir_fails_then_fixer_repairs(self, tg_home):
+        env = EnvConfig.load()
+        shutil.rmtree(env.dirs.outputs())
+        runner = LocalExecRunner()
+
+        report = runner.healthcheck(False, discard_writer())
+        by_name = {c.name: c for c in report.checks}
+        assert by_name["outputs-dir-writable"].status == "failed"
+        assert not report.ok()
+
+        # fix=True runs the mkdir fixer and re-checks
+        report = runner.healthcheck(True, discard_writer())
+        by_name = {c.name: c for c in report.checks}
+        assert by_name["outputs-dir-writable"].status == "ok"
+        assert os.path.isdir(env.dirs.outputs())
+
+    def test_unfixable_check_reports_failure(self, tg_home):
+        """A file squatting on the outputs path defeats the mkdir fixer —
+        the report must surface the failure, not paper over it."""
+        env = EnvConfig.load()
+        shutil.rmtree(env.dirs.outputs())
+        with open(env.dirs.outputs(), "w") as f:
+            f.write("squatter")
+        try:
+            report = LocalExecRunner().healthcheck(True, discard_writer())
+            by_name = {c.name: c for c in report.checks}
+            assert by_name["outputs-dir-writable"].status == "failed"
+            fixes = {f.name: f for f in report.fixes}
+            assert fixes["outputs-dir-writable"].status == "failed"
+            assert not report.ok()
+        finally:
+            os.unlink(env.dirs.outputs())
+
+
+class TestEnvThreading:
+    def test_engine_env_wins_over_environ(self, tmp_path, monkeypatch):
+        """An explicitly-constructed env must be what gets checked, not a
+        re-resolve of $TESTGROUND_HOME (the engine passes its own env)."""
+        monkeypatch.setenv("TESTGROUND_HOME", str(tmp_path / "env-home"))
+        custom = tmp_path / "custom-home"
+        env = EnvConfig.load(home=str(custom))
+        report = LocalExecRunner().healthcheck(False, discard_writer(), env=env)
+        msgs = " ".join(c.message for c in report.checks)
+        assert str(custom) in msgs
+        assert str(tmp_path / "env-home") not in msgs
+
+
+class TestSimJaxHealthcheck:
+    def test_device_checks_pass_on_cpu_mesh(self, tg_home):
+        EnvConfig.load()
+        report = SimJaxRunner().healthcheck(False, discard_writer())
+        by_name = {c.name: c for c in report.checks}
+        assert set(by_name) == {
+            "jax-importable",
+            "device-available",
+            "mesh-buildable",
+            "device-memory",
+            "outputs-dir-writable",
+        }
+        assert report.ok(), str(report)
+        # the mesh check really ran a program over every device
+        assert "mesh compiled and executed" in by_name["mesh-buildable"].message
