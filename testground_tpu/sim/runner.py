@@ -7,7 +7,6 @@ program hosts every instance (BASELINE.md targets 100k instances on a v4-8).
 
 from __future__ import annotations
 
-import functools
 import threading
 
 from testground_tpu.api import RunInput, RunOutput
@@ -18,10 +17,15 @@ from testground_tpu.runners.base import HealthcheckedRunner, Runner
 __all__ = ["SimJaxRunner"]
 
 
-@functools.lru_cache(maxsize=4)
+_mesh_check_ok: dict[tuple, str] = {}
+
+
 def _mesh_check(devs_key: tuple) -> tuple[bool, str]:
-    """Compile + execute a tiny sharded program over every device, once
-    per device set per process (the supervisor healthchecks every run)."""
+    """Compile + execute a tiny sharded program over every device. Only
+    SUCCESS is cached per device set (the supervisor healthchecks every
+    run, but a transient failure must not poison the process)."""
+    if devs_key in _mesh_check_ok:
+        return True, _mesh_check_ok[devs_key]
     import jax
     import numpy as np
 
@@ -34,7 +38,9 @@ def _mesh_check(devs_key: tuple) -> tuple[bool, str]:
     y = np.asarray(jax.jit(lambda a: a + 1)(x))
     if int(y.sum()) != int(np.arange(8 * len(devs)).sum()) + y.size:
         return False, "mesh program computed a wrong result"
-    return True, f"{len(devs)}-device mesh compiled and executed"
+    msg = f"{len(devs)}-device mesh compiled and executed"
+    _mesh_check_ok[devs_key] = msg
+    return True, msg
 
 
 class SimJaxRunner(Runner, HealthcheckedRunner):
@@ -91,7 +97,7 @@ class SimJaxRunner(Runner, HealthcheckedRunner):
             stats = getattr(devs[0], "memory_stats", lambda: None)() or {}
             limit = stats.get("bytes_limit")
             in_use = stats.get("bytes_in_use")
-            if not limit:
+            if not limit or in_use is None:
                 return True, "memory stats unavailable on this backend"
             frac = in_use / limit
             if frac > 0.95:
@@ -101,7 +107,8 @@ class SimJaxRunner(Runner, HealthcheckedRunner):
                 )
             return True, f"{in_use}/{limit} bytes in use ({frac:.0%})"
 
-        env = EnvConfig.load(ensure_dirs=False)  # observe, don't repair
+        if env is None:  # observe the environment, don't repair it
+            env = EnvConfig.load(ensure_dirs=False)
         h = Helper()
         h.enlist(
             "jax-importable",
