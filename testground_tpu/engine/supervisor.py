@@ -66,6 +66,10 @@ def process_task(engine: Engine, tsk: Task) -> None:
             ow = OutputWriter(sink=log_file)
             try:
                 engine.storage.update_current(tsk)
+                # pending commit status for CI tasks (supervisor.go:213-215)
+                from .notify import notify_task_started
+
+                notify_task_started(engine.env, tsk)
                 if tsk.type == TaskType.RUN:
                     result = do_run(engine, tsk, ow, cancel)
                 elif tsk.type == TaskType.BUILD:
@@ -93,6 +97,11 @@ def process_task(engine: Engine, tsk: Task) -> None:
         final = State.CANCELED if cancel.is_set() and tsk.error else State.COMPLETE
         tsk.states.append(DatedState(state=final, created=time.time()))
         engine.storage.archive(tsk)
+        # status webhooks: log-and-continue, never affect the task
+        # (supervisor.go:176-183)
+        from .notify import notify_task_finished
+
+        notify_task_finished(engine.env, tsk)
         S().info("task %s finished: %s", tsk.id, tsk.outcome().value)
 
 

@@ -1,0 +1,151 @@
+"""Task-status webhook tests against a mocked HTTP endpoint
+(reference: ``pkg/engine/supervisor.go:192-296``)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from testground_tpu.config import EnvConfig
+from testground_tpu.engine.notify import (
+    notify_task_finished,
+    post_status_to_github,
+    post_status_to_slack,
+)
+from testground_tpu.engine.task import (
+    CreatedBy,
+    DatedState,
+    Outcome,
+    State,
+    Task,
+    TaskType,
+)
+
+
+@pytest.fixture()
+def sink():
+    """A local HTTP server recording every (path, headers, body) POST."""
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.append(
+                (
+                    self.path,
+                    dict(self.headers),
+                    json.loads(self.rfile.read(n) or b"{}"),
+                )
+            )
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", received
+    httpd.shutdown()
+
+
+def make_task(outcome=Outcome.SUCCESS, ci=True, error=""):
+    now = time.time()
+    return Task(
+        id="tsk123",
+        type=TaskType.RUN,
+        plan="network",
+        case="ping-pong",
+        states=[
+            DatedState(state=State.SCHEDULED, created=now - 5),
+            DatedState(state=State.PROCESSING, created=now - 4),
+            DatedState(state=State.COMPLETE, created=now),
+        ],
+        result={"outcome": outcome.value},
+        error=error,
+        created_by=CreatedBy(
+            user="ci",
+            repo="example/proj" if ci else "",
+            branch="main" if ci else "",
+            commit="abc123" if ci else "",
+        ),
+    )
+
+
+class TestSlack:
+    def test_success_posts_text(self, tg_home, sink):
+        url, received = sink
+        env = EnvConfig.load()
+        env.daemon.slack_webhook_url = url
+        post_status_to_slack(env, make_task())
+        assert len(received) == 1
+        text = received[0][2]["text"]
+        assert "succeeded" in text and "network:ping-pong" in text
+        assert "tsk123" in text
+
+    def test_failure_includes_error(self, tg_home, sink):
+        url, received = sink
+        env = EnvConfig.load()
+        env.daemon.slack_webhook_url = url
+        post_status_to_slack(
+            env, make_task(outcome=Outcome.FAILURE, error="boom")
+        )
+        assert "failed" in received[0][2]["text"]
+        assert "boom" in received[0][2]["text"]
+
+    def test_unconfigured_is_noop(self, tg_home, sink):
+        _, received = sink
+        env = EnvConfig.load()
+        post_status_to_slack(env, make_task())
+        assert received == []
+
+
+class TestGithub:
+    def test_commit_status_posted(self, tg_home, sink):
+        url, received = sink
+        env = EnvConfig.load()
+        env.daemon.github_repo_status_token = "tok"
+        env.daemon.root_url = "https://tg.example"
+        post_status_to_github(env, make_task(), api_base=url)
+        path, headers, body = received[0]
+        assert path == "/repos/example/proj/statuses/abc123"
+        assert headers["Authorization"] == "Basic tok"
+        assert body["state"] == "success"
+        assert body["context"] == "testground/network/ping-pong"
+        assert body["target_url"].startswith("https://tg.example/dashboard")
+
+    def test_failure_state(self, tg_home, sink):
+        url, received = sink
+        env = EnvConfig.load()
+        env.daemon.github_repo_status_token = "tok"
+        post_status_to_github(
+            env, make_task(outcome=Outcome.FAILURE), api_base=url
+        )
+        assert received[0][2]["state"] == "failure"
+
+    def test_pending_status_while_processing(self, tg_home, sink):
+        url, received = sink
+        env = EnvConfig.load()
+        env.daemon.github_repo_status_token = "tok"
+        t = make_task()
+        t.states = t.states[:2]  # last state: PROCESSING
+        post_status_to_github(env, t, api_base=url)
+        assert received[0][2]["state"] == "pending"
+
+    def test_non_ci_task_is_skipped(self, tg_home, sink):
+        url, received = sink
+        env = EnvConfig.load()
+        env.daemon.github_repo_status_token = "tok"
+        post_status_to_github(env, make_task(ci=False), api_base=url)
+        assert received == []
+
+
+class TestNotifyNeverRaises:
+    def test_unreachable_endpoint_is_swallowed(self, tg_home):
+        env = EnvConfig.load()
+        env.daemon.slack_webhook_url = "http://127.0.0.1:1/nope"
+        notify_task_finished(env, make_task())  # must not raise
