@@ -62,7 +62,7 @@ def post_status_to_slack(env: EnvConfig, tsk: Task) -> None:
     if outcome == Outcome.SUCCESS:
         text = f"✅ {link} *{tsk.name()}* run succeeded ({took})"
     elif outcome == Outcome.CANCELED:
-        text = f"⚪ {link} *{tsk.name()}* run canceled {took} ; {tsk.error}"
+        text = f"⚪ {link} *{tsk.name()}* run canceled ({took}) ; {tsk.error}"
     elif outcome == Outcome.FAILURE:
         text = f"❌ {link} *{tsk.name()}* run failed ({took}) ; {tsk.error}"
     else:

@@ -29,6 +29,7 @@ from testground_tpu.logging_ import S
 from testground_tpu.rpc import OutputWriter
 
 from .engine import Engine
+from .notify import notify_task_finished, notify_task_started
 from .queue import QueueEmptyError
 from .task import DatedState, Outcome, State, Task, TaskType
 
@@ -67,8 +68,6 @@ def process_task(engine: Engine, tsk: Task) -> None:
             try:
                 engine.storage.update_current(tsk)
                 # pending commit status for CI tasks (supervisor.go:213-215)
-                from .notify import notify_task_started
-
                 notify_task_started(engine.env, tsk)
                 if tsk.type == TaskType.RUN:
                     result = do_run(engine, tsk, ow, cancel)
@@ -99,8 +98,6 @@ def process_task(engine: Engine, tsk: Task) -> None:
         engine.storage.archive(tsk)
         # status webhooks: log-and-continue, never affect the task
         # (supervisor.go:176-183)
-        from .notify import notify_task_finished
-
         notify_task_finished(engine.env, tsk)
         S().info("task %s finished: %s", tsk.id, tsk.outcome().value)
 

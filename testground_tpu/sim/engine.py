@@ -173,7 +173,9 @@ class SimProgram:
                 src=wsc(carry.cal.src, self._ishard(1))
                 if carry.cal.src is not None
                 else None,
-                valid=wsc(carry.cal.valid, self._ishard(1)),
+                valid=wsc(carry.cal.valid, self._ishard(1))
+                if carry.cal.valid is not None
+                else None,
                 occ=wsc(carry.cal.occ, self._ishard(1)),
                 slots=carry.cal.slots,
             ),

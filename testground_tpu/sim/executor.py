@@ -177,13 +177,34 @@ def execute_sim_run(
         getattr(cfg, "timeseries_every", 0) if ts_enabled else 0,
         ow,
     )
-    res = prog.run(
-        seed=cfg.seed,
-        max_ticks=cfg.max_ticks,
-        cancel=cancel,
-        on_chunk=on_chunk,
-        observer=recorder.observe if recorder.enabled else None,
-    )
+    # Profile capture — the pprof analog (``pkg/api/composition.go:153-162``
+    # → TestCaptureProfiles): any group requesting profiles makes the run
+    # record a jax.profiler trace (XLA ops + host timeline, viewable in
+    # TensorBoard/Perfetto) into the run's outputs dir.
+    profile_dir = None
+    if outputs_root is not None and any(g.profiles for g in job.groups):
+        profile_dir = os.path.join(
+            outputs_root, job.test_plan, job.run_id, "profiles"
+        )
+        os.makedirs(profile_dir, exist_ok=True)
+        ow.infof("capturing jax.profiler trace to %s", profile_dir)
+
+    def _run():
+        return prog.run(
+            seed=cfg.seed,
+            max_ticks=cfg.max_ticks,
+            cancel=cancel,
+            on_chunk=on_chunk,
+            observer=recorder.observe if recorder.enabled else None,
+        )
+
+    if profile_dir is not None:
+        import jax
+
+        with jax.profiler.trace(profile_dir):
+            res = _run()
+    else:
+        res = _run()
     wall = time.time() - t0
     status = res["status"]
     ow.infof(

@@ -102,8 +102,12 @@ class Calendar:
     """The in-flight message store, bucketed by arrival tick mod L.
 
     payload: tuple of W planes, each [L, N·SLOTS] int32
-    src:     [L, N·SLOTS] int32
-    valid:   [L, N·SLOTS] bool
+    src:     [L, N·SLOTS] int32 — sender index **+1**, 0 = empty slot
+             (None when the plan opted out via TRACK_SRC=False)
+    valid:   [L, N·SLOTS] bool — only materialized when ``src`` is None;
+             with provenance on, validity is ``src != 0``, which saves a
+             whole plane scatter per tick (~18% of the sustained full
+             path at 100k instances)
     occ:     [L, N] int32 — slots already filled per (bucket, dst), so
              messages enqueued on LATER ticks into the same bucket stack
              into the next free slots instead of overwriting (a TCP accept
@@ -114,8 +118,8 @@ class Calendar:
     """
 
     payload: tuple
-    src: jax.Array | None  # None when the plan opted out (TRACK_SRC=False)
-    valid: jax.Array
+    src: jax.Array | None
+    valid: jax.Array | None
     occ: jax.Array
     slots: int = dataclasses.field(metadata=dict(static=True), default=4)
 
@@ -129,7 +133,7 @@ class Calendar:
                 jnp.zeros((horizon, ns), jnp.int32) for _ in range(width)
             ),
             src=jnp.zeros((horizon, ns), jnp.int32) if track_src else None,
-            valid=jnp.zeros((horizon, ns), bool),
+            valid=None if track_src else jnp.zeros((horizon, ns), bool),
             occ=jnp.zeros((horizon, n), jnp.int32),
             slots=slots,
         )
@@ -137,6 +141,11 @@ class Calendar:
     @property
     def width(self) -> int:
         return len(self.payload)
+
+    @property
+    def occupancy_plane(self) -> jax.Array:
+        """The plane that marks filled slots: src (≠0) or valid (True)."""
+        return self.src if self.src is not None else self.valid
 
 
 def make_link_state(
@@ -157,9 +166,11 @@ def make_link_state(
 
 def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
     """Pop the bucket arriving at tick ``t`` → inboxes in plane layout
-    (payload [W, SLOTS, N], src/valid [SLOTS, N]); the bucket's valid row
-    is cleared for reuse at t+L (stale payload/src stay, masked)."""
-    horizon, ns = cal.valid.shape
+    (payload [W, SLOTS, N], src/valid [SLOTS, N]); the bucket's occupancy
+    row is cleared for reuse at t+L (stale payloads stay, masked). With
+    provenance on, the src plane doubles as occupancy (src+1, 0 = empty);
+    invalid inbox slots then read src = -1."""
+    horizon, ns = cal.occupancy_plane.shape
     slots = cal.slots
     n = ns // slots
     b = jnp.mod(t, horizon)
@@ -167,12 +178,25 @@ def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
         jax.lax.dynamic_index_in_dim(p, b, axis=0, keepdims=False)
         for p in cal.payload
     ]
-    row_s = (
-        jax.lax.dynamic_index_in_dim(cal.src, b, axis=0, keepdims=False)
-        if cal.src is not None
-        else jnp.zeros((ns,), jnp.int32)
-    )
-    row_v = jax.lax.dynamic_index_in_dim(cal.valid, b, axis=0, keepdims=False)
+    if cal.src is not None:
+        row_s1 = jax.lax.dynamic_index_in_dim(
+            cal.src, b, axis=0, keepdims=False
+        )
+        row_v = row_s1 != 0
+        row_s = row_s1 - 1
+        new_src = jax.lax.dynamic_update_index_in_dim(
+            cal.src, jnp.zeros((ns,), jnp.int32), b, axis=0
+        )
+        new_valid = None
+    else:
+        row_v = jax.lax.dynamic_index_in_dim(
+            cal.valid, b, axis=0, keepdims=False
+        )
+        row_s = jnp.zeros((ns,), jnp.int32)
+        new_src = None
+        new_valid = jax.lax.dynamic_update_index_in_dim(
+            cal.valid, jnp.zeros((ns,), bool), b, axis=0
+        )
     inbox = Inbox(
         payload=jnp.stack([r.reshape(slots, n) for r in rows]),
         src=row_s.reshape(slots, n),
@@ -180,9 +204,8 @@ def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
     )
     cal = dataclasses.replace(
         cal,
-        valid=jax.lax.dynamic_update_index_in_dim(
-            cal.valid, jnp.zeros((ns,), bool), b, axis=0
-        ),
+        src=new_src,
+        valid=new_valid,
         occ=jax.lax.dynamic_update_index_in_dim(
             cal.occ, jnp.zeros((n,), jnp.int32), b, axis=0
         ),
@@ -223,7 +246,7 @@ def enqueue(
     the tensor analog of the sidecar's whitelisted control routes
     (``docker_reactor.go:69-103`` — control traffic is never shaped).
     """
-    horizon, ns = cal.valid.shape
+    horizon, ns = cal.occupancy_plane.shape
     slots = cal.slots
     width = cal.width
     n = ns // slots
@@ -292,9 +315,30 @@ def enqueue(
 
     # --- filters: Accept / Reject / Drop per (src, dst region)
     if "filters" in features:
-        action = link.filters.reshape(-1)[
-            link.region_of[dst_safe] * n + src_f
-        ]
+        n_regions = link.filters.shape[0]
+        if n_regions == 1:
+            # single region (one group, no N_REGIONS declaration): the
+            # action depends on src only — a tile of the one filter row,
+            # no gathers at all (the dominant filters cost at 100k)
+            action = (
+                link.filters[0] if o == 1 else jnp.tile(link.filters[0], o)
+            )
+        elif n_regions <= 4:
+            # few regions: replace the flat [R·N] random gather with R
+            # broadcast selects; only the per-dst region lookup gathers
+            region = link.region_of[dst_safe]
+            action = jnp.zeros((m,), jnp.int32)
+            for r in range(n_regions):
+                row = (
+                    link.filters[r]
+                    if o == 1
+                    else jnp.tile(link.filters[r], o)
+                )
+                action = jnp.where(region == r, row, action)
+        else:
+            action = link.filters.reshape(-1)[
+                link.region_of[dst_safe] * n + src_f
+            ]
         accept = action == FILTER_ACCEPT
         rejected_msg = val_f & (action == FILTER_REJECT)
         if is_ctrl is not None:
@@ -363,16 +407,16 @@ def enqueue(
             p.at[buck_i, pos_i].set(pw, mode="drop", unique_indices=True)
             for p, pw in zip(cal.payload, pay_w)
         )
-        new_src = (
-            cal.src.at[buck_i, pos_i].set(
-                src_f, mode="drop", unique_indices=True
+        if cal.src is not None:  # src+1 doubles as the occupancy mark
+            new_src = cal.src.at[buck_i, pos_i].set(
+                src_f + 1, mode="drop", unique_indices=True
             )
-            if cal.src is not None
-            else None
-        )
-        new_valid = cal.valid.at[buck_i, pos_i].set(
-            True, mode="drop", unique_indices=True
-        )
+            new_valid = None
+        else:
+            new_src = None
+            new_valid = cal.valid.at[buck_i, pos_i].set(
+                True, mode="drop", unique_indices=True
+            )
         return (
             dataclasses.replace(
                 cal, payload=new_payload, src=new_src, valid=new_valid
@@ -459,16 +503,16 @@ def enqueue(
         p.at[buck_i, pos_i].set(pw, mode="drop", unique_indices=True)
         for p, pw in zip(cal.payload, pay_s)
     )
-    new_src = (
-        cal.src.at[buck_i, pos_i].set(
-            src_s, mode="drop", unique_indices=True
+    if cal.src is not None:  # src+1 doubles as the occupancy mark
+        new_src = cal.src.at[buck_i, pos_i].set(
+            src_s + 1, mode="drop", unique_indices=True
         )
-        if cal.src is not None
-        else None
-    )
-    new_valid = cal.valid.at[buck_i, pos_i].set(
-        True, mode="drop", unique_indices=True
-    )
+        new_valid = None
+    else:
+        new_src = None
+        new_valid = cal.valid.at[buck_i, pos_i].set(
+            True, mode="drop", unique_indices=True
+        )
 
     return (
         dataclasses.replace(

@@ -67,6 +67,43 @@ def run_sim(
     raise TimeoutError(f"task {tid} did not finish")
 
 
+class TestProfiles:
+    def test_profile_capture_writes_trace(self, tg_home):
+        """A group requesting profiles makes the run record a jax.profiler
+        trace into the run outputs dir (the pprof analog,
+        ``composition.go:153-162``)."""
+        import threading
+
+        from testground_tpu.api import RunGroup, RunInput
+        from testground_tpu.rpc import discard_writer
+        from testground_tpu.sim.executor import execute_sim_run
+
+        env = EnvConfig.load()
+        job = RunInput(
+            run_id="profrun",
+            test_plan="placebo",
+            test_case="ok",
+            total_instances=4,
+            groups=[
+                RunGroup(
+                    id="all",
+                    instances=4,
+                    artifact_path=os.path.join(PLANS, "placebo"),
+                    parameters={},
+                    profiles={"cpu": "true"},
+                )
+            ],
+            env=env,
+        )
+        out = execute_sim_run(job, discard_writer(), threading.Event())
+        assert out.result.outcome == Outcome.SUCCESS
+        pdir = os.path.join(
+            env.dirs.outputs(), "placebo", "profrun", "profiles"
+        )
+        found = [f for _, _, fs in os.walk(pdir) for f in fs]
+        assert any("trace" in f or f.endswith(".pb") for f in found), found
+
+
 class TestSimPlacebo:
     def test_ok(self, engine):
         t = run_sim(engine, "placebo", "ok", instances=8)
