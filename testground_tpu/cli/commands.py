@@ -622,5 +622,46 @@ def daemon_cmd(args) -> int:
     return serve(listen=args.listen)
 
 
+def register_sim_worker(sub) -> None:
+    p = sub.add_parser(
+        "sim-worker",
+        help="join a multi-host sim:jax cohort as a follower process "
+        "(the cluster-node analog; the leader is the engine whose "
+        "runner config sets coordinator_address)",
+    )
+    p.add_argument(
+        "--coordinator",
+        required=True,
+        help="jax.distributed coordinator host:port (process 0)",
+    )
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument(
+        "--plans",
+        default="",
+        help="plans dir holding the same plan sources as the leader "
+        "(default: $TESTGROUND_HOME/plans)",
+    )
+    p.add_argument(
+        "--once", action="store_true", help="exit after one job (tests)"
+    )
+    p.set_defaults(func=sim_worker_cmd)
+
+
+def sim_worker_cmd(args) -> int:
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.sim.executor import sim_worker_loop
+
+    plans_dir = args.plans or EnvConfig.load().dirs.plans()
+    sim_worker_loop(
+        args.coordinator,
+        args.num_processes,
+        args.process_id,
+        plans_dir,
+        once=args.once,
+    )
+    return 0
+
+
 def register_version(sub) -> None:
     sub.add_parser("version", help="print version")

@@ -99,6 +99,16 @@ class Engine:
         self._queue_kick.set()
         for t in self._workers:
             t.join(timeout=5)
+        # a leader engine drains its multi-host sim-workers on the way out
+        # (no-op unless a cohort was joined this process)
+        try:
+            from testground_tpu.sim.distributed import (
+                broadcast_shutdown_if_leader,
+            )
+
+            broadcast_shutdown_if_leader()
+        except Exception as e:  # noqa: BLE001 — shutdown is best-effort
+            S().warning("cohort shutdown broadcast failed: %s", e)
 
     # ------------------------------------------------------------- registries
 

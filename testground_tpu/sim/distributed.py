@@ -1,0 +1,172 @@
+"""Multi-host execution for the ``sim:jax`` runner.
+
+The reference scales past one host by scheduling containers on a cluster
+(``pkg/runner/cluster_k8s.go``: one pod per instance, coordinated through
+the sync service). The TPU-native analog is **multi-controller SPMD**
+(SURVEY.md §2.6/§7-M5): every host joins one ``jax.distributed`` job over
+DCN, the instance axis shards over the union of all hosts' devices, and
+XLA's collectives carry cross-host message traffic over ICI within a slice
+and DCN across slices — there is no NCCL/MPI layer to port.
+
+Topology of a run:
+
+- the **leader** (process 0) is the host whose engine executes the task;
+  it broadcasts the job spec (plan, case, shapes, seed) to the cohort,
+  runs the jitted program, gathers results, and owns outputs/journal;
+- **followers** (``tg sim-worker``) join the coordinator, receive each
+  job spec, execute the SAME program over the same global mesh (the
+  multi-controller contract: identical computations in identical order),
+  and loop for the next job.
+
+Plan sources must be present on every host at the same plan name (the
+cluster runners make the same assumption via the shared image).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = [
+    "broadcast_json",
+    "global_mesh",
+    "init_distributed",
+    "is_leader",
+    "to_host",
+]
+
+# Fixed wire size for the job-spec broadcast: multi-controller broadcasts
+# need identical static shapes on every process.
+_SPEC_BYTES = 65536
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: str, num_processes: int, process_id: int
+) -> None:
+    """Join the jax.distributed cohort (idempotent). The coordinator is
+    process 0's ``host:port`` — the DCN control endpoint."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "before" in str(e):
+            # jax's constraint: distributed must precede backend init. A
+            # warm engine (an earlier single-host run touched devices)
+            # cannot join a cohort mid-life.
+            raise RuntimeError(
+                "cannot join a multi-host cohort: this process already "
+                "initialized its jax backend (an earlier run?). Multi-host "
+                "jobs need a fresh engine process whose FIRST sim run "
+                "carries the coordinator_address config."
+            ) from e
+        raise
+    _initialized = True
+
+
+def is_multiprocess() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def is_leader() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def global_mesh():
+    """One mesh axis ``"i"`` over every device of every process — the
+    instance axis shards across hosts exactly as it does across chips."""
+    import jax
+
+    return jax.sharding.Mesh(np.asarray(jax.devices()), ("i",))
+
+
+def broadcast_json(obj: dict | None) -> dict:
+    """Leader sends ``obj``; followers pass None and receive it. One
+    fixed-size uint8 broadcast (multihost_utils.broadcast_one_to_all)."""
+    from jax.experimental import multihost_utils
+
+    if obj is not None:
+        raw = json.dumps(obj).encode()
+        if len(raw) + 8 > _SPEC_BYTES:
+            raise ValueError(
+                f"job spec too large for broadcast: {len(raw)} bytes"
+            )
+        buf = np.zeros((_SPEC_BYTES,), np.uint8)
+        header = np.frombuffer(
+            len(raw).to_bytes(8, "little"), dtype=np.uint8
+        )
+        buf[:8] = header
+        buf[8 : 8 + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    else:
+        buf = np.zeros((_SPEC_BYTES,), np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    size = int.from_bytes(out[:8].tobytes(), "little")
+    return json.loads(out[8 : 8 + size].tobytes().decode())
+
+
+def cohort_agree(ok: bool) -> bool:
+    """All-processes AND over a local readiness bit (one tiny allgather).
+    Run after receiving a job spec: a host whose plans dir cannot satisfy
+    the job votes False and EVERY process skips the job in lockstep —
+    otherwise the dead worker would strand the cohort mid-collective."""
+    from jax.experimental import multihost_utils
+
+    votes = np.asarray(
+        multihost_utils.process_allgather(
+            np.asarray([1 if ok else 0], np.uint8), tiled=True
+        )
+    )
+    return bool(votes.min() == 1)
+
+
+class CohortCancel:
+    """Cancellation as a cohort decision: the leader broadcasts its local
+    cancel state once per chunk and every process observes the same
+    answer — a leader honoring a local Event alone would break out of the
+    chunk loop and issue collectives the followers aren't running."""
+
+    def __init__(self, local_event=None):
+        self._local = local_event
+
+    def is_set(self) -> bool:
+        from jax.experimental import multihost_utils
+
+        flag = 1 if (self._local is not None and self._local.is_set()) else 0
+        out = np.asarray(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray([flag], np.uint8)
+            )
+        )
+        return bool(out[0])
+
+
+def broadcast_shutdown_if_leader() -> None:
+    """Release any waiting sim-workers when a leader engine shuts down
+    (their next broadcast receives the shutdown sentinel)."""
+    if _initialized and is_leader() and is_multiprocess():
+        broadcast_json({"shutdown": True})
+
+
+def to_host(x) -> np.ndarray:
+    """Materialize a (possibly cross-host-sharded) array on this host:
+    ``process_allgather`` when multi-process, plain ``np.asarray``
+    otherwise."""
+    if is_multiprocess():
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)

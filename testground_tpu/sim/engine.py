@@ -538,14 +538,19 @@ class SimProgram:
         return self.results(carry, ticks)
 
     def results(self, carry: SimCarry, ticks: int) -> dict[str, Any]:
+        # to_host assembles cross-host shards when the mesh spans multiple
+        # processes (a collective — every process must call results());
+        # single-process it is a plain device→host copy
+        from .distributed import to_host
+
         return {
             # host lanes are internal plumbing — plan instances only
-            "status": np.asarray(carry.status[: self.n]),
-            "finished_at": np.asarray(carry.finished_at[: self.n]),
+            "status": to_host(carry.status)[: self.n],
+            "finished_at": to_host(carry.finished_at)[: self.n],
             "ticks": ticks,
             "tick_ms": self.tick_ms,
-            "states": jax.tree.map(np.asarray, carry.states),
-            "sync_counts": np.asarray(carry.sync.counts),
-            "pub_dropped": np.asarray(carry.sync.dropped),
+            "states": jax.tree.map(to_host, carry.states),
+            "sync_counts": to_host(carry.sync.counts),
+            "pub_dropped": to_host(carry.sync.dropped),
             "groups": self.groups,
         }

@@ -53,6 +53,13 @@ class SimJaxConfig:
     # axis) — the ADDITIONAL_HOSTS analog (``local_docker.go:78``); plans
     # address them via ``env.host_index(name)``
     additional_hosts: list = dataclasses.field(default_factory=list)
+    # multi-host SPMD (SURVEY §2.6/§7-M5): when coordinator_address is set
+    # the run joins a jax.distributed cohort — this engine is the leader
+    # (process 0); every other host runs `tg sim-worker` against the same
+    # coordinator and executes the same program over the global mesh
+    coordinator_address: str = ""
+    num_processes: int = 1
+    process_id: int = 0
 
 
 def load_sim_testcases(artifact_path: str) -> dict:
@@ -111,6 +118,17 @@ def execute_sim_run(
 
     cfg = job.runner_config or SimJaxConfig()
 
+    # multi-host cohort join MUST precede any jax call that initializes
+    # the backend (jax.distributed.initialize's contract)
+    multi = False
+    if getattr(cfg, "coordinator_address", ""):
+        from .distributed import init_distributed, is_multiprocess
+
+        init_distributed(
+            cfg.coordinator_address, cfg.num_processes, cfg.process_id
+        )
+        multi = is_multiprocess()
+
     artifact = job.groups[0].artifact_path
     cases = load_sim_testcases(artifact)
     factory = cases.get(job.test_case)
@@ -123,7 +141,57 @@ def execute_sim_run(
 
     groups = build_groups(job.groups)
     n = sum(g.count for g in groups)
-    mesh = _make_mesh(cfg.shard)
+    hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
+
+    # ------------------------------------------------- multi-host cohort
+    if multi:
+        from .distributed import (
+            broadcast_json,
+            cohort_agree,
+            global_mesh,
+        )
+
+        import jax
+
+        mesh = global_mesh()  # cfg.shard has no meaning across a cohort
+        ow.infof(
+            "multi-host: %d processes, %d global devices, leader=%d",
+            jax.process_count(),
+            mesh.devices.size,
+            jax.process_index(),
+        )
+        # followers compile the identical program from this spec
+        broadcast_json(
+            {
+                "plan": job.test_plan,
+                "case": job.test_case,
+                "run_id": job.run_id,
+                "groups": [
+                    {
+                        "id": g.id,
+                        "instances": g.instances,
+                        "parameters": dict(g.parameters),
+                    }
+                    for g in job.groups
+                ],
+                "tick_ms": cfg.tick_ms,
+                "chunk": cfg.chunk,
+                "seed": cfg.seed,
+                "max_ticks": cfg.max_ticks,
+                "hosts": list(hosts),
+            }
+        )
+        # readiness vote: a worker whose plans dir cannot satisfy the job
+        # votes False and everyone skips in lockstep (a worker dying
+        # mid-program would strand the cohort inside a collective)
+        if not cohort_agree(True):
+            raise RuntimeError(
+                "a cohort member cannot satisfy this job (missing or "
+                "stale plan sources on a worker host) — run aborted "
+                "before any program collective"
+            )
+    else:
+        mesh = _make_mesh(cfg.shard)
     ow.infof(
         "sim:jax run %s: plan=%s case=%s instances=%d groups=%d "
         "tick=%.3fms devices=%s",
@@ -135,10 +203,9 @@ def execute_sim_run(
         cfg.tick_ms,
         mesh.devices.size if mesh is not None else 1,
     )
-
-    hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
     if hosts:
         ow.infof("additional hosts: %s", ",".join(hosts))
+
     prog = SimProgram(
         testcase,
         groups,
@@ -169,8 +236,12 @@ def execute_sim_run(
     outputs_root = job.env.dirs.outputs() if job.env is not None else None
     # no outputs dir → nowhere to persist samples; disable_metrics is the
     # composition's opt-out (the TEST_DISABLE_METRICS analog) — either way
-    # the hot loop must not pay the per-sample device→host sync
-    ts_enabled = outputs_root is not None and not job.disable_metrics
+    # the hot loop must not pay the per-sample device→host sync. Multi-host
+    # runs also disable sampling: a leader-local mid-run device read of a
+    # cross-host-sharded carry is not symmetric across the cohort.
+    ts_enabled = (
+        outputs_root is not None and not job.disable_metrics and not multi
+    )
     recorder = _TimeSeriesRecorder(
         testcase,
         groups,
@@ -189,11 +260,21 @@ def execute_sim_run(
         os.makedirs(profile_dir, exist_ok=True)
         ow.infof("capturing jax.profiler trace to %s", profile_dir)
 
+    if multi:
+        # cancellation must be a cohort decision: the leader's local event
+        # state is broadcast once per chunk so every process stops (or
+        # continues) in lockstep — see distributed.CohortCancel
+        from .distributed import CohortCancel
+
+        run_cancel = CohortCancel(cancel)
+    else:
+        run_cancel = cancel
+
     def _run():
         return prog.run(
             seed=cfg.seed,
             max_ticks=cfg.max_ticks,
-            cancel=cancel,
+            cancel=run_cancel,
             on_chunk=on_chunk,
             observer=recorder.observe if recorder.enabled else None,
         )
@@ -313,6 +394,89 @@ def execute_sim_run(
     if cancel.is_set():
         result.outcome = Outcome.CANCELED
     return RunOutput(run_id=job.run_id, result=result)
+
+
+def sim_worker_loop(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    plans_dir: str,
+    once: bool = False,
+    log=print,
+) -> None:
+    """Follower half of a multi-host cohort (the ``tg sim-worker`` verb).
+
+    Joins the jax.distributed job, then for each job spec the leader
+    broadcasts: load the same plan from this host's plans dir, compile the
+    identical program over the global mesh, and run it to completion —
+    the multi-controller contract. Results live in the global arrays; the
+    leader owns reporting. ``once`` exits after one job (tests)."""
+    from .distributed import broadcast_json, global_mesh, init_distributed
+    from .engine import SimProgram, build_groups
+
+    init_distributed(coordinator_address, num_processes, process_id)
+    import jax
+
+    log(
+        f"sim-worker: process {jax.process_index()}/{jax.process_count()} "
+        f"joined, {len(jax.devices())} global devices"
+    )
+    from testground_tpu.api import RunGroup
+
+    from .distributed import CohortCancel, cohort_agree
+
+    while True:
+        spec = broadcast_json(None)
+        if spec.get("shutdown"):
+            log("sim-worker: shutdown")
+            return
+        # readiness vote BEFORE any program collective: if this (or any)
+        # host cannot build the job, the whole cohort skips it
+        try:
+            cases = load_sim_testcases(os.path.join(plans_dir, spec["plan"]))
+            factory = cases[spec["case"]]
+            testcase = factory() if isinstance(factory, type) else factory
+            ok = True
+        except Exception as e:  # noqa: BLE001 — voted, not raised
+            log(f"sim-worker: cannot satisfy {spec['plan']}:{spec['case']}: {e}")
+            ok = False
+        if not cohort_agree(ok):
+            log(f"sim-worker: cohort skipped run {spec['run_id']}")
+            if once:
+                return
+            continue
+
+        groups = build_groups(
+            [
+                RunGroup(
+                    id=d["id"],
+                    instances=d["instances"],
+                    parameters=d["parameters"],
+                )
+                for d in spec["groups"]
+            ]
+        )
+        prog = SimProgram(
+            testcase,
+            groups,
+            test_plan=spec["plan"],
+            test_case=spec["case"],
+            test_run=spec["run_id"],
+            tick_ms=spec["tick_ms"],
+            mesh=global_mesh(),
+            chunk=spec["chunk"],
+            hosts=tuple(spec.get("hosts", ())),
+        )
+        res = prog.run(
+            seed=spec["seed"],
+            max_ticks=spec["max_ticks"],
+            cancel=CohortCancel(None),
+        )
+        log(
+            f"sim-worker: run {spec['run_id']} done — {res['ticks']} ticks"
+        )
+        if once:
+            return
 
 
 def _tree_slice(state_group):

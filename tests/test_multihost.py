@@ -1,0 +1,185 @@
+"""Multi-host execution test: a REAL two-process jax.distributed cohort on
+CPU (the DCN analog — SURVEY.md §2.6/§7-M5). The leader executes a sim:jax
+run with coordinator_address set; a follower subprocess runs the
+``tg sim-worker`` loop. Both compile the same program over the 4-device
+global mesh (2 processes × 2 forced host devices) and the leader's result
+must equal a plain single-process run."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+LEADER_SCRIPT = r"""
+import json, os, sys, threading
+import numpy as np
+from testground_tpu.api import RunGroup, RunInput
+from testground_tpu.config import EnvConfig
+from testground_tpu.rpc import discard_writer
+from testground_tpu.sim.executor import SimJaxConfig, execute_sim_run
+
+coord, home = sys.argv[1], sys.argv[2]
+env = EnvConfig.load(home)
+job = RunInput(
+    run_id="mhrun", test_plan="placebo", test_case="ok", total_instances=8,
+    groups=[RunGroup(id="all", instances=8,
+                     artifact_path=os.path.join(sys.argv[3], "placebo"),
+                     parameters={})],
+    runner_config=SimJaxConfig(
+        chunk=8, coordinator_address=coord, num_processes=2, process_id=0
+    ),
+    env=env,
+)
+try:
+    out = execute_sim_run(job, discard_writer(), threading.Event())
+except RuntimeError as e:
+    print(json.dumps({"aborted": str(e)}), flush=True)
+else:
+    import jax
+    print(json.dumps({
+        "outcome": out.result.outcome.value,
+        "outcomes": {k: {"ok": v.ok, "total": v.total}
+                      for k, v in out.result.outcomes.items()},
+        "processes": jax.process_count(),
+        "devices": len(jax.devices()),
+    }), flush=True)
+# the coordinator (process 0) must outlive the follower's distributed
+# shutdown — hold until the test signals via stdin
+sys.stdin.readline()
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _read_json_line(stream, timeout: float) -> str:
+    """Next stdout line that looks like JSON (gloo chatter also lands on
+    stdout), within ``timeout``."""
+    import select
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r, _, _ = select.select([stream], [], [], 1.0)
+        if r:
+            line = stream.readline()
+            if line.strip().startswith("{"):
+                return line
+    raise TimeoutError("no result line from the leader")
+
+
+def _run_cohort(tmp_path, follower_plans):
+    """Launch leader + follower subprocesses, honoring the cohort's
+    shutdown-barrier sequencing; returns (leader_result, follower_output)."""
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+
+    def env_for():
+        # a CLEAN environment, not an inherited one: accelerator-tunnel /
+        # relay variables from the host session (sitecustomize backends,
+        # remote-compile relays) leak into the cohort and hang the
+        # distributed handshake of the CPU children
+        return {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": os.environ.get("HOME", "/root"),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "TESTGROUND_HOME": str(tmp_path / "home"),
+            "PYTHONPATH": REPO_ROOT,
+        }
+
+    leader = subprocess.Popen(
+        [sys.executable, "-c", LEADER_SCRIPT, coord, str(tmp_path / "home"), PLANS],
+        env=env_for(),
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    # wait for the coordinator service to be listening before the follower
+    # dials it (jax.distributed's client retry window is finicky when the
+    # connect races the very first bind)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                break
+        except OSError:
+            if leader.poll() is not None:
+                out, err = leader.communicate()
+                raise AssertionError(f"leader died early:\n{err[-2000:]}")
+            time.sleep(0.5)
+    follower = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "testground_tpu.cli.main",
+            "sim-worker",
+            "--coordinator",
+            coord,
+            "--num-processes",
+            "2",
+            "--process-id",
+            "1",
+            "--plans",
+            follower_plans,
+            "--once",
+        ],
+        env=env_for(),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        # jax.distributed.shutdown is a BARRIER: every process must reach
+        # it or none exits. Wait for the leader's result line (its work is
+        # done, cohort still open), then release it via stdin - its exit
+        # completes the follower's shutdown barrier too.
+        result_line = _read_json_line(leader.stdout, 240)
+        leader.stdin.write("\n")
+        leader.stdin.flush()
+        lout, lerr = leader.communicate(timeout=120)
+        fout, ferr = follower.communicate(timeout=120)
+    except (subprocess.TimeoutExpired, TimeoutError) as e:
+        leader.kill()
+        follower.kill()
+        lout, lerr = leader.communicate()
+        fout, ferr = follower.communicate()
+        raise AssertionError(
+            f"cohort timed out ({e}).\nLEADER err:\n{lerr[-2000:]}\n"
+            f"FOLLOWER err:\n{ferr[-2000:]}"
+        )
+    assert leader.returncode == 0, f"leader failed:\n{lerr[-3000:]}"
+    assert follower.returncode == 0, f"follower failed:\n{ferr[-3000:]}"
+    return json.loads(result_line), fout + ferr
+
+
+def test_two_process_cohort_runs_to_completion(tmp_path):
+    """Leader (engine) + follower (tg sim-worker --once) over a local
+    coordinator; 4 global devices; outcome must be all-success."""
+    result, fol = _run_cohort(tmp_path, PLANS)
+    assert result["processes"] == 2
+    assert result["devices"] == 4
+    assert result["outcome"] == "success"
+    assert result["outcomes"]["all"] == {"ok": 8, "total": 8}
+    assert "sim-worker: run mhrun done" in fol
+
+
+def test_unsatisfiable_job_is_skipped_in_lockstep(tmp_path):
+    """A worker whose plans dir lacks the plan votes not-ready; the whole
+    cohort skips the job BEFORE any program collective - the leader gets
+    a clean error instead of a hang, the worker exits cleanly."""
+    empty = tmp_path / "empty-plans"
+    empty.mkdir()
+    result, fol = _run_cohort(tmp_path, str(empty))
+    assert "aborted" in result, result
+    assert "cohort member cannot satisfy" in result["aborted"]
+    assert "cohort skipped run mhrun" in fol
