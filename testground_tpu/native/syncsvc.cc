@@ -1,0 +1,461 @@
+// tg-syncsvc — native sync service for the local:exec runner.
+//
+// The runtime analog of the reference's sync-service container (Go +
+// Redis, pkg/runner/local_common.go:77-104): a single-threaded poll()
+// event loop serving the framework's newline-delimited-JSON protocol
+// (testground_tpu/sync/server.py is the behavioral spec):
+//
+//   request:  {"id": N, "op": <op>, ...args}\n
+//   reply:    {"id": N, ...result}\n             exactly one, except
+//   subscribe streams {"id": N, "entry": <raw>, "seq": i} frames.
+//
+// Ops: signal_entry(state), counter(state), barrier(state, target[,
+// timeout]), signal_and_wait(state, target[, timeout]),
+// publish(topic, payload), subscribe(topic).
+//
+// Design notes:
+// - publish payloads are NEVER parsed: the raw JSON value text is stored
+//   and echoed verbatim into subscribe frames, so arbitrary payloads
+//   round-trip without a full JSON implementation;
+// - one thread, no locks: barrier waiters and topic subscribers are
+//   parked records flushed when counters/topics advance — the C++ twin
+//   of the Python server's per-request threads without the threads;
+// - stdout handshake: "LISTENING <port>" once bound (the runner reads
+//   this to learn an ephemeral port).
+//
+// Build: g++ -O2 -std=c++17 -o tg-syncsvc syncsvc.cc
+// (testground_tpu/native/syncsvc.py wraps build + spawn + lifecycle).
+
+#include <arpa/inet.h>
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+double now_secs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + double(ts.tv_nsec) * 1e-9;
+}
+
+// ---------------------------------------------------------------- JSON bits
+// Minimal field extraction over one request line. Values are returned as
+// raw JSON text; strings additionally unescape via json_unescape.
+
+// Skip a JSON value starting at i; returns one-past-end, or npos on error.
+size_t skip_value(const std::string& s, size_t i) {
+  while (i < s.size() && isspace((unsigned char)s[i])) i++;
+  if (i >= s.size()) return std::string::npos;
+  char c = s[i];
+  if (c == '"') {
+    for (i++; i < s.size(); i++) {
+      if (s[i] == '\\') { i++; continue; }
+      if (s[i] == '"') return i + 1;
+    }
+    return std::string::npos;
+  }
+  if (c == '{' || c == '[') {
+    char open = c, close = (c == '{') ? '}' : ']';
+    int depth = 0;
+    bool in_str = false;
+    for (; i < s.size(); i++) {
+      char d = s[i];
+      if (in_str) {
+        if (d == '\\') { i++; continue; }
+        if (d == '"') in_str = false;
+      } else if (d == '"') {
+        in_str = true;
+      } else if (d == open) {
+        depth++;
+      } else if (d == close) {
+        depth--;
+        if (depth == 0) return i + 1;
+      }
+    }
+    return std::string::npos;
+  }
+  // number / true / false / null
+  size_t j = i;
+  while (j < s.size() && (isalnum((unsigned char)s[j]) || s[j] == '-' ||
+                          s[j] == '+' || s[j] == '.'))
+    j++;
+  return j == i ? std::string::npos : j;
+}
+
+// Raw JSON text of top-level field `key`, or empty if absent.
+std::string find_field(const std::string& line, const std::string& key) {
+  std::string pat = "\"" + key + "\"";
+  size_t i = 0;
+  bool in_str = false;
+  int depth = 0;
+  for (; i < line.size(); i++) {
+    char c = line[i];
+    if (in_str) {
+      if (c == '\\') { i++; continue; }
+      if (c == '"') in_str = false;
+      continue;
+    }
+    if (c == '{' || c == '[') { depth++; continue; }
+    if (c == '}' || c == ']') { depth--; continue; }
+    if (c == '"') {
+      if (depth == 1 && line.compare(i, pat.size(), pat) == 0) {
+        size_t j = i + pat.size();
+        while (j < line.size() && isspace((unsigned char)line[j])) j++;
+        if (j < line.size() && line[j] == ':') {
+          size_t start = j + 1;
+          while (start < line.size() && isspace((unsigned char)line[start]))
+            start++;
+          size_t end = skip_value(line, start);
+          if (end == std::string::npos) return "";
+          return line.substr(start, end - start);
+        }
+      }
+      in_str = true;
+    }
+  }
+  return "";
+}
+
+void utf8_append(std::string& out, unsigned cp) {
+  if (cp < 0x80) {
+    out += char(cp);
+  } else if (cp < 0x800) {
+    out += char(0xC0 | (cp >> 6));
+    out += char(0x80 | (cp & 0x3F));
+  } else {
+    out += char(0xE0 | (cp >> 12));
+    out += char(0x80 | ((cp >> 6) & 0x3F));
+    out += char(0x80 | (cp & 0x3F));
+  }
+}
+
+// Decode a raw JSON string token ("...") to its value; empty on error.
+std::string json_unescape(const std::string& raw) {
+  if (raw.size() < 2 || raw.front() != '"' || raw.back() != '"') return "";
+  std::string out;
+  out.reserve(raw.size());
+  for (size_t i = 1; i + 1 < raw.size(); i++) {
+    char c = raw[i];
+    if (c != '\\') { out += c; continue; }
+    if (++i + 1 > raw.size()) break;
+    switch (raw[i]) {
+      case 'n': out += '\n'; break;
+      case 't': out += '\t'; break;
+      case 'r': out += '\r'; break;
+      case 'b': out += '\b'; break;
+      case 'f': out += '\f'; break;
+      case 'u': {
+        if (i + 4 < raw.size()) {
+          unsigned cp = (unsigned)strtoul(raw.substr(i + 1, 4).c_str(),
+                                          nullptr, 16);
+          utf8_append(out, cp);
+          i += 4;
+        }
+        break;
+      }
+      default: out += raw[i];
+    }
+  }
+  return out;
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+long field_long(const std::string& line, const std::string& key, long dflt) {
+  std::string raw = find_field(line, key);
+  if (raw.empty() || raw == "null") return dflt;
+  return strtol(raw.c_str(), nullptr, 10);
+}
+
+double field_double(const std::string& line, const std::string& key,
+                    double dflt) {
+  std::string raw = find_field(line, key);
+  if (raw.empty() || raw == "null") return dflt;
+  return strtod(raw.c_str(), nullptr);
+}
+
+// ------------------------------------------------------------------- state
+
+struct Conn {
+  int fd;
+  std::string rbuf;
+};
+
+struct Waiter {           // a parked barrier / signal_and_wait
+  int fd;
+  long id;
+  std::string state;
+  long target;
+  long seq;               // -1 for plain barrier; echoed for signal_and_wait
+  double deadline;        // 0 = none
+};
+
+struct Sub {
+  int fd;
+  long id;
+  size_t cursor;
+};
+
+struct Topic {
+  std::vector<std::string> entries;  // raw JSON payloads, verbatim
+  std::vector<Sub> subs;
+};
+
+std::unordered_map<int, Conn> conns;
+std::unordered_map<std::string, long> counters;
+std::vector<Waiter> waiters;
+std::unordered_map<std::string, Topic> topics;
+
+void send_line(int fd, const std::string& line) {
+  std::string data = line + "\n";
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return;  // peer gone; conn reaped on next poll
+    off += (size_t)n;
+  }
+}
+
+void reply_err(int fd, long id, const std::string& msg) {
+  char buf[64];
+  snprintf(buf, sizeof buf, "{\"id\": %ld, \"error\": \"", id);
+  send_line(fd, std::string(buf) + json_escape(msg) + "\"}");
+}
+
+void flush_waiters(const std::string& state) {
+  long count = counters[state];
+  for (size_t i = 0; i < waiters.size();) {
+    Waiter& w = waiters[i];
+    if (w.state == state && count >= w.target) {
+      char buf[128];
+      if (w.seq >= 0)
+        snprintf(buf, sizeof buf, "{\"id\": %ld, \"seq\": %ld, \"ok\": true}",
+                 w.id, w.seq);
+      else
+        snprintf(buf, sizeof buf, "{\"id\": %ld, \"ok\": true}", w.id);
+      send_line(w.fd, buf);
+      waiters[i] = waiters.back();
+      waiters.pop_back();
+    } else {
+      i++;
+    }
+  }
+}
+
+void flush_subs(const std::string& topic_name) {
+  Topic& t = topics[topic_name];
+  for (Sub& sub : t.subs) {
+    while (sub.cursor < t.entries.size()) {
+      char head[64];
+      snprintf(head, sizeof head, "{\"id\": %ld, \"entry\": ", sub.id);
+      sub.cursor++;
+      char tail[32];
+      snprintf(tail, sizeof tail, ", \"seq\": %zu}", sub.cursor);
+      send_line(sub.fd, std::string(head) + t.entries[sub.cursor - 1] + tail);
+    }
+  }
+}
+
+void handle_line(int fd, const std::string& line) {
+  long id = field_long(line, "id", -1);
+  std::string op = json_unescape(find_field(line, "op"));
+  if (op.empty()) {
+    reply_err(fd, -1, "malformed request");
+    return;
+  }
+  char buf[160];
+  if (op == "signal_entry") {
+    std::string state = json_unescape(find_field(line, "state"));
+    long seq = ++counters[state];
+    snprintf(buf, sizeof buf, "{\"id\": %ld, \"seq\": %ld}", id, seq);
+    send_line(fd, buf);
+    flush_waiters(state);
+  } else if (op == "counter") {
+    std::string state = json_unescape(find_field(line, "state"));
+    snprintf(buf, sizeof buf, "{\"id\": %ld, \"count\": %ld}", id,
+             counters[state]);
+    send_line(fd, buf);
+  } else if (op == "barrier" || op == "signal_and_wait") {
+    std::string state = json_unescape(find_field(line, "state"));
+    long target = field_long(line, "target", 0);
+    double timeout = field_double(line, "timeout", 0.0);
+    long seq = -1;
+    if (op == "signal_and_wait") seq = ++counters[state];
+    Waiter w{fd, id, state, target, seq,
+             timeout > 0 ? now_secs() + timeout : 0.0};
+    waiters.push_back(w);
+    flush_waiters(state);  // may satisfy immediately (incl. this one)
+  } else if (op == "publish") {
+    std::string topic = json_unescape(find_field(line, "topic"));
+    std::string payload = find_field(line, "payload");
+    if (payload.empty()) payload = "null";
+    Topic& t = topics[topic];
+    t.entries.push_back(payload);
+    snprintf(buf, sizeof buf, "{\"id\": %ld, \"seq\": %zu}", id,
+             t.entries.size());
+    send_line(fd, buf);
+    flush_subs(topic);
+  } else if (op == "subscribe") {
+    std::string topic = json_unescape(find_field(line, "topic"));
+    topics[topic].subs.push_back(Sub{fd, id, 0});
+    flush_subs(topic);
+  } else {
+    reply_err(fd, id, "unknown op '" + op + "'");
+  }
+}
+
+void drop_conn(int fd) {
+  close(fd);
+  conns.erase(fd);
+  for (size_t i = 0; i < waiters.size();) {
+    if (waiters[i].fd == fd) {
+      waiters[i] = waiters.back();
+      waiters.pop_back();
+    } else {
+      i++;
+    }
+  }
+  for (auto& kv : topics) {
+    auto& subs = kv.second.subs;
+    for (size_t i = 0; i < subs.size();) {
+      if (subs[i].fd == fd) {
+        subs[i] = subs.back();
+        subs.pop_back();
+      } else {
+        i++;
+      }
+    }
+  }
+}
+
+void expire_waiters() {
+  double now = now_secs();
+  for (size_t i = 0; i < waiters.size();) {
+    if (waiters[i].deadline > 0 && now >= waiters[i].deadline) {
+      reply_err(waiters[i].fd, waiters[i].id,
+                "barrier timed out: " + waiters[i].state);
+      waiters[i] = waiters.back();
+      waiters.pop_back();
+    } else {
+      i++;
+    }
+  }
+}
+
+volatile sig_atomic_t stop_flag = 0;
+void on_term(int) { stop_flag = 1; }
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 0;
+  for (int i = 1; i + 1 < argc; i += 2)
+    if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
+
+  signal(SIGTERM, on_term);
+  signal(SIGINT, on_term);
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0 || listen(lfd, 512) != 0) {
+    perror("tg-syncsvc: bind/listen");
+    return 1;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(lfd, (sockaddr*)&addr, &alen);
+  printf("LISTENING %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  std::vector<pollfd> pfds;
+  char rbuf[65536];
+  while (!stop_flag) {
+    pfds.clear();
+    pfds.push_back({lfd, POLLIN, 0});
+    for (auto& kv : conns) pfds.push_back({kv.first, POLLIN, 0});
+
+    // poll timeout tracks the nearest barrier deadline
+    int tmo = -1;
+    double now = now_secs();
+    for (const Waiter& w : waiters)
+      if (w.deadline > 0) {
+        int ms = (int)((w.deadline - now) * 1000) + 1;
+        if (ms < 0) ms = 0;
+        if (tmo < 0 || ms < tmo) tmo = ms;
+      }
+    int rc = poll(pfds.data(), pfds.size(), tmo);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    expire_waiters();
+    for (const pollfd& p : pfds) {
+      if (!(p.revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      if (p.fd == lfd) {
+        int cfd = accept(lfd, nullptr, nullptr);
+        if (cfd >= 0) {
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          conns[cfd] = Conn{cfd, std::string()};
+        }
+        continue;
+      }
+      auto it = conns.find(p.fd);
+      if (it == conns.end()) continue;
+      ssize_t n = recv(p.fd, rbuf, sizeof rbuf, 0);
+      if (n <= 0) {
+        drop_conn(p.fd);
+        continue;
+      }
+      it->second.rbuf.append(rbuf, (size_t)n);
+      std::string& b = it->second.rbuf;
+      size_t start = 0, nl;
+      while ((nl = b.find('\n', start)) != std::string::npos) {
+        std::string line = b.substr(start, nl - start);
+        start = nl + 1;
+        if (!line.empty()) handle_line(p.fd, line);
+        if (conns.find(p.fd) == conns.end()) break;  // dropped mid-batch
+      }
+      if (conns.find(p.fd) != conns.end()) b.erase(0, start);
+    }
+  }
+  for (auto& kv : conns) close(kv.first);
+  close(lfd);
+  return 0;
+}

@@ -1,0 +1,81 @@
+"""Build + lifecycle wrapper for the native sync service (syncsvc.cc).
+
+The local:exec runner's per-run sync infrastructure can be served by the
+C++ event-loop server instead of the in-process Python one — the native
+analog of the reference deploying its Go sync-service container
+(``pkg/runner/local_common.go:77-104``). The binary is compiled once from
+the packaged source with the system ``g++`` and cached by source hash in
+``$TESTGROUND_HOME/work/bin``; hosts without a toolchain silently fall
+back to the Python server (runner config ``sync_service = "auto"``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import signal
+import subprocess
+
+from testground_tpu.logging_ import S
+
+__all__ = ["NativeSyncService", "build_syncsvc", "native_available"]
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "syncsvc.cc")
+
+
+def native_available() -> bool:
+    return shutil.which("g++") is not None and os.path.isfile(_SRC)
+
+
+def build_syncsvc(bin_dir: str) -> str:
+    """Compile (or reuse) the server binary; returns its path. The binary
+    name embeds the source hash, so edits rebuild and stale caches never
+    serve."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    os.makedirs(bin_dir, exist_ok=True)
+    out = os.path.join(bin_dir, f"tg-syncsvc-{digest}")
+    if os.path.isfile(out):
+        return out
+    tmp = f"{out}.tmp.{os.getpid()}"  # unique per builder: no write races
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-o", tmp, _SRC],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    os.replace(tmp, out)  # atomic install; last writer wins with same bits
+    S().debug("built native sync service: %s", out)
+    return out
+
+
+class NativeSyncService:
+    """Drop-in lifecycle twin of ``SyncServiceServer``: ``.address`` and
+    ``.stop()``; the server is a child process."""
+
+    def __init__(self, bin_path: str):
+        self._proc = subprocess.Popen(
+            [bin_path, "--port", "0"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        line = self._proc.stdout.readline().strip()
+        if not line.startswith("LISTENING "):
+            self._proc.kill()
+            raise RuntimeError(
+                f"native sync service failed to start (got {line!r})"
+            )
+        self.address = ("127.0.0.1", int(line.split()[1]))
+
+    def start(self) -> "NativeSyncService":
+        return self  # already serving (constructor handshake)
+
+    def stop(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGTERM)
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()

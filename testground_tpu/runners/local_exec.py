@@ -41,6 +41,10 @@ class LocalExecConfig:
 
     keep_outputs: bool = True
     run_timeout_secs: int = 0  # 0 ⇒ rely on task timeout
+    # per-run sync service backend: "native" = the C++ event-loop server
+    # (testground_tpu/native/syncsvc.cc, built on demand), "python" = the
+    # in-process server, "auto" = native when a toolchain is available
+    sync_service: str = "auto"
 
 
 class LocalExecRunner(Runner, HealthcheckedRunner):
@@ -90,6 +94,42 @@ class LocalExecRunner(Runner, HealthcheckedRunner):
 
     # ------------------------------------------------------------------ run
 
+    def _start_sync_service(self, cfg, job, ow: OutputWriter):
+        """Boot the per-run sync service: the native C++ server when the
+        config allows and a toolchain exists, else the Python one (both
+        expose .address/.stop and speak the same wire protocol)."""
+        mode = getattr(cfg, "sync_service", "auto")
+        if mode not in ("auto", "python", "native"):
+            raise ValueError(f"unknown sync_service mode {mode!r}")
+        if mode in ("auto", "native"):
+            from testground_tpu.native import (
+                NativeSyncService,
+                build_syncsvc,
+                native_available,
+            )
+
+            if native_available():
+                try:
+                    path = build_syncsvc(
+                        os.path.join(job.env.dirs.work(), "bin")
+                    )
+                    svc = NativeSyncService(path)
+                    ow.infof("sync service: native (%s)", path)
+                    return svc
+                except Exception as e:  # noqa: BLE001 — auto falls back
+                    if mode == "native":
+                        raise
+                    ow.warn(
+                        "native sync service unavailable (%s); "
+                        "falling back to python",
+                        e,
+                    )
+            elif mode == "native":
+                raise RuntimeError(
+                    "sync_service='native' but no C++ toolchain (g++) found"
+                )
+        return SyncServiceServer().start()
+
     def run(
         self, job: RunInput, ow: OutputWriter, cancel: threading.Event
     ) -> RunOutput:
@@ -99,33 +139,41 @@ class LocalExecRunner(Runner, HealthcheckedRunner):
         result = Result.for_input(job)
         pretty = PrettyPrinter(ow)
 
-        sync_server = SyncServiceServer().start()
+        sync_server = self._start_sync_service(cfg, job, ow)
         host, port = sync_server.address
 
         # runner-side outcome collection: subscribe to the run's lifecycle
-        # events before instances start (local_docker.go:217-256)
+        # events before instances start (local_docker.go:217-256). The
+        # collector is itself a sync CLIENT over TCP — backend-agnostic
+        # (in-process Python server or the native C++ one).
         outcomes: dict[tuple[str, int], str] = {}
         outcomes_lock = threading.Lock()
         expected = sum(g.instances for g in job.groups)
         all_outcomes_in = threading.Event()
-        collector_stop = threading.Event()
+
+        from testground_tpu.sync.client import SyncClient
 
         def collect() -> None:
             topic = f"run:{job.run_id}:{RUN_EVENTS_TOPIC}"
             try:
-                for evt in sync_server.service.subscribe(
-                    topic, cancel=collector_stop
-                ):
+                for evt in collector_client.subscribe(topic):
                     with outcomes_lock:
                         key = (evt.get("group", ""), int(evt.get("instance", -1)))
                         outcomes[key] = evt.get("type", "")
                         if len(outcomes) >= expected:
                             all_outcomes_in.set()
-            except TimeoutError:
+            except (TimeoutError, RuntimeError, OSError):
                 pass
 
-        collector = threading.Thread(target=collect, daemon=True)
-        collector.start()
+        try:
+            collector_client = SyncClient(host, port)
+            collector = threading.Thread(target=collect, daemon=True)
+            collector.start()
+        except Exception:
+            # don't leak the just-started sync server (for the native
+            # backend that is a real child process holding a port)
+            sync_server.stop()
+            raise
 
         procs: list[tuple[str, int, subprocess.Popen]] = []
         start_sem = threading.Semaphore(START_CONCURRENCY)
@@ -239,7 +287,7 @@ class LocalExecRunner(Runner, HealthcheckedRunner):
             for _, _, proc in procs:
                 if proc.poll() is None:
                     proc.kill()
-            collector_stop.set()
+            collector_client.close()  # unblocks the collector's subscribe
             sync_server.stop()
 
         with outcomes_lock:

@@ -16,9 +16,13 @@ Ops: ``signal_entry(state)``, ``barrier(state, target)``,
 ``signal_and_wait(state, target)``, ``publish(topic, payload)``,
 ``subscribe(topic)``, ``counter(state)``.
 
-This Python server is the behavioral spec; its throughput comfortably
-covers the local:exec envelope (2-300 real processes, ``README.md:136-139``
-— the at-scale path is the on-device sync kernel, not this server).
+This Python server is the behavioral spec; a wire-compatible native C++
+event-loop implementation lives at ``testground_tpu/native/syncsvc.cc``
+and is what the local:exec runner boots by default when a toolchain is
+available (runner config ``sync_service``, default "auto"). Either
+comfortably covers the local:exec envelope (2-300 real processes,
+``README.md:136-139`` — the at-scale path is the on-device sync kernel,
+not these servers).
 """
 
 from __future__ import annotations

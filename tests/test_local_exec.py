@@ -39,11 +39,17 @@ def engine(tg_home):
     e.stop()
 
 
-def run_plan(engine, plan, case, instances=1, params=None, timeout=60):
+def run_plan(
+    engine, plan, case, instances=1, params=None, timeout=60, run_config=None
+):
     comp = generate_default_run(
         Composition(
             global_=Global(
-                plan=plan, case=case, builder="exec:py", runner="local:exec"
+                plan=plan,
+                case=case,
+                builder="exec:py",
+                runner="local:exec",
+                run_config=dict(run_config or {}),
             ),
             groups=[Group(id="all", instances=Instances(count=instances))],
         )
@@ -142,4 +148,39 @@ class TestExample:
 
     def test_artifact(self, engine):
         t = run_plan(engine, "example", "artifact")
+        assert t.outcome() == Outcome.SUCCESS
+
+
+class TestNativeSyncService:
+    """The C++ sync service behind a full local:exec run (the sdk-side
+    barrier/signal protocol of plans/example sync over the native
+    server)."""
+
+    def test_sync_plan_over_native_server(self, engine):
+        from testground_tpu.native import native_available
+
+        if not native_available():
+            import pytest
+
+            pytest.skip("no C++ toolchain")
+        t = run_plan(
+            engine,
+            "example",
+            "sync",
+            instances=4,
+            timeout=90,
+            run_config={"sync_service": "native"},
+        )
+        assert t.outcome() == Outcome.SUCCESS
+        assert t.result["outcomes"]["all"] == {"ok": 4, "total": 4}
+
+    def test_python_backend_still_selectable(self, engine):
+        t = run_plan(
+            engine,
+            "example",
+            "sync",
+            instances=3,
+            timeout=90,
+            run_config={"sync_service": "python"},
+        )
         assert t.outcome() == Outcome.SUCCESS

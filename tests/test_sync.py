@@ -66,11 +66,28 @@ class TestInMem:
 
 
 class TestTCP:
-    @pytest.fixture()
-    def server(self):
-        srv = SyncServiceServer().start()
-        yield srv
-        srv.stop()
+    """Protocol conformance, run against BOTH wire-compatible servers:
+    the in-process Python one and the native C++ event-loop server
+    (testground_tpu/native/syncsvc.cc)."""
+
+    @pytest.fixture(params=["python", "native"])
+    def server(self, request, tmp_path):
+        if request.param == "native":
+            from testground_tpu.native import (
+                NativeSyncService,
+                build_syncsvc,
+                native_available,
+            )
+
+            if not native_available():
+                pytest.skip("no C++ toolchain")
+            srv = NativeSyncService(build_syncsvc(str(tmp_path / "bin")))
+            yield srv
+            srv.stop()
+        else:
+            srv = SyncServiceServer().start()
+            yield srv
+            srv.stop()
 
     def test_client_roundtrip(self, server):
         host, port = server.address
