@@ -1,0 +1,4 @@
+#!/bin/sh
+# exec:bin build hook (the Dockerfile analog): produce ./run
+set -e
+g++ -O2 -std=c++17 -o run main.cc

@@ -1,0 +1,164 @@
+// example-cpp — a test plan in C++, no SDK bindings.
+//
+// The compiled-language twin of the reference's plans/example-rust: the
+// platform's multi-language property is the instance PROTOCOL, which this
+// plan speaks directly —
+//   - RunParams from TEST_* environment variables,
+//   - lifecycle events as JSON lines on stdout
+//     (testground_tpu/sdk/events.py envelope),
+//   - coordination via the sync service's newline-JSON TCP protocol
+//     (testground_tpu/sync/server.py), keys namespaced "run:<id>:",
+//   - the runner's outcome collector fed by publishing the lifecycle
+//     event to the run-events topic (sdk/runenv.py _publish_event).
+//
+// Testcase "sync": leader/follower release — the plans/example sync
+// protocol (first "enrolled" signaller leads; it waits for all followers
+// on "ready", then signals "released").
+
+#include <arpa/inet.h>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+std::string getenv_or(const char* k, const char* dflt) {
+  const char* v = getenv(k);
+  return v ? v : dflt;
+}
+
+long long now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+void emit(const std::string& event_json) {
+  printf("{\"ts\": %lld, \"event\": %s}\n", now_ns(), event_json.c_str());
+  fflush(stdout);
+}
+
+void emit_message(const std::string& msg) {
+  emit("{\"type\": \"message\", \"message\": \"" + msg + "\"}");
+}
+
+// One-outstanding-request sync client over the JSON-lines protocol.
+class Sync {
+ public:
+  Sync(const std::string& host, int port, std::string ns)
+      : ns_(std::move(ns)) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+    if (connect(fd_, (sockaddr*)&addr, sizeof addr) != 0) {
+      perror("sync connect");
+      exit(1);
+    }
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  }
+
+  long signal_entry(const std::string& state) {
+    return call_long("{\"id\": " + next_id() +
+                         ", \"op\": \"signal_entry\", \"state\": \"" + ns_ +
+                         state + "\"}",
+                     "\"seq\":");
+  }
+
+  void barrier(const std::string& state, long target) {
+    call_long("{\"id\": " + next_id() + ", \"op\": \"barrier\", \"state\": \"" +
+                  ns_ + state + "\", \"target\": " + std::to_string(target) +
+                  "}",
+              "\"ok\":");
+  }
+
+  // payload is raw JSON, topic is namespaced by the caller when needed
+  long publish_raw(const std::string& topic, const std::string& payload) {
+    return call_long("{\"id\": " + next_id() +
+                         ", \"op\": \"publish\", \"topic\": \"" + topic +
+                         "\", \"payload\": " + payload + "}",
+                     "\"seq\":");
+  }
+
+  const std::string& ns() const { return ns_; }
+
+ private:
+  std::string next_id() { return std::to_string(++id_); }
+
+  // Send one request; read reply lines until the one for this id; return
+  // the number after `field` (or 1 for bare "true").
+  long call_long(const std::string& req, const std::string& field) {
+    std::string data = req + "\n";
+    if (send(fd_, data.data(), data.size(), MSG_NOSIGNAL) < 0) exit(1);
+    std::string id_pat = "\"id\": " + std::to_string(id_);
+    for (;;) {
+      size_t nl;
+      while ((nl = rbuf_.find('\n')) == std::string::npos) {
+        char chunk[4096];
+        ssize_t n = recv(fd_, chunk, sizeof chunk, 0);
+        if (n <= 0) exit(1);
+        rbuf_.append(chunk, (size_t)n);
+      }
+      std::string line = rbuf_.substr(0, nl);
+      rbuf_.erase(0, nl + 1);
+      if (line.find(id_pat) == std::string::npos) continue;
+      if (line.find("\"error\"") != std::string::npos) {
+        fprintf(stderr, "sync error: %s\n", line.c_str());
+        exit(1);
+      }
+      size_t at = line.find(field);
+      if (at == std::string::npos) return 1;
+      return strtol(line.c_str() + at + field.size(), nullptr, 10);
+    }
+  }
+
+  int fd_;
+  long id_ = 0;
+  std::string ns_;
+  std::string rbuf_;
+};
+
+}  // namespace
+
+int main() {
+  std::string run = getenv_or("TEST_RUN", "");
+  std::string group = getenv_or("TEST_GROUP_ID", "");
+  long count = atol(getenv_or("TEST_INSTANCE_COUNT", "0").c_str());
+  long seq_no = atol(getenv_or("TEST_INSTANCE_SEQ", "0").c_str());
+  std::string host = getenv_or("SYNC_SERVICE_HOST", "127.0.0.1");
+  int port = atoi(getenv_or("SYNC_SERVICE_PORT", "0").c_str());
+
+  emit_message("hello from a C++ test instance");
+
+  Sync sync(host, port, "run:" + run + ":");
+  long seq = sync.signal_entry("enrolled");
+  emit_message("my sequence ID: " + std::to_string(seq));
+
+  if (seq == 1) {
+    emit_message("i'm the leader.");
+    sync.barrier("ready", count - 1);
+    emit_message("the followers are all ready");
+    sync.signal_entry("released");
+  } else {
+    emit_message("i'm a follower; signalling ready");
+    sync.signal_entry("ready");
+    sync.barrier("released", 1);
+    emit_message("i have been released");
+  }
+
+  // lifecycle: stdout event + run-events topic for the outcome collector
+  sync.publish_raw(sync.ns() + "__run_events__",
+                   "{\"type\": \"success\", \"group\": \"" + group +
+                       "\", \"instance\": " + std::to_string(seq_no) +
+                       ", \"error\": \"\"}");
+  emit("{\"type\": \"success\"}");
+  return 0;
+}

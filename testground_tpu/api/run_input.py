@@ -28,6 +28,10 @@ class RunGroup:
     id: str
     instances: int
     artifact_path: str = ""
+    # builder that produced the artifact — runners dispatch execution on
+    # this (e.g. exec:py → interpreter, exec:bin → direct exec), never on
+    # filename conventions
+    builder: str = ""
     parameters: dict[str, str] = field(default_factory=dict)
     profiles: dict[str, str] = field(default_factory=dict)
     resources: Resources = field(default_factory=Resources)
@@ -37,6 +41,7 @@ class RunGroup:
             "id": self.id,
             "instances": self.instances,
             "artifact_path": self.artifact_path,
+            "builder": self.builder,
             "parameters": dict(self.parameters),
             "profiles": dict(self.profiles),
             "resources": self.resources.to_dict(),
@@ -48,6 +53,7 @@ class RunGroup:
             id=d["id"],
             instances=int(d["instances"]),
             artifact_path=d.get("artifact_path", ""),
+            builder=d.get("builder", ""),
             parameters=dict(d.get("parameters", {})),
             profiles=dict(d.get("profiles", {})),
             resources=Resources.from_dict(d.get("resources", {})),

@@ -3,6 +3,9 @@
 Builders registered here (mirroring ``pkg/engine/engine.go:25-30``):
 - ``exec:py`` — resolves a Python plan source dir into a runnable module
   (the analog of ``exec:go``'s host executable).
+- ``exec:bin`` — any-language plans: runs the plan's ``build.sh`` and
+  ships its ``run`` executable (the ``docker:generic`` analog behind the
+  Rust/JS plans).
 - ``sim:plan`` — resolves a plan's sim program for the ``sim:jax`` runner.
 """
 

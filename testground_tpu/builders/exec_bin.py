@@ -1,0 +1,87 @@
+"""``exec:bin`` builder: plans in ANY compiled/interpreted language.
+
+The analog of the reference's ``docker:generic`` (``pkg/build/
+docker_generic.go:34-100``: build the plan's own Dockerfile — used by the
+Rust/JS plans): the multi-language property of the platform is delivered
+by the instance PROTOCOL (TEST_* env vars + JSON event lines on stdout +
+the sync service's TCP wire protocol), not by language SDK bindings. This
+builder snapshots the plan sources, runs the plan's own ``build.sh`` when
+present (the Dockerfile analog), and requires an executable ``run`` entry
+point as the artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat
+import subprocess
+import threading
+
+from testground_tpu.api import BuildInput, BuildOutput
+from testground_tpu.rpc import OutputWriter
+
+from .base import Builder
+
+__all__ = ["ExecBinBuilder"]
+
+BUILD_TIMEOUT_SECS = 600
+
+
+class ExecBinBuilder(Builder):
+    def id(self) -> str:
+        return "exec:bin"
+
+    def build(
+        self, inp: BuildInput, ow: OutputWriter, cancel: threading.Event
+    ) -> BuildOutput:
+        src = inp.unpacked_plan_dir
+        if not src or not os.path.isdir(src):
+            raise ValueError(f"plan sources not found: {src!r}")
+
+        work = inp.env.dirs.work()
+        dest = os.path.join(work, f"exec-bin--{inp.test_plan}-{inp.build_id}")
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        shutil.copytree(
+            src,
+            dest,
+            ignore=shutil.ignore_patterns(
+                "__pycache__", "*.pyc", ".git", "_compositions"
+            ),
+        )
+
+        build_script = os.path.join(dest, "build.sh")
+        if os.path.isfile(build_script):
+            ow.infof("exec:bin: running %s", build_script)
+            proc = subprocess.run(
+                ["/bin/sh", build_script],
+                cwd=dest,
+                capture_output=True,
+                text=True,
+                timeout=BUILD_TIMEOUT_SECS,
+            )
+            if proc.stdout.strip():
+                ow.infof("build.sh stdout:\n%s", proc.stdout.strip())
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"build.sh failed (exit {proc.returncode}):\n"
+                    f"{proc.stderr.strip()}"
+                )
+
+        artifact = os.path.join(dest, "run")
+        if not os.path.isfile(artifact):
+            raise ValueError(
+                f"plan has no `run` entry point after build: {dest} "
+                "(exec:bin plans must ship or build an executable named "
+                "`run`)"
+            )
+        os.chmod(
+            artifact,
+            os.stat(artifact).st_mode | stat.S_IXUSR | stat.S_IXGRP,
+        )
+        ow.infof("exec:bin built %s -> %s", inp.test_plan, artifact)
+        return BuildOutput(builder_id=self.id(), artifact_path=artifact)
+
+    def purge(self, testplan: str, ow: OutputWriter) -> None:
+        ow.infof("exec:bin purge: artifacts are removed with the work dir")

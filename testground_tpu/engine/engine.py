@@ -68,6 +68,7 @@ class Engine:
     def new_default(cls, env: EnvConfig | None = None) -> "Engine":
         """Default engine with all first-party builders/runners registered
         (``engine.go:127-160`` NewDefaultEngine)."""
+        from testground_tpu.builders.exec_bin import ExecBinBuilder
         from testground_tpu.builders.exec_py import ExecPyBuilder
         from testground_tpu.builders.sim_plan import SimPlanBuilder
         from testground_tpu.runners.local_exec import LocalExecRunner
@@ -77,7 +78,7 @@ class Engine:
         return cls(
             EngineConfig(
                 env=env,
-                builders=[ExecPyBuilder(), SimPlanBuilder()],
+                builders=[ExecPyBuilder(), ExecBinBuilder(), SimPlanBuilder()],
                 runners=[LocalExecRunner(), SimJaxRunner()],
             )
         )

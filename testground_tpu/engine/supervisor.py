@@ -251,6 +251,7 @@ def do_run(
                     id=rg.id,
                     instances=rg.calculated_instance_count,
                     artifact_path=artifacts_by_group[backing.id],
+                    builder=backing.builder or comp.global_.builder,
                     parameters=dict(rg.test_params),
                     profiles=dict(rg.profiles),
                     resources=rg.resources,

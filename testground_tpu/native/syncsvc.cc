@@ -211,7 +211,12 @@ double field_double(const std::string& line, const std::string& key,
 struct Conn {
   int fd;
   std::string rbuf;
+  std::string wbuf;  // unsent reply bytes; drained on POLLOUT
 };
+
+// A reply backlog beyond this marks the client dead (it stopped reading);
+// dropping it beats stalling the loop for everyone else.
+constexpr size_t kMaxWbuf = 16 << 20;
 
 struct Waiter {           // a parked barrier / signal_and_wait
   int fd;
@@ -238,14 +243,35 @@ std::unordered_map<std::string, long> counters;
 std::vector<Waiter> waiters;
 std::unordered_map<std::string, Topic> topics;
 
-void send_line(int fd, const std::string& line) {
-  std::string data = line + "\n";
-  size_t off = 0;
-  while (off < data.size()) {
-    ssize_t n = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
-    if (n <= 0) return;  // peer gone; conn reaped on next poll
-    off += (size_t)n;
+std::vector<int> dead_conns;  // drop after the current dispatch completes
+
+// Try to drain a connection's write buffer; non-blocking, never stalls
+// the event loop (one wedged reader must not freeze every barrier).
+void flush_wbuf(Conn& c) {
+  while (!c.wbuf.empty()) {
+    ssize_t n = send(c.fd, c.wbuf.data(), c.wbuf.size(),
+                     MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (n > 0) {
+      c.wbuf.erase(0, (size_t)n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    dead_conns.push_back(c.fd);  // peer gone
+    return;
   }
+}
+
+void send_line(int fd, const std::string& line) {
+  auto it = conns.find(fd);
+  if (it == conns.end()) return;
+  Conn& c = it->second;
+  c.wbuf += line;
+  c.wbuf += '\n';
+  if (c.wbuf.size() > kMaxWbuf) {
+    dead_conns.push_back(fd);
+    return;
+  }
+  flush_wbuf(c);
 }
 
 void reply_err(int fd, long id, const std::string& msg) {
@@ -288,6 +314,8 @@ void flush_subs(const std::string& topic_name) {
   }
 }
 
+void expire_waiters();  // defined below; used for zero-timeout barriers
+
 void handle_line(int fd, const std::string& line) {
   long id = field_long(line, "id", -1);
   std::string op = json_unescape(find_field(line, "op"));
@@ -310,13 +338,16 @@ void handle_line(int fd, const std::string& line) {
   } else if (op == "barrier" || op == "signal_and_wait") {
     std::string state = json_unescape(find_field(line, "state"));
     long target = field_long(line, "target", 0);
-    double timeout = field_double(line, "timeout", 0.0);
+    // absent/null timeout = wait forever; an EXPLICIT 0 is an immediate
+    // non-blocking check (the Python spec server's wait_for(timeout=0))
+    double timeout = field_double(line, "timeout", -1.0);
     long seq = -1;
     if (op == "signal_and_wait") seq = ++counters[state];
     Waiter w{fd, id, state, target, seq,
-             timeout > 0 ? now_secs() + timeout : 0.0};
+             timeout >= 0 ? now_secs() + timeout : 0.0};
     waiters.push_back(w);
     flush_waiters(state);  // may satisfy immediately (incl. this one)
+    if (timeout == 0.0) expire_waiters();  // unmet zero-timeout fails now
   } else if (op == "publish") {
     std::string topic = json_unescape(find_field(line, "topic"));
     std::string payload = find_field(line, "payload");
@@ -409,7 +440,10 @@ int main(int argc, char** argv) {
   while (!stop_flag) {
     pfds.clear();
     pfds.push_back({lfd, POLLIN, 0});
-    for (auto& kv : conns) pfds.push_back({kv.first, POLLIN, 0});
+    for (auto& kv : conns)
+      pfds.push_back(
+          {kv.first,
+           (short)(POLLIN | (kv.second.wbuf.empty() ? 0 : POLLOUT)), 0});
 
     // poll timeout tracks the nearest barrier deadline
     int tmo = -1;
@@ -427,6 +461,10 @@ int main(int argc, char** argv) {
     }
     expire_waiters();
     for (const pollfd& p : pfds) {
+      if (p.fd != lfd && (p.revents & POLLOUT)) {
+        auto it = conns.find(p.fd);
+        if (it != conns.end()) flush_wbuf(it->second);
+      }
       if (!(p.revents & (POLLIN | POLLHUP | POLLERR))) continue;
       if (p.fd == lfd) {
         int cfd = accept(lfd, nullptr, nullptr);
@@ -454,6 +492,10 @@ int main(int argc, char** argv) {
       }
       if (conns.find(p.fd) != conns.end()) b.erase(0, start);
     }
+    // reap connections whose peer vanished or stopped reading
+    for (int fd : dead_conns)
+      if (conns.count(fd)) drop_conn(fd);
+    dead_conns.clear();
   }
   for (auto& kv : conns) close(kv.first);
   close(lfd);

@@ -16,6 +16,7 @@ import os
 import shutil
 import signal
 import subprocess
+import uuid
 
 from testground_tpu.logging_ import S
 
@@ -38,7 +39,9 @@ def build_syncsvc(bin_dir: str) -> str:
     out = os.path.join(bin_dir, f"tg-syncsvc-{digest}")
     if os.path.isfile(out):
         return out
-    tmp = f"{out}.tmp.{os.getpid()}"  # unique per builder: no write races
+    # unique per builder — including threads within one engine process
+    # (DEFAULT_WORKERS=2 can race here on a cold cache)
+    tmp = f"{out}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
     subprocess.run(
         ["g++", "-O2", "-std=c++17", "-o", tmp, _SRC],
         check=True,

@@ -52,7 +52,10 @@ class LocalExecRunner(Runner, HealthcheckedRunner):
         return "local:exec"
 
     def compatible_builders(self) -> list[str]:
-        return ["exec:py"]  # local_exec.go:197 (exec:go in the reference)
+        # local_exec.go:197 (exec:go in the reference); exec:bin is the
+        # any-language path — the instance protocol, not a Python SDK,
+        # is the contract
+        return ["exec:py", "exec:bin"]
 
     def config_type(self) -> type:
         return LocalExecConfig
@@ -240,9 +243,17 @@ class LocalExecRunner(Runner, HealthcheckedRunner):
                     with start_sem:
                         if cancel.is_set():
                             raise RuntimeError("run canceled during start")
+                        # dispatch on the builder that made the artifact:
+                        # exec:bin artifacts exec directly, everything
+                        # else runs through this interpreter
+                        cmd = (
+                            [g.artifact_path]
+                            if g.builder == "exec:bin"
+                            else [sys.executable, g.artifact_path]
+                        )
                         try:
                             proc = subprocess.Popen(
-                                [sys.executable, g.artifact_path],
+                                cmd,
                                 env=env,
                                 cwd=os.path.dirname(g.artifact_path),
                                 stdout=subprocess.PIPE,

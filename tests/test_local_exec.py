@@ -160,8 +160,6 @@ class TestNativeSyncService:
         from testground_tpu.native import native_available
 
         if not native_available():
-            import pytest
-
             pytest.skip("no C++ toolchain")
         t = run_plan(
             engine,
@@ -184,3 +182,64 @@ class TestNativeSyncService:
             run_config={"sync_service": "python"},
         )
         assert t.outcome() == Outcome.SUCCESS
+
+
+class TestExecBinCppPlan:
+    """A plan written in C++ with NO SDK bindings: exec:bin builds it via
+    its build.sh and the instances speak the raw protocol (TEST_* env,
+    stdout events, sync TCP) — the sdk-rust/js analog (reference
+    plans/example-rust, integration_tests/example_01)."""
+
+    @pytest.fixture()
+    def bin_engine(self, tg_home):
+        from testground_tpu.builders.exec_bin import ExecBinBuilder
+
+        env = EnvConfig.load()
+        e = Engine(
+            EngineConfig(
+                env=env,
+                builders=[ExecBinBuilder()],
+                runners=[LocalExecRunner()],
+            )
+        )
+        e.start_workers()
+        yield e
+        e.stop()
+
+    def test_cpp_sync_plan_end_to_end(self, bin_engine):
+        from testground_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        comp = generate_default_run(
+            Composition(
+                global_=Global(
+                    plan="example-cpp",
+                    case="sync",
+                    builder="exec:bin",
+                    runner="local:exec",
+                ),
+                groups=[Group(id="all", instances=Instances(count=3))],
+            )
+        )
+        manifest = TestPlanManifest.load_file(
+            os.path.join(PLANS, "example-cpp", "manifest.toml")
+        )
+        tid = bin_engine.queue_run(
+            comp, manifest, sources_dir=os.path.join(PLANS, "example-cpp")
+        )
+        deadline = time.time() + 120
+        t = None
+        while time.time() < deadline:
+            t = bin_engine.get_task(tid)
+            if t is not None and t.state().state in (
+                State.COMPLETE,
+                State.CANCELED,
+            ):
+                break
+            time.sleep(0.1)
+        assert t is not None and t.state().state == State.COMPLETE, (
+            t and t.error
+        )
+        assert t.outcome() == Outcome.SUCCESS, t.error
+        assert t.result["outcomes"]["all"] == {"ok": 3, "total": 3}

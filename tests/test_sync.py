@@ -70,8 +70,14 @@ class TestTCP:
     the in-process Python one and the native C++ event-loop server
     (testground_tpu/native/syncsvc.cc)."""
 
+    @pytest.fixture(scope="session")
+    def native_bin_dir(self, tmp_path_factory):
+        # one compile per test session: build_syncsvc caches by source
+        # digest inside this dir
+        return str(tmp_path_factory.mktemp("syncsvc-bin"))
+
     @pytest.fixture(params=["python", "native"])
-    def server(self, request, tmp_path):
+    def server(self, request, native_bin_dir):
         if request.param == "native":
             from testground_tpu.native import (
                 NativeSyncService,
@@ -81,7 +87,7 @@ class TestTCP:
 
             if not native_available():
                 pytest.skip("no C++ toolchain")
-            srv = NativeSyncService(build_syncsvc(str(tmp_path / "bin")))
+            srv = NativeSyncService(build_syncsvc(native_bin_dir))
             yield srv
             srv.stop()
         else:
