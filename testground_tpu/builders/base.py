@@ -3,12 +3,30 @@
 from __future__ import annotations
 
 import abc
+import os
+import shutil
 import threading
 
 from testground_tpu.api import BuildInput, BuildOutput
 from testground_tpu.rpc import OutputWriter
 
-__all__ = ["Builder"]
+__all__ = ["Builder", "snapshot_plan_sources"]
+
+# Paths never copied into a build snapshot (caches, VCS, fixtures).
+_SNAPSHOT_IGNORE = ("__pycache__", "*.pyc", ".git", "_compositions")
+
+
+def snapshot_plan_sources(src: str | None, dest: str) -> None:
+    """Copy plan sources into an immutable build snapshot at ``dest``
+    (replacing any previous snapshot), so later source edits don't mutate
+    queued runs. Shared by the exec:* builders."""
+    if not src or not os.path.isdir(src):
+        raise ValueError(f"plan sources not found: {src!r}")
+    if os.path.exists(dest):
+        shutil.rmtree(dest)
+    shutil.copytree(
+        src, dest, ignore=shutil.ignore_patterns(*_SNAPSHOT_IGNORE)
+    )
 
 
 class Builder(abc.ABC):

@@ -13,15 +13,15 @@ point as the artifact.
 from __future__ import annotations
 
 import os
-import shutil
 import stat
 import subprocess
 import threading
+import time
 
 from testground_tpu.api import BuildInput, BuildOutput
 from testground_tpu.rpc import OutputWriter
 
-from .base import Builder
+from .base import Builder, snapshot_plan_sources
 
 __all__ = ["ExecBinBuilder"]
 
@@ -36,38 +36,45 @@ class ExecBinBuilder(Builder):
         self, inp: BuildInput, ow: OutputWriter, cancel: threading.Event
     ) -> BuildOutput:
         src = inp.unpacked_plan_dir
-        if not src or not os.path.isdir(src):
-            raise ValueError(f"plan sources not found: {src!r}")
-
         work = inp.env.dirs.work()
         dest = os.path.join(work, f"exec-bin--{inp.test_plan}-{inp.build_id}")
-        if os.path.exists(dest):
-            shutil.rmtree(dest)
-        shutil.copytree(
-            src,
-            dest,
-            ignore=shutil.ignore_patterns(
-                "__pycache__", "*.pyc", ".git", "_compositions"
-            ),
-        )
+        snapshot_plan_sources(src, dest)
 
         build_script = os.path.join(dest, "build.sh")
         if os.path.isfile(build_script):
             ow.infof("exec:bin: running %s", build_script)
-            proc = subprocess.run(
+            # Popen + poll so a task kill interrupts a long compile instead
+            # of holding the engine worker until the timeout.
+            with subprocess.Popen(
                 ["/bin/sh", build_script],
                 cwd=dest,
-                capture_output=True,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
                 text=True,
-                timeout=BUILD_TIMEOUT_SECS,
-            )
-            if proc.stdout.strip():
-                ow.infof("build.sh stdout:\n%s", proc.stdout.strip())
+            ) as proc:
+                deadline = time.monotonic() + BUILD_TIMEOUT_SECS
+                while True:
+                    try:
+                        out, err = proc.communicate(timeout=0.5)
+                        break
+                    except subprocess.TimeoutExpired:
+                        if cancel.is_set() or time.monotonic() > deadline:
+                            proc.kill()
+                            out, err = proc.communicate()
+                            if cancel.is_set():
+                                raise RuntimeError("build canceled")
+                            raise subprocess.TimeoutExpired(
+                                build_script, BUILD_TIMEOUT_SECS, out, err
+                            )
+            if out.strip():
+                ow.infof("build.sh stdout:\n%s", out.strip())
             if proc.returncode != 0:
                 raise RuntimeError(
                     f"build.sh failed (exit {proc.returncode}):\n"
-                    f"{proc.stderr.strip()}"
+                    f"{err.strip()}"
                 )
+            if err.strip():  # surface compiler warnings on success too
+                ow.infof("build.sh stderr:\n%s", err.strip())
 
         artifact = os.path.join(dest, "run")
         if not os.path.isfile(artifact):

@@ -14,13 +14,12 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 import threading
 
 from testground_tpu.api import BuildInput, BuildOutput
 from testground_tpu.rpc import OutputWriter
 
-from .base import Builder
+from .base import Builder, snapshot_plan_sources
 
 __all__ = ["ExecPyBuilder"]
 
@@ -35,21 +34,12 @@ class ExecPyBuilder(Builder):
         src = inp.unpacked_plan_dir
         if not src or not os.path.isdir(src):
             raise ValueError(f"plan sources not found: {src!r}")
-        entry = os.path.join(src, "main.py")
-        if not os.path.isfile(entry):
+        if not os.path.isfile(os.path.join(src, "main.py")):
             raise ValueError(f"plan has no main.py entry point: {src}")
 
         work = inp.env.dirs.work()
         dest = os.path.join(work, f"exec-py--{inp.test_plan}-{inp.build_id}")
-        if os.path.exists(dest):
-            shutil.rmtree(dest)
-        shutil.copytree(
-            src,
-            dest,
-            ignore=shutil.ignore_patterns(
-                "__pycache__", "*.pyc", ".git", "_compositions"
-            ),
-        )
+        snapshot_plan_sources(src, dest)
 
         deps = {mod: {"target": t, "version": v} for mod, (t, v) in
                 inp.dependencies.items()}

@@ -176,7 +176,6 @@ class SimProgram:
                 valid=wsc(carry.cal.valid, self._ishard(1))
                 if carry.cal.valid is not None
                 else None,
-                occ=wsc(carry.cal.occ, self._ishard(1)),
                 slots=carry.cal.slots,
             ),
             link=LinkState(
