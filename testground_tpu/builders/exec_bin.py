@@ -13,6 +13,7 @@ point as the artifact.
 from __future__ import annotations
 
 import os
+import signal
 import stat
 import subprocess
 import threading
@@ -44,13 +45,17 @@ class ExecBinBuilder(Builder):
         if os.path.isfile(build_script):
             ow.infof("exec:bin: running %s", build_script)
             # Popen + poll so a task kill interrupts a long compile instead
-            # of holding the engine worker until the timeout.
+            # of holding the engine worker until the timeout. The script
+            # runs in its own session so the kill reaches the compilers it
+            # forked, not just the /bin/sh wrapper (whose orphans would
+            # otherwise hold the pipes open and block communicate()).
             with subprocess.Popen(
                 ["/bin/sh", build_script],
                 cwd=dest,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
                 text=True,
+                start_new_session=True,
             ) as proc:
                 deadline = time.monotonic() + BUILD_TIMEOUT_SECS
                 while True:
@@ -59,7 +64,10 @@ class ExecBinBuilder(Builder):
                         break
                     except subprocess.TimeoutExpired:
                         if cancel.is_set() or time.monotonic() > deadline:
-                            proc.kill()
+                            try:
+                                os.killpg(proc.pid, signal.SIGKILL)
+                            except ProcessLookupError:
+                                pass
                             out, err = proc.communicate()
                             if cancel.is_set():
                                 raise RuntimeError("build canceled")

@@ -171,6 +171,12 @@ class Client:
     def kill(self, task_id: str) -> bool:
         return bool(self._post_json("/kill", {"task_id": task_id})["killed"])
 
+    def delete(self, task_id: str) -> bool:
+        """Delete a finished task's record + log (``daemon.go:88``)."""
+        return bool(
+            self._post_json("/delete", {"task_id": task_id})["deleted"]
+        )
+
     def build_purge(self, builder: str, testplan: str = "") -> str:
         return self._post_json(
             "/build/purge", {"builder": builder, "testplan": testplan}
@@ -256,6 +262,9 @@ class RemoteEngine:
 
     def kill(self, task_id: str) -> bool:
         return self.client.kill(task_id)
+
+    def delete_task(self, task_id: str) -> bool:
+        return self.client.delete(task_id)
 
     def stop(self) -> None:  # no engine owned client-side
         pass

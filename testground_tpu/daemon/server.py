@@ -149,6 +149,7 @@ class _Handler(BaseHTTPRequestHandler):
             "/terminate": self._terminate,
             "/healthcheck": self._healthcheck,
             "/kill": self._kill,
+            "/delete": self._delete,
             "/build/purge": self._build_purge,
         }
         try:
@@ -288,6 +289,14 @@ class _Handler(BaseHTTPRequestHandler):
     def _kill(self, body: dict) -> None:
         ok = self.engine.kill(body["task_id"])
         self._send_json({"killed": bool(ok)})
+
+    def _delete(self, body: dict) -> None:
+        """Delete a finished task's record + log (``daemon.go:88``)."""
+        try:
+            ok = self.engine.delete_task(body["task_id"])
+        except ValueError as e:  # task still live
+            return self._send_error_json(str(e), 409)
+        self._send_json({"deleted": bool(ok)})
 
     def _build_purge(self, body: dict) -> None:
         buf = io.StringIO()

@@ -225,6 +225,26 @@ class Engine:
             return True
         return False
 
+    def delete_task(self, task_id: str) -> bool:
+        """Delete a FINISHED task's record + log file (the daemon's GET
+        ``/delete`` surface, ``pkg/daemon/daemon.go:88``). Live tasks must
+        be killed first — deleting a record out from under a worker would
+        orphan its cancel channel."""
+        tsk = self.storage.get(task_id)
+        if tsk is None:
+            return False
+        if tsk.state().state not in (State.COMPLETE, State.CANCELED):
+            raise ValueError(
+                f"task {task_id} is {tsk.state().state.value}; kill it "
+                "before deleting"
+            )
+        deleted = self.storage.delete(task_id)
+        try:
+            os.unlink(self.task_log_path(task_id))
+        except FileNotFoundError:
+            pass
+        return deleted
+
     # ------------------------------------------------------------------ info
 
     def get_task(self, task_id: str) -> Task | None:

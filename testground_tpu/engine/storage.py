@@ -150,6 +150,17 @@ class TaskStorage:
             out = out[:limit]
         return out
 
+    def delete(self, task_id: str) -> bool:
+        """Remove a task's records from every bucket (the reference daemon's
+        GET ``/delete`` surface, ``pkg/daemon/daemon.go:88``). Returns True
+        if anything was deleted."""
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM tasks WHERE id = ?", (task_id,)
+            )
+            self._db.commit()
+            return cur.rowcount > 0
+
     # ------------------------------------------------------------- recovery
 
     def recover_processing(self) -> list[Task]:

@@ -100,6 +100,24 @@ class TestDaemonEndToEnd:
         with pytest.raises(DaemonError):
             client.status("missing-task")
 
+    def test_delete_task(self, client):
+        """GET /delete parity (``daemon.go:88``): a finished task's record
+        and log are removed; a live/unknown task is refused/false."""
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        # a live (stalling) task is refused with a 409 until killed
+        live_id = client.run(_placebo_composition(case="stall"))
+        with pytest.raises(DaemonError, match="kill it before deleting"):
+            client.delete(live_id)
+        client.kill(live_id)
+        _wait(client, live_id)
+
+        task_id = client.run(_placebo_composition())
+        _wait(client, task_id)
+        assert client.delete(task_id) is True
+        with pytest.raises(DaemonError):  # record gone
+            client.status(task_id)
+        assert client.delete(task_id) is False  # idempotent-ish: now unknown
+
     def test_logs_unknown_task_is_clean_404(self, client):
         """The daemon must reject an unknown task id BEFORE starting the
         chunked stream, as a single well-formed error response."""
