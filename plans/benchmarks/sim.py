@@ -507,12 +507,38 @@ class Storm(SimTestcase):
 
     STATES = ["listening", "dials-done", "done-writing"]
     MSG_WIDTH = 2  # word0: kind, word1: chunk seq
-    OUT_MSGS = 8  # upper bound on conn_outgoing
-    IN_MSGS = 16  # covers the Poisson(K≤8) per-tick fan-in tail
+    OUT_MSGS = 8  # upper bound on conn_outgoing (narrowed per run below)
+    IN_MSGS = 16  # covers the Poisson(K) per-tick fan-in tail
     MAX_LINK_TICKS = 8
     TRACK_SRC = False
     SHAPING = ("latency",)
+    # every link rides the uniform DEFAULT_LINK latency and is never
+    # reshaped, so a calendar bucket only ever fills from one send tick —
+    # the transport may skip cross-tick fill tracking (api.py contract)
+    CROSS_TICK_STACKING = False
     CHUNK_BYTES = 4096  # storm.go buffersize
+
+    @classmethod
+    def specialize(cls, groups):
+        """Size the message axis to the run's actual fan-out instead of
+        the manifest upper bound: OUT_MSGS = max conn_outgoing over
+        groups. At 100k instances this cuts the per-tick sort + scatter
+        index count by OUT_MSGS/8. IN_MSGS stays at the static bound —
+        receiver in-degree is Poisson(k) over the whole run (fixed at
+        dial time, every live connection floods every tick), so the
+        inbox tail must NOT shrink with k or the ~1% of receivers with
+        in-degree > 2k would overflow every flooding tick."""
+        k = max(
+            (
+                int(g.params.get("conn_outgoing", 5))
+                for g in groups
+            ),
+            default=5,
+        )
+        k = max(1, min(k, cls.OUT_MSGS))
+        if k == cls.OUT_MSGS:
+            return cls
+        return type(f"{cls.__name__}_k{k}", (cls,), {"OUT_MSGS": k})
 
     def init(self, env):
         cls = type(self)

@@ -32,11 +32,15 @@ class ExecPyBuilder(Builder):
         self, inp: BuildInput, ow: OutputWriter, cancel: threading.Event
     ) -> BuildOutput:
         src = inp.unpacked_plan_dir
+        # entry-point check BEFORE snapshotting so a bad plan doesn't
+        # leave an orphaned snapshot dir per failed build attempt
+        if src and os.path.isdir(src) and not os.path.isfile(
+            os.path.join(src, "main.py")
+        ):
+            raise ValueError(f"plan has no main.py entry point: {src}")
         work = inp.env.dirs.work()
         dest = os.path.join(work, f"exec-py--{inp.test_plan}-{inp.build_id}")
         snapshot_plan_sources(src, dest)
-        if not os.path.isfile(os.path.join(dest, "main.py")):
-            raise ValueError(f"plan has no main.py entry point: {src}")
 
         deps = {mod: {"target": t, "version": v} for mod, (t, v) in
                 inp.dependencies.items()}

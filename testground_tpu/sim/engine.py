@@ -124,6 +124,21 @@ class SimProgram:
         # sliced out of results.
         self.hosts = tuple(hosts)
         self.n_lanes = self.n + len(self.hosts)
+        if not cls.CROSS_TICK_STACKING:
+            # statically-detectable violations of the single-send-tick
+            # bucket contract (see SimTestcase.CROSS_TICK_STACKING)
+            if "duplicate" in cls.SHAPING:
+                raise ValueError(
+                    "CROSS_TICK_STACKING=False is incompatible with "
+                    "duplicate shaping (second copies land one tick later "
+                    "in the same region of the calendar)"
+                )
+            if hosts:
+                raise ValueError(
+                    "CROSS_TICK_STACKING=False is incompatible with "
+                    "additional_hosts (control lanes ride the 1-tick floor "
+                    "while plan traffic rides the shaped latency)"
+                )
         if self.hosts:
             if not cls.TRACK_SRC:
                 raise ValueError(
@@ -414,6 +429,7 @@ class SimProgram:
             slot_mode=type(self.tc).SLOT_MODE,
             features=tuple(type(self.tc).SHAPING),
             control_start=self.n if self.hosts else None,
+            stacking=type(self.tc).CROSS_TICK_STACKING,
         )
         sync = update_sync(
             carry.sync, signals, pub_payload, pub_valid, sub_consume

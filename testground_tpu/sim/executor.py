@@ -137,9 +137,12 @@ def execute_sim_run(
             f"unknown sim test case {job.test_case!r}; plan exposes "
             f"{sorted(cases)}"
         )
-    testcase = factory() if isinstance(factory, type) else factory
-
     groups = build_groups(job.groups)
+    if isinstance(factory, type):
+        # per-run static narrowing from resolved params (SimTestcase.specialize)
+        testcase = factory.specialize(groups)()
+    else:
+        testcase = factory
     n = sum(g.count for g in groups)
     hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
 
@@ -435,7 +438,22 @@ def sim_worker_loop(
         try:
             cases = load_sim_testcases(os.path.join(plans_dir, spec["plan"]))
             factory = cases[spec["case"]]
-            testcase = factory() if isinstance(factory, type) else factory
+            groups = build_groups(
+                [
+                    RunGroup(
+                        id=d["id"],
+                        instances=d["instances"],
+                        parameters=d["parameters"],
+                    )
+                    for d in spec["groups"]
+                ]
+            )
+            # same specialization as the leader — the cohort must trace
+            # identical shapes
+            if isinstance(factory, type):
+                testcase = factory.specialize(groups)()
+            else:
+                testcase = factory
             ok = True
         except Exception as e:  # noqa: BLE001 — voted, not raised
             log(f"sim-worker: cannot satisfy {spec['plan']}:{spec['case']}: {e}")
@@ -445,17 +463,6 @@ def sim_worker_loop(
             if once:
                 return
             continue
-
-        groups = build_groups(
-            [
-                RunGroup(
-                    id=d["id"],
-                    instances=d["instances"],
-                    parameters=d["parameters"],
-                )
-                for d in spec["groups"]
-            ]
-        )
         prog = SimProgram(
             testcase,
             groups,

@@ -223,6 +223,7 @@ def enqueue(
     slot_mode: str = "sorted",
     features: tuple = FULL_SHAPING,
     control_start: int | None = None,
+    stacking: bool = True,
 ) -> tuple[Calendar, jax.Array]:
     """Shape + schedule this tick's sends (inputs in plane layout, message
     m = o·N + src). Returns (cal', rejected[N]).
@@ -243,6 +244,10 @@ def enqueue(
     filters and every shaping feature and travels at the 1-tick floor,
     the tensor analog of the sidecar's whitelisted control routes
     (``docker_reactor.go:69-103`` — control traffic is never shaped).
+
+    ``stacking`` — ``SimTestcase.CROSS_TICK_STACKING``: when False the
+    bucket-fill derivation and base gather are compiled out (ranks start
+    at 0 every tick; see the contract note in ``api.py``).
     """
     horizon, ns = cal.occupancy_plane.shape
     slots = cal.slots
@@ -481,14 +486,15 @@ def enqueue(
     # sustained full path at 100k instances). The plane's flat index
     # space is slot-major, so slice s covers positions [s·n, (s+1)·n);
     # the fill table's flat index IS the sort key (bucket·n + dst).
-    marks = cal.occupancy_plane
-    occ_table = marks[:, 0:n] != 0
-    occ_table = occ_table.astype(jnp.int32)
-    for s in range(1, slots):
-        occ_table = occ_table + (marks[:, s * n : (s + 1) * n] != 0)
-    occ_flat = occ_table.reshape(-1)
-    base = occ_flat[jnp.minimum(sk, big - 1)]
-    rank = rank + jnp.where(val_sorted, base, 0)
+    if stacking:
+        marks = cal.occupancy_plane
+        occ_table = marks[:, 0:n] != 0
+        occ_table = occ_table.astype(jnp.int32)
+        for s in range(1, slots):
+            occ_table = occ_table + (marks[:, s * n : (s + 1) * n] != 0)
+        occ_flat = occ_table.reshape(-1)
+        base = occ_flat[jnp.minimum(sk, big - 1)]
+        rank = rank + jnp.where(val_sorted, base, 0)
     val_s = val_sorted & (rank < slots)  # per-dst inbox overflow
 
     # Scatter into the [L, N·SLOTS] planes at (bucket, slot·N + dst).

@@ -275,6 +275,66 @@ class TestCrossTickStacking:
         )
         assert got == {111, 222}
 
+    def test_stacking_off_matches_on_for_uniform_latency(self):
+        """CROSS_TICK_STACKING=False (api.py contract): with one uniform
+        static latency, every bucket fills from a single send tick, so the
+        no-stacking transport must deliver identically to the stacking
+        one — same-tick fan-in still ranks into successive slots."""
+        n = 4
+        for stacking in (True, False):
+            cal = _cal(horizon=8, n=n, slots=2, width=2)
+            link = _link(n=n, latency=2.0)
+            # two senders to the same dst on the SAME tick (fan-in of 2)
+            dsts = jnp.zeros((1, n), jnp.int32).at[0, 0].set(3).at[0, 1].set(3)
+            pay = (
+                jnp.zeros((1, 2, n), jnp.int32)
+                .at[0, 0, 0].set(111)
+                .at[0, 0, 1].set(222)
+            )
+            valid = jnp.zeros((1, n), bool).at[0, 0].set(True).at[0, 1].set(True)
+            cal, _ = enqueue(
+                cal, link, dsts, pay, valid, jnp.int32(0), 1.0,
+                jax.random.key(0), stacking=stacking,
+            )
+            cal, inbox = deliver(cal, jnp.int32(2))
+            got = sorted(
+                int(inbox.payload[0, s, 3])
+                for s in range(2)
+                if bool(inbox.valid[s, 3])
+            )
+            assert got == [111, 222], f"stacking={stacking}: {got}"
+
+    def test_storm_specialize_narrows_message_axis(self):
+        """Storm's per-run specialization sizes OUT_MSGS/IN_MSGS from
+        conn_outgoing instead of the manifest upper bound."""
+        import os, sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+        from testground_tpu.sim.api import GroupSpec
+        from testground_tpu.sim.executor import load_sim_testcases
+
+        plans = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "plans",
+        )
+        storm = load_sim_testcases(os.path.join(plans, "benchmarks"))["storm"]
+        g = GroupSpec(
+            id="all", index=0, offset=0, count=8,
+            params={"conn_outgoing": "3"},
+        )
+        narrowed = storm.specialize((g,))
+        assert narrowed.OUT_MSGS == 3
+        # the inbox tail must NOT narrow with k: in-degree is Poisson(k)
+        # fixed at dial time, so shrinking IN_MSGS would turn the tail
+        # into persistent per-tick droppers
+        assert narrowed.IN_MSGS == storm.IN_MSGS
+        assert issubclass(narrowed, storm)
+        # default bound: class returned unchanged
+        g8 = GroupSpec(
+            id="all", index=0, offset=0, count=8,
+            params={"conn_outgoing": "8"},
+        )
+        assert storm.specialize((g8,)) is storm
+
     def test_occupancy_clears_after_delivery(self):
         """A delivered bucket's fill level resets, so its reuse at
         t + horizon starts from slot 0."""
