@@ -41,15 +41,13 @@ def _build(plan, case, n, params, chunk):
     from testground_tpu.sim.engine import SimProgram, build_groups
     from testground_tpu.sim.executor import load_sim_testcases
 
+    from testground_tpu.sim.executor import instantiate_testcase
+
     factory = load_sim_testcases(os.path.join(REPO, "plans", plan))[case]
     groups = build_groups(
         [RunGroup(id="all", instances=n, parameters=params)]
     )
-    tc = (
-        factory.specialize(groups)()
-        if isinstance(factory, type)
-        else factory
-    )
+    tc = instantiate_testcase(factory, groups, tick_ms=1.0)
     import jax
     import numpy as np
 

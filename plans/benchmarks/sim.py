@@ -519,7 +519,7 @@ class Storm(SimTestcase):
     CHUNK_BYTES = 4096  # storm.go buffersize
 
     @classmethod
-    def specialize(cls, groups):
+    def specialize(cls, groups, tick_ms=1.0):
         """Size the message axis to the run's actual fan-out instead of
         the manifest upper bound: OUT_MSGS = max conn_outgoing over
         groups. At 100k instances this cuts the per-tick sort + scatter

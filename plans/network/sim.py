@@ -33,10 +33,35 @@ PONG = 2
 
 class PingPong(SimTestcase):
     STATES = ["ready", "half-done"]
-    MSG_WIDTH = 4
+    MSG_WIDTH = 2  # word0: kind, word1: round
     OUT_MSGS = 2  # slot 0: pong replies, slot 1: our own pings
     IN_MSGS = 4
-    MAX_LINK_TICKS = 512
+    MAX_LINK_TICKS = 512  # upper bound; narrowed per run below
+
+    @classmethod
+    def specialize(cls, groups, tick_ms=1.0):
+        """Size the calendar horizon to the run's shaped latencies instead
+        of the 512-tick bound. The calendar is O(horizon · N · slots), so
+        at large N the static bound is what limits instances per chip:
+        with the default 100 ms latency this narrows 512 → 128 ticks and
+        a 1M-instance ping-pong fits a single 16 GB chip."""
+        lat = 0.0
+        for g in groups:
+            lat = max(
+                lat,
+                float(g.params.get("latency_ms", 100.0)),
+                float(g.params.get("latency2_ms", 10.0)),
+            )
+        need = max(1, round(lat / tick_ms)) + 2  # delay + clamp headroom
+        horizon = 8
+        while horizon < need:
+            horizon *= 2
+        horizon = min(horizon, cls.MAX_LINK_TICKS)
+        if horizon == cls.MAX_LINK_TICKS:
+            return cls
+        return type(
+            f"{cls.__name__}_h{horizon}", (cls,), {"MAX_LINK_TICKS": horizon}
+        )
 
     def init(self, env):
         z = jnp.int32(0)

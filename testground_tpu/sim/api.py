@@ -347,17 +347,22 @@ class SimTestcase:
     )
 
     @classmethod
-    def specialize(cls, groups: tuple[GroupSpec, ...]) -> type:
+    def specialize(
+        cls, groups: tuple[GroupSpec, ...], tick_ms: float = 1.0
+    ) -> type:
         """Hook: return a (possibly narrowed) testcase class for this run.
 
-        Called once per run with the resolved group layout BEFORE the
-        program is traced, so a plan can size its static tensor bounds
-        from run parameters instead of compiling worst-case shapes — e.g.
-        storm narrows ``OUT_MSGS`` from its manifest upper bound (8) to
-        the actual ``conn_outgoing`` (default 5), shrinking the message
-        axis 37%. Return ``cls`` unchanged (the default) or a subclass
-        with overridden ClassVars; never mutate ``cls`` in place (it is
-        shared across runs)."""
+        Called once per run with the resolved group layout and the
+        runner's tick duration BEFORE the program is traced, so a plan
+        can size its static tensor bounds from run parameters instead of
+        compiling worst-case shapes — e.g. storm narrows ``OUT_MSGS``
+        from its manifest upper bound (8) to the actual ``conn_outgoing``
+        (default 5), and ping-pong sizes ``MAX_LINK_TICKS`` to the shaped
+        latency instead of its 512-tick bound (the calendar is
+        O(horizon · N · slots), so the bound is what limits instance
+        count per chip). Return ``cls`` unchanged (the default) or a
+        subclass with overridden ClassVars; never mutate ``cls`` in
+        place (it is shared across runs)."""
         return cls
 
     def state_id(self, name: str) -> int:

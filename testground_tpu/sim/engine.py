@@ -126,13 +126,20 @@ class SimProgram:
         self.n_lanes = self.n + len(self.hosts)
         if not cls.CROSS_TICK_STACKING:
             # statically-detectable violations of the single-send-tick
-            # bucket contract (see SimTestcase.CROSS_TICK_STACKING)
-            if "duplicate" in cls.SHAPING:
-                raise ValueError(
-                    "CROSS_TICK_STACKING=False is incompatible with "
-                    "duplicate shaping (second copies land one tick later "
-                    "in the same region of the calendar)"
-                )
+            # bucket contract (see SimTestcase.CROSS_TICK_STACKING):
+            # any compiled-in feature that makes per-message delay vary
+            # breaks it, as do control lanes riding the 1-tick floor
+            for feat, why in (
+                ("duplicate", "second copies land one tick later"),
+                ("jitter", "per-message delay varies with the jitter draw"),
+                ("reorder", "reordered messages jump to the 1-tick floor"),
+            ):
+                if feat in cls.SHAPING:
+                    raise ValueError(
+                        f"CROSS_TICK_STACKING=False is incompatible with "
+                        f"{feat} shaping ({why}, so one calendar bucket "
+                        "fills from multiple send ticks)"
+                    )
             if hosts:
                 raise ValueError(
                     "CROSS_TICK_STACKING=False is incompatible with "

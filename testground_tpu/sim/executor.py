@@ -91,6 +91,16 @@ def load_sim_testcases(artifact_path: str) -> dict:
     return cases
 
 
+def instantiate_testcase(factory, groups, tick_ms: float):
+    """Specialize-then-instantiate a testcase factory. The SINGLE code
+    path for the run leader, the sim-worker followers, and bench — a
+    cohort must trace identical shapes, so any drift here desyncs
+    multi-host runs."""
+    if isinstance(factory, type):
+        return factory.specialize(groups, tick_ms=tick_ms)()
+    return factory
+
+
 def _parse_hosts(raw) -> tuple[str, ...]:
     """Normalize the additional_hosts config: a TOML list, or a
     comma-separated string like the reference's ADDITIONAL_HOSTS env var
@@ -138,11 +148,8 @@ def execute_sim_run(
             f"{sorted(cases)}"
         )
     groups = build_groups(job.groups)
-    if isinstance(factory, type):
-        # per-run static narrowing from resolved params (SimTestcase.specialize)
-        testcase = factory.specialize(groups)()
-    else:
-        testcase = factory
+    # per-run static narrowing from resolved params (SimTestcase.specialize)
+    testcase = instantiate_testcase(factory, groups, cfg.tick_ms)
     n = sum(g.count for g in groups)
     hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
 
@@ -450,10 +457,7 @@ def sim_worker_loop(
             )
             # same specialization as the leader — the cohort must trace
             # identical shapes
-            if isinstance(factory, type):
-                testcase = factory.specialize(groups)()
-            else:
-                testcase = factory
+            testcase = instantiate_testcase(factory, groups, spec["tick_ms"])
             ok = True
         except Exception as e:  # noqa: BLE001 — voted, not raised
             log(f"sim-worker: cannot satisfy {spec['plan']}:{spec['case']}: {e}")

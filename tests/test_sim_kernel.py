@@ -321,7 +321,12 @@ class TestCrossTickStacking:
             id="all", index=0, offset=0, count=8,
             params={"conn_outgoing": "3"},
         )
-        narrowed = storm.specialize((g,))
+        # call through the executor's single instantiation path with the
+        # tick_ms kwarg — an override whose signature drops it must fail here
+        from testground_tpu.sim.executor import instantiate_testcase
+
+        assert type(instantiate_testcase(storm, (g,), 1.0)).OUT_MSGS == 3
+        narrowed = storm.specialize((g,), tick_ms=1.0)
         assert narrowed.OUT_MSGS == 3
         # the inbox tail must NOT narrow with k: in-degree is Poisson(k)
         # fixed at dial time, so shrinking IN_MSGS would turn the tail
@@ -333,7 +338,33 @@ class TestCrossTickStacking:
             id="all", index=0, offset=0, count=8,
             params={"conn_outgoing": "8"},
         )
-        assert storm.specialize((g8,)) is storm
+        assert storm.specialize((g8,), tick_ms=1.0) is storm
+
+    def test_pingpong_specialize_narrows_horizon(self):
+        """Ping-pong sizes its calendar horizon from the shaped latency
+        (the calendar is O(horizon*N*slots), so this bounds instances
+        per chip)."""
+        import os
+        from testground_tpu.sim.api import GroupSpec
+        from testground_tpu.sim.executor import load_sim_testcases
+
+        plans = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "plans",
+        )
+        pp = load_sim_testcases(os.path.join(plans, "network"))["ping-pong"]
+        g = GroupSpec(
+            id="all", index=0, offset=0, count=4,
+            params={"latency_ms": "100", "latency2_ms": "10"},
+        )
+        narrowed = pp.specialize((g,), tick_ms=1.0)
+        assert narrowed.MAX_LINK_TICKS == 128  # 100ms + headroom → pow2
+        # a latency near the bound keeps the full horizon
+        ghi = GroupSpec(
+            id="all", index=0, offset=0, count=4,
+            params={"latency_ms": "500"},
+        )
+        assert pp.specialize((ghi,), tick_ms=1.0) is pp
 
     def test_occupancy_clears_after_delivery(self):
         """A delivered bucket's fill level resets, so its reuse at
