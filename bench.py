@@ -39,9 +39,10 @@ BASELINE_CHIPS = 4  # the north-star metric is defined on a v4-8
 def _build(plan, case, n, params, chunk):
     from testground_tpu.api import RunGroup
     from testground_tpu.sim.engine import SimProgram, build_groups
-    from testground_tpu.sim.executor import load_sim_testcases
-
-    from testground_tpu.sim.executor import instantiate_testcase
+    from testground_tpu.sim.executor import (
+        instantiate_testcase,
+        load_sim_testcases,
+    )
 
     factory = load_sim_testcases(os.path.join(REPO, "plans", plan))[case]
     groups = build_groups(
