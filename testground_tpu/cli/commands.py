@@ -40,7 +40,7 @@ def _engine(args):
     (status/logs/tasks run in fresh processes), so the memory default
     upgrades to disk unless .env.toml explicitly chose memory."""
     env = EnvConfig.load()
-    endpoint = getattr(args, "endpoint", "") or env.client.endpoint
+    endpoint = _endpoint(args, env)
     if endpoint:
         from testground_tpu.client import Client, RemoteEngine
 
@@ -84,6 +84,26 @@ def _resolve_plan(env: EnvConfig, plan: str) -> tuple[str, TestPlanManifest]:
         f"plan {plan!r} not found (searched: {candidates}); "
         f"import it with `tg plan import --from <dir>`"
     )
+
+
+def _endpoint(args, env: EnvConfig) -> str:
+    """Daemon endpoint precedence: --endpoint flag > .env.toml [client]."""
+    return getattr(args, "endpoint", "") or env.client.endpoint
+
+
+def _resolve_manifest(env: EnvConfig, args, plan: str) -> TestPlanManifest:
+    """Resolve a plan's manifest: locally, or from the daemon when
+    ``--endpoint`` points at one (GET /describe) — plans live daemon-side
+    in this framework, so a remote CLI need not hold a local copy."""
+    try:
+        return _resolve_plan(env, plan)[1]
+    except FileNotFoundError:
+        endpoint = _endpoint(args, env)
+        if not endpoint:
+            raise
+        from testground_tpu.client import Client
+
+        return Client(endpoint, token=env.client.token).describe_plan(plan)
 
 
 def _wait_task(engine: Engine, task_id: str, follow_logs: bool = True):
@@ -179,7 +199,7 @@ def run_single_cmd(args) -> int:
     if not case:
         raise ValueError("expected <plan>:<case>")
     env = EnvConfig.load()
-    _, manifest = _resolve_plan(env, plan)
+    manifest = _resolve_manifest(env, args, plan)
     builder = args.builder or manifest.defaults.get("builder", "")
     runner = args.runner or manifest.defaults.get("runner", "")
     tc = manifest.testcase_by_name(case)
@@ -291,15 +311,22 @@ def build_composition_cmd(args) -> int:
 
 
 def build_single_cmd(args) -> int:
+    from testground_tpu.client import RemoteEngine
+
     engine = _engine(args)
     try:
-        src_dir, manifest = _resolve_plan(engine.env, args.plan)
+        manifest = _resolve_manifest(engine.env, args, args.plan)
         builder = args.builder or manifest.defaults.get("builder", "")
         comp = Composition(
             global_=Global(plan=args.plan, builder=builder),
             groups=[Group(id="single", instances=Instances(count=1))],
         )
-        task_id = engine.queue_build(comp, manifest, sources_dir=src_dir)
+        if isinstance(engine, RemoteEngine):
+            # the daemon resolves sources from ITS plans dir
+            task_id = engine.queue_build(comp)
+        else:
+            src_dir, _ = _resolve_plan(engine.env, args.plan)
+            task_id = engine.queue_build(comp, manifest, sources_dir=src_dir)
         print(f"build is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
         print(f"finished build with ID: {task_id} (outcome: {t.outcome().value})")
@@ -357,7 +384,7 @@ def plan_import_cmd(args) -> int:
     src = os.path.abspath(args.source)
     if not os.path.isfile(os.path.join(src, "manifest.toml")):
         raise FileNotFoundError(f"{src} has no manifest.toml")
-    endpoint = getattr(args, "endpoint", "") or env.client.endpoint
+    endpoint = _endpoint(args, env)
     if endpoint:
         from testground_tpu.client import Client
 
@@ -446,7 +473,7 @@ def register_describe(sub) -> None:
 def describe_cmd(args) -> int:
     env = EnvConfig.load()
     plan, _, case = args.plan.partition(":")
-    _, manifest = _resolve_plan(env, plan)
+    manifest = _resolve_manifest(env, args, plan)
     if case:
         tc = manifest.testcase_by_name(case)
         if tc is None:

@@ -60,16 +60,25 @@ class Client:
         resp = conn.getresponse()
         return conn, resp
 
-    def _post_json(self, route: str, body: dict) -> dict:
-        conn, resp = self._post(route, body)
+    @staticmethod
+    def _read_json_response(conn, resp) -> dict:
+        """Read a JSON body; raise DaemonError on HTTP errors (including
+        non-JSON error bodies)."""
         try:
             data = resp.read()
-            obj = json.loads(data or b"{}")
+            try:
+                obj = json.loads(data or b"{}")
+            except ValueError:
+                obj = {"error": data.decode(errors="replace")[:500]}
             if resp.status >= 400:
                 raise DaemonError(obj.get("error") or f"HTTP {resp.status}")
             return obj
         finally:
             conn.close()
+
+    def _post_json(self, route: str, body: dict) -> dict:
+        conn, resp = self._post(route, body)
+        return self._read_json_response(conn, resp)
 
     def _post_stream(self, route: str, body: dict) -> Iterator[str]:
         """POST; yield response lines (chunked ndjson streams)."""
@@ -95,6 +104,15 @@ class Client:
                 yield buf.decode(errors="replace")
         finally:
             conn.close()
+
+    def _get_json(self, route: str, params: dict) -> dict:
+        from urllib.parse import urlencode
+
+        conn = self._conn()
+        conn.request(
+            "GET", f"{route}?{urlencode(params)}", headers=self._headers()
+        )
+        return self._read_json_response(conn, conn.getresponse())
 
     # -------------------------------------------------------------- verbs
 
@@ -177,6 +195,13 @@ class Client:
             self._post_json("/delete", {"task_id": task_id})["deleted"]
         )
 
+    def describe_plan(self, plan: str):
+        """Fetch a daemon-hosted plan's manifest (GET /describe)."""
+        from testground_tpu.api import TestPlanManifest
+
+        obj = self._get_json("/describe", {"plan": plan})
+        return TestPlanManifest.from_dict(obj["manifest"])
+
     def build_purge(self, builder: str, testplan: str = "") -> str:
         return self._post_json(
             "/build/purge", {"builder": builder, "testplan": testplan}
@@ -203,14 +228,8 @@ class Client:
             buf.getvalue(),
             self._headers("application/gzip"),
         )
-        resp = conn.getresponse()
-        try:
-            obj = json.loads(resp.read() or b"{}")
-            if resp.status >= 400:
-                raise DaemonError(obj.get("error") or f"HTTP {resp.status}")
-            return obj["imported"]
-        finally:
-            conn.close()
+        obj = self._read_json_response(conn, conn.getresponse())
+        return obj["imported"]
 
 
 class _RemoteReport(Report):

@@ -120,6 +120,7 @@ class _Handler(BaseHTTPRequestHandler):
             "/journal": lambda: self._journal(q),
             "/data": lambda: self._data(q),
             "/dashboard": lambda: self._dashboard(q),
+            "/describe": lambda: self._describe(q),
         }
         h = handlers.get(url.path)
         if h is None:
@@ -289,6 +290,23 @@ class _Handler(BaseHTTPRequestHandler):
     def _kill(self, body: dict) -> None:
         ok = self.engine.kill(body["task_id"])
         self._send_json({"killed": bool(ok)})
+
+    def _describe(self, q: dict) -> None:
+        """GET /describe?plan= — the daemon-side manifest, so a remote CLI
+        can fill composition defaults for plans that exist only on the
+        daemon (this framework hosts plans daemon-side, where the
+        reference ships local sources per request, ``client.go:84-228``)."""
+        try:
+            plan_dir = self._safe_plan_dir(q.get("plan", ""))
+        except ValueError as e:
+            return self._send_error_json(str(e), 400)
+        manifest_path = os.path.join(plan_dir, "manifest.toml")
+        if not os.path.isfile(manifest_path):
+            return self._send_error_json(
+                f"plan {q.get('plan')!r} not found on the daemon", 404
+            )
+        manifest = TestPlanManifest.load_file(manifest_path)
+        self._send_json({"manifest": manifest.to_dict()})
 
     def _delete(self, body: dict) -> None:
         """Delete a finished task's record + log (``daemon.go:88``)."""

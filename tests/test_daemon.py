@@ -100,6 +100,40 @@ class TestDaemonEndToEnd:
         with pytest.raises(DaemonError):
             client.status("missing-task")
 
+    def test_describe_plan_remote(self, client):
+        """GET /describe serves the daemon-side manifest so a remote CLI
+        can run daemon-hosted plans with no local copy."""
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        m = client.describe_plan("placebo")
+        assert m.name == "placebo"
+        assert m.testcase_by_name("ok") is not None
+        with pytest.raises(DaemonError, match="not found"):
+            client.describe_plan("nope")
+        with pytest.raises(DaemonError, match="invalid plan name"):
+            client.describe_plan("../etc")
+
+    def test_run_single_remote_without_local_plan(
+        self, daemon, tmp_path, monkeypatch, capsys
+    ):
+        """`tg run single` against a daemon must work when the plan exists
+        ONLY on the daemon (manifest fetched via /describe)."""
+        from testground_tpu.cli.main import main
+
+        Client(daemon.address).import_plan(os.path.join(PLANS, "placebo"))
+        # point the CLI at a fresh, empty home with no local plans
+        clihome = tmp_path / "clihome"
+        clihome.mkdir()
+        monkeypatch.setenv("TESTGROUND_HOME", str(clihome))
+        rc = main(
+            [
+                "--endpoint", daemon.address,
+                "run", "single", "placebo:ok",
+                "--builder", "exec:py", "--runner", "local:exec", "-i", "2",
+            ]
+        )
+        assert rc == 0
+        assert "outcome: success" in capsys.readouterr().out
+
     def test_delete_task(self, client):
         """GET /delete parity (``daemon.go:88``): a finished task's record
         and log are removed; a live/unknown task is refused/false."""
