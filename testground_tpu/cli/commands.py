@@ -86,6 +86,22 @@ def _resolve_plan(env: EnvConfig, plan: str) -> tuple[str, TestPlanManifest]:
     )
 
 
+def _created_by(args, env: EnvConfig):
+    """CreatedBy from the --metadata-* flags (+ [client] user) — the CI
+    identity that drives per-branch queue dedup (``pkg/cmd/run.go:62-70``,
+    ``queue.go:80-97``). None when no metadata was given."""
+    from testground_tpu.engine.task import CreatedBy
+
+    repo = getattr(args, "metadata_repo", "")
+    branch = getattr(args, "metadata_branch", "")
+    commit = getattr(args, "metadata_commit", "")
+    if not (repo or branch or commit or env.client.user):
+        return None
+    return CreatedBy(
+        user=env.client.user, repo=repo, branch=branch, commit=commit
+    )
+
+
 def _endpoint(args, env: EnvConfig) -> str:
     """Daemon endpoint precedence: --endpoint flag > .env.toml [client]."""
     return getattr(args, "endpoint", "") or env.client.endpoint
@@ -138,6 +154,13 @@ def _help_func(parser):
     return fn
 
 
+def _add_metadata_flags(p) -> None:
+    """CI metadata flags (``pkg/cmd/run.go:62-70``; also on build)."""
+    p.add_argument("--metadata-repo", default="", help="source repo (CI)")
+    p.add_argument("--metadata-branch", default="", help="source branch (CI)")
+    p.add_argument("--metadata-commit", default="", help="source commit (CI)")
+
+
 def register_run(sub) -> None:
     p = sub.add_parser("run", help="(builds and) runs a composition or single test case")
     p.set_defaults(func=_help_func(p))
@@ -161,6 +184,7 @@ def register_run(sub) -> None:
     pc.add_argument(
         "--result-file", default="", help="append run results as CSV rows"
     )
+    _add_metadata_flags(pc)
     pc.set_defaults(func=run_composition_cmd)
 
     ps = psub.add_parser("single", help="run a single plan/case")
@@ -176,6 +200,7 @@ def register_run(sub) -> None:
         help="test param k=v (repeatable)",
     )
     ps.add_argument("--collect", action="store_true")
+    _add_metadata_flags(ps)
     ps.set_defaults(func=run_single_cmd)
 
 
@@ -231,12 +256,15 @@ def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
 
     engine = _engine(args)
     try:
+        created_by = _created_by(args, engine.env)
         if isinstance(engine, RemoteEngine):
             # the daemon resolves the plan from ITS $TESTGROUND_HOME/plans
-            task_id = engine.queue_run(comp)
+            task_id = engine.queue_run(comp, created_by=created_by)
         else:
             src_dir, manifest = _resolve_plan(engine.env, comp.global_.plan)
-            task_id = engine.queue_run(comp, manifest, sources_dir=src_dir)
+            task_id = engine.queue_run(
+                comp, manifest, sources_dir=src_dir, created_by=created_by
+            )
         print(f"run is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
         outcome = t.outcome()
@@ -279,10 +307,12 @@ def register_build(sub) -> None:
     pc = psub.add_parser("composition")
     pc.add_argument("-f", "--file", required=True)
     pc.add_argument("--write-artifacts", action="store_true")
+    _add_metadata_flags(pc)
     pc.set_defaults(func=build_composition_cmd)
     ps = psub.add_parser("single")
     ps.add_argument("plan", help="plan name")
     ps.add_argument("--builder", default="")
+    _add_metadata_flags(ps)
     ps.set_defaults(func=build_single_cmd)
 
 
@@ -292,11 +322,14 @@ def build_composition_cmd(args) -> int:
     comp = load_composition(args.file)
     engine = _engine(args)
     try:
+        created_by = _created_by(args, engine.env)
         if isinstance(engine, RemoteEngine):
-            task_id = engine.queue_build(comp)
+            task_id = engine.queue_build(comp, created_by=created_by)
         else:
             src_dir, manifest = _resolve_plan(engine.env, comp.global_.plan)
-            task_id = engine.queue_build(comp, manifest, sources_dir=src_dir)
+            task_id = engine.queue_build(
+                comp, manifest, sources_dir=src_dir, created_by=created_by
+            )
         print(f"build is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
         print(f"finished build with ID: {task_id} (outcome: {t.outcome().value})")
@@ -315,18 +348,24 @@ def build_single_cmd(args) -> int:
 
     engine = _engine(args)
     try:
-        manifest = _resolve_manifest(engine.env, args, args.plan)
+        try:
+            src_dir, manifest = _resolve_plan(engine.env, args.plan)
+        except FileNotFoundError:
+            # daemon-hosted plan: the daemon resolves its own sources
+            src_dir = ""
+            manifest = _resolve_manifest(engine.env, args, args.plan)
         builder = args.builder or manifest.defaults.get("builder", "")
         comp = Composition(
             global_=Global(plan=args.plan, builder=builder),
             groups=[Group(id="single", instances=Instances(count=1))],
         )
+        created_by = _created_by(args, engine.env)
         if isinstance(engine, RemoteEngine):
-            # the daemon resolves sources from ITS plans dir
-            task_id = engine.queue_build(comp)
+            task_id = engine.queue_build(comp, created_by=created_by)
         else:
-            src_dir, _ = _resolve_plan(engine.env, args.plan)
-            task_id = engine.queue_build(comp, manifest, sources_dir=src_dir)
+            task_id = engine.queue_build(
+                comp, manifest, sources_dir=src_dir, created_by=created_by
+            )
         print(f"build is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
         print(f"finished build with ID: {task_id} (outcome: {t.outcome().value})")
@@ -531,6 +570,14 @@ def status_cmd(args) -> int:
         print(f"Type:    {t.type.value}")
         print(f"State:   {t.state().state.value}")
         print(f"Outcome: {t.outcome().value}")
+        cb = t.created_by
+        if cb.user or cb.repo or cb.branch or cb.commit:
+            parts = [cb.user or "-"]
+            if cb.repo or cb.branch:
+                parts.append(f"{cb.repo}@{cb.branch}" if cb.branch else cb.repo)
+            if cb.commit:
+                parts.append(cb.commit[:12])
+            print(f"By:      {' '.join(parts)}")
         if t.error:
             print(f"Error:   {t.error}")
         mj = (

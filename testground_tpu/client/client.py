@@ -116,16 +116,22 @@ class Client:
 
     # -------------------------------------------------------------- verbs
 
-    def _queue(self, route: str, composition: dict, priority: int = 0) -> str:
+    def _queue(
+        self,
+        route: str,
+        composition: dict,
+        priority: int = 0,
+        created_by: dict | None = None,
+    ) -> str:
         """POST /run or /build; parse the chunked rpc response for the
         task id (``ParseRunResponse``, ``client.go:402``)."""
         from testground_tpu.rpc import Chunk
 
+        body = {"composition": composition, "priority": priority}
+        if created_by:
+            body["created_by"] = created_by
         task_id = ""
-        for line in self._post_stream(route, {
-            "composition": composition,
-            "priority": priority,
-        }):
+        for line in self._post_stream(route, body):
             try:
                 c = Chunk.from_json(line)
             except Exception:  # noqa: BLE001 — ignore non-chunk noise
@@ -138,11 +144,15 @@ class Client:
             raise DaemonError(f"daemon {route} returned no task id")
         return task_id
 
-    def run(self, composition: dict, priority: int = 0) -> str:
-        return self._queue("/run", composition, priority)
+    def run(
+        self, composition: dict, priority: int = 0, created_by: dict | None = None
+    ) -> str:
+        return self._queue("/run", composition, priority, created_by)
 
-    def build(self, composition: dict, priority: int = 0) -> str:
-        return self._queue("/build", composition, priority)
+    def build(
+        self, composition: dict, priority: int = 0, created_by: dict | None = None
+    ) -> str:
+        return self._queue("/build", composition, priority, created_by)
 
     def tasks(self, states=None, types=None, limit=0) -> list[dict]:
         return self._post_json(
@@ -244,11 +254,23 @@ class RemoteEngine:
         self.env = env
 
     # -- queueing: manifest/sources resolve on the daemon side
-    def queue_run(self, comp, manifest=None, sources_dir="", priority=0, **_):
-        return self.client.run(comp.to_dict(), priority)
+    def queue_run(
+        self, comp, manifest=None, sources_dir="", priority=0,
+        created_by=None, **_,
+    ):
+        return self.client.run(
+            comp.to_dict(), priority,
+            created_by.to_dict() if created_by else None,
+        )
 
-    def queue_build(self, comp, manifest=None, sources_dir="", priority=0, **_):
-        return self.client.build(comp.to_dict(), priority)
+    def queue_build(
+        self, comp, manifest=None, sources_dir="", priority=0,
+        created_by=None, **_,
+    ):
+        return self.client.build(
+            comp.to_dict(), priority,
+            created_by.to_dict() if created_by else None,
+        )
 
     def get_task(self, task_id: str) -> Task | None:
         try:

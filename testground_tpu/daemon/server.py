@@ -185,6 +185,25 @@ class _Handler(BaseHTTPRequestHandler):
         # no realpath comparison so operator-made symlinked plans keep working
         return os.path.join(self.engine.env.dirs.plans(), name)
 
+    def _load_plan_manifest(self, plan: str):
+        """Resolve a daemon-hosted plan → (plan_dir, manifest), or None
+        after sending the 400/404 error response. Shared by /run, /build,
+        and /describe so the resolution rules cannot drift."""
+        try:
+            plan_dir = self._safe_plan_dir(plan)
+        except ValueError as e:
+            self._send_error_json(str(e), 400)
+            return None
+        manifest_path = os.path.join(plan_dir, "manifest.toml")
+        if not os.path.isfile(manifest_path):
+            self._send_error_json(
+                f"plan {plan!r} not found on the daemon; "
+                "import it with `tg plan import` against --endpoint",
+                404,
+            )
+            return None
+        return plan_dir, TestPlanManifest.load_file(manifest_path)
+
     def _queue(self, body: dict, kind: str) -> None:
         comp = Composition.from_dict(body["composition"])
         if kind == "run":
@@ -193,26 +212,24 @@ class _Handler(BaseHTTPRequestHandler):
             # reference daemon does during PrepareForRun
             # (composition_preparation.go:93-110 via supervisor.go:494-518)
             comp = generate_default_run(comp)
-        try:
-            plan_dir = self._safe_plan_dir(comp.global_.plan)
-        except ValueError as e:
-            return self._send_error_json(str(e), 400)
-        manifest_path = os.path.join(plan_dir, "manifest.toml")
-        if not os.path.isfile(manifest_path):
-            return self._send_error_json(
-                f"plan {comp.global_.plan!r} not found on the daemon; "
-                "import it with `tg plan import` against --endpoint",
-                404,
-            )
-        manifest = TestPlanManifest.load_file(manifest_path)
+        resolved = self._load_plan_manifest(comp.global_.plan)
+        if resolved is None:
+            return
+        plan_dir, manifest = resolved
         queue = (
             self.engine.queue_run if kind == "run" else self.engine.queue_build
         )
+        created_by = None
+        if isinstance(body.get("created_by"), dict):
+            from testground_tpu.engine.task import CreatedBy
+
+            created_by = CreatedBy.from_dict(body["created_by"])
         task_id = queue(
             comp,
             manifest,
             sources_dir=plan_dir,
             priority=int(body.get("priority", 0)),
+            created_by=created_by,
         )
         # chunked rpc response: progress line + result chunk (the wire
         # shape the reference's ParseRunResponse expects, client.go:402)
@@ -296,17 +313,10 @@ class _Handler(BaseHTTPRequestHandler):
         can fill composition defaults for plans that exist only on the
         daemon (this framework hosts plans daemon-side, where the
         reference ships local sources per request, ``client.go:84-228``)."""
-        try:
-            plan_dir = self._safe_plan_dir(q.get("plan", ""))
-        except ValueError as e:
-            return self._send_error_json(str(e), 400)
-        manifest_path = os.path.join(plan_dir, "manifest.toml")
-        if not os.path.isfile(manifest_path):
-            return self._send_error_json(
-                f"plan {q.get('plan')!r} not found on the daemon", 404
-            )
-        manifest = TestPlanManifest.load_file(manifest_path)
-        self._send_json({"manifest": manifest.to_dict()})
+        resolved = self._load_plan_manifest(q.get("plan", ""))
+        if resolved is None:
+            return
+        self._send_json({"manifest": resolved[1].to_dict()})
 
     def _delete(self, body: dict) -> None:
         """Delete a finished task's record + log (``daemon.go:88``)."""

@@ -90,6 +90,43 @@ class TestTaskTimeout:
             e.stop()
 
 
+class TestCIMetadata:
+    def test_metadata_flags_recorded_on_task(self, tg_home, capsys):
+        """--metadata-repo/branch/commit flow into the task's CreatedBy
+        (``pkg/cmd/run.go:62-70`` → ``task.go:48-53``), the identity the
+        queue's per-branch CI dedup keys on."""
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.engine import Engine
+
+        main(["plan", "import", "--from", os.path.join(PLANS, "placebo")])
+        capsys.readouterr()
+        rc = main(
+            [
+                "run", "single", "placebo:ok",
+                "--builder", "exec:py", "--runner", "local:exec", "-i", "1",
+                "--metadata-repo", "org/repo",
+                "--metadata-branch", "main",
+                "--metadata-commit", "abc123",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        task_id = out.split("run is queued with ID:")[1].split()[0]
+        # fresh engine over the same disk store reads the archived task
+        # (the CLI upgrades the default store to disk; mirror that here)
+        env = EnvConfig.load()
+        env.daemon.scheduler.task_repo_type = "disk"
+        e = Engine.new_default(env)
+        try:
+            t = e.get_task(task_id)
+            assert t.created_by.repo == "org/repo"
+            assert t.created_by.branch == "main"
+            assert t.created_by.commit == "abc123"
+            assert t.created_by_ci()
+        finally:
+            e.stop()
+
+
 class TestRunnerDisabled:
     def test_disabled_runner_is_refused(self, tg_home, capsys):
         """A runner disabled in .env.toml must refuse runs with a clear

@@ -71,6 +71,31 @@ class TestPlacebo:
         m = tc.collect_metrics(res["groups"][0], res["states"][0], res["status"])
         assert (np.asarray(m["placebo.counter"]) == 10).all()
 
+    def test_seed_determinism(self):
+        """The simulator is deterministic: the same seed reproduces a run
+        bit-for-bit (the property that makes in-sim race debugging
+        tractable where the reference relies on behavioral assertions —
+        SURVEY.md §5 'race detection'), and a different seed actually
+        changes the stochastic draws."""
+        params = {
+            "latency_ms": "3",
+            "latency2_ms": "2",
+            "tolerance_ms": "15",
+        }
+
+        def run(seed):
+            prog = SimProgram(
+                plan_case("network", "ping-pong"),
+                make_groups(16, params=params),
+                chunk=16,
+            )
+            return prog.run(seed=seed, max_ticks=256)
+
+        a, b = run(7), run(7)
+        assert (a["status"] == b["status"]).all()
+        for key in ("rtt1", "rtt2"):
+            assert (a["states"][0][key] == b["states"][0][key]).all()
+
     def test_sharded_matches_unsharded(self):
         """vmap-vs-ground-truth (BASELINE config 2 spirit): the mesh must
         not change results."""

@@ -366,6 +366,31 @@ class TestCrossTickStacking:
         )
         assert pp.specialize((ghi,), tick_ms=1.0) is pp
 
+    def test_shaping_dice_differ_by_key(self):
+        """The transport's stochastic draws (loss here) are a function of
+        the per-tick key: the same key reproduces the same drop set and a
+        different key draws a different one — run-level determinism with
+        real randomness across seeds."""
+        n, o = 8, 4
+
+        def send_burst(seed):
+            cal = _cal(horizon=8, n=n, slots=4, width=2)
+            link = _link(n=n, latency=1.0, loss=50.0)
+            dsts = jnp.tile(jnp.arange(n, dtype=jnp.int32)[None, :], (o, 1))
+            pay = jnp.ones((o, 2, n), jnp.int32)
+            valid = jnp.ones((o, n), bool)
+            cal, _ = enqueue(
+                cal, link, dsts, pay, valid, jnp.int32(0), 1.0,
+                jax.random.key(seed),
+            )
+            _, inbox = deliver(cal, jnp.int32(1))
+            return np.asarray(inbox.valid)
+
+        a, b, c = send_burst(0), send_burst(0), send_burst(1)
+        assert (a == b).all()  # same key → same drops
+        assert 0 < a.sum() < a.size  # 50% loss actually drops some
+        assert (a != c).any()  # different key → different drop set
+
     def test_occupancy_clears_after_delivery(self):
         """A delivered bucket's fill level resets, so its reuse at
         t + horizon starts from slot 0."""
