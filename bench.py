@@ -18,6 +18,13 @@ Four workloads, one JSON line:
   5-out graph (BASELINE config 5; multi-message fan-in on the sorted
   path). The reference's own envelope is 2–300 real instances per host
   (README.md:136-139); no single-host reference baseline exists at 100k.
+
+  Workload-shape note for cross-round comparison: as of round 3 flood
+  and storm pack kind+counter into ONE payload word (MSG_WIDTH 2→1;
+  receivers never read word 1) and storm narrows OUT_MSGS to the actual
+  fan-out — BENCH_r01/r02 flood/storm numbers were measured on the
+  wider shapes. The PRIMARY full-path metric is unchanged in shape
+  across rounds.
 - **correctness checkpoint**: ``network/ping-pong`` (the actual
   reference testcase, RTT windows + mid-run reshape) run at 100k to
   completion — reported as ok-instance count and wall seconds.

@@ -425,7 +425,7 @@ class PingPongFlood(SimTestcase):
     the calendar horizon only needs to cover the shaped latency.
     """
 
-    MSG_WIDTH = 2
+    MSG_WIDTH = 1  # word0 packs kind (low 2 bits) | round << 2
     OUT_MSGS = 1
     IN_MSGS = 1
     MAX_LINK_TICKS = 8
@@ -450,7 +450,7 @@ class PingPongFlood(SimTestcase):
         )
         partner = env.global_seq ^ 1
 
-        kind = inbox.payload[0]
+        kind = inbox.payload[0] & 3
         got_ping = jnp.any(inbox.valid & (kind == PING))
         got_pong = jnp.any(inbox.valid & (kind == PONG))
 
@@ -465,7 +465,7 @@ class PingPongFlood(SimTestcase):
             status=jnp.where(done, SUCCESS, RUNNING),
             outbox=Outbox.single(
                 partner,
-                jnp.stack([out_kind, rounds]),
+                jnp.stack([out_kind | (rounds << 2)]),
                 send & ~done,
                 cls.OUT_MSGS,
                 cls.MSG_WIDTH,
@@ -506,7 +506,7 @@ class Storm(SimTestcase):
     """
 
     STATES = ["listening", "dials-done", "done-writing"]
-    MSG_WIDTH = 2  # word0: kind, word1: chunk seq
+    MSG_WIDTH = 1  # word0 packs kind (low 2 bits) | chunk seq << 2
     OUT_MSGS = 8  # upper bound on conn_outgoing (narrowed per run below)
     IN_MSGS = 16  # covers the Poisson(K) per-tick fan-in tail
     MAX_LINK_TICKS = 8
@@ -609,7 +609,7 @@ class Storm(SimTestcase):
         )
         sig_written = all_written & ~state["written"]
 
-        kind = inbox.payload[0]
+        kind = inbox.payload[0] & 3
         got = inbox.valid & (kind == PING)  # chunk messages reuse kind=1
         bytes_read = state["bytes_read"] + cls.CHUNK_BYTES * jnp.sum(
             got.astype(jnp.int32)
@@ -619,13 +619,7 @@ class Storm(SimTestcase):
 
         ob = Outbox(
             dst=state["targets"],
-            payload=jnp.stack(
-                [
-                    jnp.full((cls.OUT_MSGS,), PING, jnp.int32),
-                    state["sent_chunks"],
-                ],
-                axis=-1,
-            ),
+            payload=(PING | (state["sent_chunks"] << 2))[:, None],
             valid=sending,
         )
 
