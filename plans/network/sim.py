@@ -37,6 +37,19 @@ class PingPong(SimTestcase):
     OUT_MSGS = 2  # slot 0: pong replies, slot 1: our own pings
     IN_MSGS = 4
     MAX_LINK_TICKS = 512  # upper bound; narrowed per run below
+    # the case shapes latency only (plus the dynamic mid-run reshape);
+    # duplicate-shaping stays undeclared like pingpong-sustained — its
+    # second-copy pass would double the message axis for a feature this
+    # plan never exercises
+    SHAPING = (
+        "latency",
+        "jitter",
+        "bandwidth",
+        "loss",
+        "corrupt",
+        "reorder",
+        "filters",
+    )
 
     @classmethod
     def specialize(cls, groups, tick_ms=1.0):

@@ -188,7 +188,8 @@ class SimProgram:
             carry,
             status=wsc(carry.status, self._ishard(0)),
             finished_at=wsc(carry.finished_at, self._ishard(0)),
-            cal=Calendar(
+            cal=dataclasses.replace(
+                carry.cal,  # statics (slots/flat/horizon) survive
                 payload=tuple(
                     wsc(p, self._ishard(1)) for p in carry.cal.payload
                 ),
@@ -198,7 +199,6 @@ class SimProgram:
                 valid=wsc(carry.cal.valid, self._ishard(1))
                 if carry.cal.valid is not None
                 else None,
-                slots=carry.cal.slots,
             ),
             link=LinkState(
                 egress=wsc(carry.link.egress, self._ishard(1)),
@@ -259,6 +259,10 @@ class SimProgram:
                 cls.IN_MSGS,
                 cls.MSG_WIDTH,
                 track_src=cls.TRACK_SRC,
+                # unsharded: flat planes in the scatters' linear layout
+                # (see Calendar docstring); sharded: 2-D rows whose
+                # N·SLOTS axis carries the instance-axis sharding
+                flat=self.mesh is None,
             ),
             link=make_link_state(
                 self.n_lanes,

@@ -121,25 +121,47 @@ class Calendar:
 
     The N·SLOTS axis is ordered slot-major (``pos = slot·N + dst``) so a
     row reshapes to [SLOTS, N]. ``slots`` is static structure, not data.
+
+    **Plane storage layout** (``flat``, static): unsharded programs store
+    each plane FLAT as [L·N·SLOTS] — the T(1024) linear layout XLA's
+    scatter lowering wants — so the per-tick scatters touch the buffers
+    directly. With the 2-D [L, N·SLOTS] form, XLA materializes a full
+    plane layout conversion (tiled (8,128) ↔ linear) around EVERY
+    scatter: invisible at an 8-tick horizon (~13 MB planes) but ~2.6 ms
+    per plane per direction per tick at horizon 128 (205 MB at 100k
+    instances) — most of the ping-pong correctness case's runtime.
+    Mesh-sharded programs keep the 2-D form, whose N·SLOTS axis carries
+    the instance-axis sharding; the sharded-vs-unsharded equality tests
+    cross-validate the two layouts against each other.
     """
 
     payload: tuple
     src: jax.Array | None
     valid: jax.Array | None
     slots: int = dataclasses.field(metadata=dict(static=True), default=4)
+    flat: bool = dataclasses.field(metadata=dict(static=True), default=False)
+    # bucket count — static; required to address flat planes (the 2-D
+    # form carries it in shape[0], kept in sync by empty())
+    horizon: int = dataclasses.field(metadata=dict(static=True), default=0)
 
     @staticmethod
     def empty(
-        horizon: int, n: int, slots: int, width: int, track_src: bool = True
+        horizon: int,
+        n: int,
+        slots: int,
+        width: int,
+        track_src: bool = True,
+        flat: bool = False,
     ) -> "Calendar":
         ns = n * slots
+        shape = (horizon * ns,) if flat else (horizon, ns)
         return Calendar(
-            payload=tuple(
-                jnp.zeros((horizon, ns), jnp.int32) for _ in range(width)
-            ),
-            src=jnp.zeros((horizon, ns), jnp.int32) if track_src else None,
-            valid=None if track_src else jnp.zeros((horizon, ns), bool),
+            payload=tuple(jnp.zeros(shape, jnp.int32) for _ in range(width)),
+            src=jnp.zeros(shape, jnp.int32) if track_src else None,
+            valid=None if track_src else jnp.zeros(shape, bool),
             slots=slots,
+            flat=flat,
+            horizon=horizon,
         )
 
     @property
@@ -175,33 +197,48 @@ def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
     which also resets the bucket's derived fill counts. With provenance
     on, the src plane doubles as occupancy (src+1, 0 = empty); invalid
     inbox slots then read src = -1."""
-    horizon, ns = cal.occupancy_plane.shape
     slots = cal.slots
+    if cal.flat:
+        horizon = cal.horizon
+        ns = cal.occupancy_plane.shape[0] // horizon
+    else:
+        horizon, ns = cal.occupancy_plane.shape
     n = ns // slots
     b = jnp.mod(t, horizon)
-    rows = [
-        jax.lax.dynamic_index_in_dim(p, b, axis=0, keepdims=False)
-        for p in cal.payload
-    ]
+
+    if cal.flat:
+        off = (b * ns,)
+
+        def row_of(p):
+            return jax.lax.dynamic_slice(p, off, (ns,))
+
+        def clear_row(p):
+            return jax.lax.dynamic_update_slice(
+                p, jnp.zeros((ns,), p.dtype), off
+            )
+
+    else:
+
+        def row_of(p):
+            return jax.lax.dynamic_index_in_dim(p, b, axis=0, keepdims=False)
+
+        def clear_row(p):
+            return jax.lax.dynamic_update_index_in_dim(
+                p, jnp.zeros((ns,), p.dtype), b, axis=0
+            )
+
+    rows = [row_of(p) for p in cal.payload]
     if cal.src is not None:
-        row_s1 = jax.lax.dynamic_index_in_dim(
-            cal.src, b, axis=0, keepdims=False
-        )
+        row_s1 = row_of(cal.src)
         row_v = row_s1 != 0
         row_s = row_s1 - 1
-        new_src = jax.lax.dynamic_update_index_in_dim(
-            cal.src, jnp.zeros((ns,), jnp.int32), b, axis=0
-        )
+        new_src = clear_row(cal.src)
         new_valid = None
     else:
-        row_v = jax.lax.dynamic_index_in_dim(
-            cal.valid, b, axis=0, keepdims=False
-        )
+        row_v = row_of(cal.valid)
         row_s = jnp.zeros((ns,), jnp.int32)
         new_src = None
-        new_valid = jax.lax.dynamic_update_index_in_dim(
-            cal.valid, jnp.zeros((ns,), bool), b, axis=0
-        )
+        new_valid = clear_row(cal.valid)
     inbox = Inbox(
         payload=jnp.stack([r.reshape(slots, n) for r in rows]),
         src=row_s.reshape(slots, n),
@@ -249,12 +286,28 @@ def enqueue(
     bucket-fill derivation and base gather are compiled out (ranks start
     at 0 every tick; see the contract note in ``api.py``).
     """
-    horizon, ns = cal.occupancy_plane.shape
     slots = cal.slots
     width = cal.width
+    if cal.flat:
+        horizon = cal.horizon
+        ns = cal.occupancy_plane.shape[0] // horizon
+    else:
+        horizon, ns = cal.occupancy_plane.shape
     n = ns // slots
     o, n_src = valid.shape
     assert n_src == n
+
+    def scat(plane, b_idx, p_idx, vals):
+        """Scatter (bucket, pos) → plane in its storage layout. Dropped
+        entries carry b_idx == horizon, which lands out of range in both
+        forms (flat: ≥ horizon·ns with a unique p_idx riding along)."""
+        if cal.flat:
+            return plane.at[b_idx * ns + p_idx].set(
+                vals, mode="drop", unique_indices=True
+            )
+        return plane.at[b_idx, p_idx].set(
+            vals, mode="drop", unique_indices=True
+        )
 
     midx = jnp.arange(o * n, dtype=jnp.int32)
     src_f = midx if o == 1 else jnp.mod(midx, n)
@@ -407,19 +460,15 @@ def enqueue(
         buck_i = jnp.where(val_f, jnp.mod(t + delay, horizon), jnp.int32(horizon))
         pos_i = jnp.where(val_f, slot_in_src * n + dst_safe, midx)
         new_payload = tuple(
-            p.at[buck_i, pos_i].set(pw, mode="drop", unique_indices=True)
+            scat(p, buck_i, pos_i, pw)
             for p, pw in zip(cal.payload, pay_w)
         )
         if cal.src is not None:  # src+1 doubles as the occupancy mark
-            new_src = cal.src.at[buck_i, pos_i].set(
-                src_f + 1, mode="drop", unique_indices=True
-            )
+            new_src = scat(cal.src, buck_i, pos_i, src_f + 1)
             new_valid = None
         else:
             new_src = None
-            new_valid = cal.valid.at[buck_i, pos_i].set(
-                True, mode="drop", unique_indices=True
-            )
+            new_valid = scat(cal.valid, buck_i, pos_i, True)
         return (
             dataclasses.replace(
                 cal, payload=new_payload, src=new_src, valid=new_valid
@@ -488,10 +537,16 @@ def enqueue(
     # the fill table's flat index IS the sort key (bucket·n + dst).
     if stacking:
         marks = cal.occupancy_plane
-        occ_table = marks[:, 0:n] != 0
-        occ_table = occ_table.astype(jnp.int32)
-        for s in range(1, slots):
-            occ_table = occ_table + (marks[:, s * n : (s + 1) * n] != 0)
+        if cal.flat:
+            # flat plane → [L, slots, n] view; the compare+sum fuse over
+            # the linear buffer (no retiling copy materializes)
+            m3 = marks.reshape(horizon, slots, n)
+            occ_table = (m3 != 0).sum(axis=1, dtype=jnp.int32)
+        else:
+            occ_table = marks[:, 0:n] != 0
+            occ_table = occ_table.astype(jnp.int32)
+            for s in range(1, slots):
+                occ_table = occ_table + (marks[:, s * n : (s + 1) * n] != 0)
         occ_flat = occ_table.reshape(-1)
         base = occ_flat[jnp.minimum(sk, big - 1)]
         rank = rank + jnp.where(val_sorted, base, 0)
@@ -506,19 +561,14 @@ def enqueue(
     pos_i = jnp.where(val_s, rank * n + dst_s, pos)
 
     new_payload = tuple(
-        p.at[buck_i, pos_i].set(pw, mode="drop", unique_indices=True)
-        for p, pw in zip(cal.payload, pay_s)
+        scat(p, buck_i, pos_i, pw) for p, pw in zip(cal.payload, pay_s)
     )
     if cal.src is not None:  # src+1 doubles as the occupancy mark
-        new_src = cal.src.at[buck_i, pos_i].set(
-            src_s + 1, mode="drop", unique_indices=True
-        )
+        new_src = scat(cal.src, buck_i, pos_i, src_s + 1)
         new_valid = None
     else:
         new_src = None
-        new_valid = cal.valid.at[buck_i, pos_i].set(
-            True, mode="drop", unique_indices=True
-        )
+        new_valid = scat(cal.valid, buck_i, pos_i, True)
 
     return (
         dataclasses.replace(

@@ -17,8 +17,22 @@ from testground_tpu.sim.sync_kernel import (
 )
 
 
+# every transport test runs against BOTH plane layouts: 2-D rows (the
+# mesh-sharded form) and flat (the unsharded production form) — see the
+# Calendar docstring. The autouse fixture flips the layout used by _cal.
+_CAL_FLAT = False
+
+
+@pytest.fixture(autouse=True, params=[False, True], ids=["rows", "flat"])
+def _calendar_layout(request):
+    global _CAL_FLAT
+    _CAL_FLAT = request.param
+    yield
+    _CAL_FLAT = False
+
+
 def _cal(horizon=8, n=4, slots=2, width=2):
-    return Calendar.empty(horizon, n, slots, width)
+    return Calendar.empty(horizon, n, slots, width, flat=_CAL_FLAT)
 
 
 def _link(n=4, groups=1, latency=1.0, **kw):
@@ -130,7 +144,7 @@ class TestTransport:
     def test_bandwidth_caps_messages_per_tick(self):
         """B bytes/s admits floor(B·tick/MSG_BYTES) messages per tick."""
         n, o = 2, 4
-        cal = Calendar.empty(8, n, 8, 1)
+        cal = Calendar.empty(8, n, 8, 1, flat=_CAL_FLAT)
         # 2 msgs/tick at 1ms ticks: B = 2 * 256 * 1000
         link = _link(n=n, latency=1.0, bandwidth=2 * net.MSG_BYTES * 1000.0)
         dsts = jnp.zeros((o, n), jnp.int32).at[:, 0].set(1)
@@ -153,7 +167,7 @@ class TestTransport:
         """More same-tick senders than IN_MSGS slots: the surplus drops
         (a full accept queue in the reference)."""
         n = 8
-        cal = Calendar.empty(8, n, 2, 1)  # 2 inbox slots
+        cal = Calendar.empty(8, n, 2, 1, flat=_CAL_FLAT)  # 2 inbox slots
         link = _link(n=n, latency=1.0)
         dsts = jnp.zeros((1, n), jnp.int32)  # everyone → instance 0
         pay = jnp.ones((1, 1, n), jnp.int32)
