@@ -17,6 +17,7 @@ Instances pair/chain by global sequence number; all control flow is
 
 import jax.numpy as jnp
 
+from testground_tpu.sim.net import SHAPING_NO_DUPLICATE
 from testground_tpu.sim.api import (
     FAILURE,
     FILTER_ACCEPT,
@@ -41,15 +42,7 @@ class PingPong(SimTestcase):
     # duplicate-shaping stays undeclared like pingpong-sustained — its
     # second-copy pass would double the message axis for a feature this
     # plan never exercises
-    SHAPING = (
-        "latency",
-        "jitter",
-        "bandwidth",
-        "loss",
-        "corrupt",
-        "reorder",
-        "filters",
-    )
+    SHAPING = SHAPING_NO_DUPLICATE
 
     @classmethod
     def specialize(cls, groups, tick_ms=1.0):
@@ -232,15 +225,7 @@ class PingPongSustained(SimTestcase):
     # shaping feature except duplicate (whose second-copy pass doubles
     # the message axis; plans that shape duplicates declare it — none of
     # the reference network plans do)
-    SHAPING = (
-        "latency",
-        "jitter",
-        "bandwidth",
-        "loss",
-        "corrupt",
-        "reorder",
-        "filters",
-    )
+    SHAPING = SHAPING_NO_DUPLICATE
 
     def init(self, env):
         z = jnp.int32(0)

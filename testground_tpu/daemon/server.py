@@ -5,14 +5,18 @@ number of CLI clients talk to over HTTP, mirroring the reference's route
 surface (``daemon.go:83-101``) and bearer-token auth (``daemon.go:49-70``):
 
     POST /run /build /tasks /status /logs /outputs /terminate
-         /healthcheck /kill /build/purge /plan/import
-    GET  /tasks /journal /data /dashboard
+         /healthcheck /kill /delete /build/purge /plan/import
+    GET  /tasks /journal /data /dashboard /describe /kill /delete
 
 The GET tier is the reference's web-dashboard surface (``daemon.go:83-91``,
 ``dashboard.go:44-75``): ``/journal`` returns a task's result journal,
 ``/data`` returns one measurement's sampled rows (the InfluxDB-table
-analog, served from the metrics viewer), and ``/dashboard`` renders the
-task list / per-task measurement tables as HTML.
+analog, served from the metrics viewer), ``/dashboard`` renders the
+task list / per-task measurement tables as HTML, ``/describe`` serves a
+daemon-hosted plan's manifest to remote CLIs, and ``/kill`` + ``/delete``
+are the same state-changing verbs the reference exposes on GET
+(``daemon.go:87-88``) — note they mutate on GET exactly like the
+reference's, so dashboards must not prefetch links.
 
 Transport notes (deviations are simplifications, not semantics):
 
@@ -121,6 +125,10 @@ class _Handler(BaseHTTPRequestHandler):
             "/data": lambda: self._data(q),
             "/dashboard": lambda: self._dashboard(q),
             "/describe": lambda: self._describe(q),
+            # the reference serves kill/delete on GET (daemon.go:87-88,
+            # dashboard links); the POST forms carry the same semantics
+            "/kill": lambda: self._kill(q),
+            "/delete": lambda: self._delete(q),
         }
         h = handlers.get(url.path)
         if h is None:

@@ -73,6 +73,11 @@ FULL_SHAPING = (
     "filters",
 )
 
+# FULL_SHAPING minus duplicate-shaping, whose second-copy pass doubles
+# the message axis — the declaration for plans that exercise every other
+# knob but never duplicate (both network ping-pong workloads).
+SHAPING_NO_DUPLICATE = tuple(f for f in FULL_SHAPING if f != "duplicate")
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass

@@ -100,6 +100,21 @@ class TestDaemonEndToEnd:
         with pytest.raises(DaemonError):
             client.status("missing-task")
 
+    def test_kill_delete_also_served_on_get(self, client, daemon):
+        """The reference serves kill/delete as GET routes (daemon.go:87-88,
+        dashboard links); both verbs answer on GET with query params."""
+        import json as _json
+        from urllib.request import urlopen
+
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        task_id = client.run(_placebo_composition())
+        _wait(client, task_id)
+        base = daemon.address
+        with urlopen(f"{base}/kill?task_id={task_id}") as r:
+            assert _json.load(r) == {"killed": False}  # already finished
+        with urlopen(f"{base}/delete?task_id={task_id}") as r:
+            assert _json.load(r) == {"deleted": True}
+
     def test_describe_plan_remote(self, client):
         """GET /describe serves the daemon-side manifest so a remote CLI
         can run daemon-hosted plans with no local copy."""

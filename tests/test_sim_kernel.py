@@ -17,13 +17,15 @@ from testground_tpu.sim.sync_kernel import (
 )
 
 
-# every transport test runs against BOTH plane layouts: 2-D rows (the
+# transport tests run against BOTH plane layouts: 2-D rows (the
 # mesh-sharded form) and flat (the unsharded production form) — see the
-# Calendar docstring. The autouse fixture flips the layout used by _cal.
+# Calendar docstring. Classes that exercise the calendar request the
+# fixture via @pytest.mark.usefixtures; sync/specialize tests don't
+# touch it and run once.
 _CAL_FLAT = False
 
 
-@pytest.fixture(autouse=True, params=[False, True], ids=["rows", "flat"])
+@pytest.fixture(params=[False, True], ids=["rows", "flat"])
 def _calendar_layout(request):
     global _CAL_FLAT
     _CAL_FLAT = request.param
@@ -61,6 +63,7 @@ def _send_one(cal, link, src, dst, word, t=0, tick_ms=1.0, n=4, seed=0):
     )
 
 
+@pytest.mark.usefixtures("_calendar_layout")
 class TestTransport:
     def test_latency_delivery_timing(self):
         """A message shaped with L ms latency arrives exactly ceil(L/tick)
@@ -266,6 +269,7 @@ class TestSyncKernel:
         assert int(sync.dropped[0]) == n - cap
 
 
+@pytest.mark.usefixtures("_calendar_layout")
 class TestCrossTickStacking:
     def test_two_ticks_same_bucket_stack_into_slots(self):
         """Messages enqueued on DIFFERENT ticks that land in the same

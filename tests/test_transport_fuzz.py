@@ -1,0 +1,180 @@
+"""Property-based fuzz of the calendar transport (SURVEY.md §4 tier 2,
+strengthened): random message schedules through random link shapes must
+
+1. deliver BIT-IDENTICALLY through the two plane storage layouts (flat
+   vs 2-D rows — the unsharded and mesh-sharded forms, see the Calendar
+   docstring), and
+2. satisfy the delivery invariants regardless of shaping: every delivered
+   message was actually sent (payload word0 is unique per send), arrives
+   no earlier than one tick after its send, each original message is
+   delivered at most once (at most twice with duplicate-shaping), and
+   provenance (src) matches the true sender.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from testground_tpu.sim import net
+from testground_tpu.sim.net import Calendar, deliver, enqueue
+
+
+@dataclasses.dataclass
+class Schedule:
+    n: int
+    o: int
+    slots: int
+    horizon: int
+    ticks: int
+    latency_ms: float
+    jitter_ms: float
+    loss: float
+    duplicate: float
+    sends: list  # per tick: (dst [o,n], valid [o,n]) int arrays
+    seed: int
+
+
+@st.composite
+def schedules(draw):
+    n = draw(st.integers(2, 10))
+    o = draw(st.integers(1, 3))
+    slots = draw(st.integers(1, 4))
+    horizon = draw(st.sampled_from([4, 8, 16]))
+    ticks = draw(st.integers(1, 8))
+    latency = float(draw(st.integers(1, min(horizon - 1, 5))))
+    jitter = float(draw(st.sampled_from([0.0, 0.0, 2.0])))
+    loss = float(draw(st.sampled_from([0.0, 0.0, 30.0])))
+    dup = float(draw(st.sampled_from([0.0, 0.0, 100.0])))
+    sends = []
+    for _ in range(ticks):
+        dst = draw(
+            st.lists(
+                st.lists(st.integers(0, n - 1), min_size=n, max_size=n),
+                min_size=o,
+                max_size=o,
+            )
+        )
+        valid = draw(
+            st.lists(
+                st.lists(st.booleans(), min_size=n, max_size=n),
+                min_size=o,
+                max_size=o,
+            )
+        )
+        sends.append((dst, valid))
+    return Schedule(
+        n=n, o=o, slots=slots, horizon=horizon, ticks=ticks,
+        latency_ms=latency, jitter_ms=jitter, loss=loss, duplicate=dup,
+        sends=sends, seed=draw(st.integers(0, 2**30)),
+    )
+
+
+def _run(sched: Schedule, flat: bool):
+    """Run the schedule; returns per-tick inbox snapshots (numpy)."""
+    n, o = sched.n, sched.o
+    width = 2
+    cal = Calendar.empty(
+        sched.horizon, n, sched.slots, width, track_src=True, flat=flat
+    )
+    link = net.make_link_state(
+        n,
+        1,
+        [sched.latency_ms, sched.jitter_ms, 0.0, sched.loss, 0.0, 0.0,
+         sched.duplicate],
+    )
+    out = []
+    uid = 0
+    total_ticks = sched.ticks + sched.horizon + 2
+    for t in range(total_ticks):
+        cal, inbox = deliver(cal, jnp.int32(t))
+        out.append(
+            (
+                np.asarray(inbox.payload),
+                np.asarray(inbox.src),
+                np.asarray(inbox.valid),
+            )
+        )
+        if t < sched.ticks:
+            dst_l, val_l = sched.sends[t]
+            dst = jnp.asarray(dst_l, jnp.int32)
+            valid = jnp.asarray(val_l, bool)
+            # word0: globally unique send id; word1: sender index
+            base = uid
+            uid += o * n
+            ids = jnp.arange(base, base + o * n, dtype=jnp.int32).reshape(
+                o, n
+            )
+            srcs = jnp.tile(jnp.arange(n, dtype=jnp.int32)[None, :], (o, 1))
+            payload = jnp.stack([ids, srcs], axis=1)  # [o, W, n]
+            cal, _ = enqueue(
+                cal,
+                link,
+                dst,
+                payload,
+                valid,
+                jnp.int32(t),
+                1.0,
+                jax.random.key(sched.seed + t),
+            )
+    return out
+
+
+def _sent_index(sched: Schedule):
+    """uid -> (send_tick, src, dst, was_valid)."""
+    idx = {}
+    uid = 0
+    for t in range(sched.ticks):
+        dst_l, val_l = sched.sends[t]
+        for oi in range(sched.o):
+            for s in range(sched.n):
+                idx[uid] = (t, s, dst_l[oi][s], bool(val_l[oi][s]))
+                uid += 1
+    return idx
+
+
+@settings(max_examples=25, deadline=None)
+@given(schedules())
+def test_flat_and_rows_layouts_deliver_identically(sched):
+    a = _run(sched, flat=False)
+    b = _run(sched, flat=True)
+    for (pa, sa, va), (pb, sb, vb) in zip(a, b):
+        assert (va == vb).all()
+        assert (np.where(va, sa, -1) == np.where(vb, sb, -1)).all()
+        assert (np.where(va[None], pa, -1) == np.where(vb[None], pb, -1)).all()
+
+
+@settings(max_examples=25, deadline=None)
+@given(schedules())
+def test_delivery_invariants(sched):
+    sent = _sent_index(sched)
+    deliveries = {}  # uid -> list of (arrival_tick, to, src_seen)
+    for t, (pay, src, valid) in enumerate(_run(sched, flat=True)):
+        for slot in range(valid.shape[0]):
+            for d in range(valid.shape[1]):
+                if not valid[slot, d]:
+                    continue
+                uid = int(pay[0, slot, d])
+                deliveries.setdefault(uid, []).append(
+                    (t, d, int(src[slot, d]))
+                )
+    max_copies = 2 if sched.duplicate > 0 else 1
+    for uid, arrivals in deliveries.items():
+        assert uid in sent, f"delivered a never-sent message {uid}"
+        t0, s, d0, was_valid = sent[uid]
+        assert was_valid, f"invalid send {uid} was delivered"
+        assert len(arrivals) <= max_copies, (
+            f"message {uid} delivered {len(arrivals)} times"
+        )
+        for t, to, src_seen in arrivals:
+            assert to == d0, f"message {uid} delivered to {to}, sent to {d0}"
+            assert src_seen == s, (
+                f"message {uid} src {src_seen}, sender was {s}"
+            )
+            assert t >= t0 + 1, f"message {uid} arrived before send+1"
+            assert t <= t0 + sched.horizon, (
+                f"message {uid} outlived the horizon"
+            )
