@@ -313,7 +313,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json({"report": report.to_dict(), "output": buf.getvalue()})
 
     def _kill(self, body: dict) -> None:
-        ok = self.engine.kill(body["task_id"])
+        task_id = body.get("task_id")
+        if not task_id:  # also reachable from the GET form's URL bar
+            return self._send_error_json("task_id param required", 400)
+        ok = self.engine.kill(task_id)
         self._send_json({"killed": bool(ok)})
 
     def _describe(self, q: dict) -> None:
@@ -328,8 +331,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _delete(self, body: dict) -> None:
         """Delete a finished task's record + log (``daemon.go:88``)."""
+        task_id = body.get("task_id")
+        if not task_id:
+            return self._send_error_json("task_id param required", 400)
         try:
-            ok = self.engine.delete_task(body["task_id"])
+            ok = self.engine.delete_task(task_id)
         except ValueError as e:  # task still live
             return self._send_error_json(str(e), 409)
         self._send_json({"deleted": bool(ok)})

@@ -1,0 +1,113 @@
+"""Cross-runner equivalence (BASELINE config 2's spirit: the simulator
+validated against real-process ground truth): the SAME plan, run through
+``local:exec`` (real OS processes + TCP sync service) and ``sim:jax``
+(vectorized simulation), must produce the same per-group outcomes for
+every behavior class — success, app failure, crash, and stall."""
+
+import os
+import time
+
+import pytest
+
+from testground_tpu.api import (
+    Composition,
+    Global,
+    Group,
+    Instances,
+    TestPlanManifest,
+    generate_default_run,
+)
+from testground_tpu.builders.exec_py import ExecPyBuilder
+from testground_tpu.builders.sim_plan import SimPlanBuilder
+from testground_tpu.config import EnvConfig
+from testground_tpu.engine import Engine, EngineConfig, Outcome, State
+from testground_tpu.runners.local_exec import LocalExecRunner
+from testground_tpu.sim.runner import SimJaxRunner
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+@pytest.fixture()
+def engine(tg_home):
+    e = Engine(
+        EngineConfig(
+            env=EnvConfig.load(),
+            builders=[ExecPyBuilder(), SimPlanBuilder()],
+            runners=[LocalExecRunner(), SimJaxRunner()],
+        )
+    )
+    e.start_workers()
+    yield e
+    e.stop()
+
+
+def _run(engine, case, builder, runner, instances=3, run_config=None):
+    comp = generate_default_run(
+        Composition(
+            global_=Global(
+                plan="placebo",
+                case=case,
+                builder=builder,
+                runner=runner,
+                run_config=dict(run_config or {}),
+            ),
+            groups=[Group(id="all", instances=Instances(count=instances))],
+        )
+    )
+    manifest = TestPlanManifest.load_file(
+        os.path.join(PLANS, "placebo", "manifest.toml")
+    )
+    tid = engine.queue_run(
+        comp, manifest, sources_dir=os.path.join(PLANS, "placebo")
+    )
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        t = engine.get_task(tid)
+        if t is not None and t.state().state in (
+            State.COMPLETE,
+            State.CANCELED,
+        ):
+            return t
+        time.sleep(0.05)
+    raise TimeoutError(tid)
+
+
+# behavior class -> expected outcome on BOTH substrates. `stall` is
+# bounded by the runner's own budget in each world (run_timeout for real
+# processes, max_ticks for the sim) and must come back FAILURE, not hang.
+CASES = [
+    ("ok", Outcome.SUCCESS),
+    ("abort", Outcome.FAILURE),
+    ("panic", Outcome.FAILURE),
+]
+
+
+class TestSimMatchesRealProcesses:
+    @pytest.mark.parametrize("case,expected", CASES)
+    def test_outcomes_agree(self, engine, case, expected):
+        real = _run(engine, case, "exec:py", "local:exec")
+        sim = _run(engine, case, "sim:plan", "sim:jax")
+        assert real.outcome() == expected, f"local:exec {case}"
+        assert sim.outcome() == expected, f"sim:jax {case}"
+        # per-group ok counts agree too (single-run results are flattened
+        # to a top-level outcomes dict)
+        assert real.result["outcomes"] == sim.result["outcomes"]
+
+    def test_stall_bounded_on_both(self, engine):
+        real = _run(
+            engine,
+            "stall",
+            "exec:py",
+            "local:exec",
+            run_config={"run_timeout_secs": 3},
+        )
+        sim = _run(
+            engine,
+            "stall",
+            "sim:plan",
+            "sim:jax",
+            run_config={"max_ticks": 64, "chunk": 16},
+        )
+        assert real.outcome() == Outcome.FAILURE
+        assert sim.outcome() == Outcome.FAILURE

@@ -322,6 +322,11 @@ class TestCrossTickStacking:
             )
             assert got == [111, 222], f"stacking={stacking}: {got}"
 
+
+class TestSpecialize:
+    """Per-run static narrowing (SimTestcase.specialize) — no calendar
+    involved, so these run once, outside the dual-layout fixture."""
+
     def test_storm_specialize_narrows_message_axis(self):
         """Storm's per-run specialization sizes OUT_MSGS/IN_MSGS from
         conn_outgoing instead of the manifest upper bound."""
@@ -384,6 +389,9 @@ class TestCrossTickStacking:
         )
         assert pp.specialize((ghi,), tick_ms=1.0) is pp
 
+
+@pytest.mark.usefixtures("_calendar_layout")
+class TestCalendarDice:
     def test_shaping_dice_differ_by_key(self):
         """The transport's stochastic draws (loss here) are a function of
         the per-tick key: the same key reproduces the same drop set and a
