@@ -298,6 +298,25 @@ class LocalExecRunner(Runner, HealthcheckedRunner):
             for _, _, proc in procs:
                 if proc.poll() is None:
                     proc.kill()
+            for _, _, proc in procs:  # reap — no zombies until GC
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+            # reader threads drain to EOF after the kill; release the pipe
+            # files once they have (closing a file a blocked reader still
+            # holds would deadlock — e.g. a grandchild keeping the write
+            # end open past the kill — so in that rare case we prefer the
+            # bounded fd leak and let GC finish the job)
+            pretty.wait(timeout=10.0)
+            if pretty.drained():
+                for _, _, proc in procs:
+                    for f in (proc.stdout, proc.stderr):
+                        if f is not None:
+                            try:
+                                f.close()
+                            except OSError:
+                                pass
             collector_client.close()  # unblocks the collector's subscribe
             sync_server.stop()
 

@@ -131,3 +131,10 @@ class PrettyPrinter:
             )
         with self._lock:
             return self._failed
+
+    def drained(self) -> bool:
+        """True when every stream reader has exited (hit EOF). Callers
+        must check this before closing the underlying pipe files —
+        closing a file another thread is blocked reading deadlocks in
+        CPython."""
+        return not any(t.is_alive() for t in self._threads)

@@ -4,28 +4,16 @@ validated against real-process ground truth): the SAME plan, run through
 (vectorized simulation), must produce the same per-group outcomes for
 every behavior class — success, app failure, crash, and stall."""
 
-import os
-import time
-
 import pytest
 
-from testground_tpu.api import (
-    Composition,
-    Global,
-    Group,
-    Instances,
-    TestPlanManifest,
-    generate_default_run,
-)
 from testground_tpu.builders.exec_py import ExecPyBuilder
 from testground_tpu.builders.sim_plan import SimPlanBuilder
 from testground_tpu.config import EnvConfig
-from testground_tpu.engine import Engine, EngineConfig, Outcome, State
+from testground_tpu.engine import Engine, EngineConfig, Outcome
 from testground_tpu.runners.local_exec import LocalExecRunner
 from testground_tpu.sim.runner import SimJaxRunner
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PLANS = os.path.join(REPO_ROOT, "plans")
+from tests.test_local_exec import run_plan
 
 
 @pytest.fixture()
@@ -42,40 +30,21 @@ def engine(tg_home):
     e.stop()
 
 
-def _run(engine, case, builder, runner, instances=3, run_config=None):
-    comp = generate_default_run(
-        Composition(
-            global_=Global(
-                plan="placebo",
-                case=case,
-                builder=builder,
-                runner=runner,
-                run_config=dict(run_config or {}),
-            ),
-            groups=[Group(id="all", instances=Instances(count=instances))],
-        )
+def _real(engine, case, **kw):
+    return run_plan(
+        engine, "placebo", case, instances=3, timeout=90,
+        builder="exec:py", runner="local:exec", **kw,
     )
-    manifest = TestPlanManifest.load_file(
-        os.path.join(PLANS, "placebo", "manifest.toml")
-    )
-    tid = engine.queue_run(
-        comp, manifest, sources_dir=os.path.join(PLANS, "placebo")
-    )
-    deadline = time.time() + 90
-    while time.time() < deadline:
-        t = engine.get_task(tid)
-        if t is not None and t.state().state in (
-            State.COMPLETE,
-            State.CANCELED,
-        ):
-            return t
-        time.sleep(0.05)
-    raise TimeoutError(tid)
 
 
-# behavior class -> expected outcome on BOTH substrates. `stall` is
-# bounded by the runner's own budget in each world (run_timeout for real
-# processes, max_ticks for the sim) and must come back FAILURE, not hang.
+def _sim(engine, case, **kw):
+    return run_plan(
+        engine, "placebo", case, instances=3, timeout=90,
+        builder="sim:plan", runner="sim:jax", **kw,
+    )
+
+
+# behavior class -> expected outcome on BOTH substrates
 CASES = [
     ("ok", Outcome.SUCCESS),
     ("abort", Outcome.FAILURE),
@@ -86,8 +55,8 @@ CASES = [
 class TestSimMatchesRealProcesses:
     @pytest.mark.parametrize("case,expected", CASES)
     def test_outcomes_agree(self, engine, case, expected):
-        real = _run(engine, case, "exec:py", "local:exec")
-        sim = _run(engine, case, "sim:plan", "sim:jax")
+        real = _real(engine, case)
+        sim = _sim(engine, case)
         assert real.outcome() == expected, f"local:exec {case}"
         assert sim.outcome() == expected, f"sim:jax {case}"
         # per-group ok counts agree too (single-run results are flattened
@@ -95,19 +64,12 @@ class TestSimMatchesRealProcesses:
         assert real.result["outcomes"] == sim.result["outcomes"]
 
     def test_stall_bounded_on_both(self, engine):
-        real = _run(
-            engine,
-            "stall",
-            "exec:py",
-            "local:exec",
-            run_config={"run_timeout_secs": 3},
-        )
-        sim = _run(
-            engine,
-            "stall",
-            "sim:plan",
-            "sim:jax",
-            run_config={"max_ticks": 64, "chunk": 16},
+        """`stall` is bounded by each runner's own budget (run_timeout for
+        real processes, max_ticks for the sim) and must come back FAILURE,
+        not hang."""
+        real = _real(engine, "stall", run_config={"run_timeout_secs": 3})
+        sim = _sim(
+            engine, "stall", run_config={"max_ticks": 64, "chunk": 16}
         )
         assert real.outcome() == Outcome.FAILURE
         assert sim.outcome() == Outcome.FAILURE

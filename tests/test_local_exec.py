@@ -40,15 +40,23 @@ def engine(tg_home):
 
 
 def run_plan(
-    engine, plan, case, instances=1, params=None, timeout=60, run_config=None
+    engine,
+    plan,
+    case,
+    instances=1,
+    params=None,
+    timeout=60,
+    run_config=None,
+    builder="exec:py",
+    runner="local:exec",
 ):
     comp = generate_default_run(
         Composition(
             global_=Global(
                 plan=plan,
                 case=case,
-                builder="exec:py",
-                runner="local:exec",
+                builder=builder,
+                runner=runner,
                 run_config=dict(run_config or {}),
             ),
             groups=[Group(id="all", instances=Instances(count=instances))],
