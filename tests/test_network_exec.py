@@ -1,0 +1,45 @@
+"""The exec edition of the network plan: REAL TCP ping-pong between real
+processes with sync-service address exchange (BASELINE config 1 — network
+ping-pong, 2 instances, local:exec)."""
+
+import io
+import re
+import tarfile
+
+from testground_tpu.engine import Outcome
+from testground_tpu.rpc import discard_writer
+
+from tests.test_cross_runner import engine  # noqa: F401 — fixture reuse
+from tests.test_local_exec import run_plan
+
+
+class TestRealSocketPingPong:
+    def test_two_instances(self, engine):  # noqa: F811
+        t = run_plan(engine, "network", "ping-pong", instances=2)
+        assert t.outcome() == Outcome.SUCCESS
+        # the dialer measured real RTTs on a real socket
+        buf = io.BytesIO()
+        engine.do_collect_outputs("local:exec", t.id, buf, discard_writer())
+        buf.seek(0)
+        out = ""
+        with tarfile.open(fileobj=buf, mode="r:gz") as tar:
+            for m in tar.getmembers():
+                if m.name.endswith("run.out"):
+                    out += tar.extractfile(m).read().decode()
+        rtts = re.findall(r"round \d rtt: ([0-9.]+) ms", out)
+        assert len(rtts) == 2  # one dialer, two rounds
+        assert all(float(ms) < 5000 for ms in rtts)
+
+    def test_four_instances_two_pairs(self, engine):  # noqa: F811
+        t = run_plan(engine, "network", "ping-pong", instances=4)
+        assert t.outcome() == Outcome.SUCCESS
+
+    def test_odd_count_solo_succeeds(self, engine):  # noqa: F811
+        t = run_plan(engine, "network", "ping-pong", instances=3)
+        assert t.outcome() == Outcome.SUCCESS
+
+    def test_sim_only_case_fails_cleanly(self, engine):  # noqa: F811
+        """Manifest-advertised cases without an exec edition fail with a
+        clear pointer instead of crashing with exit 2."""
+        t = run_plan(engine, "network", "traffic-allowed", instances=2)
+        assert t.outcome() == Outcome.FAILURE
