@@ -6,6 +6,7 @@ success/failure/timeout fixtures used by the integration suite
 (``integration_tests/03-05``, 14, 16).
 """
 
+import os
 import time
 
 from testground_tpu.sdk import invoke_map
@@ -33,6 +34,24 @@ def stall(runenv):
     time.sleep(24 * 3600)
 
 
+def optional_failure(runenv):
+    """Fails only when the run sets ``should_fail`` — the per-run knob the
+    multi-run suite flips (reference: the ``issue-1493-optional-failure``
+    testcase of ``plans/_integrations_runs``, driven by
+    ``integration_tests/1493_continue_on_failure.sh``)."""
+    if runenv.test_instance_params.get("should_fail", "") == "true":
+        return "failing because should_fail is set"
+    runenv.record_message("should_fail not set; succeeding")
+
+
+def silent(runenv):
+    """Exits without emitting a TERMINAL event (the start event has
+    already been flushed by invoke_map). The runner must judge the
+    instance incomplete and fail the run (reference: issue-1349,
+    ``integration_tests/14_docker_silent_test_failure.sh``)."""
+    os._exit(0)
+
+
 def metrics(runenv):
     c = runenv.R().counter("placebo.counter")
     h = runenv.R().histogram("placebo.histogram")
@@ -49,6 +68,8 @@ if __name__ == "__main__":
             "abort": abort,
             "panic": panic,
             "stall": stall,
+            "optional-failure": optional_failure,
+            "silent": silent,
             "metrics": metrics,
         }
     )

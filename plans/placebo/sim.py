@@ -42,6 +42,23 @@ class Stall(SimTestcase):
         return self.out(state, status=RUNNING)
 
 
+class OptionalFailure(SimTestcase):
+    """Per-run failure knob (the ``issue-1493-optional-failure`` analog):
+    ``should_fail`` is a group parameter, so it is a trace-time constant —
+    no data-dependent control flow enters the compiled step."""
+
+    def init(self, env):
+        self.should_fail = (
+            env.group.params.get("should_fail", "") == "true"
+        )
+        return {}
+
+    def step(self, env, state, inbox, sync, t):
+        return self.out(
+            state, status=FAILURE if self.should_fail else SUCCESS
+        )
+
+
 class Metrics(SimTestcase):
     """Counts to 10 across ticks, then succeeds; the counter lands in each
     instance's metrics.out via collect_metrics."""
@@ -66,5 +83,6 @@ sim_testcases = {
     "abort": Abort,
     "panic": Panic,
     "stall": Stall,
+    "optional-failure": OptionalFailure,
     "metrics": Metrics,
 }

@@ -270,6 +270,17 @@ def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
         outcome = t.outcome()
         print(f"finished run with ID: {task_id} (outcome: {outcome.value})")
 
+        # per-run breakdown for multi-[[runs]] compositions (the reference
+        # CLI reports each run's result as it completes, run.go:281-336)
+        run_results = (
+            t.result.get("runs", {}) if isinstance(t.result, dict) else {}
+        )
+        for rid, rres in run_results.items():
+            print(
+                f"  run {rid}: outcome: "
+                f"{rres.get('outcome', Outcome.UNKNOWN.value)}"
+            )
+
         if write_artifacts_to and isinstance(t.result, dict):
             comp_out = t.result.get("composition")
             if comp_out:
@@ -290,7 +301,21 @@ def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
                 w = csv.writer(f)
                 if new:
                     w.writerow(["task_id", "plan_case", "outcome", "error"])
-                w.writerow([t.id, t.name(), outcome.value, t.error])
+                if run_results:
+                    # one row per [[runs]] entry, like the reference's
+                    # --result-file CSV (asserted per-run by
+                    # integration_tests/1493_continue_on_failure.sh)
+                    for rid, rres in run_results.items():
+                        w.writerow(
+                            [
+                                f"{t.id}-{rid}",
+                                t.name(),
+                                rres.get("outcome", Outcome.UNKNOWN.value),
+                                t.error,
+                            ]
+                        )
+                else:
+                    w.writerow([t.id, t.name(), outcome.value, t.error])
 
         return 0 if outcome == Outcome.SUCCESS else 1
     finally:

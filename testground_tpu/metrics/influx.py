@@ -1,0 +1,119 @@
+"""Optional InfluxDB push for run metric time series.
+
+The reference SDK batches runtime metrics into InfluxDB 1.x
+(``INFLUXDB_URL`` env, ``pkg/runner/local_docker.go:353``) and the
+daemon's dashboard queries it (``pkg/metrics/viewer.go:35-80``). Here the
+canonical store is the per-run ``timeseries.jsonl`` (see
+``metrics/viewer.py``); when ``[daemon] influxdb_endpoint`` is configured
+in ``.env.toml`` the same rows are ALSO pushed to InfluxDB's
+``POST /write?db=<db>`` line-protocol endpoint so existing Grafana/Influx
+setups keep working. Push is best-effort: failures are logged and
+journaled, never fatal to the run.
+"""
+
+from __future__ import annotations
+
+import math
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from testground_tpu.logging_ import S
+
+__all__ = ["rows_to_lines", "push_rows", "escape_tag", "escape_measurement"]
+
+DEFAULT_DB = "testground"
+
+
+def escape_measurement(s: str) -> str:
+    """Line-protocol measurement escaping (commas and spaces)."""
+    return s.replace(",", r"\,").replace(" ", r"\ ")
+
+
+def escape_tag(s: str) -> str:
+    """Line-protocol tag key/value escaping (commas, equals, spaces)."""
+    return (
+        s.replace(",", r"\,").replace("=", r"\=").replace(" ", r"\ ")
+    )
+
+
+def _field_value(v) -> str | None:
+    if isinstance(v, bool):  # bool is an int subclass — check first
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return f"{v}i"
+    if isinstance(v, float):
+        # inf/nan are invalid line protocol; one bad field would make
+        # InfluxDB 400 the whole single-POST batch
+        return repr(float(v)) if math.isfinite(v) else None
+    return None
+
+
+def rows_to_lines(rows) -> list[str]:
+    """Serialize timeseries rows (the ``timeseries.jsonl`` dict shape:
+    plan/case/run/group_id/name/tick + numeric fields) into InfluxDB line
+    protocol. The measurement name keeps the reference's
+    ``results.<plan>-<case>.<metric>`` shape (``dashboard.go:112-118``)
+    and the simulated tick stands in for the timestamp (nanoseconds are
+    meaningless in simulated time; ticks order points the same way)."""
+    from testground_tpu.metrics.viewer import measurement_name
+
+    lines: list[str] = []
+    for row in rows:
+        name = row.get("name")
+        if not name:
+            continue
+        measurement = escape_measurement(
+            measurement_name(
+                str(row.get("plan", "")), str(row.get("case", "")), str(name)
+            )
+        )
+        tags = ""
+        for key in ("run", "group_id"):
+            val = str(row.get(key, ""))
+            if val:
+                tags += f",{escape_tag(key)}={escape_tag(val)}"
+        fields = []
+        for k, v in row.items():
+            if k in ("plan", "case", "run", "group_id", "name", "tick"):
+                continue
+            fv = _field_value(v)
+            if fv is not None:
+                fields.append(f"{escape_tag(k)}={fv}")
+        if not fields:
+            continue
+        tick = int(row.get("tick", 0))
+        lines.append(f"{measurement}{tags} {','.join(fields)} {tick}")
+    return lines
+
+
+def push_rows(
+    endpoint: str,
+    rows,
+    db: str = DEFAULT_DB,
+    timeout: float = 5.0,
+) -> dict:
+    """POST rows to ``<endpoint>/write?db=<db>``. Returns a journal dict
+    ``{pushed, ok, error?}`` — callers record it and move on."""
+    lines = rows_to_lines(rows)
+    journal: dict = {"pushed": len(lines), "ok": False}
+    if not lines:
+        journal["ok"] = True
+        return journal
+    url = endpoint.rstrip("/") + "/write?" + urllib.parse.urlencode({"db": db})
+    body = ("\n".join(lines) + "\n").encode("utf-8")
+    req = urllib.request.Request(
+        url,
+        data=body,
+        method="POST",
+        headers={"Content-Type": "text/plain; charset=utf-8"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            journal["ok"] = 200 <= resp.status < 300
+            if not journal["ok"]:
+                journal["error"] = f"http {resp.status}"
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        journal["error"] = str(e)
+        S().warning("influx push to %s failed: %s", endpoint, e)
+    return journal

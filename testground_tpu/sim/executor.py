@@ -355,23 +355,31 @@ def execute_sim_run(
         run_dir = os.path.join(outputs_root, job.test_plan, job.run_id)
         os.makedirs(run_dir, exist_ok=True)
         ts_path = os.path.join(run_dir, "timeseries.jsonl")
+        full_rows = [
+            {
+                "run": job.run_id,
+                "plan": job.test_plan,
+                "case": job.test_case,
+                **row,
+            }
+            for row in recorder.rows
+        ]
         with open(ts_path, "w") as f:
-            for row in recorder.rows:
-                f.write(
-                    json.dumps(
-                        {
-                            "run": job.run_id,
-                            "plan": job.test_plan,
-                            "case": job.test_case,
-                            **row,
-                        }
-                    )
-                    + "\n"
-                )
+            for row in full_rows:
+                f.write(json.dumps(row) + "\n")
         result.journal["timeseries"] = {
             "samples": len(recorder.rows),
             "every_ticks": recorder.every,
         }
+        # optional InfluxDB mirror of the same rows (the reference batches
+        # SDK metrics into InfluxDB, ``local_docker.go:353``); best-effort
+        influx_endpoint = (
+            job.env.daemon.influxdb_endpoint if job.env is not None else ""
+        )
+        if influx_endpoint:
+            from testground_tpu.metrics.influx import push_rows
+
+            result.journal["influx"] = push_rows(influx_endpoint, full_rows)
 
     for gi, g in enumerate(groups):
         st = status[g.offset : g.offset + g.count]

@@ -1,0 +1,170 @@
+"""Optional InfluxDB metrics push (reference: the SDK batches runtime
+metrics to InfluxDB via ``INFLUXDB_URL``, ``pkg/runner/local_docker.go:353``;
+here ``[daemon] influxdb_endpoint`` mirrors the run's timeseries rows to
+``POST /write?db=testground`` in line protocol)."""
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from testground_tpu.metrics.influx import push_rows, rows_to_lines
+
+ROWS = [
+    {
+        "run": "r1",
+        "plan": "network",
+        "case": "ping-pong",
+        "tick": 128,
+        "group_id": "all",
+        "name": "rtt_ticks",
+        "count": 10,
+        "mean": 5.5,
+    },
+    {
+        "run": "r1",
+        "plan": "network",
+        "case": "ping-pong",
+        "tick": 256,
+        "group_id": "g 2",
+        "name": "rtt_ticks",
+        "count": 11,
+        "mean": 6.5,
+    },
+]
+
+
+class TestLineProtocol:
+    def test_rows_to_lines(self):
+        lines = rows_to_lines(ROWS)
+        assert lines[0] == (
+            "results.network-ping-pong.rtt_ticks,run=r1,group_id=all"
+            " count=10i,mean=5.5 128"
+        )
+        # tag values with spaces are escaped, ints get the i suffix
+        assert r"group_id=g\ 2" in lines[1]
+        assert "count=11i" in lines[1]
+
+    def test_rows_without_name_or_fields_skipped(self):
+        assert rows_to_lines([{"run": "r", "tick": 1}]) == []
+        assert (
+            rows_to_lines(
+                [{"name": "m", "plan": "p", "case": "c", "tick": 1, "note": "x"}]
+            )
+            == []
+        )
+
+    def test_non_finite_fields_are_dropped(self):
+        """inf/nan are invalid line protocol; a single bad field must not
+        poison the batch (the POST carries every line of the run)."""
+        lines = rows_to_lines(
+            [
+                {
+                    "plan": "p",
+                    "case": "c",
+                    "name": "m",
+                    "tick": 0,
+                    "ratio": float("inf"),
+                    "count": 3,
+                },
+                {
+                    "plan": "p",
+                    "case": "c",
+                    "name": "m2",
+                    "tick": 0,
+                    "bad": float("nan"),
+                },
+            ]
+        )
+        assert lines == ["results.p-c.m count=3i 0"]
+
+    def test_measurement_escaping(self):
+        lines = rows_to_lines(
+            [
+                {
+                    "plan": "p p",
+                    "case": "c",
+                    "name": "m",
+                    "tick": 0,
+                    "count": 1,
+                }
+            ]
+        )
+        assert lines[0].startswith(r"results.p\ p-c.m ")
+
+
+class _CaptureHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        self.server.captured.append((self.path, self.rfile.read(n).decode()))
+        self.send_response(204)
+        self.end_headers()
+
+    def log_message(self, *a):  # noqa: D102 — quiet
+        pass
+
+
+@pytest.fixture()
+def influx_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _CaptureHandler)
+    srv.captured = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+class TestPush:
+    def test_push_rows(self, influx_server):
+        endpoint = f"http://127.0.0.1:{influx_server.server_address[1]}"
+        journal = push_rows(endpoint, ROWS)
+        assert journal == {"pushed": 2, "ok": True}
+        path, body = influx_server.captured[0]
+        assert path == "/write?db=testground"
+        assert body.count("\n") == 2
+        assert "results.network-ping-pong.rtt_ticks" in body
+
+    def test_push_empty_is_ok_and_sends_nothing(self, influx_server):
+        endpoint = f"http://127.0.0.1:{influx_server.server_address[1]}"
+        assert push_rows(endpoint, []) == {"pushed": 0, "ok": True}
+        assert influx_server.captured == []
+
+    def test_push_failure_is_journaled_not_raised(self):
+        journal = push_rows("http://127.0.0.1:1", ROWS, timeout=0.5)
+        assert journal["ok"] is False
+        assert "error" in journal
+
+
+class TestSimRunPush:
+    def test_sim_run_mirrors_timeseries_to_influx(self, tg_home, influx_server):
+        """End-to-end: a sim:jax run under an env with influxdb_endpoint
+        configured pushes its sampled rows and journals the result."""
+        from tests.test_sim_runner import run_sim
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.engine import Engine, EngineConfig, Outcome
+        from testground_tpu.sim.runner import SimJaxRunner
+
+        endpoint = f"http://127.0.0.1:{influx_server.server_address[1]}"
+        with open(os.path.join(tg_home, ".env.toml"), "w") as f:
+            f.write(f'[daemon]\ninfluxdb_endpoint = "{endpoint}"\n')
+        env = EnvConfig.load()
+        e = Engine(
+            EngineConfig(
+                env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+            )
+        )
+        e.start_workers()
+        try:
+            t = run_sim(e, "benchmarks", "netinit", instances=8)
+        finally:
+            e.stop()
+        assert t.outcome() == Outcome.SUCCESS
+        assert t.result["journal"]["influx"]["ok"] is True
+        assert t.result["journal"]["influx"]["pushed"] > 0
+        body = influx_server.captured[0][1]
+        assert "results.benchmarks-netinit.time_to_network_init_ticks" in body
