@@ -411,9 +411,19 @@ def register_plan(sub) -> None:
     pl.add_argument("--testcases", action="store_true", help="also list testcases")
     pl.set_defaults(func=plan_list_cmd)
 
-    pi = psub.add_parser("import", help="import a plan directory")
-    pi.add_argument("--from", dest="source", required=True, help="source dir")
+    pi = psub.add_parser("import", help="import a plan directory or git repo")
+    pi.add_argument(
+        "--from",
+        dest="source",
+        required=True,
+        help="source dir, or a git URL with --git",
+    )
     pi.add_argument("--name", default="", help="rename the plan on import")
+    pi.add_argument(
+        "--git",
+        action="store_true",
+        help="git-clone the source (any scheme git supports)",
+    )
     pi.add_argument(
         "--force", action="store_true", help="overwrite an existing plan"
     )
@@ -445,29 +455,67 @@ def plan_list_cmd(args) -> int:
 
 def plan_import_cmd(args) -> int:
     env = EnvConfig.load()
-    src = os.path.abspath(args.source)
-    if not os.path.isfile(os.path.join(src, "manifest.toml")):
-        raise FileNotFoundError(f"{src} has no manifest.toml")
-    endpoint = _endpoint(args, env)
-    if endpoint:
-        from testground_tpu.client import Client
+    tmp_ctx = None
+    try:
+        if args.git:
+            # clone through the git binary — any scheme git supports, like
+            # the reference's go-git clone path (``plan.go:210-214``) —
+            # into a tempdir, then fall through to the shared import tail
+            # so validation happens BEFORE any existing plan is replaced
+            import subprocess
+            import tempfile
 
-        name = Client(endpoint, token=env.client.token).import_plan(
-            src, name=args.name
-        )
-        print(f"imported plan {name} into daemon at {endpoint}")
-        return 0
-    name = args.name or os.path.basename(src.rstrip("/"))
-    dest = os.path.join(env.dirs.plans(), name)
-    if os.path.exists(dest):
-        if not args.force:
-            raise FileExistsError(
-                f"plan {name} already exists at {dest}; pass --force to replace"
+            name = args.name or os.path.basename(
+                args.source.rstrip("/").removesuffix(".git")
             )
-        shutil.rmtree(dest)
-    shutil.copytree(src, dest, ignore=shutil.ignore_patterns("__pycache__", ".git"))
-    print(f"imported plan {name} -> {dest}")
-    return 0
+            if name in ("", ".", ".."):
+                raise ValueError(
+                    f"cannot derive a plan name from {args.source!r}; "
+                    "pass --name"
+                )
+            tmp_ctx = tempfile.TemporaryDirectory(dir=env.dirs.work())
+            src = os.path.join(tmp_ctx.name, "clone")
+            res = subprocess.run(
+                ["git", "clone", "--depth", "1", args.source, src],
+                capture_output=True,
+                text=True,
+            )
+            if res.returncode != 0:
+                raise RuntimeError(f"git clone failed: {res.stderr.strip()}")
+        else:
+            name = args.name or os.path.basename(
+                os.path.abspath(args.source).rstrip("/")
+            )
+            src = os.path.abspath(args.source)
+        if not os.path.isfile(os.path.join(src, "manifest.toml")):
+            raise FileNotFoundError(
+                f"{args.source} has no manifest.toml at its root"
+            )
+        endpoint = _endpoint(args, env)
+        if endpoint:
+            from testground_tpu.client import Client
+
+            name = Client(endpoint, token=env.client.token).import_plan(
+                src, name=name
+            )
+            print(f"imported plan {name} into daemon at {endpoint}")
+            return 0
+        dest = os.path.join(env.dirs.plans(), name)
+        if os.path.exists(dest):
+            if not args.force:
+                raise FileExistsError(
+                    f"plan {name} already exists at {dest}; "
+                    "pass --force to replace"
+                )
+            shutil.rmtree(dest)
+        shutil.copytree(
+            src, dest, ignore=shutil.ignore_patterns("__pycache__", ".git")
+        )
+        print(f"imported plan {name} -> {dest}")
+        return 0
+    finally:
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
 
 
 def plan_rm_cmd(args) -> int:
@@ -689,16 +737,29 @@ def healthcheck_cmd(args) -> int:
 
 
 def register_terminate(sub) -> None:
-    p = sub.add_parser("terminate", help="terminate a runner's resources")
-    p.add_argument("--runner", required=True)
+    p = sub.add_parser(
+        "terminate",
+        help="terminate all jobs and supporting processes of a runner or builder",
+    )
+    p.add_argument("--runner", default="")
+    p.add_argument("--builder", default="")
     p.set_defaults(func=terminate_cmd)
 
 
 def terminate_cmd(args) -> int:
+    # one component at a time, like the reference (terminate.go:38-45)
+    if bool(args.runner) == bool(args.builder):
+        print(
+            "specify exactly one of --runner or --builder", file=sys.stderr
+        )
+        return 1
     engine = _engine(args)
     try:
         ow = OutputWriter(sink=None, echo=sys.stdout)
-        engine.do_terminate(args.runner, ow)
+        if args.runner:
+            engine.do_terminate(args.runner, ow, ctype="runner")
+        else:
+            engine.do_terminate(args.builder, ow, ctype="builder")
         return 0
     finally:
         engine.stop()

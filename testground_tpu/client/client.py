@@ -185,8 +185,9 @@ class Client:
         finally:
             conn.close()
 
-    def terminate(self, runner: str) -> str:
-        return self._post_json("/terminate", {"runner": runner})["output"]
+    def terminate(self, runner: str = "", builder: str = "") -> str:
+        body = {"builder": builder} if builder else {"runner": runner}
+        return self._post_json("/terminate", body)["output"]
 
     def healthcheck(self, runner: str, fix: bool = False) -> tuple[Report, str]:
         obj = self._post_json("/healthcheck", {"runner": runner, "fix": fix})
@@ -290,8 +291,11 @@ class RemoteEngine:
     def do_collect_outputs(self, runner_id, run_id, w, ow) -> None:
         self.client.collect_outputs(runner_id, run_id, w)
 
-    def do_terminate(self, runner_id, ow) -> None:
-        out = self.client.terminate(runner_id)
+    def do_terminate(self, ref, ow, ctype: str = "runner") -> None:
+        if ctype == "builder":
+            out = self.client.terminate(builder=ref)
+        else:
+            out = self.client.terminate(runner=ref)
         if out:
             print(out, end="")
 

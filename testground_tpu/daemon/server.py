@@ -302,7 +302,17 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _terminate(self, body: dict) -> None:
         buf = io.StringIO()
-        self.engine.do_terminate(body["runner"], OutputWriter(sink=None, echo=buf))
+        if body.get("builder"):
+            ref, ctype = body["builder"], "builder"
+        elif body.get("runner"):
+            ref, ctype = body["runner"], "runner"
+        else:
+            return self._send_error_json(
+                "specify exactly one of runner or builder", 400
+            )
+        self.engine.do_terminate(
+            ref, OutputWriter(sink=None, echo=buf), ctype=ctype
+        )
         self._send_json({"output": buf.getvalue()})
 
     def _healthcheck(self, body: dict) -> None:

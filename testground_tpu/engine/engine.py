@@ -304,15 +304,23 @@ class Engine:
             CollectionInput(run_id=run_id, runner_id=runner_id, env=self.env), w, ow
         )
 
-    def do_terminate(self, runner_id: str, ow) -> None:
+    def do_terminate(self, ref: str, ow, ctype: str = "runner") -> None:
+        """Terminate all jobs of a runner OR a builder (the reference's
+        DoTerminate takes a component type, ``engine.go:285-311``)."""
         from testground_tpu.runners.base import Terminatable
 
-        runner = self.runner_by_name(runner_id)
-        if runner is None:
-            raise ValueError(f"unknown runner: {runner_id}")
-        if not isinstance(runner, Terminatable):
-            raise ValueError(f"runner {runner_id} is not terminatable")
-        runner.terminate_all(ow)
+        if ctype == "runner":
+            component = self.runner_by_name(ref)
+        elif ctype == "builder":
+            component = self.builder_by_name(ref)
+        else:
+            raise ValueError(f"unknown component type: {ctype}")
+        if component is None:
+            raise ValueError(f"unknown component: {ref} (type: {ctype})")
+        if not isinstance(component, Terminatable):
+            raise ValueError(f"{ctype} {ref} is not terminatable")
+        component.terminate_all(ow)
+        ow.infof("all jobs terminated on component: %s", ref)
 
     def do_healthcheck(self, runner_id: str, fix: bool, ow):
         from testground_tpu.runners.base import HealthcheckedRunner

@@ -23,7 +23,7 @@ from testground_tpu.rpc import OutputWriter
 from testground_tpu.sdk.runparams import RunParams
 from testground_tpu.sync import RUN_EVENTS_TOPIC, SyncServiceServer
 
-from .base import HealthcheckedRunner, Runner
+from .base import HealthcheckedRunner, Runner, Terminatable
 from .outputs import instance_output_dir
 from .pretty import PrettyPrinter
 from .result import Result
@@ -47,7 +47,7 @@ class LocalExecConfig:
     sync_service: str = "auto"
 
 
-class LocalExecRunner(Runner, HealthcheckedRunner):
+class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
     def id(self) -> str:
         return "local:exec"
 

@@ -12,7 +12,11 @@ import threading
 from testground_tpu.api import RunInput, RunOutput
 from testground_tpu.rpc import OutputWriter
 
-from testground_tpu.runners.base import HealthcheckedRunner, Runner
+from testground_tpu.runners.base import (
+    HealthcheckedRunner,
+    Runner,
+    Terminatable,
+)
 
 __all__ = ["SimJaxRunner"]
 
@@ -43,7 +47,7 @@ def _mesh_check(devs_key: tuple) -> tuple[bool, str]:
     return True, msg
 
 
-class SimJaxRunner(Runner, HealthcheckedRunner):
+class SimJaxRunner(Runner, HealthcheckedRunner, Terminatable):
     def id(self) -> str:
         return "sim:jax"
 
@@ -54,6 +58,11 @@ class SimJaxRunner(Runner, HealthcheckedRunner):
         from .executor import SimJaxConfig
 
         return SimJaxConfig
+
+    def terminate_all(self, ow: OutputWriter) -> None:
+        """In-flight device dispatches stop at the next chunk boundary via
+        the task's cancel event; no containers/services persist a run."""
+        ow.infof("sim:jax: no persistent resources to terminate")
 
     def healthcheck(self, fix: bool, ow: OutputWriter, env=None):
         """Real device checks: jax imports, at least one device answers, a

@@ -151,3 +151,81 @@ class TestRunnerDisabled:
         task_id = cap.out.split("run is queued with ID:")[1].split()[0]
         assert main(["status", "-t", task_id]) == 0
         assert "disabled in .env.toml" in capsys.readouterr().out
+
+
+class TestTerminate:
+    """`tg terminate` takes a runner OR a builder, one at a time
+    (``terminate.go:38-45``; engine dispatch ``engine.go:285-311``)."""
+
+    def test_requires_exactly_one_component(self, tg_home, capsys):
+        assert main(["terminate"]) == 1
+        assert (
+            main(["terminate", "--runner", "local:exec", "--builder", "exec:py"])
+            == 1
+        )
+        assert "exactly one" in capsys.readouterr().err
+
+    def test_terminate_runner(self, tg_home, capsys):
+        assert main(["terminate", "--runner", "local:exec"]) == 0
+        assert "all jobs terminated" in capsys.readouterr().out
+
+    def test_non_terminatable_component_errors(self, tg_home, capsys):
+        assert main(["terminate", "--builder", "exec:py"]) == 1
+        assert "not terminatable" in capsys.readouterr().err
+
+    def test_unknown_component_errors(self, tg_home, capsys):
+        assert main(["terminate", "--runner", "nope:nope"]) == 1
+        assert "unknown component" in capsys.readouterr().err
+
+
+class TestPlanImportGit:
+    def test_import_from_local_git_repo(self, tg_home, tmp_path, capsys):
+        """`tg plan import --git --from <url>` clones through git (any
+        scheme git supports — the reference's go-git path, plan.go:210-214)
+        and then the plan runs."""
+        import subprocess
+
+        repo = tmp_path / "gitplan"
+        repo.mkdir()
+        src = os.path.join(PLANS, "placebo")
+        for fname in ("main.py", "manifest.toml"):
+            with open(os.path.join(src, fname)) as f:
+                (repo / fname).write_text(f.read())
+        env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+        for cmd in (
+            ["git", "init", "-q"],
+            ["git", "add", "-A"],
+            ["git", "commit", "-q", "-m", "plan"],
+        ):
+            subprocess.run(cmd, cwd=repo, check=True, env=env)
+
+        assert main(["plan", "import", "--git", "--from", str(repo),
+                     "--name", "gitbebo"]) == 0
+        out = capsys.readouterr().out
+        assert "imported plan gitbebo" in out
+        # no .git directory is imported, and the plan actually runs
+        plan_dir = os.path.join(str(tg_home), "plans", "gitbebo")
+        assert not os.path.isdir(os.path.join(plan_dir, ".git"))
+        assert main(["run", "single", "gitbebo:ok", "--builder", "exec:py",
+                     "--runner", "local:exec", "-i", "1"]) == 0
+        assert "outcome: success" in capsys.readouterr().out
+
+    def test_git_import_rejects_repo_without_manifest(
+        self, tg_home, tmp_path, capsys
+    ):
+        import subprocess
+
+        repo = tmp_path / "notaplan"
+        repo.mkdir()
+        (repo / "README.md").write_text("nope")
+        env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+        for cmd in (
+            ["git", "init", "-q"],
+            ["git", "add", "-A"],
+            ["git", "commit", "-q", "-m", "x"],
+        ):
+            subprocess.run(cmd, cwd=repo, check=True, env=env)
+        assert main(["plan", "import", "--git", "--from", str(repo)]) == 1
+        assert "manifest.toml" in capsys.readouterr().err

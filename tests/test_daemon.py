@@ -96,6 +96,26 @@ class TestDaemonEndToEnd:
         # kill an un-poppable task id → killed=False
         assert client.kill("nonexistent") is False
 
+
+    def test_terminate_runner_and_param_validation(self, client, daemon):
+        """POST /terminate takes runner OR builder; an empty body is a
+        clean 400, not a 500 (terminate.go:38-45)."""
+        import json as _json
+        import urllib.error
+        from urllib.request import Request, urlopen
+
+        out = client.terminate(runner="local:exec")
+        assert "all jobs terminated" in out
+        req = Request(
+            f"{daemon.address}/terminate",
+            data=_json.dumps({}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urlopen(req)
+        assert ei.value.code == 400
+
     def test_status_unknown_task(self, client):
         with pytest.raises(DaemonError):
             client.status("missing-task")
