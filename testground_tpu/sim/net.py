@@ -30,6 +30,14 @@ vmapping a scatter over a leading plane axis also inserts whole-array
 layout-conversion copies. Positions on the N·SLOTS axis are encoded
 ``slot·N + dst`` so a bucket row reshapes to [SLOTS, N] with N still minor.
 Measured effect at 100k instances: ~83 ms/tick → sub-ms with this layout.
+
+Negative result (measured on v4, kept so nobody retries it): re-encoding
+positions dst-major (``dst·SLOTS + slot``) to make the enqueue scatter's
+flat indices fully ascending does NOT speed the scatter — its indices are
+already bucket-ascending from the sort, and TPU scatter throughput only
+collapses (~300×) for genuinely random index streams — while the
+slots-minor views it forces (occupancy reduce over a size-2 minor axis,
+transposed inbox unpack) cost +35% on the sustained full path.
 """
 
 from __future__ import annotations
