@@ -166,10 +166,19 @@ class Build:
 
     def build_key(self) -> str:
         """Canonical key over sorted selectors + sorted dependency overrides
-        (``pkg/api/composition.go:220-241``)."""
+        (``pkg/api/composition.go:220-241``; deviation: the reference keys
+        only module:version, so two groups overriding the same module at
+        different local targets would wrongly share one artifact — we
+        include the target)."""
         selectors = ",".join(sorted(self.selectors))
         deps = sorted(self.dependencies, key=lambda d: d.module)
-        dep_str = "".join(f"{d.module}:{d.version}|" for d in deps)
+        # target is part of the key: two groups overriding the same
+        # module at different local paths must NOT share an artifact
+        # (the runner consumes targets from the built snapshot's
+        # deps.json at launch time)
+        dep_str = "".join(
+            f"{d.module}:{d.version}:{d.target}|" for d in deps
+        )
         return f"selectors={selectors};dependencies={dep_str}"
 
 

@@ -10,6 +10,7 @@ collected from sync-service events. The sync-service "infra container"
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -133,6 +134,45 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
                 )
         return SyncServiceServer().start()
 
+    @staticmethod
+    def _dep_targets(artifact_path: str, ow: OutputWriter) -> list[str]:
+        """Local dependency-override targets from the artifact's deps.json
+        (the go.mod `replace` analog, ``composition.go:302-311`` →
+        ``exec_go.go:94-118``; e2e'd by ``20_exec_go_mod_rewrites.sh``).
+        Best-effort: a missing or malformed file (exec:bin plans may ship
+        an unrelated deps.json of their own) yields no targets, never a
+        failed run. Relative targets resolve against the snapshot dir —
+        absolute paths are what compositions should declare."""
+        deps_path = os.path.join(os.path.dirname(artifact_path), "deps.json")
+        if not os.path.isfile(deps_path):
+            return []
+        try:
+            with open(deps_path) as df:
+                dep_doc = json.load(df)
+            deps = (
+                dep_doc.get("dependencies")
+                if isinstance(dep_doc, dict)
+                else None
+            )
+            if not isinstance(deps, dict):
+                return []
+            targets = []
+            for d in deps.values():
+                target = d.get("target") if isinstance(d, dict) else None
+                if target:
+                    target = str(target)
+                    if not os.path.isabs(target):
+                        target = os.path.normpath(
+                            os.path.join(
+                                os.path.dirname(artifact_path), target
+                            )
+                        )
+                    targets.append(target)
+            return targets
+        except (OSError, json.JSONDecodeError) as e:
+            ow.warn("ignoring unusable deps.json %s: %s", deps_path, e)
+            return []
+
     def run(
         self, job: RunInput, ow: OutputWriter, cancel: threading.Event
     ) -> RunOutput:
@@ -185,6 +225,7 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
         try:
             global_seq = 0
             for g in job.groups:
+                dep_targets = self._dep_targets(g.artifact_path, ow)
                 for i in range(g.instances):
                     iid = f"{g.id}[{i:03d}]"
                     out_dir = instance_output_dir(
@@ -231,15 +272,19 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
                         "XLA_FLAGS",
                     ):
                         env.pop(accel_var, None)
-                    # plans import the SDK from this checkout
+                    # plans import the SDK from this checkout; dependency
+                    # override targets (read once per group) go FIRST so
+                    # the override wins over an installed module
                     pkg_root = os.path.dirname(
                         os.path.dirname(os.path.abspath(__file__))
                     )
-                    env["PYTHONPATH"] = (
-                        os.path.dirname(pkg_root)
-                        + os.pathsep
-                        + env.get("PYTHONPATH", "")
-                    )
+                    env["PYTHONPATH"] = os.pathsep.join(
+                        dep_targets
+                        + [
+                            os.path.dirname(pkg_root),
+                            env.get("PYTHONPATH", ""),
+                        ]
+                    ).rstrip(os.pathsep)
                     with start_sem:
                         if cancel.is_set():
                             raise RuntimeError("run canceled during start")

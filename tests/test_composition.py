@@ -171,6 +171,25 @@ class TestBuildKey:
         )
         assert a.build_key() == b.build_key()
 
+    def test_dependency_target_differentiates_key(self):
+        """Deviation from the reference (which keys only module:version):
+        two groups overriding the same module at different local paths
+        must not share one artifact — the runner reads targets from the
+        built snapshot's deps.json at launch."""
+
+        def grp(gid, target):
+            return Group(
+                id=gid,
+                builder="b",
+                build=Build(
+                    dependencies=[
+                        Dependency(module="m", version="1", target=target)
+                    ]
+                ),
+            )
+
+        assert grp("a", "/a").build_key() != grp("b", "/b").build_key()
+
 
 class TestAccessors:
     def _comp(self):

@@ -1,0 +1,64 @@
+"""The shipped Grafana dashboard (the analog of the reference's
+``plans/benchmarks/grafana-dashboard/storm.json``) must stay in sync with
+the measurement names the benchmark plans actually emit through the
+InfluxDB mirror."""
+
+import json
+import os
+import re
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DASH = os.path.join(
+    REPO_ROOT, "plans", "benchmarks", "grafana-dashboard", "dashboard.json"
+)
+
+
+def _emitted_measurements():
+    """Measurement names every benchmarks testcase can produce, as the
+    influx mirror names them (results.<plan>-<case>.<metric>)."""
+    import numpy as np
+
+    from testground_tpu.metrics.viewer import measurement_name
+    from plans.benchmarks.sim import SUBTREE_SIZES  # noqa: F401
+
+    names = set()
+    # static names per testcase (mirror of each collect_metrics)
+    per_case = {
+        "barrier": [
+            f"barrier_time_{p}_percent" for p in (20, 40, 60, 80, 100)
+        ],
+        "netinit": ["time_to_network_init_ticks"],
+        "netlinkshape": [
+            "time_to_shape_network_ticks",
+            "shaped_latency_ticks",
+        ],
+        "subtree": [
+            f"subtree_time_{s}_bytes_{d}_ticks"
+            for s in SUBTREE_SIZES
+            for d in ("publish", "receive")
+        ],
+        "storm": ["storm.bytes_sent", "storm.bytes_read"],
+        "pingpong-flood": ["flood.rounds"],
+        # startup has no collect_metrics: its measurement is finished_at
+    }
+    for case, metrics in per_case.items():
+        for m in metrics:
+            names.add(measurement_name("benchmarks", case, m))
+    assert np is not None
+    return names
+
+
+def test_dashboard_is_valid_json_with_known_measurements():
+    with open(DASH) as f:
+        dash = json.load(f)
+    assert dash["panels"], "dashboard has no panels"
+    emitted = _emitted_measurements()
+    queried = set()
+    for panel in dash["panels"]:
+        for target in panel.get("targets", []):
+            q = target.get("query", "")
+            for m in re.findall(r'FROM\s+"([^"]+)"', q):
+                queried.add(m)
+    assert queried, "no influx queries found in dashboard"
+    unknown = queried - emitted
+    assert not unknown, f"dashboard queries unknown measurements: {unknown}"

@@ -243,3 +243,96 @@ class TestMixedBuilders:
         out = capsys.readouterr().out
         assert rc == 0, out
         assert "outcome: success" in out
+
+
+DEP_MAIN_PY = '''
+from testground_tpu.sdk import invoke_map
+
+
+def ok(runenv):
+    import fancylib
+    if fancylib.VALUE != "overridden":
+        return f"expected overridden fancylib, got {fancylib.VALUE!r}"
+    runenv.record_message("fancylib override active")
+
+
+if __name__ == "__main__":
+    invoke_map({"ok": ok})
+'''
+
+DEP_MANIFEST = """
+name = "depplan"
+
+[defaults]
+builder = "exec:py"
+runner = "local:exec"
+
+[builders."exec:py"]
+enabled = true
+
+[runners."local:exec"]
+enabled = true
+
+[[testcases]]
+name = "ok"
+instances = { min = 1, max = 10, default = 1 }
+"""
+
+
+class TestDependencyOverrides:
+    """The go.mod-rewrite analog (``20_exec_go_mod_rewrites.sh``,
+    ``exec_go.go:94-118``): a composition's build dependency override with
+    a local target must be visible to the running instances."""
+
+    def _import_plan(self, tmp_path):
+        plan_dir = tmp_path / "depplan"
+        plan_dir.mkdir()
+        (plan_dir / "main.py").write_text(DEP_MAIN_PY)
+        (plan_dir / "manifest.toml").write_text(DEP_MANIFEST)
+        main(["plan", "import", "--from", str(plan_dir)])
+
+    def _composition(self, target=""):
+        return f"""
+[metadata]
+name = "dep-override"
+
+[global]
+plan = "depplan"
+case = "ok"
+builder = "exec:py"
+runner = "local:exec"
+
+[[groups]]
+id = "all"
+[groups.instances]
+count = 1
+[[groups.build.dependencies]]
+module = "fancylib"
+version = "0.0.1"
+{f'target = "{target}"' if target else ""}
+"""
+
+    def test_override_target_wins(self, tg_home, tmp_path, capsys):
+        self._import_plan(tmp_path)
+        override = tmp_path / "override"
+        override.mkdir()
+        (override / "fancylib.py").write_text('VALUE = "overridden"\n')
+        comp = tmp_path / "comp.toml"
+        comp.write_text(self._composition(target=str(override)))
+        capsys.readouterr()
+        rc = main(["run", "composition", "-f", str(comp)])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "outcome: success" in out
+
+    def test_without_target_module_is_missing(self, tg_home, tmp_path, capsys):
+        """No override target → the instance can't import fancylib and
+        the run fails (proves the PYTHONPATH override is what made the
+        positive case pass)."""
+        self._import_plan(tmp_path)
+        comp = tmp_path / "comp.toml"
+        comp.write_text(self._composition())
+        capsys.readouterr()
+        rc = main(["run", "composition", "-f", str(comp)])
+        assert rc != 0
+        assert "outcome: failure" in capsys.readouterr().out
