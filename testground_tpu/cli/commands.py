@@ -184,6 +184,12 @@ def register_run(sub) -> None:
     pc.add_argument(
         "--result-file", default="", help="append run results as CSV rows"
     )
+    pc.add_argument(
+        "--detach",
+        action="store_true",
+        help="queue the task and exit without waiting (the reference's "
+        "non---wait mode; follow later with `tg logs -f`)",
+    )
     _add_metadata_flags(pc)
     pc.set_defaults(func=run_composition_cmd)
 
@@ -200,6 +206,28 @@ def register_run(sub) -> None:
         help="test param k=v (repeatable)",
     )
     ps.add_argument("--collect", action="store_true")
+    ps.add_argument(
+        "-ub",
+        "--use-build",
+        default="",
+        help="build artifact from a previous build (skips the build step)",
+    )
+    ps.add_argument(
+        "--run-cfg",
+        action="append",
+        default=[],
+        help="override runner configuration k=v (repeatable)",
+    )
+    ps.add_argument(
+        "--disable-metrics",
+        action="store_true",
+        help="disable metrics batching",
+    )
+    ps.add_argument(
+        "--detach",
+        action="store_true",
+        help="queue the task and exit without waiting",
+    )
     _add_metadata_flags(ps)
     ps.set_defaults(func=run_single_cmd)
 
@@ -230,7 +258,15 @@ def run_single_cmd(args) -> int:
     tc = manifest.testcase_by_name(case)
     instances = args.instances or (tc.instances.default if tc else 1) or 1
     comp = Composition(
-        global_=Global(plan=plan, case=case, builder=builder, runner=runner),
+        global_=Global(
+            plan=plan,
+            case=case,
+            builder=builder,
+            runner=runner,
+            # --run-cfg k=v overrides (run.go:104-107)
+            run_config=parse_key_values(getattr(args, "run_cfg", [])),
+            disable_metrics=getattr(args, "disable_metrics", False),
+        ),
         groups=[
             Group(
                 id="single",
@@ -241,6 +277,10 @@ def run_single_cmd(args) -> int:
     comp.groups[0].run.test_params = {
         k: str(v) for k, v in parse_key_values(args.test_param).items()
     }
+    if getattr(args, "use_build", ""):
+        # --use-build: reuse a prior build's artifact, skipping the build
+        # step entirely (run.go:119-123; reuse check supervisor do_build)
+        comp.groups[0].run.artifact = args.use_build
     from testground_tpu.api import generate_default_run
 
     comp = generate_default_run(comp)
@@ -266,6 +306,35 @@ def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
                 comp, manifest, sources_dir=src_dir, created_by=created_by
             )
         print(f"run is queued with ID: {task_id}")
+        if getattr(args, "detach", False):
+            # queue-only mode (the reference without --wait, run.go:348):
+            # in-process engines must keep running the task, so detach is
+            # only meaningful against a daemon
+            if not isinstance(engine, RemoteEngine):
+                print(
+                    "warning: --detach without --endpoint queues into an "
+                    "in-process engine that exits with the CLI; waiting "
+                    "instead",
+                    file=sys.stderr,
+                )
+            else:
+                dropped = [
+                    flag
+                    for flag, attr in (
+                        ("--collect", "collect"),
+                        ("--collect-file", "collect_file"),
+                        ("--result-file", "result_file"),
+                        ("--write-artifacts", "write_artifacts"),
+                    )
+                    if getattr(args, attr, None)
+                ]
+                if dropped:
+                    print(
+                        "warning: --detach does not wait for the task, so "
+                        f"{', '.join(dropped)} will be ignored",
+                        file=sys.stderr,
+                    )
+                return 0
         t = _wait_task(engine, task_id)
         outcome = t.outcome()
         print(f"finished run with ID: {task_id} (outcome: {outcome.value})")
@@ -394,6 +463,11 @@ def build_single_cmd(args) -> int:
         print(f"build is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
         print(f"finished build with ID: {task_id} (outcome: {t.outcome().value})")
+        if isinstance(t.result, dict):
+            for gid, artifact in t.result.get("artifacts", {}).items():
+                # printed so a later `tg run single --use-build <artifact>`
+                # can reuse it (run.go:119-123)
+                print(f"group {gid} artifact: {artifact}")
         return 0 if t.outcome() == Outcome.SUCCESS else 1
     finally:
         engine.stop()

@@ -229,3 +229,81 @@ class TestPlanImportGit:
             subprocess.run(cmd, cwd=repo, check=True, env=env)
         assert main(["plan", "import", "--git", "--from", str(repo)]) == 1
         assert "manifest.toml" in capsys.readouterr().err
+
+
+class TestRunFlags:
+    """`tg run single` parity flags: --use-build, --run-cfg,
+    --disable-metrics (``run.go:83-140``)."""
+
+    def test_use_build_reuses_artifact(self, tg_home, capsys):
+        main(["plan", "import", "--from", os.path.join(PLANS, "placebo")])
+        capsys.readouterr()
+        assert main(["build", "single", "placebo", "--builder", "exec:py"]) == 0
+        out = capsys.readouterr().out
+        artifact = out.split("group single artifact:")[1].split()[0]
+        assert os.path.isfile(artifact)
+
+        rc = main(
+            [
+                "run", "single", "placebo:ok",
+                "--builder", "exec:py", "--runner", "local:exec",
+                "-i", "1", "--use-build", artifact,
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "outcome: success" in out
+        # no new build happened: the run reused the prebuilt artifact
+        assert "built: artifact" not in out
+
+    def test_run_cfg_overrides_runner_config(self, tg_home, capsys):
+        """--run-cfg trims the sim tick budget, so a stalling plan fails
+        fast instead of burning the default 100k-tick budget."""
+        main(["plan", "import", "--from", os.path.join(PLANS, "placebo")])
+        capsys.readouterr()
+        rc = main(
+            [
+                "run", "single", "placebo:stall",
+                "--builder", "sim:plan", "--runner", "sim:jax",
+                "-i", "4", "--run-cfg", "max_ticks=8",
+                "--run-cfg", "chunk=4",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc != 0
+        assert "outcome: failure" in out
+
+    def test_disable_metrics_reaches_the_instances(self, tg_home, capsys):
+        """--disable-metrics lands in the composition and the instances'
+        TEST_DISABLE_METRICS env. Semantics follow sdk-go: diagnostics
+        batching is disabled, results (R()) still write metrics.out."""
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.engine import Engine
+
+        main(["plan", "import", "--from", os.path.join(PLANS, "placebo")])
+        capsys.readouterr()
+        rc = main(
+            [
+                "run", "single", "placebo:metrics",
+                "--builder", "exec:py", "--runner", "local:exec",
+                "-i", "1", "--disable-metrics",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        task_id = out.split("run is queued with ID:")[1].split()[0]
+        env = EnvConfig.load()
+        env.daemon.scheduler.task_repo_type = "disk"
+        e = Engine.new_default(env)
+        try:
+            t = e.get_task(task_id)
+            comp = t.result["composition"]
+            assert comp["global"]["disable_metrics"] is True
+        finally:
+            e.stop()
+        # results metrics still recorded (R() is not what the flag gates)
+        metrics_out = os.path.join(
+            env.dirs.outputs(), "placebo", task_id, "single", "0",
+            "metrics.out",
+        )
+        assert os.path.getsize(metrics_out) > 0

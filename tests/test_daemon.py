@@ -289,3 +289,39 @@ count = 2
         assert task_id in capsys.readouterr().out
         assert main(["--endpoint", ep, "logs", "-t", task_id]) == 0
         assert main(["--endpoint", ep, "healthcheck", "--runner", "local:exec"]) == 0
+
+    def test_detach_queues_without_waiting(self, daemon, tmp_path, capsys):
+        """`tg run composition --detach` against a daemon exits right
+        after queueing (the reference's non---wait mode); the task then
+        completes on the daemon and is queryable."""
+        from testground_tpu.cli.main import main
+
+        ep = daemon.address
+        main(
+            [
+                "--endpoint", ep, "plan", "import",
+                "--from", os.path.join(PLANS, "placebo"),
+            ]
+        )
+        comp_file = tmp_path / "comp.toml"
+        comp_file.write_text(
+            "[metadata]\nname = \"detached\"\n\n"
+            "[global]\nplan = \"placebo\"\ncase = \"ok\"\n"
+            "builder = \"exec:py\"\nrunner = \"local:exec\"\n"
+            "total_instances = 1\n\n"
+            "[[groups]]\nid = \"all\"\n[groups.instances]\ncount = 1\n"
+        )
+        capsys.readouterr()
+        rc = main(
+            [
+                "--endpoint", ep, "run", "composition",
+                "-f", str(comp_file), "--detach",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "run is queued with ID:" in out
+        assert "finished run" not in out  # did not wait
+        task_id = out.split("run is queued with ID:")[1].split()[0]
+        t = _wait(Client(ep), task_id)
+        assert t["states"][-1]["state"] == "complete"
