@@ -1,0 +1,30 @@
+# Developer entry points (the reference's Makefile analog: tidy/build/
+# test-go/integration targets become pytest tiers + the bench).
+
+PY ?= python
+
+.PHONY: test test-kernel test-e2e bench dryrun
+
+# the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e
+test:
+	$(PY) -m pytest tests/ -q
+
+# fast unit tier only (no engine/e2e; ~seconds)
+test-kernel:
+	$(PY) -m pytest tests/test_composition.py tests/test_preparation.py \
+		tests/test_manifest.py tests/test_config.py tests/test_template.py \
+		tests/test_rpc.py tests/test_toml_writer.py tests/test_engine.py -q
+
+# the integration tier: real processes + daemon + cross-runner
+test-e2e:
+	$(PY) -m pytest tests/test_local_exec.py tests/test_daemon.py \
+		tests/test_cli_e2e.py tests/test_integration_scenarios.py \
+		tests/test_cross_runner.py -q
+
+# headline numbers on the local accelerator (one JSON line)
+bench:
+	$(PY) bench.py
+
+# the multi-chip compile/correctness gate on a virtual 8-device mesh
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

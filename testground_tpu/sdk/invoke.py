@@ -61,6 +61,36 @@ def invoke_map(testcases: dict[str, Callable]) -> None:
     net_client = NetworkClient(sync_client, env)
     init_ctx = InitContext(sync_client, net_client)
 
+    # profile capture (the sdk-go pprof analog, SURVEY §5: a "cpu"
+    # profile runs for the whole test): group `profiles = {cpu = "..."}`
+    # → TEST_CAPTURE_PROFILES → a cProfile session around the testcase,
+    # dumped as pstats into the instance's outputs dir
+    profiler = None
+    if (
+        "cpu" in env.params.test_capture_profiles
+        and env.params.test_outputs_path
+    ):
+        import cProfile
+
+        profiler = cProfile.Profile()
+
+    def _stop_profile():
+        # best-effort: a failed dump must never change the instance's
+        # outcome (it runs in the finally of every exit path)
+        if profiler is None:
+            return
+        import os
+
+        profiler.disable()
+        try:
+            profiler.dump_stats(
+                os.path.join(
+                    env.params.test_outputs_path, "profile-cpu.pstats"
+                )
+            )
+        except OSError as e:
+            print(f"could not write cpu profile: {e}", file=sys.stderr)
+
     env.record_start()
     try:
         # initialized testcases (2-arg) wait for the network first, like
@@ -68,11 +98,16 @@ def invoke_map(testcases: dict[str, Callable]) -> None:
         import inspect
 
         nparams = len(inspect.signature(fn).parameters)
-        if nparams >= 2:
-            net_client.wait_network_initialized()
-            err = fn(env, init_ctx)
-        else:
-            err = fn(env)
+        if profiler is not None:
+            profiler.enable()
+        try:
+            if nparams >= 2:
+                net_client.wait_network_initialized()
+                err = fn(env, init_ctx)
+            else:
+                err = fn(env)
+        finally:
+            _stop_profile()
     except SystemExit:
         raise
     except BaseException as e:  # noqa: BLE001 — crash semantics

@@ -251,3 +251,49 @@ class TestExecBinCppPlan:
         )
         assert t.outcome() == Outcome.SUCCESS, t.error
         assert t.result["outcomes"]["all"] == {"ok": 3, "total": 3}
+
+
+class TestProfileCapture:
+    def test_cpu_profile_written_per_instance(self, engine):
+        """A group requesting a cpu profile gets a pstats dump in each
+        instance's outputs dir (the sdk-go pprof analog, SURVEY §5)."""
+        import pstats
+
+        comp = generate_default_run(
+            Composition(
+                global_=Global(
+                    plan="placebo",
+                    case="ok",
+                    builder="exec:py",
+                    runner="local:exec",
+                ),
+                groups=[Group(id="all", instances=Instances(count=2))],
+            )
+        )
+        comp.runs[0].groups[0].profiles = {"cpu": "true"}
+        manifest = TestPlanManifest.load_file(
+            os.path.join(PLANS, "placebo", "manifest.toml")
+        )
+        tid = engine.queue_run(
+            comp, manifest, sources_dir=os.path.join(PLANS, "placebo")
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            t = engine.get_task(tid)
+            if t is not None and t.state().state in (
+                State.COMPLETE,
+                State.CANCELED,
+            ):
+                break
+            time.sleep(0.05)
+        assert t.outcome() == Outcome.SUCCESS
+        from testground_tpu.config import EnvConfig
+
+        outputs = EnvConfig.load().dirs.outputs()
+        for i in range(2):
+            prof = os.path.join(
+                outputs, "placebo", tid, "all", str(i), "profile-cpu.pstats"
+            )
+            assert os.path.isfile(prof), prof
+            stats = pstats.Stats(prof)
+            assert stats.total_calls >= 0
