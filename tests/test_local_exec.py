@@ -49,6 +49,7 @@ def run_plan(
     run_config=None,
     builder="exec:py",
     runner="local:exec",
+    profiles=None,
 ):
     comp = generate_default_run(
         Composition(
@@ -64,6 +65,8 @@ def run_plan(
     )
     if params:
         comp.runs[0].groups[0].test_params.update(params)
+    if profiles:
+        comp.runs[0].groups[0].profiles = dict(profiles)
     manifest = TestPlanManifest.load_file(
         os.path.join(PLANS, plan, "manifest.toml")
     )
@@ -259,41 +262,18 @@ class TestProfileCapture:
         instance's outputs dir (the sdk-go pprof analog, SURVEY §5)."""
         import pstats
 
-        comp = generate_default_run(
-            Composition(
-                global_=Global(
-                    plan="placebo",
-                    case="ok",
-                    builder="exec:py",
-                    runner="local:exec",
-                ),
-                groups=[Group(id="all", instances=Instances(count=2))],
-            )
-        )
-        comp.runs[0].groups[0].profiles = {"cpu": "true"}
-        manifest = TestPlanManifest.load_file(
-            os.path.join(PLANS, "placebo", "manifest.toml")
-        )
-        tid = engine.queue_run(
-            comp, manifest, sources_dir=os.path.join(PLANS, "placebo")
-        )
-        deadline = time.time() + 60
-        while time.time() < deadline:
-            t = engine.get_task(tid)
-            if t is not None and t.state().state in (
-                State.COMPLETE,
-                State.CANCELED,
-            ):
-                break
-            time.sleep(0.05)
-        assert t.outcome() == Outcome.SUCCESS
         from testground_tpu.config import EnvConfig
 
+        t = run_plan(
+            engine, "placebo", "ok", instances=2, profiles={"cpu": "true"}
+        )
+        assert t.outcome() == Outcome.SUCCESS
         outputs = EnvConfig.load().dirs.outputs()
         for i in range(2):
             prof = os.path.join(
-                outputs, "placebo", tid, "all", str(i), "profile-cpu.pstats"
+                outputs, "placebo", t.id, "all", str(i), "profile-cpu.pstats"
             )
             assert os.path.isfile(prof), prof
-            stats = pstats.Stats(prof)
-            assert stats.total_calls >= 0
+            # the testcase always makes calls, so an empty profile means
+            # the profiler never ran
+            assert pstats.Stats(prof).total_calls > 0
