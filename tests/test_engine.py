@@ -221,8 +221,10 @@ class FakeRunner(Runner):
         return RunOutput(run_id=job.run_id, result=r)
 
 
-def make_engine(tg_home, runner=None, builder=None):
+def make_engine(tg_home, runner=None, builder=None, workers=None):
     env = EnvConfig.load()
+    if workers is not None:
+        env.daemon.scheduler.workers = workers
     engine = Engine(
         EngineConfig(
             env=env,
@@ -406,5 +408,67 @@ class TestEngineEndToEnd:
             t = wait_complete(engine, tid)
             assert t.outcome() == Outcome.SUCCESS
             assert set(t.result["runs"].keys()) == {"r1", "r2"}
+        finally:
+            engine.stop()
+
+
+class TestConcurrentWorkers:
+    """The worker pool under load: many tasks across several workers, with
+    kills landing mid-flight (the reference's 2-worker default pool,
+    ``engine.go:120-122``, exercised far past its normal cadence)."""
+
+    def test_many_tasks_drain_with_correct_outcomes(self, tg_home):
+        from testground_tpu.api import generate_default_run
+
+        ok_runner = FakeRunner(delay=0.05)
+        engine = make_engine(tg_home, runner=ok_runner, workers=4)
+        engine.start_workers()
+        try:
+            ids = [
+                engine.queue_run(
+                    generate_default_run(simple_composition()),
+                    simple_manifest(),
+                    sources_dir="",
+                )
+                for _ in range(12)
+            ]
+            tasks = [wait_complete(engine, tid, timeout=30) for tid in ids]
+            assert all(t.outcome() == Outcome.SUCCESS for t in tasks)
+            # every task ran exactly one runner job; nothing was lost or
+            # double-dispatched across the 4 workers
+            assert len(ok_runner.jobs) == 12
+            assert len({j.run_id for j in ok_runner.jobs}) == 12
+        finally:
+            engine.stop()
+
+    def test_kills_mid_flight_do_not_disturb_others(self, tg_home):
+        from testground_tpu.api import generate_default_run
+
+        slow = FakeRunner(delay=5.0)
+        engine = make_engine(tg_home, runner=slow, workers=3)
+        engine.start_workers()
+        try:
+            ids = [
+                engine.queue_run(
+                    generate_default_run(simple_composition()),
+                    simple_manifest(),
+                    sources_dir="",
+                )
+                for _ in range(3)
+            ]
+            # let them all get picked up, then kill the middle one
+            deadline = time.time() + 10
+            while time.time() < deadline and len(slow.jobs) < 3:
+                time.sleep(0.02)
+            # all three must actually be mid-flight, else this silently
+            # degrades into a queued-cancel test
+            assert len(slow.jobs) == 3
+            assert engine.kill(ids[1]) is True
+            killed = wait_complete(engine, ids[1], timeout=10)
+            assert killed.outcome() == Outcome.CANCELED
+            # the kill is fast; the survivors keep running to success
+            for tid in (ids[0], ids[2]):
+                t = wait_complete(engine, tid, timeout=30)
+                assert t.outcome() == Outcome.SUCCESS
         finally:
             engine.stop()
