@@ -16,7 +16,7 @@ Semantics (matching the reference sync service as used by
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Iterator
+from typing import Any, Iterator
 
 __all__ = ["InMemSyncService"]
 

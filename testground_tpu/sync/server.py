@@ -28,10 +28,8 @@ not these servers).
 from __future__ import annotations
 
 import json
-import socket
 import socketserver
 import threading
-from typing import Any
 
 from testground_tpu.logging_ import S
 

@@ -2,7 +2,6 @@
 /root/reference/plans/additional_hosts — whitelisted control routes)."""
 
 import os
-import time
 
 import numpy as np
 import pytest

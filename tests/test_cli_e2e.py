@@ -6,7 +6,6 @@ describe output, the task timeout, and the runner-disabled flag
 import os
 import time
 
-import pytest
 
 from testground_tpu.cli.main import main
 from testground_tpu.engine import State

@@ -1,7 +1,6 @@
 """Engine/task/queue/storage tests, mirroring the reference's
 ``pkg/task/{queue,storage,task}_test.go`` + supervisor behaviors."""
 
-import threading
 import time
 
 import pytest

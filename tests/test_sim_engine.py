@@ -7,7 +7,6 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from testground_tpu.api import RunGroup
 from testground_tpu.sim.api import (

@@ -2,7 +2,6 @@
 (the reference's sync-service contract, SURVEY.md §2.6)."""
 
 import threading
-import time
 
 import pytest
 
