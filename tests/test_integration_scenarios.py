@@ -336,3 +336,53 @@ version = "0.0.1"
         rc = main(["run", "composition", "-f", str(comp)])
         assert rc != 0
         assert "outcome: failure" in capsys.readouterr().out
+
+
+BROKEN_BUILD_SH = """#!/bin/sh
+echo "this build always fails" >&2
+exit 3
+"""
+
+
+class TestAbortOnBrokenBuild:
+    """A broken build aborts the whole multi-run task before ANY run
+    executes (``1493_abort_on_broken_build.sh``: builds happen up front,
+    supervisor.go:495-518)."""
+
+    def test_no_runs_execute_after_build_failure(
+        self, tg_home, tmp_path, capsys
+    ):
+        plan_dir = tmp_path / "broken"
+        plan_dir.mkdir()
+        (plan_dir / "manifest.toml").write_text(
+            'name = "broken"\n\n[defaults]\nbuilder = "exec:bin"\n'
+            'runner = "local:exec"\n\n[builders."exec:bin"]\nenabled = true\n'
+            '\n[runners."local:exec"]\nenabled = true\n\n[[testcases]]\n'
+            'name = "ok"\ninstances = { min = 1, max = 10, default = 1 }\n'
+        )
+        build_sh = plan_dir / "build.sh"
+        build_sh.write_text(BROKEN_BUILD_SH)
+        build_sh.chmod(0o755)
+        main(["plan", "import", "--from", str(plan_dir)])
+
+        comp = tmp_path / "comp.toml"
+        comp.write_text(
+            "[metadata]\nname = \"broken-multi\"\n\n"
+            "[global]\nplan = \"broken\"\ncase = \"ok\"\n"
+            "builder = \"exec:bin\"\nrunner = \"local:exec\"\n\n"
+            "[[groups]]\nid = \"g\"\n[groups.instances]\ncount = 1\n\n"
+            "[[runs]]\nid = \"r1\"\n[[runs.groups]]\nid = \"g\"\n"
+            "[runs.groups.instances]\ncount = 1\n\n"
+            "[[runs]]\nid = \"r2\"\n[[runs.groups]]\nid = \"g\"\n"
+            "[runs.groups.instances]\ncount = 1\n"
+        )
+        capsys.readouterr()
+        rc = main(["run", "composition", "-f", str(comp)])
+        out = capsys.readouterr().out
+        assert rc != 0
+        assert "outcome: failure" in out
+        # the failure is the BUILD's: no per-run results were produced
+        assert "run r1:" not in out and "run r2:" not in out
+        # and no instance outputs exist for either run
+        outputs_root = os.path.join(EnvConfig.load().dirs.outputs(), "broken")
+        assert not os.path.isdir(outputs_root) or os.listdir(outputs_root) == []
