@@ -120,15 +120,19 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
         handlers = {
+            "/": self._root_redirect,
             "/tasks": lambda: self._tasks({}),
             "/journal": lambda: self._journal(q),
             "/data": lambda: self._data(q),
             "/dashboard": lambda: self._dashboard(q),
             "/describe": lambda: self._describe(q),
-            # the reference serves kill/delete on GET (daemon.go:87-88,
-            # dashboard links); the POST forms carry the same semantics
+            # the reference serves kill/delete/logs/outputs on GET too
+            # (daemon.go:85-91, dashboard links); the POST forms carry the
+            # same semantics
             "/kill": lambda: self._kill(q),
             "/delete": lambda: self._delete(q),
+            "/logs": lambda: self._get_logs(q),
+            "/outputs": lambda: self._get_outputs(q),
         }
         h = handlers.get(url.path)
         if h is None:
@@ -266,6 +270,28 @@ class _Handler(BaseHTTPRequestHandler):
         if t is None:
             return self._send_error_json(f"unknown task {body['task_id']}", 404)
         self._send_json({"task": t.to_dict()})
+
+    def _root_redirect(self) -> None:
+        """GET / → the dashboard (``daemon.go:91`` redirect)."""
+        self.send_response(302)
+        self.send_header("Location", "/dashboard")
+        # explicit empty body: keep-alive clients (curl, browsers) would
+        # otherwise read until timeout waiting for an unframed body
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _get_logs(self, q: dict) -> None:
+        if "task_id" not in q:
+            return self._send_error_json("task_id is required", 400)
+        # never follow on GET: a dashboard link must terminate
+        self._logs({"task_id": q["task_id"]})
+
+    def _get_outputs(self, q: dict) -> None:
+        if "runner" not in q or "run_id" not in q:
+            return self._send_error_json(
+                "runner and run_id are required", 400
+            )
+        self._outputs({"runner": q["runner"], "run_id": q["run_id"]})
 
     def _logs(self, body: dict) -> None:
         task_id = body["task_id"]

@@ -325,3 +325,45 @@ count = 2
         task_id = out.split("run is queued with ID:")[1].split()[0]
         t = _wait(Client(ep), task_id)
         assert t["states"][-1]["state"] == "complete"
+
+
+class TestGetRoutes:
+    """GET /logs, /outputs, and the / redirect (daemon.go:85-91 serves
+    these on GET for dashboard links)."""
+
+    def test_get_logs_and_outputs(self, client, daemon):
+        import io as _io
+        import tarfile
+        from urllib.request import urlopen
+
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        task_id = client.run(_placebo_composition())
+        _wait(client, task_id)
+        base = daemon.address
+
+        with urlopen(f"{base}/logs?task_id={task_id}") as r:
+            body = r.read().decode()
+        # the task log must be THIS run's: its own id appears in the lines
+        assert task_id in body
+
+        with urlopen(
+            f"{base}/outputs?runner=local:exec&run_id={task_id}"
+        ) as r:
+            data = r.read()
+        with tarfile.open(fileobj=_io.BytesIO(data), mode="r:gz") as tar:
+            assert any("run.out" in n for n in tar.getnames())
+
+    def test_get_logs_requires_task_id(self, daemon):
+        import urllib.error
+        from urllib.request import urlopen
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urlopen(f"{daemon.address}/logs")
+        assert ei.value.code == 400
+
+    def test_root_redirects_to_dashboard(self, daemon):
+        from urllib.request import urlopen
+
+        with urlopen(f"{daemon.address}/") as r:
+            # urllib follows the 302; we land on the dashboard HTML
+            assert r.url.endswith("/dashboard")
