@@ -6,7 +6,8 @@ surface (``daemon.go:83-101``) and bearer-token auth (``daemon.go:49-70``):
 
     POST /run /build /tasks /status /logs /outputs /terminate
          /healthcheck /kill /delete /build/purge /plan/import
-    GET  /tasks /journal /data /dashboard /describe /kill /delete
+    GET  / /tasks /logs /outputs /journal /data /dashboard /describe
+         /kill /delete
 
 The GET tier is the reference's web-dashboard surface (``daemon.go:83-91``,
 ``dashboard.go:44-75``): ``/journal`` returns a task's result journal,
@@ -311,6 +312,18 @@ class _Handler(BaseHTTPRequestHandler):
     def _outputs(self, body: dict) -> None:
         runner = body["runner"]
         run_id = body["run_id"]
+        # run ids are single path components (xid-style, engine/task.py);
+        # anything else could walk the collection root out of the outputs
+        # tree and exfiltrate arbitrary directories as a tgz
+        if (
+            run_id != os.path.basename(run_id)
+            or run_id in ("", ".", "..")
+            or "/" in run_id
+            or "\\" in run_id
+        ):
+            return self._send_error_json(
+                f"invalid run id {run_id!r}", 400
+            )
         # spool to a temp file so HTTP status can still signal failure
         with tempfile.TemporaryFile() as spool:
             from testground_tpu.rpc import discard_writer
@@ -494,7 +507,15 @@ class _Handler(BaseHTTPRequestHandler):
             f"<p>task <code>{esc(task_id)}</code> — "
             f"{esc(t.plan)}:{esc(t.case)} — state {esc(t.state().state.value)}, "
             f"outcome {esc(t.outcome().value)} — "
-            f'<a href="/journal?task_id={esc(task_id)}">journal</a></p>'
+            f'<a href="/journal?task_id={esc(task_id)}">journal</a> · '
+            f'<a href="/logs?task_id={esc(task_id)}">logs</a>'
+            + (
+                f' · <a href="/outputs?runner={esc(t.runner)}&amp;run_id='
+                f'{esc(task_id)}">outputs</a>'
+                if t.runner  # build tasks have no run outputs
+                else ""
+            )
+            + "</p>"
         )
         self._send_html(
             _page(f"{t.plan}:{t.case}", header + "".join(sections))

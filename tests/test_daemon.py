@@ -367,3 +367,40 @@ class TestGetRoutes:
         with urlopen(f"{daemon.address}/") as r:
             # urllib follows the 302; we land on the dashboard HTML
             assert r.url.endswith("/dashboard")
+
+
+class TestConcurrentClients:
+    def test_parallel_runs_from_many_clients(self, client, daemon):
+        """Several clients queue runs at once; the daemon's engine drains
+        them all with correct outcomes (ThreadingHTTPServer + engine locks
+        under real concurrency)."""
+        import concurrent.futures
+
+        client.import_plan(os.path.join(PLANS, "placebo"))
+
+        def one(i):
+            c = Client(daemon.address)
+            case = "ok" if i % 2 == 0 else "abort"
+            tid = c.run(_placebo_composition(case=case, instances=1))
+            t = _wait(c, tid, timeout=120)
+            return case, t["result"]["outcome"] if t.get("result") else None
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+            results = list(ex.map(one, range(6)))
+        for case, outcome in results:
+            expected = "success" if case == "ok" else "failure"
+            assert outcome == expected, (case, outcome)
+
+    def test_get_outputs_rejects_traversal_run_id(self, daemon):
+        """run_id must be a single path component — a traversal id would
+        tar arbitrary host directories out through the open GET route."""
+        import urllib.error
+        from urllib.parse import quote
+        from urllib.request import urlopen
+
+        bad = quote("../../../../etc", safe="")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urlopen(
+                f"{daemon.address}/outputs?runner=local:exec&run_id={bad}"
+            )
+        assert ei.value.code == 400
