@@ -679,20 +679,52 @@ def register_tasks(sub) -> None:
     p = sub.add_parser("tasks", help="list tasks")
     p.add_argument("--state", action="append", default=[], help="filter by state")
     p.add_argument("--type", action="append", default=[], help="filter by type")
+    p.add_argument(
+        "--before", default="", help="created before (YYYY-MM-DD[ HH:MM:SS])"
+    )
+    p.add_argument(
+        "--after", default="", help="created after (YYYY-MM-DD[ HH:MM:SS])"
+    )
     p.add_argument("-n", "--limit", type=int, default=0)
     p.set_defaults(func=tasks_cmd)
 
 
+def _parse_when(text: str) -> float | None:
+    """YYYY-MM-DD[ HH:MM:SS] → epoch seconds (local time)."""
+    if not text:
+        return None
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            return time.mktime(time.strptime(text, fmt))
+        except ValueError:
+            continue
+    raise ValueError(
+        f"cannot parse time {text!r}; use YYYY-MM-DD or 'YYYY-MM-DD HH:MM:SS'"
+    )
+
+
 def tasks_cmd(args) -> int:
+    # validate the date flags before spinning up an engine
+    before, after = _parse_when(args.before), _parse_when(args.after)
     engine = _engine(args)
     try:
         tasks = engine.tasks(
-            states=args.state or None, types=args.type or None, limit=args.limit
+            states=args.state or None,
+            types=args.type or None,
+            before=before,
+            after=after,
+            limit=args.limit,
         )
+        # ID / DATE / PLAN:CASE / DURATION / STATE / TYPE + outcome — the
+        # reference's tabwriter column order (tasks.go:50-54)
         for t in tasks:
+            created = time.strftime(
+                "%Y-%m-%d %H:%M:%S", time.localtime(t.created())
+            )
             print(
-                f"{t.id}  {t.type.value:5}  {t.name():24}  "
-                f"{t.state().state.value:10}  {t.outcome().value}"
+                f"{t.id}  {created}  {t.name():24}  {t.took():7.1f}s  "
+                f"{t.state().state.value:10}  {t.type.value:5}  "
+                f"{t.outcome().value}"
             )
         return 0
     finally:

@@ -154,9 +154,18 @@ class Client:
     ) -> str:
         return self._queue("/build", composition, priority, created_by)
 
-    def tasks(self, states=None, types=None, limit=0) -> list[dict]:
+    def tasks(
+        self, states=None, types=None, before=None, after=None, limit=0
+    ) -> list[dict]:
         return self._post_json(
-            "/tasks", {"states": states, "types": types, "limit": limit}
+            "/tasks",
+            {
+                "states": states,
+                "types": types,
+                "before": before,
+                "after": after,
+                "limit": limit,
+            },
         )["tasks"]
 
     def status(self, task_id: str) -> dict:
@@ -279,10 +288,18 @@ class RemoteEngine:
         except DaemonError:
             return None
 
-    def tasks(self, states=None, types=None, limit=0, **_) -> list[Task]:
+    def tasks(
+        self, states=None, types=None, before=None, after=None, limit=0, **_
+    ) -> list[Task]:
         return [
             Task.from_dict(d)
-            for d in self.client.tasks(states=states, types=types, limit=limit)
+            for d in self.client.tasks(
+                states=states,
+                types=types,
+                before=before,
+                after=after,
+                limit=limit,
+            )
         ]
 
     def logs(self, task_id: str, follow: bool = False, **_) -> Iterator[str]:

@@ -259,9 +259,24 @@ class _Handler(BaseHTTPRequestHandler):
         self._queue(body, "build")
 
     def _tasks(self, body: dict) -> None:
+        def when(key):
+            v = body.get(key)
+            if v is None:
+                return None
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                raise ValueError(f"invalid {key}: {v!r}") from None
+
+        try:
+            before, after = when("before"), when("after")
+        except ValueError as e:
+            return self._send_error_json(str(e), 400)
         tasks = self.engine.tasks(
             states=body.get("states") or None,
             types=body.get("types") or None,
+            before=before,
+            after=after,
             limit=int(body.get("limit") or 0),
         )
         self._send_json({"tasks": [t.to_dict() for t in tasks]})

@@ -306,3 +306,45 @@ class TestRunFlags:
             "metrics.out",
         )
         assert os.path.getsize(metrics_out) > 0
+
+
+class TestTasksListing:
+    def test_columns_and_date_filters(self, tg_home, capsys):
+        """`tg tasks` prints the reference's columns (ID/DATE/TYPE/NAME/
+        DURATION/STATE + outcome, tasks.go:50-54) and supports date-range
+        filters over the archived store."""
+        main(["plan", "import", "--from", os.path.join(PLANS, "placebo")])
+        capsys.readouterr()
+        assert main(
+            [
+                "run", "single", "placebo:ok",
+                "--builder", "exec:py", "--runner", "local:exec", "-i", "1",
+            ]
+        ) == 0
+        capsys.readouterr()
+
+        assert main(["tasks"]) == 0
+        out = capsys.readouterr().out
+        line = [ln for ln in out.splitlines() if "placebo:ok" in ln][0]
+        assert "complete" in line and "success" in line
+        assert "s  " in line  # duration column
+        import re
+
+        assert re.search(r"\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}", line)
+
+        # --after tomorrow → nothing; --after yesterday → our task
+        import datetime
+
+        today = datetime.date.today()
+        tomorrow = (today + datetime.timedelta(days=1)).isoformat()
+        yesterday = (today - datetime.timedelta(days=1)).isoformat()
+        assert main(["tasks", "--after", tomorrow]) == 0
+        assert "placebo:ok" not in capsys.readouterr().out
+        assert main(["tasks", "--after", yesterday]) == 0
+        assert "placebo:ok" in capsys.readouterr().out
+        assert main(["tasks", "--before", yesterday]) == 0
+        assert "placebo:ok" not in capsys.readouterr().out
+
+    def test_bad_date_errors(self, tg_home, capsys):
+        assert main(["tasks", "--after", "not-a-date"]) == 1
+        assert "cannot parse time" in capsys.readouterr().err
