@@ -122,7 +122,7 @@ class _Handler(BaseHTTPRequestHandler):
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
         handlers = {
             "/": self._root_redirect,
-            "/tasks": lambda: self._tasks({}),
+            "/tasks": lambda: self._tasks(q),
             "/journal": lambda: self._journal(q),
             "/data": lambda: self._data(q),
             "/dashboard": lambda: self._dashboard(q),

@@ -310,9 +310,9 @@ class TestRunFlags:
 
 class TestTasksListing:
     def test_columns_and_date_filters(self, tg_home, capsys):
-        """`tg tasks` prints the reference's columns (ID/DATE/TYPE/NAME/
-        DURATION/STATE + outcome, tasks.go:50-54) and supports date-range
-        filters over the archived store."""
+        """`tg tasks` prints the reference's column order (ID / DATE /
+        PLAN:CASE / DURATION / STATE / TYPE + outcome, tasks.go:50-54)
+        and supports date-range filters over the archived store."""
         main(["plan", "import", "--from", os.path.join(PLANS, "placebo")])
         capsys.readouterr()
         assert main(

@@ -404,3 +404,18 @@ class TestConcurrentClients:
                 f"{daemon.address}/outputs?runner=local:exec&run_id={bad}"
             )
         assert ei.value.code == 400
+
+    def test_get_tasks_honors_query_filters(self, client, daemon):
+        """GET /tasks applies before/after query params (the dashboard's
+        GET surface must filter like POST does)."""
+        import json as _json
+        from urllib.request import urlopen
+
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        tid = client.run(_placebo_composition(instances=1))
+        _wait(client, tid)
+        base = daemon.address
+        with urlopen(f"{base}/tasks") as r:
+            assert any(t["id"] == tid for t in _json.load(r)["tasks"])
+        with urlopen(f"{base}/tasks?before=1000000000") as r:
+            assert _json.load(r)["tasks"] == []
