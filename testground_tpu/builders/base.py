@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import abc
 import os
+import re
 import shutil
 import threading
 
@@ -14,6 +15,37 @@ __all__ = ["Builder", "snapshot_plan_sources"]
 
 # Paths never copied into a build snapshot (caches, VCS, fixtures).
 _SNAPSHOT_IGNORE = ("__pycache__", "*.pyc", ".git", "_compositions")
+
+
+def purge_snapshots(prefix: str, testplan: str, ow: OutputWriter, env) -> int:
+    """Delete every ``<work>/<prefix>--<testplan>-<build-id>`` snapshot —
+    the shared artifact naming of the snapshot builders. Returns the count
+    removed; a missing env (interface parity callers) removes nothing."""
+    if env is None:
+        return 0
+    work = env.dirs.work()
+    if not os.path.isdir(work):
+        return 0
+    # exact plan match: build ids are 20-char xids (engine/task.py), with
+    # an optional per-group suffix — a bare prefix match would also claim
+    # plans whose names extend this one (net vs net-v2)
+    pat = re.compile(
+        rf"^{re.escape(prefix)}--{re.escape(testplan)}"
+        rf"-[a-z0-9]{{20}}(-\d+)?$"
+    )
+    removed = 0
+    for name in os.listdir(work):
+        if not pat.match(name):
+            continue
+        path = os.path.join(work, name)
+        try:
+            shutil.rmtree(path)
+        except OSError as e:
+            ow.warn("could not purge %s: %s", name, e)
+            continue
+        ow.infof("purged %s", name)
+        removed += 1
+    return removed
 
 
 def snapshot_plan_sources(src: str | None, dest: str) -> None:
@@ -41,8 +73,10 @@ class Builder(abc.ABC):
         self, inp: BuildInput, ow: OutputWriter, cancel: threading.Event
     ) -> BuildOutput: ...
 
-    def purge(self, testplan: str, ow: OutputWriter) -> None:
-        """Free resources such as caches."""
+    def purge(self, testplan: str, ow: OutputWriter, env=None) -> None:
+        """Drop cached artifacts for one plan (``api.Builder.Purge``,
+        ``pkg/api/builder.go:14-26``). ``env`` is the engine's EnvConfig —
+        builders locate their snapshots under its work dir."""
 
     def config_type(self) -> type | None:
         return None

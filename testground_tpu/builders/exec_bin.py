@@ -22,7 +22,7 @@ import time
 from testground_tpu.api import BuildInput, BuildOutput
 from testground_tpu.rpc import OutputWriter
 
-from .base import Builder, snapshot_plan_sources
+from .base import Builder, purge_snapshots, snapshot_plan_sources
 
 __all__ = ["ExecBinBuilder"]
 
@@ -98,5 +98,6 @@ class ExecBinBuilder(Builder):
         ow.infof("exec:bin built %s -> %s", inp.test_plan, artifact)
         return BuildOutput(builder_id=self.id(), artifact_path=artifact)
 
-    def purge(self, testplan: str, ow: OutputWriter) -> None:
-        ow.infof("exec:bin purge: artifacts are removed with the work dir")
+    def purge(self, testplan: str, ow: OutputWriter, env=None) -> None:
+        removed = purge_snapshots("exec-bin", testplan, ow, env)
+        ow.infof("exec:bin purge: removed %d snapshot(s)", removed)

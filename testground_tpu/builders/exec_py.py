@@ -19,7 +19,7 @@ import threading
 from testground_tpu.api import BuildInput, BuildOutput
 from testground_tpu.rpc import OutputWriter
 
-from .base import Builder, snapshot_plan_sources
+from .base import Builder, purge_snapshots, snapshot_plan_sources
 
 __all__ = ["ExecPyBuilder"]
 
@@ -55,10 +55,7 @@ class ExecPyBuilder(Builder):
             dependencies={m: d["version"] for m, d in deps.items()},
         )
 
-    def purge(self, testplan: str, ow: OutputWriter) -> None:
-        """Remove snapshot artifacts for a plan (``exec_go`` has no cache;
-        this clears the snapshots)."""
-        # The work dir is per-EnvConfig; purge walks known prefixes.
-        # Engine passes no env here, so this is a no-op placeholder kept for
-        # interface parity; per-plan purge happens via the engine's work dir.
-        ow.infof("exec:py purge: snapshots are removed with the work dir")
+    def purge(self, testplan: str, ow: OutputWriter, env=None) -> None:
+        """Remove this builder's snapshot artifacts for a plan."""
+        removed = purge_snapshots("exec-py", testplan, ow, env)
+        ow.infof("exec:py purge: removed %d snapshot(s)", removed)

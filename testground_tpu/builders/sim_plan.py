@@ -16,7 +16,7 @@ import threading
 from testground_tpu.api import BuildInput, BuildOutput
 from testground_tpu.rpc import OutputWriter
 
-from .base import Builder
+from .base import Builder, purge_snapshots
 
 __all__ = ["SimPlanBuilder"]
 
@@ -51,3 +51,7 @@ class SimPlanBuilder(Builder):
         )
         ow.infof("sim:plan built %s -> %s", inp.test_plan, dest)
         return BuildOutput(builder_id=self.id(), artifact_path=dest)
+
+    def purge(self, testplan: str, ow: OutputWriter, env=None) -> None:
+        removed = purge_snapshots("sim-plan", testplan, ow, env)
+        ow.infof("sim:plan purge: removed %d snapshot(s)", removed)

@@ -409,6 +409,13 @@ def register_build(sub) -> None:
     _add_metadata_flags(ps)
     ps.set_defaults(func=build_single_cmd)
 
+    pp = psub.add_parser(
+        "purge", help="purge the cache for a builder and testplan"
+    )
+    pp.add_argument("-b", "--builder", required=True)
+    pp.add_argument("-p", "--plan", required=True)
+    pp.set_defaults(func=build_purge_cmd)
+
 
 def build_composition_cmd(args) -> int:
     from testground_tpu.client import RemoteEngine
@@ -433,6 +440,19 @@ def build_composition_cmd(args) -> int:
                 Composition.from_dict(comp_out).write_file(args.file)
                 print(f"wrote artifacts into composition {args.file}")
         return 0 if t.outcome() == Outcome.SUCCESS else 1
+    finally:
+        engine.stop()
+
+
+def build_purge_cmd(args) -> int:
+    """(``build.go:91-110`` purge — drop a builder's cached artifacts for
+    one plan)."""
+    engine = _engine(args)
+    try:
+        ow = OutputWriter(sink=None, echo=sys.stdout)
+        engine.do_build_purge(args.builder, args.plan, ow)
+        print(f"purged {args.builder} cache for plan {args.plan}")
+        return 0
     finally:
         engine.stop()
 

@@ -322,6 +322,11 @@ class RemoteEngine:
             print(out, end="")
         return report
 
+    def do_build_purge(self, builder_id, testplan, ow) -> None:
+        out = self.client.build_purge(builder_id, testplan)
+        if out:
+            print(out, end="")
+
     def kill(self, task_id: str) -> bool:
         return self.client.kill(task_id)
 

@@ -336,4 +336,4 @@ class Engine:
         builder = self.builder_by_name(builder_id)
         if builder is None:
             raise ValueError(f"unknown builder: {builder_id}")
-        builder.purge(testplan, ow)
+        builder.purge(testplan, ow, env=self.env)

@@ -348,3 +348,70 @@ class TestTasksListing:
     def test_bad_date_errors(self, tg_home, capsys):
         assert main(["tasks", "--after", "not-a-date"]) == 1
         assert "cannot parse time" in capsys.readouterr().err
+
+
+class TestBuildPurge:
+    def test_purge_removes_plan_artifacts(self, tg_home, capsys):
+        """`tg build purge -b exec:py -p placebo` removes the builder's
+        cached snapshots for that plan and leaves other plans' artifacts
+        alone (build.go:91-110)."""
+        from testground_tpu.config import EnvConfig
+
+        main(["plan", "import", "--from", os.path.join(PLANS, "placebo")])
+        main(["plan", "import", "--from", os.path.join(PLANS, "example")])
+        capsys.readouterr()
+        assert main(["build", "single", "placebo", "--builder", "exec:py"]) == 0
+        assert main(["build", "single", "example", "--builder", "exec:py"]) == 0
+        capsys.readouterr()
+
+        work = EnvConfig.load().dirs.work()
+        before = os.listdir(work)
+        assert any("placebo" in d for d in before)
+        assert any("example" in d for d in before)
+
+        assert main(["build", "purge", "-b", "exec:py", "-p", "placebo"]) == 0
+        assert "purged exec:py cache" in capsys.readouterr().out
+        after = os.listdir(work)
+        assert not any("exec-py--placebo" in d for d in after)
+        assert any("example" in d for d in after)
+
+    def test_purge_unknown_builder_errors(self, tg_home, capsys):
+        assert main(["build", "purge", "-b", "nope:x", "-p", "p"]) == 1
+        assert "unknown builder" in capsys.readouterr().err
+
+    def test_purge_does_not_touch_name_extending_plans(
+        self, tg_home, tmp_path, capsys
+    ):
+        """Purging plan 'net' must not claim a plan named 'net-v2'
+        (exact-id matching, not a bare prefix). Manifest names are the
+        canonical plan identity (prepare_for_build), so the fixtures
+        carry distinct manifests."""
+        from testground_tpu.config import EnvConfig
+
+        for name in ("net", "net-v2"):
+            plan = tmp_path / name
+            plan.mkdir()
+            with open(os.path.join(PLANS, "placebo", "main.py")) as f:
+                (plan / "main.py").write_text(f.read())
+            (plan / "manifest.toml").write_text(
+                f'name = "{name}"\n\n[defaults]\nbuilder = "exec:py"\n'
+                'runner = "local:exec"\n\n[builders."exec:py"]\n'
+                'enabled = true\n\n[runners."local:exec"]\nenabled = true\n'
+                '\n[[testcases]]\nname = "ok"\n'
+                "instances = { min = 1, max = 10, default = 1 }\n"
+            )
+            main(["plan", "import", "--from", str(plan)])
+            capsys.readouterr()
+            assert main(["build", "single", name, "--builder", "exec:py"]) == 0
+        capsys.readouterr()
+
+        work = EnvConfig.load().dirs.work()
+        assert any(d.startswith("exec-py--net-v2-") for d in os.listdir(work))
+        assert main(["build", "purge", "-b", "exec:py", "-p", "net"]) == 0
+        after = os.listdir(work)
+        # net's snapshot gone, net-v2's untouched
+        assert not any(
+            d.startswith("exec-py--net-") and not d.startswith("exec-py--net-v2-")
+            for d in after
+        )
+        assert any(d.startswith("exec-py--net-v2-") for d in after)
