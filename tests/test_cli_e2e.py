@@ -415,3 +415,30 @@ class TestBuildPurge:
             for d in after
         )
         assert any(d.startswith("exec-py--net-v2-") for d in after)
+
+
+class TestCollectVerb:
+    def test_collect_writes_tgz(self, tg_home, tmp_path, capsys):
+        """`tg collect <run-id> --runner X -o file` downloads the outputs
+        archive (collect.go → POST /outputs; layout common.go:42-116)."""
+        import tarfile
+
+        main(["plan", "import", "--from", os.path.join(PLANS, "placebo")])
+        capsys.readouterr()
+        assert main(
+            [
+                "run", "single", "placebo:ok",
+                "--builder", "exec:py", "--runner", "local:exec", "-i", "2",
+            ]
+        ) == 0
+        out = capsys.readouterr().out
+        task_id = out.split("run is queued with ID:")[1].split()[0]
+
+        dest = tmp_path / "outs.tgz"
+        assert main(
+            ["collect", task_id, "--runner", "local:exec", "-o", str(dest)]
+        ) == 0
+        with tarfile.open(dest, mode="r:gz") as tar:
+            names = tar.getnames()
+        assert f"{task_id}/single/0/run.out" in names
+        assert f"{task_id}/single/1/run.out" in names
