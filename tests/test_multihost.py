@@ -25,6 +25,7 @@ from testground_tpu.rpc import discard_writer
 from testground_tpu.sim.executor import SimJaxConfig, execute_sim_run
 
 coord, home = sys.argv[1], sys.argv[2]
+n_procs = int(sys.argv[4]) if len(sys.argv) > 4 else 2
 env = EnvConfig.load(home)
 job = RunInput(
     run_id="mhrun", test_plan="placebo", test_case="ok", total_instances=8,
@@ -32,7 +33,8 @@ job = RunInput(
                      artifact_path=os.path.join(sys.argv[3], "placebo"),
                      parameters={})],
     runner_config=SimJaxConfig(
-        chunk=8, coordinator_address=coord, num_processes=2, process_id=0
+        chunk=8, coordinator_address=coord, num_processes=n_procs,
+        process_id=0,
     ),
     env=env,
 )
@@ -76,9 +78,10 @@ def _read_json_line(stream, timeout: float) -> str:
     raise TimeoutError("no result line from the leader")
 
 
-def _run_cohort(tmp_path, follower_plans):
-    """Launch leader + follower subprocesses, honoring the cohort's
-    shutdown-barrier sequencing; returns (leader_result, follower_output)."""
+def _run_cohort(tmp_path, follower_plans, n_procs=2):
+    """Launch leader + (n_procs-1) follower subprocesses, honoring the
+    cohort's shutdown-barrier sequencing; returns
+    (leader_result, combined_follower_output)."""
     port = _free_port()
     coord = f"127.0.0.1:{port}"
 
@@ -97,7 +100,8 @@ def _run_cohort(tmp_path, follower_plans):
         }
 
     leader = subprocess.Popen(
-        [sys.executable, "-c", LEADER_SCRIPT, coord, str(tmp_path / "home"), PLANS],
+        [sys.executable, "-c", LEADER_SCRIPT, coord, str(tmp_path / "home"),
+         PLANS, str(n_procs)],
         env=env_for(),
         stdin=subprocess.PIPE,
         stdout=subprocess.PIPE,
@@ -117,27 +121,30 @@ def _run_cohort(tmp_path, follower_plans):
                 out, err = leader.communicate()
                 raise AssertionError(f"leader died early:\n{err[-2000:]}")
             time.sleep(0.5)
-    follower = subprocess.Popen(
-        [
-            sys.executable,
-            "-m",
-            "testground_tpu.cli.main",
-            "sim-worker",
-            "--coordinator",
-            coord,
-            "--num-processes",
-            "2",
-            "--process-id",
-            "1",
-            "--plans",
-            follower_plans,
-            "--once",
-        ],
-        env=env_for(),
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        text=True,
-    )
+    followers = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "testground_tpu.cli.main",
+                "sim-worker",
+                "--coordinator",
+                coord,
+                "--num-processes",
+                str(n_procs),
+                "--process-id",
+                str(pid),
+                "--plans",
+                follower_plans,
+                "--once",
+            ],
+            env=env_for(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(1, n_procs)
+    ]
     try:
         # jax.distributed.shutdown is a BARRIER: every process must reach
         # it or none exits. Wait for the leader's result line (its work is
@@ -147,19 +154,28 @@ def _run_cohort(tmp_path, follower_plans):
         leader.stdin.write("\n")
         leader.stdin.flush()
         lout, lerr = leader.communicate(timeout=120)
-        fout, ferr = follower.communicate(timeout=120)
+        fouts = []
+        for follower in followers:
+            fout, ferr = follower.communicate(timeout=120)
+            fouts.append(fout + ferr)
     except (subprocess.TimeoutExpired, TimeoutError) as e:
         leader.kill()
-        follower.kill()
+        for follower in followers:
+            follower.kill()
         lout, lerr = leader.communicate()
-        fout, ferr = follower.communicate()
+        ferrs = "".join(
+            "".join(follower.communicate()) for follower in followers
+        )
         raise AssertionError(
             f"cohort timed out ({e}).\nLEADER err:\n{lerr[-2000:]}\n"
-            f"FOLLOWER err:\n{ferr[-2000:]}"
+            f"FOLLOWERS:\n{ferrs[-2000:]}"
         )
     assert leader.returncode == 0, f"leader failed:\n{lerr[-3000:]}"
-    assert follower.returncode == 0, f"follower failed:\n{ferr[-3000:]}"
-    return json.loads(result_line), fout + ferr
+    for i, follower in enumerate(followers):
+        assert follower.returncode == 0, (
+            f"follower {i + 1} failed:\n{fouts[i][-3000:]}"
+        )
+    return json.loads(result_line), "".join(fouts)
 
 
 def test_two_process_cohort_runs_to_completion(tmp_path):
@@ -183,3 +199,15 @@ def test_unsatisfiable_job_is_skipped_in_lockstep(tmp_path):
     assert "aborted" in result, result
     assert "cohort member cannot satisfy" in result["aborted"]
     assert "cohort skipped run mhrun" in fol
+
+
+def test_three_process_cohort_runs_to_completion(tmp_path):
+    """Leader + TWO followers (6 global devices): the fan-out path, not
+    just a pair — every process compiles the same program and the
+    instance axis shards over the union of the hosts' devices."""
+    result, fol = _run_cohort(tmp_path, PLANS, n_procs=3)
+    assert result["processes"] == 3
+    assert result["devices"] == 6
+    assert result["outcome"] == "success"
+    assert result["outcomes"]["all"] == {"ok": 8, "total": 8}
+    assert fol.count("sim-worker: run mhrun done") == 2
