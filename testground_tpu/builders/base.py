@@ -10,6 +10,7 @@ import threading
 
 from testground_tpu.api import BuildInput, BuildOutput
 from testground_tpu.rpc import OutputWriter
+from testground_tpu.runners.base import Terminatable
 
 __all__ = ["Builder", "snapshot_plan_sources"]
 
@@ -61,9 +62,18 @@ def snapshot_plan_sources(src: str | None, dest: str) -> None:
     )
 
 
-class Builder(abc.ABC):
+class Builder(Terminatable, abc.ABC):
     """A builder takes a test plan and builds it into executable form so it
-    can be scheduled by a runner."""
+    can be scheduled by a runner.
+
+    Builders are Terminatable so ``tg terminate --builder`` succeeds (the
+    reference's DoTerminate accepts builders, ``engine.go:285-311``); the
+    snapshot builders run synchronously inside the worker with no external
+    jobs, so the default terminate is a no-op report — mirroring the
+    runners' no-op implementations."""
+
+    def terminate_all(self, ow: OutputWriter) -> None:
+        ow.infof("builder %s has no external jobs to terminate", self.id())
 
     @abc.abstractmethod
     def id(self) -> str: ...

@@ -380,7 +380,12 @@ def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
                                 f"{t.id}-{rid}",
                                 t.name(),
                                 rres.get("outcome", Outcome.UNKNOWN.value),
-                                t.error,
+                                # per-run error, not the task-level one — a
+                                # failure in run A must not show up on run
+                                # B's row (when do_run raises, the task
+                                # result has no 'runs' key and the single
+                                # task-level row below carries t.error)
+                                rres.get("error", ""),
                             ]
                         )
                 else:

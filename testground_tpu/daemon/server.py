@@ -119,7 +119,14 @@ class _Handler(BaseHTTPRequestHandler):
         from urllib.parse import parse_qs, urlparse
 
         url = urlparse(self.path)
-        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        # states/types are list-valued filters (storage.filter uses `in`
+        # membership — a scalar string would substring-match); every other
+        # key is a scalar and takes the first occurrence, matching the
+        # reference's mux.Vars semantics.
+        q = {
+            k: (v if k in ("states", "types") else v[0])
+            for k, v in parse_qs(url.query).items()
+        }
         handlers = {
             "/": self._root_redirect,
             "/tasks": lambda: self._tasks(q),
@@ -272,9 +279,18 @@ class _Handler(BaseHTTPRequestHandler):
             before, after = when("before"), when("after")
         except ValueError as e:
             return self._send_error_json(str(e), 400)
+        def listy(key):
+            # POST bodies carry JSON lists; a bare string (hand-rolled
+            # client) must become a one-element list, not a substring
+            # matcher inside storage.filter's `in` membership test.
+            v = body.get(key)
+            if not v:
+                return None
+            return [v] if isinstance(v, str) else list(v)
+
         tasks = self.engine.tasks(
-            states=body.get("states") or None,
-            types=body.get("types") or None,
+            states=listy("states"),
+            types=listy("types"),
             before=before,
             after=after,
             limit=int(body.get("limit") or 0),
@@ -518,18 +534,33 @@ class _Handler(BaseHTTPRequestHandler):
             )
         if not sections:
             sections = ["<p>No measurements for this test plan.</p>"]
+        # multi-[[runs]] tasks store outputs under <task_id>-<run_id> dirs
+        # (supervisor run_id framing); one link per run, else one for the
+        # single-run task
+        output_links = ""
+        if t.runner:  # build tasks have no run outputs
+            run_results = (
+                t.result.get("runs") if isinstance(t.result, dict) else None
+            )
+            if isinstance(run_results, dict) and run_results:
+                links = [
+                    (f"outputs[{esc(rid)}]", f"{task_id}-{rid}")
+                    for rid in run_results
+                ]
+            else:
+                links = [("outputs", task_id)]
+            output_links = "".join(
+                f' · <a href="/outputs?runner={esc(t.runner)}&amp;run_id='
+                f'{esc(rid)}">{label}</a>'
+                for label, rid in links
+            )
         header = (
             f"<p>task <code>{esc(task_id)}</code> — "
             f"{esc(t.plan)}:{esc(t.case)} — state {esc(t.state().state.value)}, "
             f"outcome {esc(t.outcome().value)} — "
             f'<a href="/journal?task_id={esc(task_id)}">journal</a> · '
             f'<a href="/logs?task_id={esc(task_id)}">logs</a>'
-            + (
-                f' · <a href="/outputs?runner={esc(t.runner)}&amp;run_id='
-                f'{esc(task_id)}">outputs</a>'
-                if t.runner  # build tasks have no run outputs
-                else ""
-            )
+            + output_links
             + "</p>"
         )
         self._send_html(

@@ -275,7 +275,25 @@ def do_run(
             run.total_instances,
             runner_id,
         )
-        out = runner.run(rinput, ow, cancel)
+        try:
+            out = runner.run(rinput, ow, cancel)
+        except Exception as e:  # noqa: BLE001 — per-run isolation
+            # single-run: the exception IS the task error (existing path).
+            # multi-[[runs]]: record it on THIS run and keep going — the
+            # reference's MultiRunStrategy continues past a failed run
+            # (run.go:281-336, 1493_continue_on_failure.sh), and the CSV
+            # attributes the error to the run that raised it, not to all.
+            # Cancellation is not a per-run failure: re-raise so the task
+            # archives as CANCELED, not COMPLETE/FAILURE.
+            if len(comp.runs) == 1 or cancel.is_set():
+                raise
+            ow.write_error(f"run {run.id} failed: {e}")
+            run_results[run.id] = {
+                "outcome": Outcome.FAILURE.value,
+                "error": str(e),
+            }
+            outcome = Outcome.FAILURE
+            continue
         result = out.result if out is not None else None
         result_dict = (
             result.to_dict() if hasattr(result, "to_dict") else (result or {})

@@ -49,13 +49,18 @@ def _field_value(v) -> str | None:
     return None
 
 
-def rows_to_lines(rows) -> list[str]:
+def rows_to_lines(rows, base_ns: int = 0) -> list[str]:
     """Serialize timeseries rows (the ``timeseries.jsonl`` dict shape:
     plan/case/run/group_id/name/tick + numeric fields) into InfluxDB line
     protocol. The measurement name keeps the reference's
-    ``results.<plan>-<case>.<metric>`` shape (``dashboard.go:112-118``)
-    and the simulated tick stands in for the timestamp (nanoseconds are
-    meaningless in simulated time; ticks order points the same way)."""
+    ``results.<plan>-<case>.<metric>`` shape (``dashboard.go:112-118``).
+
+    Timestamps are ``base_ns + tick`` nanoseconds: push_rows passes the
+    wall-clock push time as ``base_ns`` so points land inside Grafana's
+    default ``now-6h`` window (simulated ticks alone would put everything
+    at ~1970), while the +tick offset keeps per-tick points distinct and
+    ordered within a series. The simulated tick itself is preserved as an
+    integer field so panels can plot against it."""
     from testground_tpu.metrics.viewer import measurement_name
 
     lines: list[str] = []
@@ -83,7 +88,8 @@ def rows_to_lines(rows) -> list[str]:
         if not fields:
             continue
         tick = int(row.get("tick", 0))
-        lines.append(f"{measurement}{tags} {','.join(fields)} {tick}")
+        fields.append(f"tick={tick}i")
+        lines.append(f"{measurement}{tags} {','.join(fields)} {base_ns + tick}")
     return lines
 
 
@@ -95,7 +101,9 @@ def push_rows(
 ) -> dict:
     """POST rows to ``<endpoint>/write?db=<db>``. Returns a journal dict
     ``{pushed, ok, error?}`` — callers record it and move on."""
-    lines = rows_to_lines(rows)
+    import time
+
+    lines = rows_to_lines(rows, base_ns=time.time_ns())
     journal: dict = {"pushed": len(lines), "ok": False}
     if not lines:
         journal["ok"] = True

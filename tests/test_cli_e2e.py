@@ -168,9 +168,12 @@ class TestTerminate:
         assert main(["terminate", "--runner", "local:exec"]) == 0
         assert "all jobs terminated" in capsys.readouterr().out
 
-    def test_non_terminatable_component_errors(self, tg_home, capsys):
-        assert main(["terminate", "--builder", "exec:py"]) == 1
-        assert "not terminatable" in capsys.readouterr().err
+    def test_terminate_builder(self, tg_home, capsys):
+        """Builders are terminatable (no-op — snapshot builds run
+        synchronously with no external jobs), so the reference's
+        --builder surface succeeds (``engine.go:285-311``)."""
+        assert main(["terminate", "--builder", "exec:py"]) == 0
+        assert "all jobs terminated" in capsys.readouterr().out
 
     def test_unknown_component_errors(self, tg_home, capsys):
         assert main(["terminate", "--runner", "nope:nope"]) == 1

@@ -353,6 +353,31 @@ class TestGetRoutes:
         with tarfile.open(fileobj=_io.BytesIO(data), mode="r:gz") as tar:
             assert any("run.out" in n for n in tar.getnames())
 
+    def test_dashboard_multi_run_outputs_links(self, client, daemon):
+        """Multi-[[runs]] tasks store outputs under <task_id>-<run_id>
+        dirs, so the dashboard must emit one outputs link per run — a
+        bare task_id link would 404 (same gap as CLI --collect after a
+        multi-run composition)."""
+        from urllib.request import urlopen
+
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        comp = _placebo_composition(instances=1)
+        comp["runs"] = [
+            {"id": "r_a", "groups": [{"id": "all", "instances": {"count": 1}}]},
+            {"id": "r_b", "groups": [{"id": "all", "instances": {"count": 1}}]},
+        ]
+        task_id = client.run(comp)
+        _wait(client, task_id)
+        with urlopen(f"{daemon.address}/dashboard?task_id={task_id}") as r:
+            html = r.read().decode()
+        for rid in ("r_a", "r_b"):
+            assert f"run_id={task_id}-{rid}" in html
+        # and each linked tarball actually downloads
+        with urlopen(
+            f"{daemon.address}/outputs?runner=local:exec&run_id={task_id}-r_a"
+        ) as r:
+            assert r.read()[:2] == b"\x1f\x8b"  # gzip magic
+
     def test_get_logs_requires_task_id(self, daemon):
         import urllib.error
         from urllib.request import urlopen
@@ -418,4 +443,26 @@ class TestConcurrentClients:
         with urlopen(f"{base}/tasks") as r:
             assert any(t["id"] == tid for t in _json.load(r)["tasks"])
         with urlopen(f"{base}/tasks?before=1000000000") as r:
+            assert _json.load(r)["tasks"] == []
+
+    def test_get_tasks_states_types_are_lists(self, client, daemon):
+        """states/types query params are list filters, not substring
+        matchers: repeated params all apply, and a state name that is a
+        substring of nothing real ('comp') must match nothing."""
+        import json as _json
+        from urllib.request import urlopen
+
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        tid = client.run(_placebo_composition(instances=1))
+        _wait(client, tid)
+        base = daemon.address
+        with urlopen(f"{base}/tasks?states=complete&types=run") as r:
+            assert any(t["id"] == tid for t in _json.load(r)["tasks"])
+        # repeated values: either state matching suffices
+        with urlopen(f"{base}/tasks?states=canceled&states=complete") as r:
+            assert any(t["id"] == tid for t in _json.load(r)["tasks"])
+        # a superstring of a real state is NOT a match (scalar strings
+        # used to flow into storage.filter's `in` and substring-match:
+        # 'complete' in 'completely' was True)
+        with urlopen(f"{base}/tasks?states=completely") as r:
             assert _json.load(r)["tasks"] == []

@@ -40,11 +40,21 @@ class TestLineProtocol:
         lines = rows_to_lines(ROWS)
         assert lines[0] == (
             "results.network-ping-pong.rtt_ticks,run=r1,group_id=all"
-            " count=10i,mean=5.5 128"
+            " count=10i,mean=5.5,tick=128i 128"
         )
         # tag values with spaces are escaped, ints get the i suffix
         assert r"group_id=g\ 2" in lines[1]
         assert "count=11i" in lines[1]
+
+    def test_base_ns_offsets_timestamps(self):
+        """push_rows passes wall-clock time as base_ns so points land in
+        Grafana's default now-6h window; tick stays both an offset (point
+        ordering within a series) and an integer field (plottable)."""
+        base = 1_700_000_000_000_000_000
+        lines = rows_to_lines(ROWS, base_ns=base)
+        assert lines[0].endswith(f" {base + 128}")
+        assert lines[1].endswith(f" {base + 256}")
+        assert "tick=128i" in lines[0]
 
     def test_rows_without_name_or_fields_skipped(self):
         assert rows_to_lines([{"run": "r", "tick": 1}]) == []
@@ -77,7 +87,7 @@ class TestLineProtocol:
                 },
             ]
         )
-        assert lines == ["results.p-c.m count=3i 0"]
+        assert lines == ["results.p-c.m count=3i,tick=0i 0"]
 
     def test_measurement_escaping(self):
         lines = rows_to_lines(
