@@ -77,19 +77,27 @@ def _build(plan, case, n, params, chunk):
 
 
 def _timed_ticks(prog, ticks):
-    """Warm one chunk (compile excluded), run ~`ticks` more, and return
-    (carry, actual_ticks, wall). Actual ticks come from the carry's tick
-    counter, which stops advancing once every instance is terminal — a
-    workload finishing mid-chunk is not credited for no-op ticks."""
+    """Warm one chunk (compile excluded from the throughput number but
+    REPORTED — the north star says wall-clock, so the one-off cost must
+    be visible), run ~`ticks` more, and return (carry, actual_ticks,
+    wall, compile_secs). Actual ticks come from the carry's tick counter,
+    which stops advancing once every instance is terminal — a workload
+    finishing mid-chunk is not credited for no-op ticks."""
     import jax
     import numpy as np
 
+    tc0 = time.perf_counter()
     carry = jax.jit(lambda: prog.init_carry(0))()
     fn = prog.compiled_chunk()
     carry, _ = fn(carry)
     # D2H forces completion on remotely-tunneled backends where
     # block_until_ready may not block
     warm_t = int(np.asarray(carry.t))
+    # compile_secs = init trace/compile + first chunk trace/compile/run;
+    # the warm chunk's execution (~chunk ticks of steady-state work) is
+    # inside it, so this slightly OVERstates pure compilation — the
+    # honest direction for a "wall-clock includes compile" claim
+    compile_secs = time.perf_counter() - tc0
     t0 = time.perf_counter()
     dispatched = 0
     while dispatched < ticks:
@@ -98,7 +106,7 @@ def _timed_ticks(prog, ticks):
         if bool(done):
             break
     run_ticks = int(np.asarray(carry.t)) - warm_t
-    return carry, run_ticks, time.perf_counter() - t0
+    return carry, run_ticks, time.perf_counter() - t0, compile_secs
 
 
 def bench_sustained(n, ticks):
@@ -114,16 +122,16 @@ def bench_sustained(n, ticks):
         },
         chunk=250,
     )
-    carry, run_ticks, wall = _timed_ticks(prog, ticks)
+    carry, run_ticks, wall, compile_secs = _timed_ticks(prog, ticks)
     import numpy as np
 
     rounds = int(np.asarray(carry.states[0]["rounds"]).sum())
     print(
         f"# full path: {run_ticks} ticks in {wall:.2f}s "
-        f"({rounds} total rounds exchanged)",
+        f"(+{compile_secs:.1f}s compile; {rounds} total rounds exchanged)",
         file=sys.stderr,
     )
-    return n * run_ticks / wall
+    return n * run_ticks / wall, compile_secs
 
 
 def bench_flood(n, ticks):
@@ -134,8 +142,12 @@ def bench_flood(n, ticks):
         {"duration_ticks": str(10 * ticks), "latency_ms": "4"},
         chunk=500,
     )
-    _, run_ticks, wall = _timed_ticks(prog, ticks)
-    print(f"# fast path: {run_ticks} ticks in {wall:.2f}s", file=sys.stderr)
+    _, run_ticks, wall, compile_secs = _timed_ticks(prog, ticks)
+    print(
+        f"# fast path: {run_ticks} ticks in {wall:.2f}s "
+        f"(+{compile_secs:.1f}s compile)",
+        file=sys.stderr,
+    )
     return n * run_ticks / wall
 
 
@@ -151,7 +163,7 @@ def bench_storm(n):
         },
         chunk=64,
     )
-    carry, run_ticks, wall = _timed_ticks(prog, 4096)
+    carry, run_ticks, wall, _ = _timed_ticks(prog, 4096)
     import numpy as np
 
     ok = int((np.asarray(carry.status) == 1).sum())
@@ -172,15 +184,16 @@ def bench_pingpong_correctness(n):
     )
     import numpy as np
 
-    carry, run_ticks, wall = _timed_ticks(prog, 2048)
+    carry, run_ticks, wall, compile_secs = _timed_ticks(prog, 2048)
     st = np.asarray(carry.status)
     ok = int((st == 1).sum())
     print(
         f"# ping-pong@{n}: {ok}/{n} ok in {wall:.2f}s post-compile "
-        f"({run_ticks} timed ticks, RTT windows asserted in sim time)",
+        f"(+{compile_secs:.1f}s compile; {run_ticks} timed ticks, "
+        "RTT windows asserted in sim time)",
         file=sys.stderr,
     )
-    return ok, wall
+    return ok, wall, compile_secs
 
 
 def main() -> int:
@@ -200,7 +213,7 @@ def main() -> int:
         file=sys.stderr,
     )
 
-    full = bench_sustained(n, ticks)
+    full, full_compile = bench_sustained(n, ticks)
     result = {
         "metric": "sim_peer_ticks_per_sec",
         "value": round(full, 1),
@@ -213,12 +226,16 @@ def main() -> int:
             3,
         ),
         "devices": len(devs),
+        # one-off cost excluded from the throughput number above — the
+        # north star is wall-clock, so report it alongside (VERDICT r3
+        # weak #4); steady-state reruns hit the persistent compile cache
+        "compile_secs": round(full_compile, 2),
     }
 
     if not args.skip_secondary:
         flood = bench_flood(n, ticks)
         storm, storm_ok = bench_storm(n)
-        pp_ok, pp_wall = bench_pingpong_correctness(n)
+        pp_ok, pp_wall, pp_compile = bench_pingpong_correctness(n)
         result["secondary"] = {
             "flood_peer_ticks_per_sec": round(flood, 1),
             "flood_vs_baseline": round(
@@ -228,6 +245,7 @@ def main() -> int:
             "storm_ok": storm_ok,
             "pingpong_100ms_ok": pp_ok,
             "pingpong_100ms_wall_secs": round(pp_wall, 2),
+            "pingpong_100ms_compile_secs": round(pp_compile, 2),
         }
 
     print(json.dumps(result))

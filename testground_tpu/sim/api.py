@@ -285,7 +285,12 @@ class SimTestcase:
     # — ``net_filters[g]`` is the action toward group g). A positive value
     # declares that many regions; instances start in region = their group
     # index and may reassign themselves mid-run via ``StepOut.region``
-    # (splitbrain's dynamic seq%3 partitioning).
+    # (splitbrain's dynamic seq%3 partitioning). PARITY BOUND: the
+    # reference allows arbitrarily many per-subnet rules
+    # (``link.go:187-217``); here ``N_REGIONS = N`` with
+    # ``region = global_seq`` gives full per-instance granularity, but
+    # the dense [R, N] filter table is O(N²) — practical to ~8k
+    # instances (a 64 MB table at 4k). Beyond that, coarsen regions.
     N_REGIONS: ClassVar[int] = 0
     MSG_WIDTH: ClassVar[int] = 4
     OUT_MSGS: ClassVar[int] = 1
@@ -319,13 +324,23 @@ class SimTestcase:
     #   entirely (the dominant per-tick cost at 100k instances). Only
     #   valid when the traffic pattern guarantees at most ONE sender per
     #   (receiver, outbox-slot, tick) — pairwise or ring topologies —
-    #   and ignores duplicate-shaping. Colliding sends are undefined.
+    #   and ignores duplicate-shaping. Colliding sends are undefined;
+    #   the runner's ``validate = true`` debug option detects them and
+    #   fails the run naming the colliding (receiver, slot) instead of
+    #   silently corrupting (see SimJaxConfig.validate).
     SLOT_MODE: ClassVar[str] = "sorted"
+    # Egress-queue bound (messages) under "bandwidth_queue" shaping —
+    # HTB's queue limit; only a full queue drops (tail-drop).
+    BW_QUEUE_MSGS: ClassVar[int] = 128
     # Which LinkShape features this plan's network configs may exercise.
     # Features not declared are compiled out of the transport (their RNG
     # draws and gathers disappear): a latency-only plan pays nothing for
     # loss/corrupt/reorder/duplicate machinery. "filters" covers the
-    # Accept/Reject/Drop table.
+    # Accept/Reject/Drop table. Declaring "bandwidth_queue" (not in the
+    # default set) switches the bandwidth knob from the per-tick
+    # admission cap (drop) to the HTB-faithful token bucket: excess
+    # messages queue per-src and arrive late, only a full queue
+    # (BW_QUEUE_MSGS) tail-drops — see the semantics note in ``net.py``.
     SHAPING: ClassVar[tuple] = (
         "latency",
         "jitter",
@@ -461,10 +476,11 @@ class SimTestcase:
         """Build a LinkShape vector (``network.LinkShape`` field order,
         ``pkg/sidecar/link.go:155-183``).
 
-        Bandwidth is drop-not-queue: messages over the per-tick admission
-        cap are dropped at send time, and a bandwidth below one message
-        per tick (MSG_BYTES/tick_s, i.e. 256 KB/s at 1 ms ticks) admits
-        nothing — see the deviation note in ``sim/net.py``."""
+        Bandwidth semantics follow the plan's SHAPING declaration:
+        "bandwidth" is a per-tick admission cap (over-cap messages drop
+        at send time); "bandwidth_queue" is the HTB-faithful token
+        bucket (excess messages queue and arrive late; only a full
+        BW_QUEUE_MSGS queue drops) — see ``sim/net.py``."""
         return jnp.stack(
             [
                 jnp.asarray(x, jnp.float32)

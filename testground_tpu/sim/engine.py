@@ -75,6 +75,11 @@ class SimCarry:
     keys: jax.Array  # [N] per-instance PRNG keys
     net_key: jax.Array  # link-model PRNG key
     t: jax.Array  # int32 current tick
+    # --- cumulative transport diagnostics (scalars; surfaced in results)
+    clamped: jax.Array  # horizon-clamped deliveries (see NetFeedback)
+    bw_dropped: jax.Array  # bandwidth_queue tail-drops
+    collisions: jax.Array  # direct-mode slot collisions (validate runs)
+    collision_where: jax.Array  # [2] (dst, slot) of the first collision
 
 
 def build_groups(run_groups, parameters_of=None) -> tuple[GroupSpec, ...]:
@@ -106,6 +111,7 @@ class SimProgram:
         mesh: jax.sharding.Mesh | None = None,
         chunk: int = 128,
         hosts: tuple[str, ...] = (),
+        validate: bool = False,
     ):
         self.tc = testcase
         self.groups = groups
@@ -124,6 +130,27 @@ class SimProgram:
         # sliced out of results.
         self.hosts = tuple(hosts)
         self.n_lanes = self.n + len(self.hosts)
+        self.validate = bool(validate)
+        # Static horizon check: the plan's DEFAULT_LINK must be
+        # deliverable within the calendar — shaped reconfigurations are
+        # runtime data and get the clamp counter instead (NetFeedback).
+        base_ticks = int(
+            np.ceil((cls.DEFAULT_LINK[0] + cls.DEFAULT_LINK[1]) / tick_ms)
+        )
+        if base_ticks > cls.MAX_LINK_TICKS - 1:
+            raise ValueError(
+                f"DEFAULT_LINK latency+jitter ({cls.DEFAULT_LINK[0]}+"
+                f"{cls.DEFAULT_LINK[1]} ms = {base_ticks} ticks at "
+                f"{tick_ms} ms/tick) exceeds the calendar horizon "
+                f"MAX_LINK_TICKS-1 = {cls.MAX_LINK_TICKS - 1}; raise "
+                "MAX_LINK_TICKS or the tick duration"
+            )
+        if "bandwidth_queue" in cls.SHAPING and "bandwidth" in cls.SHAPING:
+            raise ValueError(
+                "declare either 'bandwidth' (admission-cap drop) or "
+                "'bandwidth_queue' (HTB queueing), not both — they are "
+                "two semantics for the same LinkShape knob"
+            )
         if not cls.CROSS_TICK_STACKING:
             # statically-detectable violations of the single-send-tick
             # bucket contract (see SimTestcase.CROSS_TICK_STACKING):
@@ -133,6 +160,10 @@ class SimProgram:
                 ("duplicate", "second copies land one tick later"),
                 ("jitter", "per-message delay varies with the jitter draw"),
                 ("reorder", "reordered messages jump to the 1-tick floor"),
+                (
+                    "bandwidth_queue",
+                    "queued messages defer by a backlog-dependent delay",
+                ),
             ):
                 if feat in cls.SHAPING:
                     raise ValueError(
@@ -204,6 +235,9 @@ class SimProgram:
                 egress=wsc(carry.link.egress, self._ishard(1)),
                 filters=wsc(carry.link.filters, self._ishard(1)),
                 region_of=wsc(carry.link.region_of, self._ishard(0)),
+                backlog=wsc(carry.link.backlog, self._ishard(0))
+                if carry.link.backlog is not None
+                else None,
             ),
             rejected=wsc(carry.rejected, self._ishard(0)),
         )
@@ -271,6 +305,7 @@ class SimProgram:
                 # instances start in region = group index; plans with
                 # N_REGIONS > len(groups) reassign via StepOut.region
                 region_of=region_of,
+                track_backlog="bandwidth_queue" in cls.SHAPING,
             ),
             sync=make_sync_state(
                 self.n, self.n_states, self.n_topics, cls.TOPIC_CAP, cls.PUB_WIDTH
@@ -279,6 +314,10 @@ class SimProgram:
             keys=keys,
             net_key=net_key,
             t=jnp.int32(0),
+            clamped=jnp.int32(0),
+            bw_dropped=jnp.int32(0),
+            collisions=jnp.int32(0),
+            collision_where=jnp.zeros((2,), jnp.int32),
         )
         if self.mesh is not None:
             carry = jax.jit(self._constrain)(carry)
@@ -428,7 +467,7 @@ class SimProgram:
         )
 
         net_key, k_msg = jax.random.split(carry.net_key)
-        cal, rejected = enqueue(
+        cal, fb = enqueue(
             cal,
             carry.link,
             dst,
@@ -441,6 +480,8 @@ class SimProgram:
             features=tuple(type(self.tc).SHAPING),
             control_start=self.n if self.hosts else None,
             stacking=type(self.tc).CROSS_TICK_STACKING,
+            bw_queue_cap=type(self.tc).BW_QUEUE_MSGS,
+            validate=self.validate,
         )
         sync = update_sync(
             carry.sync, signals, pub_payload, pub_valid, sub_consume
@@ -492,7 +533,15 @@ class SimProgram:
             net_region,
             net_region_valid,
         )
+        if fb.backlog is not None:  # HTB queue depths advance each tick
+            link = dataclasses.replace(link, backlog=fb.backlog)
 
+        # first collision wins: keep the earliest (dst, slot) for the error
+        collision_where = jnp.where(
+            (carry.collisions == 0) & (fb.collisions > 0),
+            fb.collision_where,
+            carry.collision_where,
+        )
         return self._constrain(
             SimCarry(
                 states=new_states,
@@ -501,10 +550,14 @@ class SimProgram:
                 cal=cal,
                 link=link,
                 sync=sync,
-                rejected=rejected,
+                rejected=fb.rejected,
                 keys=carry.keys,
                 net_key=net_key,
                 t=t + 1,
+                clamped=carry.clamped + fb.clamped,
+                bw_dropped=carry.bw_dropped + fb.bw_dropped,
+                collisions=carry.collisions + fb.collisions,
+                collision_where=collision_where,
             )
         )
 
@@ -578,5 +631,9 @@ class SimProgram:
             "states": jax.tree.map(to_host, carry.states),
             "sync_counts": to_host(carry.sync.counts),
             "pub_dropped": to_host(carry.sync.dropped),
+            "latency_clamped": int(to_host(carry.clamped)),
+            "bw_queue_dropped": int(to_host(carry.bw_dropped)),
+            "collisions": int(to_host(carry.collisions)),
+            "collision_where": to_host(carry.collision_where).tolist(),
             "groups": self.groups,
         }

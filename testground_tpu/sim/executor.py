@@ -49,6 +49,11 @@ class SimJaxConfig:
     # of the reference SDK's periodic InfluxDB metric batches; each sample is
     # a device→host state read, so the cadence bounds the overhead
     timeseries_every: int = 1024
+    # debug: direct-slot-mode collision detection — reads back occupancy
+    # each tick and FAILS the run naming the colliding (receiver, slot)
+    # instead of silently corrupting inbox slots (costs a per-tick sort +
+    # gather, so off by default)
+    validate: bool = False
     # whitelisted control-route service hosts (echo lanes past the instance
     # axis) — the ADDITIONAL_HOSTS analog (``local_docker.go:78``); plans
     # address them via ``env.host_index(name)``
@@ -226,6 +231,7 @@ def execute_sim_run(
         mesh=mesh,
         chunk=cfg.chunk,
         hosts=hosts,
+        validate=bool(getattr(cfg, "validate", False)),
     )
 
     t0 = time.time()
@@ -305,6 +311,29 @@ def execute_sim_run(
         wall,
         n * res["ticks"] / max(wall, 1e-9),
     )
+    if res.get("collisions", 0) > 0:
+        # a direct-mode contract violation under validate: fail the run
+        # naming the collision (the data is corrupt — do not report
+        # plan-level outcomes computed from it)
+        c_dst, c_slot = res.get("collision_where", [0, 0])
+        raise RuntimeError(
+            f"direct slot-mode collision: {res['collisions']} conflicting "
+            f"writes detected (first at receiver {c_dst}, inbox slot "
+            f"{c_slot}) — the plan violates the ≤1 sender per (receiver, "
+            "slot, tick) contract; use SLOT_MODE='sorted' or fix the "
+            "traffic pattern"
+        )
+    if res.get("latency_clamped", 0) > 0:
+        # netem never silently shortens a configured delay — surface the
+        # clamp in the task log AND the journal (link.go:169-179 parity)
+        ow.warn(
+            "sim:jax %s: %d deliveries exceeded the calendar horizon and "
+            "were clamped to MAX_LINK_TICKS-1 — a shaped latency/jitter/"
+            "backlog does not fit the calendar; raise MAX_LINK_TICKS "
+            "(results arrive EARLIER than configured)",
+            job.run_id,
+            res["latency_clamped"],
+        )
 
     # ------------------------------------------------ outcomes + outputs
     result = Result.for_input(job)
@@ -407,6 +436,8 @@ def execute_sim_run(
         "wall_secs": wall,
         "devices": int(mesh.devices.size) if mesh is not None else 1,
         "pub_dropped": res["pub_dropped"].tolist(),
+        "latency_clamped": res.get("latency_clamped", 0),
+        "bw_queue_dropped": res.get("bw_queue_dropped", 0),
     }
     result.update_outcome()
     if cancel.is_set():

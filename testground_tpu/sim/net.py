@@ -50,7 +50,14 @@ import numpy as np
 
 from .api import FILTER_ACCEPT, FILTER_REJECT, Inbox
 
-__all__ = ["LinkState", "Calendar", "deliver", "enqueue", "make_link_state"]
+__all__ = [
+    "LinkState",
+    "Calendar",
+    "NetFeedback",
+    "deliver",
+    "enqueue",
+    "make_link_state",
+]
 
 # LinkShape plane indices (order of network.LinkShape fields,
 # ``pkg/sidecar/link.go:155-183``).
@@ -60,13 +67,18 @@ LATENCY, JITTER, BANDWIDTH, LOSS, CORRUPT, REORDER, DUPLICATE = range(7)
 # reference shapes bits/s on real frames; messages here are fixed-width
 # records, so bandwidth B bytes/s admits B·tick_s/MSG_BYTES msgs per tick.
 #
-# SEMANTICS DEVIATION (drop, not queue): HTB holds excess packets in a
-# queue and releases them as tokens accrue; this transport has no egress
-# queue, so messages past the per-tick cap are DROPPED at send time. In
-# particular a bandwidth below MSG_BYTES/tick_s (cap floor() → 0) admits
-# nothing at all — a permanent blackhole, where netem/HTB would still
-# trickle packets late. Plans must keep shaped bandwidths ≥ one message
-# per tick (at 1 ms ticks: ≥ 256 KB/s) or treat lower values as DROP.
+# Two bandwidth semantics, chosen by the plan's SHAPING declaration:
+# - "bandwidth": per-tick admission cap — messages past the cap are
+#   DROPPED at send time (cheapest; fine for plans asserting throughput
+#   ceilings). A bandwidth below MSG_BYTES/tick_s (cap floor() → 0)
+#   admits nothing at all under this mode.
+# - "bandwidth_queue": HTB-faithful token bucket (``link.go:155-183``) —
+#   excess messages are HELD in a per-src FIFO egress queue and released
+#   as service accrues (rate = B·tick_s/MSG_BYTES msgs/tick, fractional
+#   rates < 1 msg/tick trickle messages late instead of blackholing);
+#   the queue is bounded (BW_QUEUE_MSGS) and only overflow drops, which
+#   is HTB's actual behavior. Costs one [N] backlog state + a small
+#   per-message cumsum, so it is opt-in.
 MSG_BYTES = 256.0
 
 # Every LinkShape feature (``SimTestcase.SHAPING`` defaults to all).
@@ -95,18 +107,57 @@ class LinkState:
     egress:    [7, N] float32 — one plane per LinkShape component
     filters:   [R, N] int32 — filter action of instance n toward region r
     region_of: [N] int32 — dst instance → region index
+    backlog:   [N] float32 — per-src egress-queue depth in messages (the
+               HTB token-bucket state; None unless the plan declares
+               "bandwidth_queue" shaping)
 
     Regions default to groups (``region_of`` starts as the group index),
     reproducing per-dst-group filtering; plans that partition *within* a
     group (splitbrain's seq%3 regions, ``plans/splitbrain/main.go:85-88``)
     declare ``N_REGIONS`` and reassign ``region_of`` dynamically via
     ``StepOut.region`` — the tensor analog of the reference's arbitrary
-    per-subnet rules (``link.go:187-217``) at region granularity.
+    per-subnet rules (``link.go:187-217``). ``N_REGIONS = N`` with
+    ``region = global_seq`` gives full per-instance granularity; the
+    dense [R, N] table is then O(N²), so that escape hatch is for runs
+    up to ~8k instances (see the parity note in ``sim/api.py``).
     """
 
     egress: jax.Array
     filters: jax.Array
     region_of: jax.Array
+    backlog: jax.Array | None = None
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class NetFeedback:
+    """Per-tick transport feedback returned by :func:`enqueue`.
+
+    rejected:  [N] int32 — sender's messages suppressed by a REJECT filter
+               (surfaced to the sender next tick — ``link.go:196-205``)
+    clamped:   int32 scalar — messages whose computed delay exceeded the
+               calendar horizon and was CLAMPED to horizon-1 this tick. A
+               nonzero count means the run's MAX_LINK_TICKS is undersized
+               for a shaped latency/jitter/backlog — netem never silently
+               shortens a configured delay (``link.go:169-179``), so the
+               engine accumulates this and the runner surfaces it loudly.
+    bw_dropped: int32 scalar — messages dropped by a FULL bandwidth_queue
+               egress queue this tick (HTB tail-drop)
+    backlog:   [N] float32 | None — next tick's egress-queue depths
+               (None unless "bandwidth_queue" shaping is compiled in)
+    collisions: int32 scalar — direct-slot-mode (receiver, slot, tick)
+               write conflicts detected this tick (always 0 unless
+               ``validate=True``)
+    collision_where: [2] int32 — (dst, slot) of the first collision this
+               tick (undefined when collisions == 0)
+    """
+
+    rejected: jax.Array
+    clamped: jax.Array
+    bw_dropped: jax.Array
+    backlog: jax.Array | None
+    collisions: jax.Array
+    collision_where: jax.Array
 
 
 @jax.tree_util.register_dataclass
@@ -188,7 +239,11 @@ class Calendar:
 
 
 def make_link_state(
-    n: int, n_regions: int, default_shape, region_of=None
+    n: int,
+    n_regions: int,
+    default_shape,
+    region_of=None,
+    track_backlog: bool = False,
 ) -> LinkState:
     egress = jnp.tile(
         jnp.asarray(default_shape, jnp.float32)[:, None], (1, n)
@@ -200,6 +255,7 @@ def make_link_state(
         egress=egress,
         filters=filters,
         region_of=jnp.asarray(region_of, jnp.int32),
+        backlog=jnp.zeros((n,), jnp.float32) if track_backlog else None,
     )
 
 
@@ -274,13 +330,16 @@ def enqueue(
     features: tuple = FULL_SHAPING,
     control_start: int | None = None,
     stacking: bool = True,
-) -> tuple[Calendar, jax.Array]:
+    bw_queue_cap: int = 128,
+    validate: bool = False,
+) -> tuple[Calendar, NetFeedback]:
     """Shape + schedule this tick's sends (inputs in plane layout, message
-    m = o·N + src). Returns (cal', rejected[N]).
+    m = o·N + src). Returns (cal', NetFeedback).
 
-    rejected[i] counts instance i's messages suppressed by a REJECT filter
-    (surfaced to the sender next tick, mirroring a PROHIBIT route's
-    immediate "connection refused" — ``link.go:196-205``).
+    ``NetFeedback.rejected[i]`` counts instance i's messages suppressed by
+    a REJECT filter (surfaced to the sender next tick, mirroring a
+    PROHIBIT route's immediate "connection refused" — ``link.go:196-205``);
+    see :class:`NetFeedback` for the clamp/queue/collision counters.
 
     ``slot_mode`` — see ``SimTestcase.SLOT_MODE``: "sorted" (general,
     sort-based slot ranking) or "direct" (slot = outbox index; no sort, no
@@ -298,6 +357,13 @@ def enqueue(
     ``stacking`` — ``SimTestcase.CROSS_TICK_STACKING``: when False the
     bucket-fill derivation and base gather are compiled out (ranks start
     at 0 every tick; see the contract note in ``api.py``).
+
+    ``bw_queue_cap`` — ``SimTestcase.BW_QUEUE_MSGS``: bound (in messages)
+    of the per-src egress queue under "bandwidth_queue" shaping.
+
+    ``validate`` — direct-slot-mode debug check: read back occupancy and
+    detect same-tick duplicate (receiver, slot) writes, reporting them in
+    ``NetFeedback.collisions`` instead of silently corrupting slots.
     """
     slots = cal.slots
     width = cal.width
@@ -420,8 +486,10 @@ def enqueue(
     else:
         rejected = jnp.zeros((n,), jnp.int32)
 
-    # --- bandwidth: admit the first floor(B·tick/MSG_BYTES) msgs per src
-    if "bandwidth" in features:
+    # --- bandwidth, admission-cap semantics: admit the first
+    # floor(B·tick/MSG_BYTES) msgs per src, drop the rest (the cheap
+    # mode; "bandwidth_queue" below supersedes it with HTB queueing)
+    if "bandwidth" in features and "bandwidth_queue" not in features:
         bw = eg(BANDWIDTH)
         cap = jnp.where(
             bw <= 0.0,
@@ -455,12 +523,84 @@ def enqueue(
     if "jitter" in features:
         delay_ms = delay_ms + eg(JITTER) * u("jitter")
     delay = jnp.ceil(delay_ms / tick_ms).astype(jnp.int32)
-    delay = jnp.clip(delay, 1, horizon - 1)
+    delay = jnp.maximum(delay, 1)
     if "reorder" in features:
         reorder = u("reorder") * 100.0 < eg(REORDER)
         delay = jnp.where(reorder, 1, delay)
+
+    # --- bandwidth, HTB-queue semantics (``link.go:155-183``): excess
+    # messages are deferred, not dropped — each src's egress is a FIFO
+    # served at rate B·tick_s/MSG_BYTES msgs/tick (fractional rates
+    # trickle messages late instead of blackholing); only a FULL queue
+    # tail-drops, which is HTB's actual behavior. The queue is virtual:
+    # deferring a message k service-ticks = scheduling its calendar
+    # arrival k ticks later, so the only state is the per-src backlog,
+    # measured in TICKS of remaining link busy time (not messages): a
+    # mid-run rate INCREASE then drains the backlog at the new rate
+    # without overtaking already-scheduled messages — FIFO holds, as in
+    # HTB. (A rate DECREASE cannot retroactively slow messages already
+    # scheduled — the calendar cannot recall them; new traffic queues at
+    # the new rate behind the old busy time.)
+    bw_dropped = jnp.int32(0)
+    new_backlog = link.backlog
+    if "bandwidth_queue" in features:
+        assert link.backlog is not None, (
+            "bandwidth_queue shaping needs a LinkState built with "
+            "track_backlog=True"
+        )
+        bw = eg(BANDWIDTH)
+        rate = bw * (tick_ms / 1000.0) / MSG_BYTES  # msgs/tick, per-msg
+        safe_rate = jnp.maximum(rate, 1e-9)
+        queued = val_f & (bw > 0.0)  # bw ≤ 0 = unshaped, bypasses queue
+        if is_ctrl is not None:
+            queued = queued & ~is_ctrl
+        # FIFO position this tick: outbox order among the src's queued
+        # messages, each occupying 1/rate ticks of link time behind the
+        # standing busy-time backlog
+        qmask = queued.reshape(o, n).astype(jnp.float32)
+        ahead = (jnp.cumsum(qmask, axis=0) - qmask).reshape(-1)
+        backlog_m = link.backlog if o == 1 else jnp.tile(link.backlog, o)
+        # bound the queue in MESSAGES at the current rate (HTB's packet
+        # limit): standing ticks × rate + position ahead. Across a rate
+        # change this is an approximation — the standing busy time is
+        # valued in CURRENT-rate message equivalents (exact counting
+        # would need per-message departure state); steady-rate plans get
+        # the exact HTB bound
+        q_msgs = backlog_m * rate + ahead
+        overflow_q = queued & (q_msgs >= jnp.float32(bw_queue_cap))
+        bw_dropped = jnp.sum(overflow_q.astype(jnp.int32))
+        val_f = val_f & ~overflow_q
+        queued = queued & ~overflow_q
+        # departure offset = whole ticks of busy time ahead; the 1e-4
+        # nudge keeps exact boundaries (k·(1/rate)) from rounding to the
+        # LATER tick under float32 (1.0/0.5000001 → 1.99…)
+        dt = jnp.floor(
+            backlog_m + ahead / safe_rate + 1e-4
+        ).astype(jnp.int32)
+        delay = delay + jnp.where(queued, dt, 0)
+        # admitted messages extend the busy time by 1/rate each; one tick
+        # of service elapses before the next enqueue
+        admitted = jnp.sum(
+            queued.reshape(o, n).astype(jnp.float32), axis=0
+        )
+        rate_src = jnp.maximum(
+            link.egress[BANDWIDTH] * (tick_ms / 1000.0) / MSG_BYTES, 1e-9
+        )
+        new_backlog = jnp.where(
+            link.egress[BANDWIDTH] <= 0.0,
+            jnp.float32(0.0),
+            jnp.maximum(link.backlog + admitted / rate_src - 1.0, 0.0),
+        )
+
     if is_ctrl is not None:  # control routes ride at the 1-tick floor
         delay = jnp.where(is_ctrl, 1, delay)
+
+    # --- calendar-horizon overflow: netem never silently shortens a
+    # configured delay (``link.go:169-179``), so every clamp is COUNTED
+    # and surfaced (engine accumulates → journal + runner warning)
+    # rather than silently speeding the link up.
+    clamped = jnp.sum((val_f & (delay > horizon - 1)).astype(jnp.int32))
+    delay = jnp.clip(delay, 1, horizon - 1)
 
     if slot_mode == "direct":
         # slot = the sender's outbox index: one scatter index per message
@@ -472,6 +612,35 @@ def enqueue(
             )
         buck_i = jnp.where(val_f, jnp.mod(t + delay, horizon), jnp.int32(horizon))
         pos_i = jnp.where(val_f, slot_in_src * n + dst_safe, midx)
+
+        # Debug-mode collision detection: the mode's contract is ≤1
+        # sender per (receiver, slot, tick) and a blind scatter silently
+        # corrupts on violation — under validate, detect both same-tick
+        # duplicate targets (sorted adjacent equal keys) and writes onto
+        # a still-occupied slot (pre-scatter occupancy readback), and
+        # report the first colliding (dst, slot).
+        collisions = jnp.int32(0)
+        collision_where = jnp.zeros((2,), jnp.int32)
+        if validate:
+            big_c = horizon * ns
+            big_i = jnp.int32(big_c)
+            lin = jnp.where(val_f, buck_i * ns + pos_i, big_i)
+            ks = jax.lax.sort(lin)
+            dup = (ks[1:] == ks[:-1]) & (ks[1:] < big_i)
+            plane = cal.occupancy_plane
+            flatp = plane if cal.flat else plane.reshape(-1)
+            occ = (flatp[jnp.minimum(lin, big_i - 1)] != 0) & val_f
+            collisions = jnp.sum(dup.astype(jnp.int32)) + jnp.sum(
+                occ.astype(jnp.int32)
+            )
+            first_dup = jnp.min(
+                jnp.where(dup, ks[1:], big_i), initial=big_c
+            )
+            first_occ = jnp.min(jnp.where(occ, lin, big_i), initial=big_c)
+            first = jnp.minimum(first_dup, first_occ)
+            p = jnp.mod(first, jnp.int32(ns))
+            collision_where = jnp.stack([jnp.mod(p, n), p // n])
+
         new_payload = tuple(
             scat(p, buck_i, pos_i, pw)
             for p, pw in zip(cal.payload, pay_w)
@@ -486,7 +655,14 @@ def enqueue(
             dataclasses.replace(
                 cal, payload=new_payload, src=new_src, valid=new_valid
             ),
-            rejected,
+            NetFeedback(
+                rejected=rejected,
+                clamped=clamped,
+                bw_dropped=bw_dropped,
+                backlog=new_backlog,
+                collisions=collisions,
+                collision_where=collision_where,
+            ),
         )
 
     # --- duplicate: second copy, one tick later
@@ -498,6 +674,12 @@ def enqueue(
         pay2 = [jnp.concatenate([p, p]) for p in pay_w]
         src2 = jnp.concatenate([src_f, src_f])
         val2 = jnp.concatenate([val_f, dup])
+        # a copy whose +1 lands past the horizon clips back onto its
+        # original's tick — that too is a shortened configured delay, so
+        # it joins the clamp count (delay is already ≤ horizon-1 here)
+        clamped = clamped + jnp.sum(
+            (dup & (delay >= horizon - 1)).astype(jnp.int32)
+        )
         delay2 = jnp.concatenate(
             [delay, jnp.clip(delay + 1, 1, horizon - 1)]
         )
@@ -587,7 +769,14 @@ def enqueue(
         dataclasses.replace(
             cal, payload=new_payload, src=new_src, valid=new_valid
         ),
-        rejected,
+        NetFeedback(
+            rejected=rejected,
+            clamped=clamped,
+            bw_dropped=bw_dropped,
+            backlog=new_backlog,
+            collisions=jnp.int32(0),
+            collision_where=jnp.zeros((2,), jnp.int32),
+        ),
     )
 
 
@@ -613,4 +802,9 @@ def apply_net_updates(
     region_of = link.region_of
     if net_region is not None and net_region_valid is not None:
         region_of = jnp.where(net_region_valid, net_region, region_of)
-    return LinkState(egress=egress, filters=filters, region_of=region_of)
+    return LinkState(
+        egress=egress,
+        filters=filters,
+        region_of=region_of,
+        backlog=link.backlog,
+    )
