@@ -134,13 +134,16 @@ class SimProgram:
         # Static horizon check: the plan's DEFAULT_LINK must be
         # deliverable within the calendar — shaped reconfigurations are
         # runtime data and get the clamp counter instead (NetFeedback).
+        jitter_ms = (
+            cls.DEFAULT_LINK[1] if "jitter" in cls.SHAPING else 0.0
+        )  # the jitter plane is compiled out when undeclared
         base_ticks = int(
-            np.ceil((cls.DEFAULT_LINK[0] + cls.DEFAULT_LINK[1]) / tick_ms)
+            np.ceil((cls.DEFAULT_LINK[0] + jitter_ms) / tick_ms)
         )
         if base_ticks > cls.MAX_LINK_TICKS - 1:
             raise ValueError(
                 f"DEFAULT_LINK latency+jitter ({cls.DEFAULT_LINK[0]}+"
-                f"{cls.DEFAULT_LINK[1]} ms = {base_ticks} ticks at "
+                f"{jitter_ms} ms = {base_ticks} ticks at "
                 f"{tick_ms} ms/tick) exceeds the calendar horizon "
                 f"MAX_LINK_TICKS-1 = {cls.MAX_LINK_TICKS - 1}; raise "
                 "MAX_LINK_TICKS or the tick duration"
@@ -150,6 +153,20 @@ class SimProgram:
                 "declare either 'bandwidth' (admission-cap drop) or "
                 "'bandwidth_queue' (HTB queueing), not both — they are "
                 "two semantics for the same LinkShape knob"
+            )
+        if "bandwidth_queue" in cls.SHAPING and cls.SLOT_MODE == "direct":
+            raise ValueError(
+                "bandwidth_queue is incompatible with SLOT_MODE='direct': "
+                "queue deferral makes two sends from one outbox slot land "
+                "on the same (receiver, slot, tick) and silently collide"
+            )
+        if "bandwidth_queue" in cls.SHAPING and "duplicate" in cls.SHAPING:
+            raise ValueError(
+                "bandwidth_queue is incompatible with duplicate shaping: "
+                "second copies would bypass the egress queue (tc shapes "
+                "netem duplicates through the HTB class; the transport "
+                "creates copies after queue metering) — PARITY BOUND, "
+                "use admission-cap 'bandwidth' with duplicate instead"
             )
         if not cls.CROSS_TICK_STACKING:
             # statically-detectable violations of the single-send-tick

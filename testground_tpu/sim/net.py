@@ -802,9 +802,9 @@ def apply_net_updates(
     region_of = link.region_of
     if net_region is not None and net_region_valid is not None:
         region_of = jnp.where(net_region_valid, net_region, region_of)
-    return LinkState(
-        egress=egress,
-        filters=filters,
-        region_of=region_of,
-        backlog=link.backlog,
+    # replace() preserves fields with no reconfiguration surface (the
+    # HTB backlog) by construction — a field-by-field rebuild would
+    # silently drop whatever LinkState grows next
+    return dataclasses.replace(
+        link, egress=egress, filters=filters, region_of=region_of
     )

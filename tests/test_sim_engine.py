@@ -386,6 +386,103 @@ class TestTransportDiagnostics:
         with pytest.raises(ValueError, match="not both"):
             SimProgram(Both(), make_groups(2))
 
+    def test_queue_incompatible_with_direct_and_duplicate(self):
+        """Deferral breaks direct mode's one-writer contract, and
+        duplicate copies would bypass queue metering — both rejected
+        statically instead of corrupting/overshooting silently."""
+
+        class QDirect(SimTestcase):
+            SHAPING = ("latency", "bandwidth_queue")
+            SLOT_MODE = "direct"
+
+            def step(self, env, state, inbox, sync, t):
+                return self.out(state, status=SUCCESS)
+
+        with pytest.raises(ValueError, match="direct"):
+            SimProgram(QDirect(), make_groups(2))
+
+        class QDup(SimTestcase):
+            SHAPING = ("latency", "bandwidth_queue", "duplicate")
+
+            def step(self, env, state, inbox, sync, t):
+                return self.out(state, status=SUCCESS)
+
+        with pytest.raises(ValueError, match="duplicate"):
+            SimProgram(QDup(), make_groups(2))
+
+    def test_undeclared_jitter_not_in_horizon_check(self):
+        """DEFAULT_LINK jitter only counts against the horizon when the
+        plan actually compiles jitter in — the plane is dead otherwise."""
+
+        class NoJit(SimTestcase):
+            SHAPING = ("latency",)
+            MAX_LINK_TICKS = 8
+            DEFAULT_LINK = (2.0, 500.0, 0.0, 0.0, 0.0, 0.0, 0.0)
+
+            def step(self, env, state, inbox, sync, t):
+                return self.out(state, status=SUCCESS)
+
+        SimProgram(NoJit(), make_groups(2))  # must not raise
+
+    def test_per_instance_filter_granularity(self):
+        """The N_REGIONS = N escape hatch: region == instance gives full
+        per-(src, dst) rule granularity — the tensor analog of the
+        reference's arbitrarily-many per-subnet routes
+        (``link.go:187-217``). Each instance drops exactly its right
+        neighbor's traffic; every other pair flows."""
+        from testground_tpu.sim.api import FILTER_ACCEPT, FILTER_DROP, Outbox
+
+        N = 8
+
+        class PerInstance(SimTestcase):
+            SHAPING = ("latency", "filters")
+            N_REGIONS = N
+            MSG_WIDTH = 1
+            OUT_MSGS = 1
+            IN_MSGS = N
+
+            def init(self, env):
+                return {"got_from": jnp.zeros((N,), jnp.int32)}
+
+            def step(self, env, state, inbox, sync, t):
+                i = env.global_seq
+                # t=0: claim region = my instance id and install MY rule
+                # row: DROP toward region (i+1) % N, ACCEPT elsewhere
+                rules = jnp.where(
+                    jnp.arange(N) == jnp.mod(i + 1, N),
+                    FILTER_DROP,
+                    FILTER_ACCEPT,
+                )
+                # t=2..: send to every peer, one per tick (dst cycles)
+                dst = jnp.mod(i + t, N)
+                ob = Outbox.single(
+                    dst, jnp.asarray([1]), (t >= 2) & (t < 2 + N), 1, 1
+                )
+                got = state["got_from"].at[inbox.src].add(
+                    inbox.valid.astype(jnp.int32), mode="drop"
+                )
+                return self.out(
+                    {"got_from": got},
+                    status=jnp.where(t >= 2 + N + 4, SUCCESS, RUNNING),
+                    outbox=ob,
+                    region=i,
+                    region_valid=t == 0,
+                    net_filters=rules,
+                    net_filters_valid=t == 0,
+                )
+
+        res = SimProgram(PerInstance(), make_groups(N), chunk=8).run(
+            max_ticks=32
+        )
+        assert (res["status"] == SUCCESS).all()
+        got = np.asarray(res["states"][0]["got_from"])  # [dst, src]
+        for src in range(N):
+            for dst in range(N):
+                if src == dst:
+                    continue
+                expect = 0 if dst == (src + 1) % N else 1
+                assert got[dst, src] == expect, (src, dst, got[dst, src])
+
     def test_direct_collision_detected_under_validate(self):
         """A colliding direct-mode plan reports the conflict via results
         when validate is on, and runs as today without (VERDICT r3 weak

@@ -2,6 +2,8 @@
 tensors (SURVEY.md §4 tier 2 — the mock-reactor tier, except the "mock" is
 the real simulator on CPU)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -236,12 +238,7 @@ class TestBandwidthQueue:
             cal, fb = self._send_burst(cal, link, 0, 2, k=1, o=1, t=t, n=n)
             assert int(fb.bw_dropped) == 0
             assert int(fb.clamped) == 0
-            link = net.LinkState(
-                egress=link.egress,
-                filters=link.filters,
-                region_of=link.region_of,
-                backlog=fb.backlog,
-            )
+            link = dataclasses.replace(link, backlog=fb.backlog)
             # backlog is link busy time in ticks: each message adds
             # 1/rate = 2 ticks, one tick of service elapses per tick
             assert float(fb.backlog[0]) == pytest.approx(float(t + 1))
@@ -295,12 +292,8 @@ class TestBandwidthQueue:
         # tick 1: rate jumps 100×; C must still depart AFTER B (cap is
         # raised: the message bound values standing busy time at the NEW
         # rate — see the approximation note in net.py)
-        fast = self._qlink(n, rate=10.0)
-        link = net.LinkState(
-            egress=fast.egress,
-            filters=fast.filters,
-            region_of=fast.region_of,
-            backlog=fb.backlog,
+        link = dataclasses.replace(
+            self._qlink(n, rate=10.0), backlog=fb.backlog
         )
         cal, fb = self._send_burst(
             cal, link, 0, 2, k=1, o=1, t=1, n=n, cap=1024
@@ -320,11 +313,8 @@ class TestBandwidthQueue:
         n = 4
         cal = Calendar.empty(32, n, 4, 1, flat=_CAL_FLAT)
         link = self._qlink(n, rate=0.0)
-        link = net.LinkState(  # rate 0 encodes as bandwidth 0 = unlimited
-            egress=link.egress.at[net.BANDWIDTH].set(0.0),
-            filters=link.filters,
-            region_of=link.region_of,
-            backlog=link.backlog,
+        link = dataclasses.replace(  # bandwidth 0 = unlimited
+            link, egress=link.egress.at[net.BANDWIDTH].set(0.0)
         )
         cal, fb = self._send_burst(cal, link, 0, 1, k=4, o=4, t=0, n=n)
         assert int(fb.bw_dropped) == 0
