@@ -1,0 +1,100 @@
+"""A second no-SDK language speaking the instance protocol end to end.
+
+The reference proves multi-language via JS/Rust plans with shell e2e
+coverage (``plans/example-js``, ``integration_tests/
+example_02_js_pingpong.sh``); here the Perl plan ``plans/example-perl``
+is implemented from ``docs/INSTANCE_PROTOCOL.md`` alone — TEST_* env,
+stdout event lines, sync TCP barriers/pubsub with interleaved reply
+matching, REAL inter-instance TCP ping-pong traffic, and the run-events
+outcome publish — and must pass the same outcome/collection assertions
+as any SDK plan."""
+
+import os
+import shutil
+import tarfile
+
+import pytest
+
+from testground_tpu.cli.main import main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("perl") is None, reason="no perl interpreter"
+)
+
+
+def _run(instances, rounds=3):
+    assert main(["plan", "import", "--from", os.path.join(PLANS, "example-perl")]) == 0
+    return main(
+        [
+            "run", "single", "example-perl:pingpong",
+            "--builder", "exec:bin",
+            "--runner", "local:exec",
+            "-i", str(instances),
+            "-tp", f"rounds={rounds}",
+        ]
+    )
+
+
+class TestPerlPingPong:
+    def test_pairs_exchange_real_traffic(self, tg_home, tmp_path, capsys):
+        """4 instances pair up over sync pubsub, exchange 3 TCP ping/pong
+        rounds each, and all report success (example_02_js_pingpong.sh
+        analog: ``assert_run_outcome_is success``)."""
+        rc = _run(instances=4)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "(outcome: success)" in out
+        # real rounds ran: the dialers printed RTT message lines
+        assert out.count("round 3 rtt:") == 2  # one per pair
+        # every instance's terminal event reached the outcome collector
+        assert "4/4" in out
+
+    def test_odd_instance_count_runs_solo(self, tg_home, tmp_path, capsys):
+        """The unpaired instance must succeed solo, not hang a barrier
+        (the sim edition's odd-instance contract, applied to real
+        processes)."""
+        rc = _run(instances=3)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "runs solo" in out
+        assert "3/3" in out
+
+    def test_collection_layout(self, tg_home, tmp_path, capsys):
+        """tg collect returns the reference outputs layout
+        (<plan>/<run>/<group>/<instance>/ — ``local_docker.go:258-267``)
+        for a no-SDK plan too."""
+        rc = _run(instances=2)
+        out = capsys.readouterr().out
+        assert rc == 0
+        run_id = out.split("finished run with ID:")[1].split()[0]
+        tgz = str(tmp_path / "out.tgz")
+        assert main(["collect", run_id, "-o", tgz]) == 0
+        capsys.readouterr()
+        with tarfile.open(tgz, "r:gz") as tar:
+            names = tar.getnames()
+        # both instance dirs present under the group
+        assert any("/single/0" in n for n in names), names
+        assert any("/single/1" in n for n in names), names
+
+    def test_failure_propagates(self, tg_home, tmp_path, capsys):
+        """An unknown case makes every instance publish a failure event;
+        the run outcome must be failure (silent-failure guard,
+        ``14_test_silent_failure.sh`` analog)."""
+        assert (
+            main(["plan", "import", "--from", os.path.join(PLANS, "example-perl")])
+            == 0
+        )
+        rc = main(
+            [
+                "run", "single", "example-perl:nope",
+                "--builder", "exec:bin",
+                "--runner", "local:exec",
+                "-i", "2",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "(outcome: failure)" in out
