@@ -194,6 +194,10 @@ def execute_sim_run(
                 "seed": cfg.seed,
                 "max_ticks": cfg.max_ticks,
                 "hosts": list(hosts),
+                # every program-shaping option must reach the followers —
+                # a validate mismatch would trace different programs and
+                # desync the cohort inside a collective
+                "validate": bool(getattr(cfg, "validate", False)),
             }
         )
         # readiness vote: a worker whose plans dir cannot satisfy the job
@@ -516,6 +520,7 @@ def sim_worker_loop(
             mesh=global_mesh(),
             chunk=spec["chunk"],
             hosts=tuple(spec.get("hosts", ())),
+            validate=bool(spec.get("validate", False)),
         )
         res = prog.run(
             seed=spec["seed"],

@@ -1,0 +1,129 @@
+"""Sync-service stress at the local:exec envelope (~300 real processes).
+
+The reference sizes its Redis infra for this tier (maxclients sizing,
+``pkg/runner/local_common.go:55,77-104``; local runner envelope 2-300
+instances, ``README.md:136-139``). Here BOTH per-run sync backends — the
+Python thread-per-connection server and the native C++ event-loop server
+— must hold 300 concurrent clients through a full-run pattern:
+signal_and_wait barrier at target 300, one publish each, then every
+client subscribe-reads all 300 entries. Measured timings land in
+PERF.md's sync-envelope table.
+
+Each client is a minimal raw-socket process (json+socket only — no SDK,
+no jax) so the test stresses the SERVER, not interpreter startup."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from testground_tpu.native import build_syncsvc, native_available
+from testground_tpu.sync import SyncServiceServer
+
+N = 300
+
+# argv: host port n idx — exits 0 only if barrier+publish+subscribe(n) all
+# complete; the deliberately dumb line loop keeps the client beyond
+# suspicion when the server misbehaves
+CLIENT = r"""
+import json, socket, sys
+host, port, n, idx = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+sock = socket.create_connection((host, port), timeout=180)
+f = sock.makefile("rw", encoding="utf-8")
+
+def send(req):
+    f.write(json.dumps(req) + "\n")
+    f.flush()
+
+def wait_reply(rid):
+    for line in f:
+        m = json.loads(line)
+        if m.get("id") == rid:
+            if "error" in m:
+                sys.stderr.write(m["error"] + "\n")
+                sys.exit(2)
+            return m
+    sys.exit(3)
+
+send({"id": 1, "op": "signal_and_wait", "state": "stress:big",
+      "target": n, "timeout": 170})
+wait_reply(1)
+send({"id": 2, "op": "publish", "topic": "stress:t", "payload": idx})
+wait_reply(2)
+send({"id": 3, "op": "subscribe", "topic": "stress:t"})
+got = 0
+for line in f:
+    m = json.loads(line)
+    if m.get("id") == 3 and "entry" in m:
+        got += 1
+        if got >= n:
+            print("OK")
+            sys.exit(0)
+sys.exit(4)
+"""
+
+
+def _stress(server, label, tmp_path):
+    host, port = server.address
+    script = tmp_path / "client.py"
+    script.write_text(CLIENT)
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # keep accelerator hooks out of 300 child interpreters (the
+        # local_exec runner does the same for its instances)
+        if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    t0 = time.time()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), host, str(port), str(N), str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for i in range(N)
+    ]
+    spawn_secs = time.time() - t0
+    failures = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            failures.append((i, "timeout", err.strip()))
+            continue
+        if p.returncode != 0 or "OK" not in out:
+            failures.append((i, p.returncode, err.strip()))
+    total_secs = time.time() - t0
+    assert not failures, f"{label}: {len(failures)} failed, first: {failures[:3]}"
+    print(
+        f"\n{label}: {N} clients barrier+pub+sub({N}) in "
+        f"{total_secs:.1f}s (spawn {spawn_secs:.1f}s)"
+    )
+    return total_secs
+
+
+class TestSyncEnvelope:
+    def test_python_server_holds_300_clients(self, tmp_path):
+        server = SyncServiceServer().start()
+        try:
+            _stress(server, "python server", tmp_path)
+        finally:
+            server.stop()
+
+    def test_native_server_holds_300_clients(self, tmp_path):
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        from testground_tpu.native import NativeSyncService
+
+        path = build_syncsvc(str(tmp_path / "bin"))
+        server = NativeSyncService(path)
+        try:
+            _stress(server, "native server", tmp_path)
+        finally:
+            server.stop()
