@@ -376,3 +376,27 @@ class TestMessageBearingCohorts:
                 params={},
                 n_procs=2,
             )
+
+    def test_storm_two_process_bit_equal(self, tmp_path):
+        """storm's random 5-out gossip graph is the WORST-case
+        cross-shard scatter (every instance floods arbitrary peers) —
+        through a real 2-process cohort it exercises cross-process
+        calendar traffic far beyond the pairwise workloads, and the
+        byte counters must still match single-process exactly
+        (reference: plans/benchmarks/storm.go:66-120)."""
+        digest = self._assert_cohort_equals_single(
+            tmp_path,
+            "benchmarks",
+            "storm",
+            instances=16,
+            params={
+                "conn_outgoing": "5",
+                "conn_delay_ticks": "8",
+                "data_size_kb": "64",
+            },
+            n_procs=2,
+        )
+        sent = sum(
+            e["metrics"].get("storm.bytes_sent", 0) for e in digest.values()
+        )
+        assert sent > 0, digest

@@ -38,17 +38,9 @@ class Schedule:
     seed: int
 
 
-@st.composite
-def schedules(draw):
-    n = draw(st.integers(2, 10))
-    o = draw(st.integers(1, 3))
-    slots = draw(st.integers(1, 4))
-    horizon = draw(st.sampled_from([4, 8, 16]))
-    ticks = draw(st.integers(1, 8))
-    latency = float(draw(st.integers(1, min(horizon - 1, 5))))
-    jitter = float(draw(st.sampled_from([0.0, 0.0, 2.0])))
-    loss = float(draw(st.sampled_from([0.0, 0.0, 30.0])))
-    dup = float(draw(st.sampled_from([0.0, 0.0, 100.0])))
+def _draw_sends(draw, n, o, ticks):
+    """Per-tick (dst [o,n], valid [o,n]) schedules — shared by both
+    strategies so the send shape can never silently diverge."""
     sends = []
     for _ in range(ticks):
         dst = draw(
@@ -66,6 +58,28 @@ def schedules(draw):
             )
         )
         sends.append((dst, valid))
+    return sends
+
+
+def _uid_payload(base, o, n):
+    """[o, 2, n] payload: word0 = globally unique send id, word1 = src."""
+    ids = jnp.arange(base, base + o * n, dtype=jnp.int32).reshape(o, n)
+    srcs = jnp.tile(jnp.arange(n, dtype=jnp.int32)[None, :], (o, 1))
+    return jnp.stack([ids, srcs], axis=1)
+
+
+@st.composite
+def schedules(draw):
+    n = draw(st.integers(2, 10))
+    o = draw(st.integers(1, 3))
+    slots = draw(st.integers(1, 4))
+    horizon = draw(st.sampled_from([4, 8, 16]))
+    ticks = draw(st.integers(1, 8))
+    latency = float(draw(st.integers(1, min(horizon - 1, 5))))
+    jitter = float(draw(st.sampled_from([0.0, 0.0, 2.0])))
+    loss = float(draw(st.sampled_from([0.0, 0.0, 30.0])))
+    dup = float(draw(st.sampled_from([0.0, 0.0, 100.0])))
+    sends = _draw_sends(draw, n, o, ticks)
     return Schedule(
         n=n, o=o, slots=slots, horizon=horizon, ticks=ticks,
         latency_ms=latency, jitter_ms=jitter, loss=loss, duplicate=dup,
@@ -102,14 +116,9 @@ def _run(sched: Schedule, flat: bool):
             dst_l, val_l = sched.sends[t]
             dst = jnp.asarray(dst_l, jnp.int32)
             valid = jnp.asarray(val_l, bool)
-            # word0: globally unique send id; word1: sender index
             base = uid
             uid += o * n
-            ids = jnp.arange(base, base + o * n, dtype=jnp.int32).reshape(
-                o, n
-            )
-            srcs = jnp.tile(jnp.arange(n, dtype=jnp.int32)[None, :], (o, 1))
-            payload = jnp.stack([ids, srcs], axis=1)  # [o, W, n]
+            payload = _uid_payload(base, o, n)
             cal, _ = enqueue(
                 cal,
                 link,
@@ -142,6 +151,153 @@ def test_flat_and_rows_layouts_deliver_identically(sched):
     a = _run(sched, flat=False)
     b = _run(sched, flat=True)
     for (pa, sa, va), (pb, sb, vb) in zip(a, b):
+        assert (va == vb).all()
+        assert (np.where(va, sa, -1) == np.where(vb, sb, -1)).all()
+        assert (np.where(va[None], pa, -1) == np.where(vb[None], pb, -1)).all()
+
+
+@dataclasses.dataclass
+class QueueSchedule:
+    n: int
+    o: int
+    ticks: int
+    rate: float  # msgs/tick service rate (HTB token bucket)
+    cap: int  # queue bound in messages
+    sends: list
+    seed: int
+
+
+@st.composite
+def queue_schedules(draw):
+    n = draw(st.integers(2, 6))
+    o = draw(st.integers(1, 4))
+    ticks = draw(st.integers(1, 6))
+    rate = draw(st.sampled_from([0.25, 0.5, 1.0, 2.0]))
+    cap = draw(st.sampled_from([2, 4, 128]))
+    sends = []
+    for _ in range(ticks):
+        dst = draw(
+            st.lists(
+                st.lists(st.integers(0, n - 1), min_size=n, max_size=n),
+                min_size=o,
+                max_size=o,
+            )
+        )
+        valid = draw(
+            st.lists(
+                st.lists(st.booleans(), min_size=n, max_size=n),
+                min_size=o,
+                max_size=o,
+            )
+        )
+        sends.append((dst, valid))
+    return QueueSchedule(
+        n=n, o=o, ticks=ticks, rate=rate, cap=cap, sends=sends,
+        seed=draw(st.integers(0, 2**30)),
+    )
+
+
+def _run_queue(sched: QueueSchedule, flat: bool):
+    """Random schedule through HTB bandwidth_queue shaping; returns
+    (per-tick inboxes, total bw_dropped, total clamped). Inbox slots and
+    horizon are sized so NOTHING else can drop — every loss must be a
+    counted queue tail-drop."""
+    n, o = sched.n, sched.o
+    width = 2
+    slots = sched.ticks * o * n  # worst-case same-bucket stacking
+    # worst dt: the deepest ACHIEVABLE queue (can't exceed either the cap
+    # or the schedule's total sends) at this service rate
+    max_queued = min(sched.cap, sched.ticks * o * n)
+    horizon = int(max_queued / sched.rate) + sched.ticks + 8
+    cal = Calendar.empty(horizon, n, slots, width, track_src=True, flat=flat)
+    bw = sched.rate * net.MSG_BYTES * 1000.0  # rate msgs/tick at 1ms ticks
+    link = net.make_link_state(
+        n, 1, [1.0, 0.0, bw, 0.0, 0.0, 0.0, 0.0], track_backlog=True
+    )
+    out = []
+    uid = 0
+    dropped = 0
+    clamped = 0
+    total_ticks = sched.ticks + horizon
+    for t in range(total_ticks):
+        cal, inbox = deliver(cal, jnp.int32(t))
+        out.append(
+            (
+                np.asarray(inbox.payload),
+                np.asarray(inbox.src),
+                np.asarray(inbox.valid),
+            )
+        )
+        if t < sched.ticks:
+            dst_l, val_l = sched.sends[t]
+            base = uid
+            uid += o * n
+            cal, fb = enqueue(
+                cal,
+                link,
+                jnp.asarray(dst_l, jnp.int32),
+                _uid_payload(base, o, n),
+                jnp.asarray(val_l, bool),
+                jnp.int32(t),
+                1.0,
+                jax.random.key(sched.seed + t),
+                features=("latency", "bandwidth_queue"),
+                bw_queue_cap=sched.cap,
+            )
+            link = dataclasses.replace(link, backlog=fb.backlog)
+            dropped += int(fb.bw_dropped)
+            clamped += int(fb.clamped)
+    return out, dropped, clamped
+
+
+@settings(max_examples=25, deadline=None)
+@given(queue_schedules())
+def test_bandwidth_queue_conserves_and_keeps_fifo(sched):
+    """HTB queue fuzz: (1) conservation — every valid send is delivered
+    exactly once OR counted as a queue tail-drop (nothing vanishes
+    silently, the property the old drop-at-send bandwidth could not
+    offer); (2) per-src FIFO — a src's queued messages arrive in send
+    order (the reference's HTB class queue can never reorder);
+    (3) both plane layouts agree."""
+    inboxes, dropped, clamped = _run_queue(sched, flat=True)
+    assert clamped == 0  # horizon was sized to make clamps impossible
+
+    deliveries = {}  # uid -> arrival tick
+    for t, (pay, src, valid) in enumerate(inboxes):
+        for slot in range(valid.shape[0]):
+            for d in range(valid.shape[1]):
+                if valid[slot, d]:
+                    uid = int(pay[0, slot, d])
+                    assert uid not in deliveries, f"{uid} delivered twice"
+                    deliveries[uid] = t
+
+    valid_sends = 0
+    per_src_uids = {}
+    uid = 0
+    for t in range(sched.ticks):
+        dst_l, val_l = sched.sends[t]
+        for oi in range(sched.o):
+            for s in range(sched.n):
+                if val_l[oi][s]:
+                    valid_sends += 1
+                    per_src_uids.setdefault(s, []).append(uid)
+                uid += 1
+    assert len(deliveries) == valid_sends - dropped, (
+        f"sent {valid_sends}, delivered {len(deliveries)}, "
+        f"counted drops {dropped}"
+    )
+    # FIFO: uids ascend in send order (tick, then outbox slot — exactly
+    # the queue admission order), so arrivals must be non-decreasing
+    for s, uids in per_src_uids.items():
+        arrivals = [deliveries[u] for u in uids if u in deliveries]
+        assert arrivals == sorted(arrivals), (
+            f"src {s} deliveries reordered: {arrivals}"
+        )
+
+    # layout equality on the same schedule
+    inboxes_r, dropped_r, _ = _run_queue(sched, flat=False)
+    assert dropped_r == dropped
+    for (pa, sa, va), (pb, sb, vb) in zip(inboxes, inboxes_r):
         assert (va == vb).all()
         assert (np.where(va, sa, -1) == np.where(vb, sb, -1)).all()
         assert (np.where(va[None], pa, -1) == np.where(vb[None], pb, -1)).all()
