@@ -123,6 +123,7 @@ if __name__ == "__main__":
             # fail cleanly with a pointer instead of exiting 2
             "traffic-allowed": _sim_only("traffic-allowed"),
             "traffic-blocked": _sim_only("traffic-blocked"),
+            "traffic-shaped": _sim_only("traffic-shaped"),
             "pingpong-sustained": _sim_only("pingpong-sustained"),
         }
     )

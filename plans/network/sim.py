@@ -388,9 +388,131 @@ class TrafficBlocked(_Traffic):
     BLOCKED = True
 
 
+class TrafficShaped(SimTestcase):
+    """Ring burst through an HTB-shaped link ("bandwidth_queue"): each
+    instance floods ``burst`` messages in ONE tick at a bandwidth of
+    ``rate`` msgs/tick and the receiver asserts BOTH properties the
+    reference's HTB gives real traffic (``pkg/sidecar/link.go:155-183``):
+
+    - conservation — every message arrives (the admission-cap semantics
+      would drop burst − rate of them at send time; rates below one
+      message per tick would deliver nothing at all);
+    - pacing — the queue services exactly ``rate`` per tick, so message
+      j arrives at send_tick + latency + floor(j/rate), and the LAST
+      arrival tick is checked exactly (simulated time, no tolerance).
+    """
+
+    STATES = ["net-ready"]
+    MSG_WIDTH = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 64  # narrowed by specialize below
+    SHAPING = ("latency", "bandwidth_queue")
+
+    @classmethod
+    def specialize(cls, groups, tick_ms=1.0):
+        from testground_tpu.sim.net import MSG_BYTES
+
+        # one burst/rate per RUN: DEFAULT_LINK (the shaped bandwidth) is
+        # global and the outbox shape is a class attribute, so per-group
+        # values cannot differ — reject loudly instead of shaping group
+        # B at group A's rate
+        bursts = {int(g.params.get("burst", 8)) for g in groups} or {8}
+        rates = {float(g.params.get("rate", 2.0)) for g in groups} or {2.0}
+        if len(bursts) > 1 or len(rates) > 1:
+            raise ValueError(
+                "traffic-shaped needs identical burst/rate across groups "
+                f"(got bursts={sorted(bursts)}, rates={sorted(rates)})"
+            )
+        burst, rate = bursts.pop(), rates.pop()
+        if rate <= 0:
+            raise ValueError(
+                f"traffic-shaped rate must be > 0 msgs/tick (got {rate}); "
+                "rate 0 means an unshaped link — use traffic-allowed"
+            )
+        # bandwidth bytes/s for `rate` msgs/tick (MSG_BYTES per message)
+        bw = rate * MSG_BYTES * 1000.0 / tick_ms
+        horizon = int(burst / rate) + 8  # last dt + latency + slack
+
+        class Specialized(cls):
+            OUT_MSGS = burst
+            # worst case the whole burst lands in one tick (rate ≥ burst)
+            IN_MSGS = burst
+            MAX_LINK_TICKS = horizon
+            DEFAULT_LINK = (1.0, 0.0, bw, 0.0, 0.0, 0.0, 0.0)
+
+        return Specialized
+
+    def init(self, env):
+        return {
+            "phase": jnp.int32(0),
+            "sent_at": jnp.int32(-1),
+            "received": jnp.int32(0),
+            "last_arrival": jnp.int32(-1),
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        n = env.test_instance_count
+        burst = (
+            env.int_param("burst") if "burst" in env.group.params else 8
+        )
+        rate = (
+            env.float_param("rate") if "rate" in env.group.params else 2.0
+        )
+        succ = jnp.mod(env.global_seq + 1, n)
+
+        phase = state["phase"]
+        ready = sync.counts[self.state_id("net-ready")] >= n
+        p0 = phase == 0
+        send = (phase == 1) & ready
+
+        received = state["received"] + inbox.count
+        last_arrival = jnp.where(
+            inbox.count > 0, t, state["last_arrival"]
+        )
+        sent_at = jnp.where(send, t, state["sent_at"])
+
+        # exact HTB schedule: burst message j departs floor(j/rate) ticks
+        # late and rides the 1-tick latency floor
+        expected_last = sent_at + 1 + jnp.int32(
+            jnp.floor((burst - 1) / rate + 1e-4)
+        )
+        deadline = expected_last + 4
+        judge = (phase == 2) & (t > deadline)
+        ok = (received == burst) & (last_arrival == expected_last)
+        status = jnp.where(
+            judge, jnp.where(ok, SUCCESS, FAILURE), RUNNING
+        ).astype(jnp.int32)
+
+        ob = Outbox(
+            dst=jnp.full((burst,), succ, jnp.int32),
+            payload=jnp.ones((burst, 1), jnp.int32),
+            valid=jnp.full((burst,), send, bool),
+        )
+        return self.out(
+            {
+                "phase": jnp.where(p0, 1, jnp.where(send, 2, phase)).astype(
+                    jnp.int32
+                ),
+                "sent_at": sent_at,
+                "received": received,
+                "last_arrival": last_arrival,
+            },
+            status=status,
+            outbox=ob,
+            signals=self.signal("net-ready") * p0,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {
+            "traffic.received": final_state["received"],
+            "traffic.last_arrival_tick": final_state["last_arrival"],
+        }
+
+
 sim_testcases = {
     "ping-pong": PingPong,
     "pingpong-sustained": PingPongSustained,
     "traffic-allowed": TrafficAllowed,
     "traffic-blocked": TrafficBlocked,
+    "traffic-shaped": TrafficShaped,
 }
