@@ -1,0 +1,46 @@
+"""polyglot plan, Python edition — one HALF of a cross-language cohort.
+
+The same plan directory ships a Perl edition (``run``, built by
+``exec:bin``); a composition puts one group on each builder and every
+instance — regardless of language — coordinates through the SAME
+per-run sync service: all signal ``enrolled``, barrier on the full
+cross-group count, publish their language to one topic, and verify they
+see every peer (a dense 1..N seq set and at least one entry from
+another language when the run is actually mixed).
+
+The reference's multi-language story is per-plan (a JS plan OR a Rust
+plan); this testcase proves the instance protocol
+(docs/INSTANCE_PROTOCOL.md) interoperates ACROSS languages in one run.
+"""
+
+from testground_tpu.sdk import invoke_map
+
+BARRIER_TIMEOUT = 60.0
+
+
+def rendezvous(runenv, initctx):
+    client = initctx.sync_client
+    n = runenv.test_instance_count
+
+    seq = client.signal_and_wait("enrolled", n, timeout=BARRIER_TIMEOUT)
+    runenv.record_message("python instance enrolled as %d/%d", seq, n)
+
+    client.publish("langs", {"seq": seq, "lang": "python"})
+    seen = {}
+    for entry in client.subscribe("langs", timeout=BARRIER_TIMEOUT):
+        seen[int(entry["seq"])] = entry["lang"]
+        if len(seen) >= n:
+            break
+
+    if sorted(seen) != list(range(1, n + 1)):
+        return f"expected seqs 1..{n}, saw {sorted(seen)}"
+    langs = set(seen.values())
+    runenv.record_message("rendezvous of %s complete", "+".join(sorted(langs)))
+    # all peers checked in; close the run in lockstep so no language's
+    # exit can strand another's subscribe
+    client.signal_and_wait("done", n, timeout=BARRIER_TIMEOUT)
+    return None
+
+
+if __name__ == "__main__":
+    invoke_map({"rendezvous": rendezvous})

@@ -79,6 +79,54 @@ class TestPerlPingPong:
         assert any("/single/0" in n for n in names), names
         assert any("/single/1" in n for n in names), names
 
+    def test_polyglot_cross_language_rendezvous(self, tg_home, tmp_path, capsys):
+        """Python and Perl instances in ONE run (mixed builders: exec:py
+        group + exec:bin group of the same plan) coordinate through the
+        same sync service — shared enrolled/done barriers at the full
+        cross-group count and a shared pubsub topic where every instance
+        sees every peer's language. The reference's multi-language story
+        is per-plan; this proves the instance protocol interoperates
+        ACROSS languages inside one run."""
+        assert (
+            main(["plan", "import", "--from", os.path.join(PLANS, "polyglot")])
+            == 0
+        )
+        comp = tmp_path / "poly.toml"
+        comp.write_text(
+            """
+[metadata]
+name = "polyglot-rendezvous"
+
+[global]
+plan = "polyglot"
+case = "rendezvous"
+builder = "exec:py"
+runner = "local:exec"
+
+[[groups]]
+id = "pythons"
+builder = "exec:py"
+[groups.instances]
+count = 2
+
+[[groups]]
+id = "perls"
+builder = "exec:bin"
+[groups.instances]
+count = 2
+"""
+        )
+        capsys.readouterr()
+        rc = main(["run", "composition", "-f", str(comp)])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "(outcome: success)" in out
+        # both languages enrolled and each saw BOTH languages at the
+        # rendezvous (the topic carried cross-language entries)
+        assert "python instance enrolled" in out
+        assert "perl instance enrolled" in out
+        assert out.count("rendezvous of perl+python complete") == 4
+
     def test_failure_propagates(self, tg_home, tmp_path, capsys):
         """An unknown case makes every instance publish a failure event;
         the run outcome must be failure (silent-failure guard,
