@@ -10,6 +10,9 @@ Sim twin of the reference's ``plans/network`` testcases:
 - ``traffic-allowed`` / ``traffic-blocked`` (``traffic.go:16-46``): every
   instance sends to its ring successor under an Accept vs Drop filter and
   asserts traffic did / did not flow.
+- ``traffic-shaped``: a one-tick burst through an HTB-shaped link
+  (``link.go:155-183`` bandwidth semantics) asserting conservation and
+  exact per-tick pacing in simulated time.
 
 Instances pair/chain by global sequence number; all control flow is
 ``jnp.where`` over int32 state so the whole case vmaps and jits.
