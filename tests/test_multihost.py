@@ -377,6 +377,20 @@ class TestMessageBearingCohorts:
                 n_procs=2,
             )
 
+    def test_splitbrain_drop_four_process_bit_equal(self, tmp_path):
+        """The widest fan-out: leader + THREE followers (8 global
+        devices) running splitbrain/drop at 12 instances — the mod-3
+        regions interleave across four processes' shards and the result
+        still matches single-process bit for bit."""
+        self._assert_cohort_equals_single(
+            tmp_path,
+            "splitbrain",
+            "drop",
+            instances=12,
+            params={},
+            n_procs=4,
+        )
+
     def test_storm_two_process_bit_equal(self, tmp_path):
         """storm's random 5-out gossip graph is the WORST-case
         cross-shard scatter (every instance floods arbitrary peers) —
