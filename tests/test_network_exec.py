@@ -38,6 +38,20 @@ class TestRealSocketPingPong:
         t = run_plan(engine, "network", "ping-pong", instances=3)
         assert t.outcome() == Outcome.SUCCESS
 
+    def test_local_envelope_200_instances(self, engine):  # noqa: F811
+        """The reference's local-runner envelope is 2-300 REAL instances
+        per host (``README.md:136-139``); run 200 real SDK processes —
+        100 concurrent TCP pairs with sync-service address exchange and
+        a 200-wide listening barrier — through the full local:exec
+        runner path (rate-limited start, pretty events, outcome
+        collection). The earlier 300-client stress hit the sync servers
+        directly; this drives the whole runner at envelope scale."""
+        t = run_plan(
+            engine, "network", "ping-pong", instances=200, timeout=300
+        )
+        assert t.outcome() == Outcome.SUCCESS, t.error
+        assert t.result["outcomes"]["all"] == {"ok": 200, "total": 200}
+
     def test_sim_only_case_fails_cleanly(self, engine):  # noqa: F811
         """Manifest-advertised cases without an exec edition fail with a
         clear pointer instead of crashing with exit 2."""
