@@ -391,6 +391,21 @@ class TestMessageBearingCohorts:
             n_procs=4,
         )
 
+    def test_traffic_shaped_two_process_bit_equal(self, tmp_path):
+        """The HTB bandwidth queue (r4's new shaping mode) through a
+        real cohort: the per-src backlog state is instance-sharded, so
+        the token bucket must meter identically when its halves live on
+        different processes — the plan's exact-pacing assertions plus
+        per-instance bit-equality gate it."""
+        self._assert_cohort_equals_single(
+            tmp_path,
+            "network",
+            "traffic-shaped",
+            instances=8,
+            params={"burst": "6", "rate": "1.5"},
+            n_procs=2,
+        )
+
     def test_storm_two_process_bit_equal(self, tmp_path):
         """storm's random 5-out gossip graph is the WORST-case
         cross-shard scatter (every instance floods arbitrary peers) —
