@@ -31,7 +31,10 @@ plan = spec.get("plan", "placebo")
 case = spec.get("case", "ok")
 instances = int(spec.get("instances", 8))
 env = EnvConfig.load(home)
-cfg = SimJaxConfig(chunk=int(spec.get("chunk", 8)))
+cfg = SimJaxConfig(
+    chunk=int(spec.get("chunk", 8)),
+    validate=bool(spec.get("validate", False)),
+)
 if coord:  # multi-host cohort leader; empty coord = plain single process
     cfg.coordinator_address = coord
     cfg.num_processes = n_procs
@@ -292,7 +295,8 @@ def _instance_digest(home, plan, run_id="mhrun"):
 
 class TestMessageBearingCohorts:
     def _assert_cohort_equals_single(
-        self, tmp_path, plan, case, instances, params, n_procs
+        self, tmp_path, plan, case, instances, params, n_procs,
+        validate=False,
     ):
         run_id = f"mh-{case}"  # unique per call: homes are shared
         spec = {
@@ -302,6 +306,7 @@ class TestMessageBearingCohorts:
             "params": params,
             "chunk": 64,
             "run_id": run_id,
+            "validate": validate,
         }
         result, _ = _run_cohort(tmp_path, PLANS, n_procs=n_procs, spec=spec)
         assert result["outcome"] == "success", result
@@ -389,6 +394,22 @@ class TestMessageBearingCohorts:
             instances=12,
             params={},
             n_procs=4,
+        )
+
+    def test_direct_mode_validate_in_cohort(self, tmp_path):
+        """A direct-slot-mode plan under validate=true through a real
+        cohort: the leader broadcasts the flag, so BOTH processes trace
+        the validate-enabled program (a mismatch would trace different
+        programs and desync inside a collective). The clean flood passes
+        the collision check and stays bit-equal to single-process."""
+        self._assert_cohort_equals_single(
+            tmp_path,
+            "benchmarks",
+            "pingpong-flood",
+            instances=8,
+            params={"duration_ticks": "64", "latency_ms": "4"},
+            n_procs=2,
+            validate=True,
         )
 
     def test_traffic_shaped_two_process_bit_equal(self, tmp_path):
