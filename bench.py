@@ -131,7 +131,27 @@ def bench_sustained(n, ticks):
         f"(+{compile_secs:.1f}s compile; {rounds} total rounds exchanged)",
         file=sys.stderr,
     )
-    return n * run_ticks / wall, compile_secs
+    # warm-rerun probe: a FRESH jit of the same program (what a new run
+    # of this composition compiles) against the now-populated persistent
+    # cache — trace/lower + cache read instead of XLA compile. The
+    # wrapper def (a) is a distinct callable, so jit's in-process trace
+    # cache cannot shortcut the re-trace a new process would pay, and
+    # (b) keeps __name__ = "_chunk_step", so the HLO module sym_name —
+    # part of the persistent cache key — matches the cold entry.
+    import jax
+
+    def _chunk_step(c):
+        return prog._chunk_step(c)
+
+    tw = time.perf_counter()
+    jax.jit(_chunk_step, donate_argnums=0).lower(carry).compile()
+    warm_compile_secs = time.perf_counter() - tw
+    print(
+        f"# warm recompile (persistent cache): {warm_compile_secs:.1f}s "
+        f"vs {compile_secs:.1f}s cold",
+        file=sys.stderr,
+    )
+    return n * run_ticks / wall, compile_secs, warm_compile_secs
 
 
 def bench_flood(n, ticks):
@@ -203,6 +223,13 @@ def main() -> int:
     p.add_argument("--skip-secondary", action="store_true")
     args = p.parse_args()
 
+    # compiled programs are the framework's build artifact: warm processes
+    # (and explicit `tg build` precompiles) read compiles from this cache
+    from testground_tpu.utils.compile_cache import enable_compile_cache
+
+    cache_dir = enable_compile_cache()
+    print(f"# compile cache: {cache_dir or 'disabled'}", file=sys.stderr)
+
     import jax
 
     n, ticks = args.instances, args.ticks
@@ -213,7 +240,7 @@ def main() -> int:
         file=sys.stderr,
     )
 
-    full, full_compile = bench_sustained(n, ticks)
+    full, full_compile, warm_compile = bench_sustained(n, ticks)
     result = {
         "metric": "sim_peer_ticks_per_sec",
         "value": round(full, 1),
@@ -228,8 +255,14 @@ def main() -> int:
         "devices": len(devs),
         # one-off cost excluded from the throughput number above — the
         # north star is wall-clock, so report it alongside (VERDICT r3
-        # weak #4); steady-state reruns hit the persistent compile cache
+        # weak #4). The persistent compile cache is wired above (and in
+        # the executor + sim:plan precompile), so this drops to the
+        # trace/lower+deserialize floor for any process after the first;
+        # a driver-fresh bench run reports the cold number honestly.
         "compile_secs": round(full_compile, 2),
+        # a fresh jit of the same program against the populated cache —
+        # what any warm rerun of this composition pays instead of compile
+        "warm_compile_secs": round(warm_compile, 2),
     }
 
     if not args.skip_secondary:

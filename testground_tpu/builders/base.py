@@ -90,3 +90,25 @@ class Builder(Terminatable, abc.ABC):
 
     def config_type(self) -> type | None:
         return None
+
+
+class Precompiler(abc.ABC):
+    """A builder whose artifact includes a compiled program.
+
+    The reference's expensive artifact production happens at *build* time,
+    BuildKey-deduped (``pkg/engine/supervisor.go:359-364``; go-build cache
+    ``pkg/build/docker_go.go:266-283``). For JAX-program builders the
+    expensive step is XLA compilation, so an explicit build task
+    additionally traces + compiles the composition's programs into the
+    persistent compile cache (``utils/compile_cache.py``) — a later run of
+    the same composition skips XLA compile entirely."""
+
+    @abc.abstractmethod
+    def precompile(
+        self,
+        comp,
+        manifest,
+        env,
+        ow: OutputWriter,
+        cancel: threading.Event,
+    ) -> None: ...

@@ -9,19 +9,38 @@ shared with ``exec:py`` so queued runs are immune to source edits.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import shutil
 import threading
+import time
 
 from testground_tpu.api import BuildInput, BuildOutput
 from testground_tpu.rpc import OutputWriter
 
-from .base import Builder, purge_snapshots
+from .base import Builder, Precompiler, purge_snapshots
 
 __all__ = ["SimPlanBuilder"]
 
 
-class SimPlanBuilder(Builder):
+def _source_digest(artifact_dir: str) -> str:
+    """Digest of the snapshot's Python sources (path + contents) — the
+    part of the precompile BuildKey that invalidates on plan edits."""
+    h = hashlib.sha256()
+    for root, dirs, files in os.walk(artifact_dir):
+        dirs.sort()
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            h.update(os.path.relpath(path, artifact_dir).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+class SimPlanBuilder(Builder, Precompiler):
     def id(self) -> str:
         return "sim:plan"
 
@@ -55,3 +74,173 @@ class SimPlanBuilder(Builder):
     def purge(self, testplan: str, ow: OutputWriter, env=None) -> None:
         removed = purge_snapshots("sim-plan", testplan, ow, env)
         ow.infof("sim:plan purge: removed %d snapshot(s)", removed)
+
+    # ------------------------------------------------------- build = compile
+
+    def precompile(self, comp, manifest, env, ow, cancel) -> None:
+        """Trace + compile the composition's sim programs into the
+        persistent XLA cache — the build-time analog of the reference's
+        image build (``pkg/build/docker_go.go:266-283``): expensive
+        artifact production happens in the *build* task, deduped by a
+        BuildKey, and runs of the same composition become cache reads.
+
+        Uses the EXACT code path the sim:jax executor uses (same testcase
+        specialization, same mesh construction, same program options) so
+        the traced HLO — and therefore the XLA cache key — is identical.
+        The chunk program is compiled AOT (``lower().compile()``) without
+        executing a tick; only ``init_carry`` executes, to produce a carry
+        whose shardings match what the run will feed the chunk."""
+        from testground_tpu.api import prepare_for_run
+        from testground_tpu.config import CoalescedConfig
+        from testground_tpu.utils.compile_cache import enable_compile_cache
+
+        cache_dir = enable_compile_cache(env.dirs.home if env else None)
+        if cache_dir is None:
+            ow.infof("sim:plan precompile skipped: compile cache disabled")
+            return
+        if not comp.global_.case:
+            # case-less `tg build single <plan>`: there is no composition
+            # to resolve a program from — snapshot-only build, like the
+            # reference building a plan image without a run
+            ow.infof(
+                "sim:plan precompile skipped: no test case on this build"
+            )
+            return
+        from testground_tpu.sim.executor import (
+            SimJaxConfig,
+            _make_mesh,
+            _parse_hosts,
+            instantiate_testcase,
+            load_sim_testcases,
+        )
+        from testground_tpu.sim.engine import SimProgram, build_groups
+
+        artifacts = {g.id: g.run.artifact for g in comp.groups}
+        # prepare BEFORE coalescing the runner config: prepare_for_run is
+        # what fills manifest runner-config defaults into run_config, and
+        # do_run coalesces after it — a different order here would compile
+        # a different program than the run executes (wasting the cache and
+        # poisoning the BuildKey marker)
+        comp = prepare_for_run(comp, manifest)
+        cfg = (
+            CoalescedConfig()
+            .append(env.runners.get("sim:jax") if env else None)
+            .append(comp.global_.run_config)
+            .coalesce_into(SimJaxConfig)
+        )
+        hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
+        digests = {
+            path: _source_digest(path) for path in set(artifacts.values())
+        }
+
+        import jax
+
+        # one compile per distinct program shape across [[runs]] — the
+        # BuildKey analog: the key is (plan source digest, case, group
+        # layout/params, every program-shaping option, backend + topology +
+        # jax version); an edited plan re-keys via the source digest
+        seen: set[str] = set()
+        for run in comp.runs:
+            spec = {
+                "sources": digests[
+                    artifacts[
+                        comp.get_group(
+                            run.groups[0].effective_group_id()
+                        ).id
+                    ]
+                ],
+                "plan": comp.global_.plan,
+                "case": comp.global_.case,
+                "groups": [
+                    {
+                        "id": rg.id,
+                        "instances": rg.calculated_instance_count,
+                        "parameters": dict(rg.test_params),
+                    }
+                    for rg in run.groups
+                ],
+                "tick_ms": cfg.tick_ms,
+                "chunk": cfg.chunk,
+                "seed": cfg.seed,
+                "shard": cfg.shard,
+                "validate": bool(getattr(cfg, "validate", False)),
+                "hosts": list(hosts),
+                "backend": jax.default_backend(),
+                "devices": jax.device_count(),
+                "jax": jax.__version__,
+            }
+            key = hashlib.sha256(
+                json.dumps(spec, sort_keys=True).encode()
+            ).hexdigest()[:32]
+            if key in seen:
+                continue
+            seen.add(key)
+            marker = os.path.join(cache_dir, "precompiled", f"{key}.json")
+            if os.path.exists(marker):
+                ow.infof(
+                    "sim:plan precompile: cache hit for run %s (key %s)",
+                    run.id,
+                    key,
+                )
+                continue
+            if cancel.is_set():
+                return
+            t0 = time.perf_counter()
+            first = comp.get_group(run.groups[0].effective_group_id())
+            cases = load_sim_testcases(artifacts[first.id])
+            factory = cases.get(comp.global_.case)
+            if factory is None:
+                ow.warn(
+                    "sim:plan precompile: case %r not in plan (%s) — skipped",
+                    comp.global_.case,
+                    sorted(cases),
+                )
+                return
+            from testground_tpu.api import RunGroup
+
+            groups = build_groups(
+                [
+                    RunGroup(
+                        id=rg.id,
+                        instances=rg.calculated_instance_count,
+                        parameters=dict(rg.test_params),
+                    )
+                    for rg in run.groups
+                ]
+            )
+            testcase = instantiate_testcase(factory, groups, cfg.tick_ms)
+            prog = SimProgram(
+                testcase,
+                groups,
+                test_plan=comp.global_.plan,
+                test_case=comp.global_.case,
+                test_run="build",
+                tick_ms=cfg.tick_ms,
+                mesh=_make_mesh(cfg.shard),
+                chunk=cfg.chunk,
+                hosts=hosts,
+                validate=bool(getattr(cfg, "validate", False)),
+            )
+            # Walk the exact compile sequence the executor walks. Under a
+            # mesh the chunk compiles TWICE at runtime: the first call
+            # sees init's output shardings, but XLA assigns the per-group
+            # state leaves its own (GSPMD) shardings, so the second call
+            # retraces at that fixed point (one iteration — verified; see
+            # SimProgram.run). Execute one chunk here so both variants
+            # land in the cache; the run then compiles nothing.
+            carry = jax.jit(lambda: prog.init_carry(cfg.seed))()  # noqa: B023
+            fn = prog.compiled_chunk()
+            carry, _done = fn(carry)  # compiles variant 1 + runs one chunk
+            fn.lower(carry).compile()  # fixed-point variant, no execution
+            del carry
+            secs = time.perf_counter() - t0
+            os.makedirs(os.path.dirname(marker), exist_ok=True)
+            with open(marker, "w") as f:
+                json.dump({**spec, "compile_secs": round(secs, 3)}, f)
+            ow.infof(
+                "sim:plan precompiled run %s into %s in %.1fs (key %s)",
+                run.id,
+                cache_dir,
+                secs,
+                key,
+            )

@@ -25,6 +25,12 @@ class Directories:
     def daemon(self) -> str:
         return os.path.join(self.home, "data", "daemon")
 
+    def compile_cache(self) -> str:
+        """Persistent XLA compilation cache — the build-artifact cache
+        analog of the reference's go-build cache image
+        (``pkg/build/docker_go.go:266-283``)."""
+        return os.path.join(self.home, "data", "compile-cache")
+
     def all(self) -> list[str]:
         return [
             self.home,

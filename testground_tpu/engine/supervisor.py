@@ -177,6 +177,21 @@ def do_build_task(
     built = do_build(
         engine, comp, manifest, tsk.input.get("sources_dir", ""), tsk.id, ow, cancel
     )
+    # build = compile: an explicit build task additionally precompiles the
+    # composition's programs into the persistent XLA cache (the analog of
+    # the reference's build-time image production, supervisor.go:359-364).
+    # The implicit build inside a run task skips this — the run compiles
+    # (and populates the same cache) immediately afterwards anyway.
+    from testground_tpu.builders.base import Precompiler
+
+    for builder_id in built.list_builders():
+        builder = engine.builder_by_name(builder_id)
+        if isinstance(builder, Precompiler) and not cancel.is_set():
+            try:
+                builder.precompile(built, manifest, engine.env, ow, cancel)
+            except Exception as e:  # noqa: BLE001 — precompile is an
+                # optimization; the snapshot artifact above is already valid
+                ow.warn("%s precompile failed (build still ok): %s", builder_id, e)
     return {
         "outcome": Outcome.SUCCESS.value,
         "artifacts": {g.id: g.run.artifact for g in built.groups},

@@ -615,14 +615,32 @@ class SimProgram:
         forces a device sync, so observers should sample on a cadence, not
         every call).
         """
+        import time as _time
+
         # init is traceable; jit it so construction is one dispatch rather
         # than hundreds of eager ops (matters on remote-tunneled devices).
+        t0 = _time.perf_counter()
         carry = jax.jit(lambda: self.init_carry(seed))()
         fn = self.compiled_chunk()
         ticks = 0
+        compile_secs = 0.0
         while ticks < max_ticks:
             carry, done = fn(carry)
             ticks += self.chunk
+            if compile_secs == 0.0:
+                # init + first chunk = trace/lower + XLA compile (or a
+                # persistent-cache read — see utils/compile_cache) + one
+                # chunk's execution; the honest over-count direction, same
+                # convention as bench.py's compile_secs. Under a mesh the
+                # SECOND dispatch recompiles once more: XLA assigns the
+                # unconstrained per-group state leaves GSPMD shardings, so
+                # the chunk retraces at that fixed point (stable from then
+                # on — verified). That cost lands in run wall; the
+                # sim:plan precompile warms BOTH variants. D2H read, not
+                # block_until_ready — the latter may return early on
+                # remotely-tunneled backends (same workaround as bench.py)
+                np.asarray(done)
+                compile_secs = _time.perf_counter() - t0
             if on_chunk is not None:
                 on_chunk(ticks)
             if observer is not None:
@@ -631,7 +649,9 @@ class SimProgram:
                 break
             if cancel is not None and cancel.is_set():
                 break
-        return self.results(carry, ticks)
+        res = self.results(carry, ticks)
+        res["compile_secs"] = compile_secs
+        return res
 
     def results(self, carry: SimCarry, ticks: int) -> dict[str, Any]:
         # to_host assembles cross-host shards when the mesh spans multiple

@@ -130,8 +130,13 @@ def execute_sim_run(
     job: RunInput, ow: OutputWriter, cancel: threading.Event
 ) -> RunOutput:
     from .engine import SimProgram, build_groups
+    from testground_tpu.utils.compile_cache import enable_compile_cache
 
     cfg = job.runner_config or SimJaxConfig()
+    # the compiled XLA program is this framework's build artifact: route
+    # compilation through the persistent cache so a precompiled build
+    # (sim:plan) or any prior run of the same program skips XLA compile
+    enable_compile_cache(job.env.dirs.home if job.env is not None else None)
 
     # multi-host cohort join MUST precede any jax call that initializes
     # the backend (jax.distributed.initialize's contract)
@@ -438,6 +443,10 @@ def execute_sim_run(
         "ticks": res["ticks"],
         "tick_ms": cfg.tick_ms,
         "wall_secs": wall,
+        # init + first chunk (trace/lower + XLA compile or persistent-cache
+        # read + one chunk's execution) — drops to a small fraction when a
+        # build precompiled this program (see builders/sim_plan.py)
+        "compile_secs": round(res.get("compile_secs", 0.0), 3),
         "devices": int(mesh.devices.size) if mesh is not None else 1,
         "pub_dropped": res["pub_dropped"].tolist(),
         "latency_clamped": res.get("latency_clamped", 0),
@@ -466,7 +475,9 @@ def sim_worker_loop(
     leader owns reporting. ``once`` exits after one job (tests)."""
     from .distributed import broadcast_json, global_mesh, init_distributed
     from .engine import SimProgram, build_groups
+    from testground_tpu.utils.compile_cache import enable_compile_cache
 
+    enable_compile_cache()
     init_distributed(coordinator_address, num_processes, process_id)
     import jax
 
