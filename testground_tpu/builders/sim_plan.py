@@ -110,10 +110,10 @@ class SimPlanBuilder(Builder, Precompiler):
             SimJaxConfig,
             _make_mesh,
             _parse_hosts,
-            instantiate_testcase,
-            load_sim_testcases,
+            _precheck_device_memory,
+            load_and_specialize,
+            make_sim_program,
         )
-        from testground_tpu.sim.engine import SimProgram, build_groups
 
         artifacts = {g.id: g.run.artifact for g in comp.groups}
         # prepare BEFORE coalescing the runner config: prepare_for_run is
@@ -187,18 +187,14 @@ class SimPlanBuilder(Builder, Precompiler):
                 return
             t0 = time.perf_counter()
             first = comp.get_group(run.groups[0].effective_group_id())
-            cases = load_sim_testcases(artifacts[first.id])
-            factory = cases.get(comp.global_.case)
-            if factory is None:
-                ow.warn(
-                    "sim:plan precompile: case %r not in plan (%s) — skipped",
-                    comp.global_.case,
-                    sorted(cases),
-                )
-                return
             from testground_tpu.api import RunGroup
 
-            groups = build_groups(
+            # same load/specialize/construct helpers as the executor and
+            # the sim-worker — the single-code-path guarantee behind the
+            # "identical HLO" claim above
+            testcase, groups = load_and_specialize(
+                artifacts[first.id],
+                comp.global_.case,
                 [
                     RunGroup(
                         id=rg.id,
@@ -206,21 +202,26 @@ class SimPlanBuilder(Builder, Precompiler):
                         parameters=dict(rg.test_params),
                     )
                     for rg in run.groups
-                ]
+                ],
+                cfg.tick_ms,
             )
-            testcase = instantiate_testcase(factory, groups, cfg.tick_ms)
-            prog = SimProgram(
+            mesh = _make_mesh(cfg.shard)
+            prog = make_sim_program(
                 testcase,
                 groups,
                 test_plan=comp.global_.plan,
                 test_case=comp.global_.case,
                 test_run="build",
                 tick_ms=cfg.tick_ms,
-                mesh=_make_mesh(cfg.shard),
+                mesh=mesh,
                 chunk=cfg.chunk,
                 hosts=hosts,
                 validate=bool(getattr(cfg, "validate", False)),
             )
+            # same capacity precheck as the run: an oversized composition
+            # must refuse readably at BUILD time too, not die as an XLA
+            # OOM inside the precompile's chunk execution
+            _precheck_device_memory(prog, cfg, mesh, ow)
             # Walk the exact compile sequence the executor walks. Under a
             # mesh the chunk compiles TWICE at runtime: the first call
             # sees init's output shardings, but XLA assigns the per-group

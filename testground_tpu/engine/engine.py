@@ -100,13 +100,17 @@ class Engine:
         self._queue_kick.set()
         for t in self._workers:
             t.join(timeout=5)
-        # a leader engine drains its multi-host sim-workers on the way out
-        # (no-op unless a cohort was joined this process)
+        # a leader engine drains its multi-host sim-workers on the way
+        # out: through the isolated leader child when one exists
+        # (sim/cohort.py), or directly if a cohort was joined in this
+        # process (isolate_cohort=False)
         try:
+            from testground_tpu.sim.cohort import shutdown_leader_child
             from testground_tpu.sim.distributed import (
                 broadcast_shutdown_if_leader,
             )
 
+            shutdown_leader_child()
             broadcast_shutdown_if_leader()
         except Exception as e:  # noqa: BLE001 — shutdown is best-effort
             S().warning("cohort shutdown broadcast failed: %s", e)

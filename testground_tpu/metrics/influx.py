@@ -98,12 +98,22 @@ def push_rows(
     rows,
     db: str = DEFAULT_DB,
     timeout: float = 5.0,
+    base_ns: int | None = None,
 ) -> dict:
     """POST rows to ``<endpoint>/write?db=<db>``. Returns a journal dict
-    ``{pushed, ok, error?}`` — callers record it and move on."""
+    ``{pushed, ok, error?}`` — callers record it and move on.
+
+    ``base_ns`` must be stable per run (the executor passes the run's
+    start wall-clock): a per-push ``time.time_ns()`` would interleave
+    periodic flushes by push time instead of tick, write duplicate points
+    on retry, and let base1+tick_a collide with base2+tick_b across
+    batches, silently overwriting a point with an identical tagset. The
+    per-call fallback exists only for standalone one-shot callers."""
     import time
 
-    lines = rows_to_lines(rows, base_ns=time.time_ns())
+    lines = rows_to_lines(
+        rows, base_ns=time.time_ns() if base_ns is None else base_ns
+    )
     journal: dict = {"pushed": len(lines), "ok": False}
     if not lines:
         journal["ok"] = True

@@ -291,6 +291,9 @@ class SimTestcase:
     # ``region = global_seq`` gives full per-instance granularity, but
     # the dense [R, N] filter table is O(N²) — practical to ~8k
     # instances (a 64 MB table at 4k). Beyond that, coarsen regions.
+    # Tables over ``engine.MAX_FILTER_CELLS`` (1 GiB of int32) are
+    # refused statically at program build with a readable error rather
+    # than dying as an XLA allocation failure mid-trace.
     N_REGIONS: ClassVar[int] = 0
     MSG_WIDTH: ClassVar[int] = 4
     OUT_MSGS: ClassVar[int] = 1

@@ -58,6 +58,11 @@ class SimJaxConfig:
     # axis) — the ADDITIONAL_HOSTS analog (``local_docker.go:78``); plans
     # address them via ``env.host_index(name)``
     additional_hosts: list = dataclasses.field(default_factory=list)
+    # per-run device-memory precheck (the cluster capacity precheck
+    # analog, ``cluster_k8s.go:958-1012``): 0 = auto-detect the device's
+    # bytes_limit (skipped when the backend exposes no memory stats),
+    # -1 = disabled, >0 = explicit per-device budget in bytes
+    memory_limit_bytes: int = 0
     # multi-host SPMD (SURVEY §2.6/§7-M5): when coordinator_address is set
     # the run joins a jax.distributed cohort — this engine is the leader
     # (process 0); every other host runs `tg sim-worker` against the same
@@ -65,6 +70,11 @@ class SimJaxConfig:
     coordinator_address: str = ""
     num_processes: int = 1
     process_id: int = 0
+    # run the cohort-leader half in a killable child process so member
+    # death fails the TASK, not the daemon (sim/cohort.py); stripped on
+    # the child hop. False = join jax.distributed in this process (the
+    # sim-worker loop, and the child itself)
+    isolate_cohort: bool = True
 
 
 def load_sim_testcases(artifact_path: str) -> dict:
@@ -106,6 +116,57 @@ def instantiate_testcase(factory, groups, tick_ms: float):
     return factory
 
 
+def load_and_specialize(artifact_path, test_case, run_groups, tick_ms):
+    """Plan sources → specialized testcase + group layout. Shared by the
+    run leader, the sim-worker followers, and the sim:plan precompile —
+    one path, so cohorts trace identical shapes and the precompile's
+    cache entries are the ones the run reads."""
+    from .engine import build_groups
+
+    cases = load_sim_testcases(artifact_path)
+    factory = cases.get(test_case)
+    if factory is None:
+        raise ValueError(
+            f"unknown sim test case {test_case!r}; plan exposes "
+            f"{sorted(cases)}"
+        )
+    groups = build_groups(run_groups)
+    return instantiate_testcase(factory, groups, tick_ms), groups
+
+
+def make_sim_program(
+    testcase,
+    groups,
+    *,
+    test_plan,
+    test_case,
+    test_run,
+    tick_ms,
+    mesh,
+    chunk,
+    hosts,
+    validate,
+):
+    """The ONE construction site for a run's SimProgram. Every
+    program-shaping option is a REQUIRED keyword: adding one here forces
+    the leader, the followers, and the precompile to thread it through,
+    instead of silently compiling different programs."""
+    from .engine import SimProgram
+
+    return SimProgram(
+        testcase,
+        groups,
+        test_plan=test_plan,
+        test_case=test_case,
+        test_run=test_run,
+        tick_ms=tick_ms,
+        mesh=mesh,
+        chunk=chunk,
+        hosts=hosts,
+        validate=validate,
+    )
+
+
 def _parse_hosts(raw) -> tuple[str, ...]:
     """Normalize the additional_hosts config: a TOML list, or a
     comma-separated string like the reference's ADDITIONAL_HOSTS env var
@@ -126,13 +187,73 @@ def _make_mesh(shard: bool):
     return jax.sharding.Mesh(np.asarray(devs), ("i",))
 
 
+# headroom multiplier over the exact carry footprint: donation double-
+# buffers the carry between chunks and the tick body materializes
+# transient planes (inbox window, outbox concat, scatter operands) of
+# the calendar's order of magnitude
+_MEM_HEADROOM = 2.5
+
+
+def _precheck_device_memory(prog, cfg, mesh, ow) -> None:
+    """Refuse an oversized composition BEFORE tracing — the per-run
+    analog of the reference's cluster capacity precheck
+    (``cluster_k8s.go:958-1012``: composition resources vs cluster
+    capacity at schedule time, not an OOM mid-run). The estimate is the
+    eval_shape-exact carry footprint × a documented headroom factor,
+    divided across mesh devices (the big planes shard by instance; the
+    replicated sync state is negligible beside them)."""
+    limit = int(getattr(cfg, "memory_limit_bytes", 0) or 0)
+    if limit < 0:
+        return
+    if limit == 0:
+        import jax
+
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
+        limit = stats.get("bytes_limit") or 0
+        if not limit:
+            return  # backend exposes no memory stats — nothing to check
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    carry = prog.estimate_carry_bytes()
+    need = int(carry * _MEM_HEADROOM / n_dev)
+    if need > limit:
+        raise RuntimeError(
+            f"composition needs ~{need / 2**30:.2f} GiB per device "
+            f"(carry {carry / 2**30:.2f} GiB × {_MEM_HEADROOM} headroom "
+            f"/ {n_dev} device(s)) but the device budget is "
+            f"{limit / 2**30:.2f} GiB — shrink the composition "
+            "(instances, IN_MSGS/MSG_WIDTH, MAX_LINK_TICKS, TOPIC_CAP) "
+            "or run on more devices; set runner config "
+            "memory_limit_bytes = -1 to override this precheck"
+        )
+    ow.infof(
+        "memory precheck: ~%.2f GiB/device of %.2f GiB budget (carry "
+        "%.2f GiB on %d device(s))",
+        need / 2**30,
+        limit / 2**30,
+        carry / 2**30,
+        n_dev,
+    )
+
+
 def execute_sim_run(
     job: RunInput, ow: OutputWriter, cancel: threading.Event
 ) -> RunOutput:
-    from .engine import SimProgram, build_groups
     from testground_tpu.utils.compile_cache import enable_compile_cache
 
     cfg = job.runner_config or SimJaxConfig()
+    # Multi-host: the engine NEVER joins the cohort in-process — a member
+    # death LOG(FATAL)s every joined process once the coordination
+    # service notices (no Python hook exists), which would kill the
+    # daemon. The leader half runs in a killable child instead; this
+    # process supervises it and fails the task cleanly on member death
+    # (the watchRunPods analog, ``cluster_k8s.go:696``). The child runs
+    # THIS function again with isolate_cohort stripped.
+    if getattr(cfg, "coordinator_address", "") and getattr(
+        cfg, "isolate_cohort", True
+    ):
+        from .cohort import run_in_cohort_child
+
+        return run_in_cohort_child(job, cfg, ow, cancel)
     # the compiled XLA program is this framework's build artifact: route
     # compilation through the persistent cache so a precompiled build
     # (sim:plan) or any prior run of the same program skips XLA compile
@@ -150,16 +271,10 @@ def execute_sim_run(
         multi = is_multiprocess()
 
     artifact = job.groups[0].artifact_path
-    cases = load_sim_testcases(artifact)
-    factory = cases.get(job.test_case)
-    if factory is None:
-        raise ValueError(
-            f"unknown sim test case {job.test_case!r}; plan exposes "
-            f"{sorted(cases)}"
-        )
-    groups = build_groups(job.groups)
     # per-run static narrowing from resolved params (SimTestcase.specialize)
-    testcase = instantiate_testcase(factory, groups, cfg.tick_ms)
+    testcase, groups = load_and_specialize(
+        artifact, job.test_case, job.groups, cfg.tick_ms
+    )
     n = sum(g.count for g in groups)
     hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
 
@@ -230,7 +345,7 @@ def execute_sim_run(
     if hosts:
         ow.infof("additional hosts: %s", ",".join(hosts))
 
-    prog = SimProgram(
+    prog = make_sim_program(
         testcase,
         groups,
         test_plan=job.test_plan,
@@ -242,6 +357,7 @@ def execute_sim_run(
         hosts=hosts,
         validate=bool(getattr(cfg, "validate", False)),
     )
+    _precheck_device_memory(prog, cfg, mesh, ow)
 
     t0 = time.time()
     last_report = [t0]
@@ -332,6 +448,20 @@ def execute_sim_run(
             "slot, tick) contract; use SLOT_MODE='sorted' or fix the "
             "traffic pattern"
         )
+    if res.get("bw_rate_change_backlogged", 0) > 0:
+        # informational, not fatal: the HTB queue-occupancy BOUND (tail-
+        # drop point) is approximate across these events — pacing and
+        # FIFO order remain exact (see net.py bandwidth_queue notes and
+        # tests/test_transport_fuzz.py rate-change cases)
+        ow.warn(
+            "sim:jax %s: bandwidth changed under a standing egress "
+            "backlog %d time(s) — the bandwidth_queue occupancy bound "
+            "values standing busy time at the current rate, so tail-drop "
+            "thresholds around those ticks are approximate (pacing and "
+            "FIFO order are unaffected)",
+            job.run_id,
+            res["bw_rate_change_backlogged"],
+        )
     if res.get("latency_clamped", 0) > 0:
         # netem never silently shortens a configured delay — surface the
         # clamp in the task log AND the journal (link.go:169-179 parity)
@@ -417,7 +547,11 @@ def execute_sim_run(
         if influx_endpoint:
             from testground_tpu.metrics.influx import push_rows
 
-            result.journal["influx"] = push_rows(influx_endpoint, full_rows)
+            # base_ns = run start, NOT push time: stable per run, so
+            # re-pushes are idempotent and batches never collide
+            result.journal["influx"] = push_rows(
+                influx_endpoint, full_rows, base_ns=int(t0 * 1e9)
+            )
 
     for gi, g in enumerate(groups):
         st = status[g.offset : g.offset + g.count]
@@ -439,10 +573,13 @@ def execute_sim_run(
                 outputs_root, job, g, st, res, metrics.get(g.id)
             )
 
+    import jax as _jax
+
     result.journal["sim"] = {
         "ticks": res["ticks"],
         "tick_ms": cfg.tick_ms,
         "wall_secs": wall,
+        "processes": int(_jax.process_count()),
         # init + first chunk (trace/lower + XLA compile or persistent-cache
         # read + one chunk's execution) — drops to a small fraction when a
         # build precompiled this program (see builders/sim_plan.py)
@@ -451,6 +588,7 @@ def execute_sim_run(
         "pub_dropped": res["pub_dropped"].tolist(),
         "latency_clamped": res.get("latency_clamped", 0),
         "bw_queue_dropped": res.get("bw_queue_dropped", 0),
+        "bw_rate_change_backlogged": res.get("bw_rate_change_backlogged", 0),
     }
     result.update_outcome()
     if cancel.is_set():
@@ -472,9 +610,12 @@ def sim_worker_loop(
     broadcasts: load the same plan from this host's plans dir, compile the
     identical program over the global mesh, and run it to completion —
     the multi-controller contract. Results live in the global arrays; the
-    leader owns reporting. ``once`` exits after one job (tests)."""
+    leader owns reporting. ``once`` serves at most one job, then keeps
+    participating in the spec broadcast until the leader's shutdown
+    sentinel arrives — leaving the collective early would desync the
+    cohort (tests use this; a second job spec in once mode is skipped
+    via the readiness vote)."""
     from .distributed import broadcast_json, global_mesh, init_distributed
-    from .engine import SimProgram, build_groups
     from testground_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -489,6 +630,7 @@ def sim_worker_loop(
 
     from .distributed import CohortCancel, cohort_agree
 
+    served = False
     while True:
         spec = broadcast_json(None)
         if spec.get("shutdown"):
@@ -497,9 +639,13 @@ def sim_worker_loop(
         # readiness vote BEFORE any program collective: if this (or any)
         # host cannot build the job, the whole cohort skips it
         try:
-            cases = load_sim_testcases(os.path.join(plans_dir, spec["plan"]))
-            factory = cases[spec["case"]]
-            groups = build_groups(
+            if once and served:
+                raise RuntimeError("once-mode worker already served a job")
+            # same load + specialization as the leader — the cohort must
+            # trace identical shapes (shared helper, not a copy)
+            testcase, groups = load_and_specialize(
+                os.path.join(plans_dir, spec["plan"]),
+                spec["case"],
                 [
                     RunGroup(
                         id=d["id"],
@@ -507,21 +653,17 @@ def sim_worker_loop(
                         parameters=d["parameters"],
                     )
                     for d in spec["groups"]
-                ]
+                ],
+                spec["tick_ms"],
             )
-            # same specialization as the leader — the cohort must trace
-            # identical shapes
-            testcase = instantiate_testcase(factory, groups, spec["tick_ms"])
             ok = True
         except Exception as e:  # noqa: BLE001 — voted, not raised
             log(f"sim-worker: cannot satisfy {spec['plan']}:{spec['case']}: {e}")
             ok = False
         if not cohort_agree(ok):
             log(f"sim-worker: cohort skipped run {spec['run_id']}")
-            if once:
-                return
             continue
-        prog = SimProgram(
+        prog = make_sim_program(
             testcase,
             groups,
             test_plan=spec["plan"],
@@ -541,8 +683,7 @@ def sim_worker_loop(
         log(
             f"sim-worker: run {spec['run_id']} done — {res['ticks']} ticks"
         )
-        if once:
-            return
+        served = True
 
 
 def _tree_slice(state_group):

@@ -625,19 +625,24 @@ def enqueue(
             big_c = horizon * ns
             big_i = jnp.int32(big_c)
             lin = jnp.where(val_f, buck_i * ns + pos_i, big_i)
-            ks = jax.lax.sort(lin)
-            dup = (ks[1:] == ks[:-1]) & (ks[1:] < big_i)
+            # argsort (not sort) so sorted-adjacent duplicates map back to
+            # their message index: a message that BOTH duplicates a
+            # same-tick key AND lands on an occupied slot is one conflict,
+            # not two — the masks OR per message before counting
+            perm = jnp.argsort(lin)
+            ks = lin[perm]
+            dup_sorted = (ks[1:] == ks[:-1]) & (ks[1:] < big_i)
+            dup = (
+                jnp.zeros_like(val_f).at[perm[1:]].set(dup_sorted)
+            )
             plane = cal.occupancy_plane
             flatp = plane if cal.flat else plane.reshape(-1)
             occ = (flatp[jnp.minimum(lin, big_i - 1)] != 0) & val_f
-            collisions = jnp.sum(dup.astype(jnp.int32)) + jnp.sum(
-                occ.astype(jnp.int32)
+            conflict = dup | occ
+            collisions = jnp.sum(conflict.astype(jnp.int32))
+            first = jnp.min(
+                jnp.where(conflict, lin, big_i), initial=big_c
             )
-            first_dup = jnp.min(
-                jnp.where(dup, ks[1:], big_i), initial=big_c
-            )
-            first_occ = jnp.min(jnp.where(occ, lin, big_i), initial=big_c)
-            first = jnp.minimum(first_dup, first_occ)
             p = jnp.mod(first, jnp.int32(ns))
             collision_where = jnp.stack([jnp.mod(p, n), p // n])
 

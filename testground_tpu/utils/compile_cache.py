@@ -66,6 +66,15 @@ def enable_compile_cache(home: str | None = None) -> str | None:
         # small programs the test suite compiles
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        if _enabled_dir is not None:
+            # jax binds its cache object to the directory at first use;
+            # a later config change alone is ignored — rebind explicitly
+            # (one daemon can serve runs under different homes)
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
     except Exception:  # noqa: BLE001 — caching is an optimization, never fatal
         return None
     _enabled_dir = d

@@ -1,0 +1,80 @@
+"""The JS/Node no-SDK plan (VERDICT r4 #7), runtime-gated.
+
+The reference ships a JS ping-pong with shell e2e coverage
+(``plans/example-js``, ``integration_tests/example_02_js_pingpong.sh``);
+``plans/example-js/run`` here is a Node implementation of
+``docs/INSTANCE_PROTOCOL.md`` — same flow as the proven Perl plan
+(pair discovery over sync pubsub, REAL TCP ping/pong rounds, barriers,
+run-events outcome publish). The e2e tests skip when no ``node``
+runtime exists (this image ships none — install node in CI to run them
+green there); the manifest/layout checks always run."""
+
+import os
+import shutil
+
+import pytest
+
+from testground_tpu.api import TestPlanManifest
+from testground_tpu.cli.main import main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+HAS_NODE = shutil.which("node") is not None
+
+
+class TestPlanShape:
+    """Runtime-independent checks — these gate the plan's packaging even
+    where node is absent."""
+
+    def test_manifest_parses_and_targets_exec_bin(self):
+        m = TestPlanManifest.load_file(
+            os.path.join(PLANS, "example-js", "manifest.toml")
+        )
+        assert m.name == "example-js"
+        assert m.testcase_by_name("pingpong") is not None
+        assert m.has_runner("local:exec")
+
+    def test_entry_point_is_executable_node(self):
+        run = os.path.join(PLANS, "example-js", "run")
+        assert os.access(run, os.X_OK)
+        with open(run) as f:
+            first = f.readline()
+        assert "node" in first, first  # #!/usr/bin/env node
+
+
+def _run(instances, rounds=3):
+    assert (
+        main(["plan", "import", "--from", os.path.join(PLANS, "example-js")])
+        == 0
+    )
+    return main(
+        [
+            "run", "single", "example-js:pingpong",
+            "--builder", "exec:bin",
+            "--runner", "local:exec",
+            "-i", str(instances),
+            "-tp", f"rounds={rounds}",
+        ]
+    )
+
+
+@pytest.mark.skipif(not HAS_NODE, reason="no node runtime in this image")
+class TestJsPingPong:
+    def test_pairs_exchange_real_traffic(self, tg_home, tmp_path, capsys):
+        """4 instances pair up over sync pubsub, exchange 3 TCP
+        ping/pong rounds each, and all report success
+        (example_02_js_pingpong.sh: ``assert_run_outcome_is success``)."""
+        rc = _run(instances=4)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "(outcome: success)" in out
+        assert out.count("round 3 rtt:") == 2  # one dialer per pair
+        assert "4/4" in out
+
+    def test_odd_instance_count_runs_solo(self, tg_home, tmp_path, capsys):
+        rc = _run(instances=3)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "runs solo" in out
+        assert "3/3" in out

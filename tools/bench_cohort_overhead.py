@@ -1,0 +1,185 @@
+"""Measure cohort (multi-process) overhead on the virtual CPU mesh.
+
+PERF.md's ICI throughput claim needs a bound on the FRAMEWORK's own
+cohort overhead, independent of interconnect speed (VERDICT r4 weak #3):
+this tool runs the same workload over the same GLOBAL device count as
+
+- ``1proc``: one process owning all D virtual devices, and
+- ``2proc``: a real jax.distributed cohort — leader child + one
+  ``tg sim-worker`` — with D/2 devices per process (cross-process
+  collectives ride gloo/TCP, the DCN stand-in),
+
+and reports steady-state wall (journal ``wall_secs − compile_secs``)
+plus the 2proc/1proc ratio. Identical global mesh ⇒ identical program
+shapes; only the process boundary differs.
+
+Usage:  python tools/bench_cohort_overhead.py [--devices 2]
+Writes one JSON line per workload to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+RUNNER = r"""
+import json, os, sys, threading
+from testground_tpu.api import RunGroup, RunInput
+from testground_tpu.config import EnvConfig
+from testground_tpu.rpc import discard_writer
+from testground_tpu.sim.executor import SimJaxConfig, execute_sim_run
+
+spec = json.loads(sys.argv[1])
+env = EnvConfig.load(spec["home"])
+cfg = SimJaxConfig(chunk=spec["chunk"], max_ticks=spec["max_ticks"])
+if spec.get("coord"):
+    cfg.coordinator_address = spec["coord"]
+    cfg.num_processes = 2
+    cfg.process_id = 0
+job = RunInput(
+    run_id="ovh", test_plan=spec["plan"], test_case=spec["case"],
+    total_instances=spec["n"],
+    groups=[RunGroup(id="all", instances=spec["n"],
+                     artifact_path=os.path.join(spec["plans"], spec["plan"]),
+                     parameters=spec["params"])],
+    runner_config=cfg, env=env)
+out = execute_sim_run(job, discard_writer(), threading.Event())
+sim = out.result.journal["sim"]
+print("OVH " + json.dumps({
+    "outcome": out.result.outcome.value, "ticks": sim["ticks"],
+    "wall": sim["wall_secs"], "compile": sim["compile_secs"],
+    "devices": sim["devices"], "processes": sim.get("processes", 1),
+}), flush=True)
+sys.stdin.readline()
+"""
+
+
+def _env(home, device_count):
+    return {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={device_count}",
+        "TESTGROUND_HOME": str(home),
+        "PYTHONPATH": REPO_ROOT,
+    }
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _read_result(proc, timeout):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line == "":
+            raise RuntimeError("runner died: " + proc.stderr.read()[-2000:])
+        if line.startswith("OVH "):
+            return json.loads(line[4:])
+    raise TimeoutError("no result from runner")
+
+
+def measure(spec, devices, cohort, timeout=1800):
+    home = tempfile.mkdtemp(prefix="tg-ovh-")
+    spec = dict(spec, home=home, plans=PLANS)
+    follower = None
+    if cohort:
+        port = _free_port()
+        spec["coord"] = f"127.0.0.1:{port}"
+    per_proc = devices // 2 if cohort else devices
+    leader = subprocess.Popen(
+        [sys.executable, "-c", RUNNER, json.dumps(spec)],
+        env=_env(home, per_proc),
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        if cohort:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    with socket.create_connection(
+                        ("127.0.0.1", port), timeout=1
+                    ):
+                        break
+                except OSError:
+                    time.sleep(0.5)
+            follower = subprocess.Popen(
+                [sys.executable, "-m", "testground_tpu.cli.main",
+                 "sim-worker", "--coordinator", spec["coord"],
+                 "--num-processes", "2", "--process-id", "1",
+                 "--plans", PLANS, "--once"],
+                env=_env(home, per_proc),
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        res = _read_result(leader, timeout)
+        leader.stdin.write("\n")
+        leader.stdin.flush()
+        leader.wait(timeout=120)
+        if follower is not None:
+            follower.wait(timeout=120)
+        return res
+    finally:
+        for p in (leader, follower):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+
+WORKLOADS = [
+    {
+        "label": "storm@4k",
+        "plan": "benchmarks", "case": "storm", "n": 4096,
+        "params": {"conn_outgoing": "5", "conn_delay_ticks": "32",
+                   "data_size_kb": "512"},
+        "chunk": 16, "max_ticks": 512,
+    },
+    {
+        "label": "pingpong-sustained@8k",
+        "plan": "network", "case": "pingpong-sustained", "n": 8192,
+        "params": {"duration_ticks": "100000", "latency_ms": "4",
+                   "latency2_ms": "2", "reshape_every": "1000"},
+        "chunk": 64, "max_ticks": 1024,
+    },
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=2,
+                    help="GLOBAL virtual device count (split in half "
+                    "across the 2-process cohort)")
+    args = ap.parse_args()
+    for w in WORKLOADS:
+        spec = {k: w[k] for k in
+                ("plan", "case", "n", "params", "chunk", "max_ticks")}
+        a = measure(spec, args.devices, cohort=False)
+        b = measure(spec, args.devices, cohort=True)
+        for r, name in ((a, "1proc"), (b, "2proc")):
+            assert r["outcome"] in ("success", "failure"), (w["label"], r)
+        sa = a["wall"] - a["compile"]
+        sb = b["wall"] - b["compile"]
+        print(json.dumps({
+            "workload": w["label"], "devices": args.devices,
+            "ticks": a["ticks"],
+            "steady_1proc_secs": round(sa, 2),
+            "steady_2proc_secs": round(sb, 2),
+            "ratio_2proc_over_1proc": round(sb / max(sa, 1e-9), 3),
+            "compile_1proc": round(a["compile"], 1),
+            "compile_2proc": round(b["compile"], 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
