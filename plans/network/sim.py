@@ -13,18 +13,25 @@ Sim twin of the reference's ``plans/network`` testcases:
 - ``traffic-shaped``: a one-tick burst through an HTB-shaped link
   (``link.go:155-183`` bandwidth semantics) asserting conservation and
   exact per-tick pacing in simulated time.
+- ``traffic-ruled``: ring traffic cut mid-run by per-instance RANGE
+  RULES (``link.go:187-217`` — each instance reconfiguring its own
+  subnet-rule list), asserting the one-tick turnaround, the REJECT
+  feedback, and untouched traffic before the cut — at any scale
+  (O(N·K), PERF.md r5).
 
 Instances pair/chain by global sequence number; all control flow is
 ``jnp.where`` over int32 state so the whole case vmaps and jits.
 """
 
 import jax.numpy as jnp
+import numpy as np
 
 from testground_tpu.sim.net import SHAPING_NO_DUPLICATE
 from testground_tpu.sim.api import (
     FAILURE,
     FILTER_ACCEPT,
     FILTER_DROP,
+    FILTER_REJECT,
     RUNNING,
     SUCCESS,
     Outbox,
@@ -391,6 +398,89 @@ class TrafficBlocked(_Traffic):
     BLOCKED = True
 
 
+class TrafficRuled(SimTestcase):
+    """Ring traffic cut mid-run by a per-instance RANGE RULE — the
+    "filter_rules" granularity model (the reference sidecar's
+    per-instance subnet rules, ``pkg/sidecar/link.go:187-217``: each
+    instance reconfigures its OWN rule list; a subnet is a contiguous
+    index range under sequential addressing).
+
+    Every instance streams to its ring successor; at ``cut_tick`` each
+    instance installs a REJECT rule covering exactly its successor. The
+    plan asserts three things the region table cannot express at scale:
+    the rule applies from the next tick (deliveries stop at
+    cut_tick + 1 + latency), the REJECT feeds back to the sender (the
+    PROHIBIT analog), and traffic before the cut was untouched.
+    """
+
+    FILTER_RULES = 2
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 8
+    SHAPING = ("latency", "filter_rules")
+    DEFAULT_LINK = (1.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0)
+
+    def init(self, env):
+        return {
+            "received": jnp.int32(0),
+            "last_arrival": jnp.int32(-1),
+            "rejected": jnp.int32(0),
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        n = env.test_instance_count
+        cut = (
+            env.int_param("cut_tick")
+            if "cut_tick" in env.group.params
+            else 8
+        )
+        stop = (
+            env.int_param("stop_tick")
+            if "stop_tick" in env.group.params
+            else 24
+        )
+        succ = jnp.mod(env.global_seq + 1, n)
+
+        received = state["received"] + inbox.count
+        last = jnp.where(inbox.count > 0, t, state["last_arrival"])
+        rejected = state["rejected"] + sync.rejected
+
+        # sends at tick s arrive s + delay (delay = ceil(latency/tick),
+        # static at trace time); the rule lands at cut's end, so the
+        # last delivered send is the one at cut — cut+1 messages, last
+        # arriving at cut + delay — and every later send REJECTs back
+        delay = int(np.ceil(self.DEFAULT_LINK[0] / env.tick_ms))
+        expect_recv = cut + 1
+        expect_last = cut + delay
+        expect_rej = stop - (cut + 1)
+        judge = t >= stop + delay + 4
+        ok = (
+            (received == expect_recv)
+            & (last == expect_last)
+            & (rejected == expect_rej)
+        )
+        return self.out(
+            {
+                "received": received,
+                "last_arrival": last,
+                "rejected": rejected,
+            },
+            status=jnp.where(
+                judge, jnp.where(ok, SUCCESS, FAILURE), RUNNING
+            ).astype(jnp.int32),
+            outbox=Outbox.single(succ, jnp.asarray([1]), t < stop, 1, 1),
+            net_rules=self.filter_rules((succ, succ + 1, FILTER_REJECT)),
+            net_rules_valid=t == cut,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {
+            "traffic.received": final_state["received"],
+            "traffic.rejected": final_state["rejected"],
+        }
+
+
 class TrafficShaped(SimTestcase):
     """Ring burst through an HTB-shaped link ("bandwidth_queue"): each
     instance floods ``burst`` messages in ONE tick at a bandwidth of
@@ -518,4 +608,5 @@ sim_testcases = {
     "traffic-allowed": TrafficAllowed,
     "traffic-blocked": TrafficBlocked,
     "traffic-shaped": TrafficShaped,
+    "traffic-ruled": TrafficRuled,
 }

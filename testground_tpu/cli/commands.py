@@ -409,7 +409,11 @@ def register_build(sub) -> None:
     _add_metadata_flags(pc)
     pc.set_defaults(func=build_composition_cmd)
     ps = psub.add_parser("single")
-    ps.add_argument("plan", help="plan name")
+    ps.add_argument(
+        "plan",
+        help="<plan> or <plan>:<case> — naming a case lets program "
+        "builders (sim:plan) precompile that case into the compile cache",
+    )
     ps.add_argument("--builder", default="")
     _add_metadata_flags(ps)
     ps.set_defaults(func=build_single_cmd)
@@ -465,18 +469,36 @@ def build_purge_cmd(args) -> int:
 def build_single_cmd(args) -> int:
     from testground_tpu.client import RemoteEngine
 
+    plan, _, case = args.plan.partition(":")
     engine = _engine(args)
     try:
         try:
-            src_dir, manifest = _resolve_plan(engine.env, args.plan)
+            src_dir, manifest = _resolve_plan(engine.env, plan)
         except FileNotFoundError:
             # daemon-hosted plan: the daemon resolves its own sources
             src_dir = ""
-            manifest = _resolve_manifest(engine.env, args, args.plan)
+            manifest = _resolve_manifest(engine.env, args, plan)
         builder = args.builder or manifest.defaults.get("builder", "")
+        # with a case the build can precompile (build = compile for
+        # sim:plan); the instance count and runner default from the
+        # manifest, matching what a default `tg run single` would execute
+        instances = 1
+        runner = ""
+        if case:
+            tc = manifest.testcase_by_name(case)
+            if tc is None:
+                raise ValueError(
+                    f"test case {case} not found in plan {plan}"
+                )
+            instances = tc.instances.default or tc.instances.minimum or 1
+            runner = manifest.defaults.get("runner", "")
         comp = Composition(
-            global_=Global(plan=args.plan, builder=builder),
-            groups=[Group(id="single", instances=Instances(count=1))],
+            global_=Global(
+                plan=plan, case=case, builder=builder, runner=runner
+            ),
+            groups=[
+                Group(id="single", instances=Instances(count=instances))
+            ],
         )
         created_by = _created_by(args, engine.env)
         if isinstance(engine, RemoteEngine):

@@ -263,6 +263,11 @@ class StepOut:
     net_shape_valid: jax.Array  # bool — apply net_shape this tick
     net_filters: jax.Array  # [R] int32 — per-dst-region filter actions
     net_filters_valid: jax.Array  # bool
+    # [K, 3] int32 — this instance's new range-rule list (start, end,
+    # action) per rule, first match wins; the "filter_rules" feature's
+    # reconfiguration surface (replaces the whole list when valid)
+    net_rules: jax.Array
+    net_rules_valid: jax.Array  # bool
     region: jax.Array  # int32 — this instance's new region id
     region_valid: jax.Array  # bool — apply region this tick
 
@@ -290,11 +295,19 @@ class SimTestcase:
     # (``link.go:187-217``); here ``N_REGIONS = N`` with
     # ``region = global_seq`` gives full per-instance granularity, but
     # the dense [R, N] filter table is O(N²) — practical to ~8k
-    # instances (a 64 MB table at 4k). Beyond that, coarsen regions.
+    # instances (a 64 MB table at 4k). Beyond that, coarsen regions or
+    # switch to "filter_rules" range-rule lists (below), which keep
+    # per-instance rules O(N·K) at any scale.
     # Tables over ``engine.MAX_FILTER_CELLS`` (1 GiB of int32) are
     # refused statically at program build with a readable error rather
     # than dying as an XLA allocation failure mid-trace.
     N_REGIONS: ClassVar[int] = 0
+    # Max range rules per instance for the "filter_rules" SHAPING feature
+    # (the scalable per-instance filter model — LinkState.rules): each
+    # instance carries up to K (start, end, action) rules over dst
+    # indices, first match wins, no match = Accept. Declare K here AND
+    # "filter_rules" in SHAPING; mutually exclusive with "filters".
+    FILTER_RULES: ClassVar[int] = 0
     MSG_WIDTH: ClassVar[int] = 4
     OUT_MSGS: ClassVar[int] = 1
     IN_MSGS: ClassVar[int] = 4
@@ -422,6 +435,8 @@ class SimTestcase:
         net_shape_valid=False,
         net_filters=None,
         net_filters_valid=False,
+        net_rules=None,
+        net_rules_valid=False,
         region=None,
         region_valid=False,
     ) -> StepOut:
@@ -453,6 +468,10 @@ class SimTestcase:
             if net_filters is None
             else jnp.asarray(net_filters, jnp.int32),
             net_filters_valid=jnp.asarray(net_filters_valid, bool),
+            net_rules=jnp.zeros((0, 3), jnp.int32)
+            if net_rules is None
+            else jnp.asarray(net_rules, jnp.int32),
+            net_rules_valid=jnp.asarray(net_rules_valid, bool),
             region=jnp.int32(0)
             if region is None
             else jnp.asarray(region, jnp.int32),
@@ -498,3 +517,32 @@ class SimTestcase:
                 )
             ]
         )
+
+    def filter_rules(self, *rules) -> jax.Array:
+        """Build a [FILTER_RULES, 3] rule list for ``StepOut.net_rules``.
+
+        Each rule is ``(start, end, action)``: the action applies to
+        sends whose dst index lies in ``[start, end)``, FIRST match
+        wins, unmatched sends are Accepted. Unused tail slots are padded
+        with the never-matching (0, 0, Accept). Entries may be traced
+        arrays — ranges can depend on runtime state (the analog of the
+        reference instance reconfiguring its own subnet rules mid-run).
+        """
+        k = type(self).FILTER_RULES
+        if len(rules) > k:
+            raise ValueError(
+                f"{len(rules)} rules > FILTER_RULES={k}; raise the "
+                "declaration"
+            )
+        rows = [
+            jnp.stack(
+                [
+                    jnp.asarray(s, jnp.int32),
+                    jnp.asarray(e, jnp.int32),
+                    jnp.asarray(a, jnp.int32),
+                ]
+            )
+            for (s, e, a) in rules
+        ]
+        rows += [jnp.zeros((3,), jnp.int32)] * (k - len(rows))
+        return jnp.stack(rows)

@@ -19,7 +19,12 @@ Topology of a run:
   and loop for the next job.
 
 Plan sources must be present on every host at the same plan name (the
-cluster runners make the same assumption via the shared image).
+cluster runners make the same assumption via the shared image), and
+every host must expose the SAME local device count —
+``jax.multihost_utils`` shapes its collectives as
+``[num_processes, local_devices]``, so an asymmetric cohort fails with a
+reshape error at the first broadcast (the reference makes the analogous
+uniformity assumption across its worker nodes).
 """
 
 from __future__ import annotations

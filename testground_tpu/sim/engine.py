@@ -156,6 +156,19 @@ class SimProgram:
                 f"MAX_LINK_TICKS-1 = {cls.MAX_LINK_TICKS - 1}; raise "
                 "MAX_LINK_TICKS or the tick duration"
             )
+        if "filter_rules" in cls.SHAPING:
+            if "filters" in cls.SHAPING:
+                raise ValueError(
+                    "declare either 'filters' (dense per-dst-region "
+                    "table) or 'filter_rules' (per-instance range-rule "
+                    "lists), not both — two granularity models for the "
+                    "same Accept/Reject/Drop semantics"
+                )
+            if cls.FILTER_RULES <= 0:
+                raise ValueError(
+                    "'filter_rules' shaping needs FILTER_RULES > 0 (the "
+                    "max rules per instance)"
+                )
         if "bandwidth_queue" in cls.SHAPING and "bandwidth" in cls.SHAPING:
             raise ValueError(
                 "declare either 'bandwidth' (admission-cap drop) or "
@@ -282,6 +295,9 @@ class SimProgram:
                 backlog=wsc(carry.link.backlog, self._ishard(0))
                 if carry.link.backlog is not None
                 else None,
+                rules=wsc(carry.link.rules, self._ishard(2))
+                if carry.link.rules is not None
+                else None,
             ),
             rejected=wsc(carry.rejected, self._ishard(0)),
         )
@@ -350,6 +366,11 @@ class SimProgram:
                 # N_REGIONS > len(groups) reassign via StepOut.region
                 region_of=region_of,
                 track_backlog="bandwidth_queue" in cls.SHAPING,
+                n_rules=(
+                    cls.FILTER_RULES
+                    if "filter_rules" in cls.SHAPING
+                    else 0
+                ),
             ),
             sync=make_sync_state(
                 self.n, self.n_states, self.n_topics, cls.TOPIC_CAP, cls.PUB_WIDTH
@@ -434,6 +455,8 @@ class SimProgram:
                     net_shape_valid=0,
                     net_filters=-1,
                     net_filters_valid=0,
+                    net_rules=-1,
+                    net_rules_valid=0,
                     region=0,
                     region_valid=0,
                 ),
@@ -534,24 +557,47 @@ class SimProgram:
 
         net_shape = catl(lambda o: o.net_shape)  # [7, N]
         net_shape_valid = cat0(lambda o: o.net_shape_valid) & active
-        n_regions = self.n_regions
-        if any(o.net_filters.shape[0] == n_regions for o in outs):
-            # Groups may differ: ones emitting the 0-width sentinel get a
-            # zero plane with valid=False so the concat stays rectangular.
+
+        def merge_reconfig_plane(width, zero_shape, getter, vgetter):
+            """Concat a per-group OPTIONAL reconfig plane along the
+            instance axis: groups emitting the 0-width sentinel get a
+            zero plane with valid=False so the concat stays rectangular;
+            (None, None) when no group emits at all."""
+            if width <= 0 or not any(
+                getter(o).shape[0] == width for o in outs
+            ):
+                return None, None
             planes, valids = [], []
             for gi, o in enumerate(outs):
                 count = self.groups[gi].count
-                if o.net_filters.shape[0] == n_regions:
-                    planes.append(o.net_filters)
-                    valids.append(o.net_filters_valid)
+                if getter(o).shape[0] == width:
+                    planes.append(getter(o))
+                    valids.append(vgetter(o))
                 else:
-                    planes.append(jnp.zeros((n_regions, count), jnp.int32))
+                    planes.append(jnp.zeros(zero_shape(count), jnp.int32))
                     valids.append(jnp.zeros((count,), bool))
-            net_filters = jnp.concatenate(planes, axis=-1)  # [R, N]
-            net_filters_valid = jnp.concatenate(valids, axis=0) & active
-        else:  # no group drives filters (0-width sentinel)
+            return (
+                jnp.concatenate(planes, axis=-1),
+                jnp.concatenate(valids, axis=0) & active,
+            )
+
+        n_regions = self.n_regions
+        net_filters, net_filters_valid = merge_reconfig_plane(
+            n_regions,
+            lambda c: (n_regions, c),
+            lambda o: o.net_filters,
+            lambda o: o.net_filters_valid,
+        )
+        if net_filters is None:  # no group drives filters
             net_filters = jnp.zeros((n_regions, self.n), jnp.int32)
             net_filters_valid = jnp.zeros((self.n,), bool)
+        n_rules = cls.FILTER_RULES if "filter_rules" in cls.SHAPING else 0
+        net_rules, net_rules_valid = merge_reconfig_plane(
+            n_rules,
+            lambda c: (n_rules, 3, c),
+            lambda o: o.net_rules,
+            lambda o: o.net_rules_valid,
+        )
         net_region = cat0(lambda o: o.region)
         net_region_valid = cat0(lambda o: o.region_valid) & active
         if self.hosts:
@@ -569,6 +615,9 @@ class SimProgram:
             net_filters_valid = pad_cols(net_filters_valid, False)
             net_region = pad_cols(net_region)
             net_region_valid = pad_cols(net_region_valid, False)
+            if net_rules is not None:
+                net_rules = pad_cols(net_rules)
+                net_rules_valid = pad_cols(net_rules_valid, False)
         link = apply_net_updates(
             carry.link,
             net_shape,
@@ -577,6 +626,8 @@ class SimProgram:
             net_filters_valid,
             net_region,
             net_region_valid,
+            net_rules,
+            net_rules_valid,
         )
         bw_rate_changed = carry.bw_rate_changed
         if fb.backlog is not None:  # HTB queue depths advance each tick

@@ -269,6 +269,19 @@ def execute_sim_run(
             cfg.coordinator_address, cfg.num_processes, cfg.process_id
         )
         multi = is_multiprocess()
+        if int(getattr(cfg, "num_processes", 1)) > 1 and not multi:
+            # a backend that "initialized" without actually joining (e.g.
+            # a plugin that ignores the distributed runtime) must not
+            # silently run the job on the wrong topology — the workers
+            # would strand, and results would claim a cohort that never
+            # existed
+            raise RuntimeError(
+                f"runner config requested a {cfg.num_processes}-process "
+                "cohort but the distributed runtime reports a single "
+                "process — the jax backend did not join (environment "
+                "mismatch between cohort members?); refusing to run on "
+                "the wrong topology"
+            )
 
     artifact = job.groups[0].artifact_path
     # per-run static narrowing from resolved params (SimTestcase.specialize)

@@ -110,6 +110,18 @@ class LinkState:
     backlog:   [N] float32 — per-src egress-queue depth in messages (the
                HTB token-bucket state; None unless the plan declares
                "bandwidth_queue" shaping)
+    rules:     [K, 3, N] int32 — per-instance RANGE-RULE lists (the
+               "filter_rules" feature; None unless declared). Rule k of
+               instance n is (start, end, action) over dst indices,
+               matching dst ∈ [start, end) — the iptables-style rule
+               list the reference sidecar applies per instance
+               (``link.go:187-217``: each instance's own rules keyed by
+               dst subnet, and a subnet IS a contiguous index range
+               under sequential instance addressing). FIRST match wins;
+               no match = Accept; start ≥ end = unset. O(N·K) state and
+               O(m·K) lookups, so per-instance granularity stays usable
+               at ANY instance count — the scalable alternative to the
+               dense ``N_REGIONS = N`` escape hatch (O(N²), ~8k bound).
 
     Regions default to groups (``region_of`` starts as the group index),
     reproducing per-dst-group filtering; plans that partition *within* a
@@ -119,13 +131,15 @@ class LinkState:
     per-subnet rules (``link.go:187-217``). ``N_REGIONS = N`` with
     ``region = global_seq`` gives full per-instance granularity; the
     dense [R, N] table is then O(N²), so that escape hatch is for runs
-    up to ~8k instances (see the parity note in ``sim/api.py``).
+    up to ~8k instances (see the parity note in ``sim/api.py``) — past
+    that, use ``rules`` above.
     """
 
     egress: jax.Array
     filters: jax.Array
     region_of: jax.Array
     backlog: jax.Array | None = None
+    rules: jax.Array | None = None
 
 
 @jax.tree_util.register_dataclass
@@ -244,6 +258,7 @@ def make_link_state(
     default_shape,
     region_of=None,
     track_backlog: bool = False,
+    n_rules: int = 0,
 ) -> LinkState:
     egress = jnp.tile(
         jnp.asarray(default_shape, jnp.float32)[:, None], (1, n)
@@ -256,6 +271,8 @@ def make_link_state(
         filters=filters,
         region_of=jnp.asarray(region_of, jnp.int32),
         backlog=jnp.zeros((n,), jnp.float32) if track_backlog else None,
+        # all-zero = every rule unset (start 0 ≥ end 0): accept everything
+        rules=jnp.zeros((n_rules, 3, n), jnp.int32) if n_rules > 0 else None,
     )
 
 
@@ -448,8 +465,10 @@ def enqueue(
         else None
     )
 
-    # --- filters: Accept / Reject / Drop per (src, dst region)
+    # --- filters: Accept / Reject / Drop — two granularity models
+    action = None
     if "filters" in features:
+        # per-(src instance, dst REGION) dense table
         n_regions = link.filters.shape[0]
         if n_regions == 1:
             # single region (one group, no N_REGIONS declaration): the
@@ -474,6 +493,34 @@ def enqueue(
             action = link.filters.reshape(-1)[
                 link.region_of[dst_safe] * n + src_f
             ]
+    elif "filter_rules" in features:
+        # per-src RANGE-RULE lists over dst indices (see LinkState.rules):
+        # K first-match passes. Rule fields are SRC-indexed, and src_f is
+        # an o-fold tile of the instance axis, so — exactly like eg() —
+        # every row read is a broadcast tile, never a random gather: the
+        # whole lookup is 3K tiled VPU compares, O(m·K) elementwise with
+        # NO scalar-core lanes (measured ~11× at 64k when written as
+        # gathers; ~free as tiles), unlike the dense table's O(N²)
+        # region gather at per-instance granularity
+        assert link.rules is not None, (
+            "filter_rules shaping needs a LinkState built with n_rules>0"
+        )
+
+        def srow(x):  # src-indexed [N] row → per-message
+            return x if o == 1 else jnp.tile(x, o)
+
+        action = jnp.full((m,), FILTER_ACCEPT, jnp.int32)
+        matched = jnp.zeros((m,), bool)
+        for k in range(link.rules.shape[0]):
+            start = srow(link.rules[k, 0])
+            end = srow(link.rules[k, 1])
+            act_k = srow(link.rules[k, 2])
+            hit = (
+                ~matched & (dst_safe >= start) & (dst_safe < end)
+            )  # unset rules (start >= end) can never hit
+            action = jnp.where(hit, act_k, action)
+            matched = matched | hit
+    if action is not None:
         accept = action == FILTER_ACCEPT
         rejected_msg = val_f & (action == FILTER_REJECT)
         if is_ctrl is not None:
@@ -793,6 +840,8 @@ def apply_net_updates(
     net_filters_valid: jax.Array,  # [N]
     net_region: jax.Array | None = None,  # [N] int32
     net_region_valid: jax.Array | None = None,  # [N]
+    net_rules: jax.Array | None = None,  # [K, 3, N] int32
+    net_rules_valid: jax.Array | None = None,  # [N]
 ) -> LinkState:
     """Apply per-instance network reconfigurations emitted by steps — the
     sidecar handler's "apply each network.Config received" loop
@@ -807,9 +856,31 @@ def apply_net_updates(
     region_of = link.region_of
     if net_region is not None and net_region_valid is not None:
         region_of = jnp.where(net_region_valid, net_region, region_of)
+    rules = link.rules
+    if net_rules is not None and net_rules_valid is not None:
+        # shape agreement is the engine's contract — a silent skip here
+        # would mask an engine-side plumbing bug as "rules never applied"
+        if rules is None:
+            raise ValueError(
+                "net_rules update against a LinkState without rule "
+                "planes (n_rules=0) — declare 'filter_rules' shaping"
+            )
+        if net_rules.shape[0] != rules.shape[0]:
+            raise ValueError(
+                f"net_rules K={net_rules.shape[0]} != LinkState "
+                f"K={rules.shape[0]}"
+            )
+        # a valid emission replaces the instance's WHOLE rule list (the
+        # reference's ConfigureNetwork replaces the rule set, it does
+        # not merge)
+        rules = jnp.where(net_rules_valid[None, None, :], net_rules, rules)
     # replace() preserves fields with no reconfiguration surface (the
     # HTB backlog) by construction — a field-by-field rebuild would
     # silently drop whatever LinkState grows next
     return dataclasses.replace(
-        link, egress=egress, filters=filters, region_of=region_of
+        link,
+        egress=egress,
+        filters=filters,
+        region_of=region_of,
+        rules=rules,
     )

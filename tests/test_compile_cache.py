@@ -259,3 +259,66 @@ class TestBuildPrecompiles:
         assert t3.outcome() == Outcome.SUCCESS, t3.error
         log3 = open(engine.task_log_path(t3.id)).read()
         assert "precompile: cache hit" in log3, log3[-2000:]
+
+    def test_multi_runs_precompile_one_marker_per_shape(
+        self, engine, tg_home
+    ):
+        """A [[runs]]-bearing composition precompiles each DISTINCT
+        program shape once: two runs at different instance counts → two
+        markers; a third run repeating the first count adds nothing."""
+        from testground_tpu.api import Run
+
+        comp = _composition(instances=4)
+        base = comp.runs[0]
+
+        def run_at(rid, count):
+            r = Run.from_dict(base.to_dict())
+            r.id = rid
+            r.groups[0].instances.count = count
+            return r
+
+        comp.runs = [
+            run_at("a", 4),
+            run_at("b", 6),
+            run_at("a2", 4),  # same shape as "a" — deduped in-build
+        ]
+        manifest = TestPlanManifest.load_file(
+            os.path.join(PLANS, "network", "manifest.toml")
+        )
+        t = _wait(
+            engine,
+            engine.queue_build(
+                comp, manifest, sources_dir=os.path.join(PLANS, "network")
+            ),
+        )
+        assert t.outcome() == Outcome.SUCCESS, t.error
+        cache = os.path.join(str(tg_home), "data", "compile-cache")
+        assert len(os.listdir(os.path.join(cache, "precompiled"))) == 2
+
+    def test_build_single_with_case_precompiles_via_cli(
+        self, tg_home, capsys
+    ):
+        """`tg build single <plan>:<case>` resolves the case (instance
+        count from the manifest default) so the sim:plan builder can
+        precompile — the CLI face of build = compile."""
+        from testground_tpu.cli.main import main
+
+        assert (
+            main(
+                ["plan", "import", "--from", os.path.join(PLANS, "network")]
+            )
+            == 0
+        )
+        capsys.readouterr()
+        rc = main(
+            ["build", "single", "network:ping-pong", "--builder", "sim:plan"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        cache = os.path.join(str(tg_home), "data", "compile-cache")
+        markers = os.listdir(os.path.join(cache, "precompiled"))
+        assert len(markers) == 1
+        marker = json.load(
+            open(os.path.join(cache, "precompiled", markers[0]))
+        )
+        assert marker["case"] == "ping-pong"

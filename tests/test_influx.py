@@ -148,6 +148,17 @@ class TestPush:
         assert journal["ok"] is False
         assert "error" in journal
 
+    def test_stable_base_ns_makes_repushes_idempotent(self, influx_server):
+        """ADVICE r4: a retried push with the run's stable base_ns must
+        produce byte-identical line-protocol (same timestamps), so Influx
+        overwrites points instead of duplicating them; per-call wall
+        clocks would re-stamp every retry."""
+        endpoint = f"http://127.0.0.1:{influx_server.server_address[1]}"
+        push_rows(endpoint, ROWS, base_ns=1_700_000_000_000_000_000)
+        push_rows(endpoint, ROWS, base_ns=1_700_000_000_000_000_000)
+        (_, body1), (_, body2) = influx_server.captured
+        assert body1 == body2
+
 
 class TestSimRunPush:
     def test_sim_run_mirrors_timeseries_to_influx(self, tg_home, influx_server):

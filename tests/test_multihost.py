@@ -666,3 +666,119 @@ class TestCohortCancel:
             for p in (leader, follower):
                 if p is not None and p.poll() is None:
                     p.kill()
+
+
+class TestEngineCohort:
+    def test_engine_task_runs_cohort_and_stop_drains_it(
+        self, tmp_path, monkeypatch
+    ):
+        """The daemon-shaped path: an in-process Engine executes a
+        multi-host run task (runner config carries the coordinator), the
+        isolated leader child joins the cohort on the engine's behalf,
+        and engine.stop() drains the worker through the child's shutdown
+        broadcast — the engine process itself never joins jax.distributed
+        (its own jax state stays single-process)."""
+        import jax
+
+        from testground_tpu.api import (
+            Composition, Global, Group, Instances, TestPlanManifest,
+            generate_default_run,
+        )
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.engine import Engine, EngineConfig, Outcome, State
+        from testground_tpu.sim.runner import SimJaxRunner
+
+        home = tmp_path / "home"
+        # the leader CHILD inherits this process's env: pin it to the
+        # worker's topology (cohorts need UNIFORM per-process device
+        # counts — jax.multihost_utils shapes collectives as
+        # [n_processes, local_devices]) and scrub the accelerator-tunnel
+        # activation vars, which would otherwise hijack the child onto a
+        # remote backend that cannot join the CPU cohort (the executor
+        # now refuses that loudly rather than running single-process)
+        monkeypatch.setenv("TESTGROUND_HOME", str(home))
+        monkeypatch.setenv(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=2"
+        )
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        for var in (
+            "PALLAS_AXON_POOL_IPS",
+            "PALLAS_AXON_REMOTE_COMPILE",
+            "AXON_LOOPBACK_RELAY",
+        ):
+            monkeypatch.delenv(var, raising=False)
+        port = _free_port()
+        engine = Engine(
+            EngineConfig(
+                env=EnvConfig.load(),
+                builders=[SimPlanBuilder()],
+                runners=[SimJaxRunner()],
+            )
+        )
+        engine.start_workers()
+        follower = None
+        try:
+            comp = generate_default_run(
+                Composition(
+                    global_=Global(
+                        plan="network", case="ping-pong",
+                        builder="sim:plan", runner="sim:jax",
+                        run_config={
+                            "coordinator_address": f"127.0.0.1:{port}",
+                            "num_processes": 2,
+                            "process_id": 0,
+                            "chunk": 8,
+                        },
+                    ),
+                    groups=[
+                        Group(id="all", instances=Instances(count=8))
+                    ],
+                )
+            )
+            manifest = TestPlanManifest.load_file(
+                os.path.join(PLANS, "network", "manifest.toml")
+            )
+            tid = engine.queue_run(
+                comp, manifest, sources_dir=os.path.join(PLANS, "network")
+            )
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    with socket.create_connection(
+                        ("127.0.0.1", port), timeout=1
+                    ):
+                        break
+                except OSError:
+                    time.sleep(0.5)
+            follower = subprocess.Popen(
+                [sys.executable, "-m", "testground_tpu.cli.main",
+                 "sim-worker", "--coordinator", f"127.0.0.1:{port}",
+                 "--num-processes", "2", "--process-id", "1",
+                 "--plans", PLANS, "--once"],
+                env=_clean_env(home),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                t = engine.get_task(tid)
+                if t is not None and t.state().state in (
+                    State.COMPLETE, State.CANCELED,
+                ):
+                    break
+                time.sleep(0.2)
+            assert t.outcome() == Outcome.SUCCESS, t.error
+            assert t.result["outcomes"]["all"]["ok"] == 8
+            # the engine's own jax never joined the cohort
+            assert jax.process_count() == 1
+            # stop() drains the worker through the leader child
+            engine.stop()
+            fout, _ = follower.communicate(timeout=120)
+            assert follower.returncode == 0, fout[-3000:]
+            assert "sim-worker: shutdown" in fout
+        finally:
+            if follower is not None and follower.poll() is None:
+                follower.kill()
+            engine.stop()

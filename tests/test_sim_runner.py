@@ -155,6 +155,22 @@ class TestSimNetwork:
         t = run_sim(engine, "network", "traffic-blocked", instances=4)
         assert t.outcome() == Outcome.SUCCESS
 
+    def test_traffic_ruled(self, engine):
+        """Per-instance range-rule filters through the full stack: the
+        plan asserts exact pre-cut delivery, one-tick rule turnaround,
+        and REJECT feedback counts (plans/network TrafficRuled)."""
+        t = run_sim(
+            engine,
+            "network",
+            "traffic-ruled",
+            instances=6,
+            params={"cut_tick": "6", "stop_tick": "20"},
+        )
+        assert t.outcome() == Outcome.SUCCESS
+        m = t.result["journal"]["metrics"]["all"]
+        assert m["traffic.received"]["mean"] == 7.0  # cut+1
+        assert m["traffic.rejected"]["mean"] == 13.0  # stop-(cut+1)
+
 
 class TestMemoryPrecheck:
     """Per-run device-memory precheck (VERDICT r4 #8) — the analog of
