@@ -470,9 +470,10 @@ from testground_tpu.config import EnvConfig
 from testground_tpu.rpc import OutputWriter
 from testground_tpu.sim.executor import SimJaxConfig, execute_sim_run
 coord, home, plans, logpath = sys.argv[1:5]
+n_procs = int(sys.argv[5]) if len(sys.argv) > 5 else 2
 env = EnvConfig.load(home)
 cfg = SimJaxConfig(
-    chunk=8, coordinator_address=coord, num_processes=2, process_id=0
+    chunk=8, coordinator_address=coord, num_processes=n_procs, process_id=0
 )
 job = RunInput(
     run_id="deathrun", test_plan="network", test_case="pingpong-sustained",
@@ -494,23 +495,24 @@ sys.stdin.readline()
 
 
 class TestCohortMemberDeath:
-    def test_follower_sigkill_fails_task_cleanly_and_engine_survives(
-        self, tmp_path
-    ):
+    def _run_death(self, tmp_path, n_procs, kill_idx):
+        """Form an n_procs cohort, SIGKILL follower `kill_idx` once the
+        chunk loop demonstrably runs, and assert the leader's task fails
+        readably in bounded time while the engine process survives."""
         port = _free_port()
         coord = f"127.0.0.1:{port}"
         home = tmp_path / "home"
         logpath = str(tmp_path / "leader.log")
         leader = subprocess.Popen(
             [sys.executable, "-c", DEATH_LEADER_SCRIPT, coord, str(home),
-             PLANS, logpath],
+             PLANS, logpath, str(n_procs)],
             env=_clean_env(home),
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
         )
-        follower = None
+        followers = []
         try:
             deadline = time.time() + 120
             while time.time() < deadline:
@@ -522,15 +524,22 @@ class TestCohortMemberDeath:
                 except OSError:
                     assert leader.poll() is None, "leader died early"
                     time.sleep(0.5)
-            follower = subprocess.Popen(
-                [sys.executable, "-m", "testground_tpu.cli.main",
-                 "sim-worker", "--coordinator", coord,
-                 "--num-processes", "2", "--process-id", "1",
-                 "--plans", PLANS, "--once"],
-                env=_clean_env(home),
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
-            )
+            # append one-by-one (not a comprehension) so a failed spawn
+            # still leaves the earlier followers reachable by the
+            # finally-block cleanup
+            for pid in range(1, n_procs):
+                followers.append(
+                    subprocess.Popen(
+                        [sys.executable, "-m", "testground_tpu.cli.main",
+                         "sim-worker", "--coordinator", coord,
+                         "--num-processes", str(n_procs),
+                         "--process-id", str(pid),
+                         "--plans", PLANS, "--once"],
+                        env=_clean_env(home),
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL,
+                    )
+                )
             # wait until the chunk loop is demonstrably executing (the
             # 5-second cadence progress line), so the kill lands MID-RUN,
             # not during compile or setup
@@ -550,7 +559,7 @@ class TestCohortMemberDeath:
             else:
                 raise AssertionError("run never reached the chunk loop")
 
-            follower.kill()
+            followers[kill_idx].kill()
             t_kill = time.time()
             line = _read_json_line(leader.stdout, 60)
             elapsed = time.time() - t_kill
@@ -567,9 +576,23 @@ class TestCohortMemberDeath:
             _, lerr = leader.communicate(timeout=60)
             assert leader.returncode == 0, lerr[-3000:]
         finally:
-            for p in (leader, follower):
+            for p in [leader] + followers:
                 if p is not None and p.poll() is None:
                     p.kill()
+
+    def test_follower_sigkill_fails_task_cleanly_and_engine_survives(
+        self, tmp_path
+    ):
+        self._run_death(tmp_path, n_procs=2, kill_idx=0)
+
+    def test_one_of_two_followers_dying_fails_the_three_process_cohort(
+        self, tmp_path
+    ):
+        """The mechanism is not pair-specific: with two followers, one
+        death must fail the run the same way (the survivor's runtime is
+        poisoned too — the whole generation restarts, as a lost pod
+        fails the reference's whole run)."""
+        self._run_death(tmp_path, n_procs=3, kill_idx=1)
 
 
 CANCEL_LEADER_SCRIPT = r"""
