@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-kernel test-e2e bench dryrun
+.PHONY: test test-kernel test-e2e bench dryrun telemetry-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e
 test:
@@ -24,6 +24,12 @@ test-e2e:
 # headline numbers on the local accelerator (one JSON line)
 bench:
 	$(PY) bench.py
+
+# telemetry-plane contract check (docs/OBSERVABILITY.md): a tiny run with
+# telemetry on must produce a non-empty, schema-valid sim_timeseries.jsonl
+# whose per-tick sums equal the journal's cumulative totals
+telemetry-smoke:
+	$(PY) tools/telemetry_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -11,7 +11,7 @@ unchanged.
 from __future__ import annotations
 
 import json
-import tomllib
+from testground_tpu.utils.compat import tomllib
 from dataclasses import dataclass, field
 from typing import Any
 

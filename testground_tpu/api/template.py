@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 import re
-import tomllib
+from testground_tpu.utils.compat import tomllib
 
 from ..utils.toml_writer import dumps as _toml_dumps
 

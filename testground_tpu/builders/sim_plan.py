@@ -129,6 +129,17 @@ class SimPlanBuilder(Builder, Precompiler):
             .coalesce_into(SimJaxConfig)
         )
         hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
+        # mirror the executor's telemetry gate EXACTLY (executor
+        # telemetry_on): the composition's disable_metrics opt-out and
+        # multi-host cohorts both force telemetry off at run time, so a
+        # build under either must precompile the telemetry-OFF variant
+        # or it warms a program the run never traces (and the run pays
+        # the full XLA compile)
+        telemetry = (
+            bool(getattr(cfg, "telemetry", False))
+            and not comp.global_.disable_metrics
+            and not getattr(cfg, "coordinator_address", "")
+        )
         digests = {
             path: _source_digest(path) for path in set(artifacts.values())
         }
@@ -164,6 +175,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 "seed": cfg.seed,
                 "shard": cfg.shard,
                 "validate": bool(getattr(cfg, "validate", False)),
+                "telemetry": telemetry,
                 "hosts": list(hosts),
                 "backend": jax.default_backend(),
                 "devices": jax.device_count(),
@@ -217,6 +229,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 chunk=cfg.chunk,
                 hosts=hosts,
                 validate=bool(getattr(cfg, "validate", False)),
+                telemetry=telemetry,
             )
             # same capacity precheck as the run: an oversized composition
             # must refuse readably at BUILD time too, not die as an XLA
@@ -231,7 +244,9 @@ class SimPlanBuilder(Builder, Precompiler):
             # land in the cache; the run then compiles nothing.
             carry = jax.jit(lambda: prog.init_carry(cfg.seed))()  # noqa: B023
             fn = prog.compiled_chunk()
-            carry, _done = fn(carry)  # compiles variant 1 + runs one chunk
+            # compiles variant 1 + runs one chunk (telemetry programs
+            # return (carry, done, block) — take the carry positionally)
+            carry = fn(carry)[0]
             fn.lower(carry).compile()  # fixed-point variant, no execution
             del carry
             secs = time.perf_counter() - t0

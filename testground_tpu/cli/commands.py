@@ -778,10 +778,44 @@ def tasks_cmd(args) -> int:
         engine.stop()
 
 
+def register_stats(sub) -> None:
+    p = sub.add_parser(
+        "stats",
+        help="show a completed task's sim telemetry summary "
+        "(message flow, timings, memory footprint — docs/OBSERVABILITY.md)",
+    )
+    p.add_argument("task", help="task id")
+    p.set_defaults(func=stats_cmd)
+
+
+def stats_cmd(args) -> int:
+    from testground_tpu.client import RemoteEngine
+    from testground_tpu.runners.pretty import render_telemetry_summary
+
+    engine = _engine(args)
+    try:
+        if isinstance(engine, RemoteEngine):
+            data = engine.task_stats(args.task)
+        else:
+            t = engine.get_task(args.task)
+            if t is None:
+                raise KeyError(f"unknown task {args.task}")
+            data = t.stats_payload()
+        print(render_telemetry_summary(data))
+        return 0
+    finally:
+        engine.stop()
+
+
 def register_status(sub) -> None:
     p = sub.add_parser("status", help="get task status")
     p.add_argument("-t", "--task", required=True, help="task id")
     p.add_argument("--extended", action="store_true")
+    p.add_argument(
+        "--telemetry",
+        action="store_true",
+        help="also render the sim telemetry summary table",
+    )
     p.set_defaults(func=status_cmd)
 
 
@@ -821,6 +855,14 @@ def status_cmd(args) -> int:
                             f"min={agg['min']:.3f} max={agg['max']:.3f} "
                             f"n={agg['count']}"
                         )
+        if getattr(args, "telemetry", False):
+            from testground_tpu.runners.pretty import (
+                render_telemetry_summary,
+            )
+
+            print("Telemetry:")
+            summary = render_telemetry_summary(t.stats_payload())
+            print("\n".join(f"  {line}" for line in summary.splitlines()))
         if args.extended:
             import json
 

@@ -171,6 +171,11 @@ class Client:
     def status(self, task_id: str) -> dict:
         return self._post_json("/status", {"task_id": task_id})["task"]
 
+    def stats(self, task_id: str) -> dict:
+        """GET /stats — a task's sim telemetry summary (the ``tg stats``
+        backend): identity + the journal's sim/telemetry/events sections."""
+        return self._get_json("/stats", {"task_id": task_id})
+
     def logs(self, task_id: str, follow: bool = False) -> Iterator[str]:
         return self._post_stream(
             "/logs", {"task_id": task_id, "follow": follow}
@@ -287,6 +292,12 @@ class RemoteEngine:
             return Task.from_dict(self.client.status(task_id))
         except DaemonError:
             return None
+
+    def task_stats(self, task_id: str) -> dict:
+        """One round trip to the daemon's /stats route (the remote half
+        of ``tg stats``; in-process engines assemble the same payload
+        via Task.stats_payload)."""
+        return self.client.stats(task_id)
 
     def tasks(
         self, states=None, types=None, before=None, after=None, limit=0, **_

@@ -9,7 +9,7 @@ Populated by coalescing, in descending precedence:
 from __future__ import annotations
 
 import os
-import tomllib
+from testground_tpu.utils.compat import tomllib
 from dataclasses import dataclass, field
 
 from .dirs import Directories

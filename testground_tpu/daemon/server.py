@@ -6,8 +6,8 @@ surface (``daemon.go:83-101``) and bearer-token auth (``daemon.go:49-70``):
 
     POST /run /build /tasks /status /logs /outputs /terminate
          /healthcheck /kill /delete /build/purge /plan/import
-    GET  / /tasks /logs /outputs /journal /data /dashboard /describe
-         /kill /delete
+    GET  / /tasks /logs /outputs /journal /stats /data /dashboard
+         /describe /kill /delete
 
 The GET tier is the reference's web-dashboard surface (``daemon.go:83-91``,
 ``dashboard.go:44-75``): ``/journal`` returns a task's result journal,
@@ -131,6 +131,7 @@ class _Handler(BaseHTTPRequestHandler):
             "/": self._root_redirect,
             "/tasks": lambda: self._tasks(q),
             "/journal": lambda: self._journal(q),
+            "/stats": lambda: self._stats(q),
             "/data": lambda: self._data(q),
             "/dashboard": lambda: self._dashboard(q),
             "/describe": lambda: self._describe(q),
@@ -448,6 +449,18 @@ class _Handler(BaseHTTPRequestHandler):
             t.result.get("journal", {}) if isinstance(t.result, dict) else {}
         )
         self._send_json({"task_id": task_id, "journal": journal})
+
+    def _stats(self, q: dict) -> None:
+        """GET /stats?task_id= — the task's sim telemetry summary (the
+        ``tg stats`` backend; docs/OBSERVABILITY.md): identity + the
+        journal's sim/telemetry/events sections, i.e. everything the
+        console table needs in one round trip. The payload shape is
+        Task.stats_payload — shared with the in-process CLI."""
+        task_id = q.get("task_id", "")
+        t = self.engine.get_task(task_id)
+        if t is None:
+            return self._send_error_json(f"unknown task {task_id}", 404)
+        self._send_json(t.stats_payload())
 
     def _data(self, q: dict) -> None:
         """GET /data?task_id=&metric= — one measurement's sampled rows

@@ -182,6 +182,27 @@ class Task:
                 return Outcome.UNKNOWN
         return Outcome.UNKNOWN
 
+    def stats_payload(self) -> dict:
+        """The telemetry-summary payload (``tg stats`` / GET /stats):
+        identity plus the result journal's sim/telemetry/events sections.
+        ONE builder for the daemon route and the in-process CLI, so the
+        two surfaces cannot drift."""
+        journal = (
+            self.result.get("journal", {})
+            if isinstance(self.result, dict)
+            else {}
+        )
+        return {
+            "task_id": self.id,
+            "plan": self.plan,
+            "case": self.case,
+            "state": self.state().state.value,
+            "outcome": self.outcome().value,
+            "sim": journal.get("sim", {}),
+            "telemetry": journal.get("telemetry", {}),
+            "events": journal.get("events", {}),
+        }
+
     def to_dict(self) -> dict:
         return {
             "version": self.version,

@@ -49,11 +49,19 @@ def _field_value(v) -> str | None:
     return None
 
 
-def rows_to_lines(rows, base_ns: int = 0) -> list[str]:
+def rows_to_lines(
+    rows, base_ns: int = 0, dropped: list[str] | None = None
+) -> list[str]:
     """Serialize timeseries rows (the ``timeseries.jsonl`` dict shape:
     plan/case/run/group_id/name/tick + numeric fields) into InfluxDB line
     protocol. The measurement name keeps the reference's
     ``results.<plan>-<case>.<metric>`` shape (``dashboard.go:112-118``).
+
+    Non-finite floats (NaN/Inf) are invalid line protocol — one such
+    field would make InfluxDB 400 the whole single-POST batch — so they
+    are dropped from the line; pass ``dropped`` to collect their
+    ``<measurement>.<field>`` names (push_rows journals and warns about
+    them instead of losing metrics silently).
 
     Timestamps are ``base_ns + tick`` nanoseconds: push_rows passes the
     wall-clock push time as ``base_ns`` so points land inside Grafana's
@@ -85,6 +93,14 @@ def rows_to_lines(rows, base_ns: int = 0) -> list[str]:
             fv = _field_value(v)
             if fv is not None:
                 fields.append(f"{escape_tag(k)}={fv}")
+            elif (
+                dropped is not None
+                and isinstance(v, float)
+                and not math.isfinite(v)
+            ):
+                # non-float non-values (strings, nested dicts) are simply
+                # not fields; only NaN/Inf is a LOST metric worth flagging
+                dropped.append(f"{measurement}.{k}")
         if not fields:
             continue
         tick = int(row.get("tick", 0))
@@ -111,10 +127,27 @@ def push_rows(
     per-call fallback exists only for standalone one-shot callers."""
     import time
 
+    dropped: list[str] = []
     lines = rows_to_lines(
-        rows, base_ns=time.time_ns() if base_ns is None else base_ns
+        rows,
+        base_ns=time.time_ns() if base_ns is None else base_ns,
+        dropped=dropped,
     )
     journal: dict = {"pushed": len(lines), "ok": False}
+    if dropped:
+        # journal the lost fields (deduped, bounded) AND warn — a NaN/Inf
+        # metric must be visible somewhere, since the line protocol
+        # cannot carry it
+        uniq = sorted(set(dropped))
+        journal["dropped_fields"] = uniq[:32]
+        journal["dropped_field_count"] = len(dropped)
+        S().warning(
+            "influx push: dropped %d non-finite field value(s) (%s%s) — "
+            "NaN/Inf is invalid line protocol",
+            len(dropped),
+            ", ".join(uniq[:5]),
+            ", ..." if len(uniq) > 5 else "",
+        )
     if not lines:
         journal["ok"] = True
         return journal

@@ -8,6 +8,15 @@ metrics per group on a tick cadence and appends rows to
 ``<outputs>/<plan>/<run-id>/timeseries.jsonl``; this viewer scans those
 files. Measurement names keep the reference's ``results.<plan>-<case>.
 <metric>`` shape so dashboard URLs and labels look the same.
+
+A second measurement family comes from the sim telemetry plane
+(``sim_timeseries.jsonl``, docs/OBSERVABILITY.md): per-tick engine
+counters — message flow, calendar depth, sync occupancy, live instances.
+Each counter surfaces as measurement ``sim.<counter>`` (group_id
+``_run``, since the counters are run-global), and the per-group live
+counts as ``sim.live`` dimensioned by group_id. Counter rows carry the
+raw per-tick value in every field slot (count/mean/min/max) so existing
+dashboard tables and the Influx mirror render them unchanged.
 """
 
 from __future__ import annotations
@@ -18,12 +27,57 @@ import os
 
 from testground_tpu.config import EnvConfig
 
-__all__ = ["Row", "Viewer", "clean", "measurement_name"]
+__all__ = ["Row", "Viewer", "clean", "expand_sim_row", "measurement_name"]
 
 # Tag keys that identify rather than dimension a series — excluded from the
 # dashboard's tag pickers like the reference's tagsIgnoreList
 # (``viewer.go:13-22``).
 TAGS_IGNORE = {"plan", "case", "group_id", "run"}
+
+# The sim telemetry plane's per-run series file name — the writer owns
+# the constant (sim/telemetry.py has no jax dependency).
+from testground_tpu.sim.telemetry import SIM_SERIES_FILE  # noqa: E402
+
+# Keys of a sim telemetry row that identify rather than measure.
+_SIM_IDENTITY = {"run", "plan", "case", "tick"}
+
+
+def expand_sim_row(row: dict):
+    """One sim_timeseries.jsonl row → viewer-shaped rows, one per
+    counter: measurement ``sim.<counter>`` with the per-tick value in
+    every field slot, and ``sim.live`` per group from the nested live
+    map. Non-numeric values are skipped (the jsonl is an open format)."""
+    base = {k: row.get(k, "") for k in ("run", "plan", "case")}
+    tick = row.get("tick", 0)
+    for key, val in row.items():
+        if key in _SIM_IDENTITY:
+            continue
+        if key == "live" and isinstance(val, dict):
+            for gid, v in val.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    yield {
+                        **base,
+                        "tick": tick,
+                        "group_id": str(gid),
+                        "name": "sim.live",
+                        "count": v,
+                        "mean": v,
+                        "min": v,
+                        "max": v,
+                    }
+            continue
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            continue
+        yield {
+            **base,
+            "tick": tick,
+            "group_id": "_run",
+            "name": f"sim.{key}",
+            "count": val,
+            "mean": val,
+            "min": val,
+            "max": val,
+        }
 
 
 def clean(name: str) -> str:
@@ -61,16 +115,35 @@ class Viewer:
     # ------------------------------------------------------------- scanning
 
     def _run_dirs(self, plan: str):
+        """Yield (run_id, plan-metric series path | None, sim telemetry
+        series path | None) for every run dir carrying either family."""
         root = os.path.join(self.env.dirs.outputs(), plan)
         if not os.path.isdir(root):
             return
         for run_id in sorted(os.listdir(root)):
             ts = os.path.join(root, run_id, "timeseries.jsonl")
-            if os.path.isfile(ts):
-                yield run_id, ts
+            sim = os.path.join(root, run_id, SIM_SERIES_FILE)
+            ts_ok = os.path.isfile(ts)
+            sim_ok = os.path.isfile(sim)
+            if ts_ok or sim_ok:
+                yield run_id, (ts if ts_ok else None), (
+                    sim if sim_ok else None
+                )
+
+    @staticmethod
+    def _read_jsonl(path: str):
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue
 
     def _iter_rows(self, plan: str, case: str | None, run_id: str | None):
-        for rid, path in self._run_dirs(plan):
+        for rid, ts_path, sim_path in self._run_dirs(plan):
             # a task's runs are <task-id> (single run) or <task-id>-<run-id>
             # (multi-run [[runs]] compositions — supervisor run_id scheme),
             # so a task-scoped query matches both
@@ -80,18 +153,16 @@ class Viewer:
                 and not rid.startswith(run_id + "-")
             ):
                 continue
-            with open(path, "r", encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        row = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
+            if ts_path is not None:
+                for row in self._read_jsonl(ts_path):
                     if case is not None and row.get("case") != case:
                         continue
                     yield row
+            if sim_path is not None:
+                for row in self._read_jsonl(sim_path):
+                    if case is not None and row.get("case") != case:
+                        continue
+                    yield from expand_sim_row(row)
 
     # ---------------------------------------------------------------- query
 

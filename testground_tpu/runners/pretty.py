@@ -16,7 +16,87 @@ from typing import IO
 from testground_tpu.rpc import OutputWriter
 from testground_tpu.sdk.events import parse_event_line
 
-__all__ = ["PrettyPrinter"]
+__all__ = ["PrettyPrinter", "render_telemetry_summary"]
+
+
+def render_telemetry_summary(stats: dict) -> str:
+    """Render a completed task's telemetry summary as an aligned table —
+    the console surface of the sim telemetry plane (``tg stats <task>``
+    and ``tg status --telemetry``; docs/OBSERVABILITY.md).
+
+    ``stats`` is the /stats payload shape: identity fields plus the
+    journal's ``sim`` / ``telemetry`` / ``events`` sections (all
+    optional — non-sim tasks render whatever they have)."""
+    sim = stats.get("sim") or {}
+    tele = stats.get("telemetry") or {}
+    events = stats.get("events") or {}
+    ident = f"{stats.get('plan', '?')}:{stats.get('case', '?')}"
+    if stats.get("task_id"):
+        ident += f"  ({stats['task_id']})"
+    if not (sim or tele or events):
+        # e.g. a build task, or a run that recorded nothing
+        return f"task  {ident}\nno telemetry recorded for this task"
+    rows: list[tuple[str, str]] = [("task", ident)]
+    if stats.get("outcome"):
+        rows.append(("outcome", str(stats["outcome"])))
+    if sim:
+        ticks = sim.get("ticks", 0)
+        tick_ms = sim.get("tick_ms", 0.0)
+        rows.append(
+            (
+                "ticks",
+                f"{ticks} ({ticks * tick_ms / 1000.0:.2f} sim-s at "
+                f"{tick_ms:g} ms/tick)",
+            )
+        )
+        rows.append(
+            (
+                "wall",
+                f"{sim.get('wall_secs', 0.0):.2f}s (compile "
+                f"{sim.get('compile_secs', 0.0):.2f}s) on "
+                f"{sim.get('devices', 1)} device(s) / "
+                f"{sim.get('processes', 1)} process(es)",
+            )
+        )
+        if "carry_bytes" in sim:
+            rows.append(
+                (
+                    "carry",
+                    f"{sim['carry_bytes'] / 2**20:.2f} MiB device-resident",
+                )
+            )
+        rows.append(
+            (
+                "messages",
+                "delivered={d} enqueued={e} dropped={x} rejected={r} "
+                "in-flight={f}".format(
+                    d=sim.get("msgs_delivered", 0),
+                    e=sim.get("msgs_enqueued", 0),
+                    x=sim.get("msgs_dropped", 0),
+                    r=sim.get("msgs_rejected", 0),
+                    f=sim.get("msgs_in_flight", 0),
+                ),
+            )
+        )
+        for key, label in (
+            ("latency_clamped", "horizon-clamped"),
+            ("bw_queue_dropped", "bw-queue-dropped"),
+        ):
+            if sim.get(key):
+                rows.append((label, str(sim[key])))
+    if tele:
+        shown = f"{tele.get('rows', 0)} per-tick rows"
+        if tele.get("file"):  # absent when no outputs dir held the series
+            shown += f" ({tele['file']})"
+        rows.append(("telemetry", shown))
+    for gid, counts in sorted(events.items()):
+        if isinstance(counts, dict):
+            shown = ", ".join(
+                f"{k}={v}" for k, v in sorted(counts.items()) if v
+            )
+            rows.append((f"group {gid}", shown or "-"))
+    width = max(len(k) for k, _ in rows)
+    return "\n".join(f"{k:<{width}}  {v}" for k, v in rows)
 
 _CLASS = {
     "error": "ERROR",

@@ -43,6 +43,7 @@ from .api import (
     SyncView,
 )
 from .net import (
+    MSG_BYTES,
     Calendar,
     LinkState,
     apply_net_updates,
@@ -54,14 +55,52 @@ from .sync_kernel import (
     SyncState,
     make_sub_window,
     make_sync_state,
+    sync_occupancy,
     update_sync,
 )
+from .telemetry import TELEMETRY_FIXED_COLUMNS
 
 __all__ = ["MAX_FILTER_CELLS", "SimCarry", "SimProgram", "build_groups"]
 
 # Budget for the dense [R, N] per-region filter table, in int32 cells
 # (2**28 = 1 GiB). See the N_REGIONS guard in SimProgram.__init__.
 MAX_FILTER_CELLS = 2**28
+
+
+# The cumulative flow counters accumulate in two int32 limbs (hi, lo)
+# with a 30-bit spill: a single int32 would wrap after ~2^31 messages —
+# about 21k ticks at the 100k-instance scale this engine targets — and
+# jnp.int64 silently narrows to int32 without the x64 flag. Per-tick
+# deltas are bounded far below 2^30 (≤ 2·OUT_MSGS·N messages), so the
+# limb arithmetic is exact indefinitely.
+_LIMB_BITS = 30
+_LIMB_MASK = (1 << _LIMB_BITS) - 1
+
+
+def _acc_zero() -> jax.Array:
+    return jnp.zeros((2,), jnp.int32)
+
+
+def _acc_add(acc: jax.Array, delta: jax.Array) -> jax.Array:
+    lo = acc[1] + delta
+    return jnp.stack(
+        [acc[0] + jax.lax.shift_right_logical(lo, _LIMB_BITS), lo & _LIMB_MASK]
+    )
+
+
+def _acc_total(acc_host) -> int:
+    return (int(acc_host[0]) << _LIMB_BITS) + int(acc_host[1])
+
+
+def _poll_done(done) -> bool:
+    """The single blocking device→host sync per chunk dispatch. D2H read,
+    not block_until_ready — the latter may return early on remotely-
+    tunneled backends (same workaround as bench.py). The telemetry plane
+    piggybacks on this poll: once the done scalar is host-visible the
+    chunk's counter block is already materialized, so reading it is a
+    copy, not another sync. Tests monkeypatch this function to count
+    syncs per chunk (telemetry on must equal telemetry off)."""
+    return bool(np.asarray(done))
 
 
 @jax.tree_util.register_dataclass
@@ -88,6 +127,21 @@ class SimCarry:
     bw_rate_changed: jax.Array
     collisions: jax.Array  # direct-mode slot collisions (validate runs)
     collision_where: jax.Array  # [2] (dst, slot) of the first collision
+    # --- cumulative message-flow totals (always maintained — a few
+    # scalar adds per tick; the telemetry plane's ground truth, which
+    # the per-tick counter block must sum back to). Each is a [2] int32
+    # (hi, lo) limb pair — see _acc_add — so totals stay exact past
+    # int32 range without jax x64. cal_depth is the in-flight calendar
+    # occupancy, tracked incrementally (enqueued - delivered) instead of
+    # rescanning the O(L·N·SLOTS) planes; a plain int32 suffices — it is
+    # bounded by the calendar's cell count, and a ≥2^31-cell calendar is
+    # unallocatable anyway.
+    msgs_delivered: jax.Array
+    msgs_sent: jax.Array
+    msgs_enqueued: jax.Array
+    msgs_dropped: jax.Array
+    msgs_rejected: jax.Array
+    cal_depth: jax.Array
 
 
 def build_groups(run_groups, parameters_of=None) -> tuple[GroupSpec, ...]:
@@ -120,6 +174,7 @@ class SimProgram:
         chunk: int = 128,
         hosts: tuple[str, ...] = (),
         validate: bool = False,
+        telemetry: bool = False,
     ):
         self.tc = testcase
         self.groups = groups
@@ -139,6 +194,15 @@ class SimProgram:
         self.hosts = tuple(hosts)
         self.n_lanes = self.n + len(self.hosts)
         self.validate = bool(validate)
+        # Per-tick counter block (telemetry plane): when enabled, every
+        # tick emits one K-vector through the scan's ys output and the
+        # chunk returns a [chunk, K] block beside the done flag. A static
+        # compile-time option — off, the block is compiled out entirely
+        # (K = 0 and _chunk_step keeps its two-tuple shape).
+        self.telemetry = bool(telemetry)
+        self._tele_k = (
+            len(TELEMETRY_FIXED_COLUMNS) + len(groups) if telemetry else 0
+        )
         # Static horizon check: the plan's DEFAULT_LINK must be
         # deliverable within the calendar — shaped reconfigurations are
         # runtime data and get the clamp counter instead (NetFeedback).
@@ -384,6 +448,12 @@ class SimProgram:
             bw_rate_changed=jnp.int32(0),
             collisions=jnp.int32(0),
             collision_where=jnp.zeros((2,), jnp.int32),
+            msgs_delivered=_acc_zero(),
+            msgs_sent=_acc_zero(),
+            msgs_enqueued=_acc_zero(),
+            msgs_dropped=_acc_zero(),
+            msgs_rejected=_acc_zero(),
+            cal_depth=jnp.int32(0),
         )
         if self.mesh is not None:
             carry = jax.jit(self._constrain)(carry)
@@ -391,10 +461,16 @@ class SimProgram:
 
     # ---------------------------------------------------------------- tick
 
-    def _tick(self, carry: SimCarry) -> SimCarry:
+    def _tick(self, carry: SimCarry) -> tuple[SimCarry, jax.Array]:
+        """One simulated tick. Returns (carry', telemetry vector) — the
+        vector is the per-tick counter block row ([K] int32, K = 0 when
+        telemetry is compiled out; see telemetry.TELEMETRY_FIXED_COLUMNS
+        for the column schema)."""
         cls = type(self.tc)
         t = carry.t
         cal, inbox_all = deliver(carry.cal, t)
+        # messages popped into inboxes this tick (incl. host echo lanes)
+        delivered_t = jnp.sum(inbox_all.valid.astype(jnp.int32))
         sub_payload, sub_valid = make_sub_window(carry.sync, cls.SUB_K)
         env_keys = jax.vmap(jax.random.fold_in)(
             carry.keys, jnp.broadcast_to(t, (self.n,))
@@ -651,7 +727,16 @@ class SimProgram:
             fb.collision_where,
             carry.collision_where,
         )
-        return self._constrain(
+
+        # --- message-flow accounting: conservation closes per tick —
+        # sent (incl. duplicate copies) = enqueued + rejected + dropped,
+        # so every shaped loss (loss%, DROP filters, bandwidth, slot
+        # overflow, bad dst) lands in exactly one counter.
+        rejected_t = jnp.sum(fb.rejected)
+        dropped_t = fb.sent - fb.enqueued - rejected_t
+        cal_depth = carry.cal_depth + fb.enqueued - delivered_t
+
+        new_carry = self._constrain(
             SimCarry(
                 states=new_states,
                 status=status,
@@ -668,8 +753,48 @@ class SimProgram:
                 bw_rate_changed=bw_rate_changed,
                 collisions=carry.collisions + fb.collisions,
                 collision_where=collision_where,
+                msgs_delivered=_acc_add(carry.msgs_delivered, delivered_t),
+                msgs_sent=_acc_add(carry.msgs_sent, fb.sent),
+                msgs_enqueued=_acc_add(carry.msgs_enqueued, fb.enqueued),
+                msgs_dropped=_acc_add(carry.msgs_dropped, dropped_t),
+                msgs_rejected=_acc_add(carry.msgs_rejected, rejected_t),
+                cal_depth=cal_depth,
             )
         )
+        if not self.telemetry:
+            return new_carry, jnp.zeros((0,), jnp.int32)
+        # per-tick counter block row (TELEMETRY_FIXED_COLUMNS order, then
+        # one live-instance count per group) — all scalar reductions over
+        # arrays the tick already materialized, so the block costs no
+        # extra memory traffic of the calendar's order
+        sig_occ, pub_occ = sync_occupancy(sync)
+        live = [
+            jnp.sum(
+                (status[g.offset : g.offset + g.count] == RUNNING).astype(
+                    jnp.int32
+                )
+            )
+            for g in self.groups
+        ]
+        tele = jnp.stack(
+            [
+                t,
+                delivered_t,
+                fb.sent,
+                fb.enqueued,
+                dropped_t,
+                rejected_t,
+                # int multiply: exact over the full int32 range (the
+                # float32 detour would round above 2^24 bytes/tick); the
+                # column wraps only past 2^31/MSG_BYTES ≈ 8.4M msgs/tick
+                fb.enqueued * jnp.int32(MSG_BYTES),
+                cal_depth,
+                sig_occ,
+                pub_occ,
+                *live,
+            ]
+        ).astype(jnp.int32)
+        return new_carry, tele
 
     # ------------------------------------------------------------- sizing
 
@@ -690,21 +815,45 @@ class SimProgram:
     # ----------------------------------------------------------- execution
 
     def _chunk_step(self, carry: SimCarry):
-        """Run up to `chunk` ticks; ticks after global completion no-op."""
+        """Run up to `chunk` ticks; ticks after global completion no-op.
+
+        Returns ``(carry, done)`` — or ``(carry, done, tele_block)`` with
+        a ``[chunk, K]`` per-tick counter block when the program was built
+        with ``telemetry=True`` (post-completion padding rows carry tick
+        = -1; the host decoder drops them). The block rides the scan's
+        stacked ys, so it reaches the host in the same dispatch result as
+        the done flag — no extra device round-trip."""
+        k = self._tele_k
 
         def body(c, _):
             # host lanes never terminate — only plan instances gate done
             done = jnp.all(c.status[: self.n] != RUNNING)
-            c = jax.lax.cond(done, lambda x: x, self._tick, c)
-            return c, None
+            c, tele = jax.lax.cond(
+                done,
+                lambda x: (x, jnp.full((k,), -1, jnp.int32)),
+                self._tick,
+                c,
+            )
+            return c, tele
 
-        carry, _ = jax.lax.scan(body, carry, None, length=self.chunk)
-        return carry, jnp.all(carry.status[: self.n] != RUNNING)
+        carry, tele = jax.lax.scan(body, carry, None, length=self.chunk)
+        done = jnp.all(carry.status[: self.n] != RUNNING)
+        if not self.telemetry:
+            return carry, done
+        return carry, done, tele
 
     def compiled_chunk(self):
         if self._chunk_fn is None:
             self._chunk_fn = jax.jit(self._chunk_step, donate_argnums=0)
         return self._chunk_fn
+
+    def telemetry_schema(self) -> tuple[str, ...]:
+        """Column names of the per-tick counter block, in device order:
+        the fixed flow/occupancy counters, then one ``live_<group id>``
+        column per group."""
+        return TELEMETRY_FIXED_COLUMNS + tuple(
+            f"live_{g.id}" for g in self.groups
+        )
 
     def run(
         self,
@@ -713,6 +862,7 @@ class SimProgram:
         cancel=None,
         on_chunk: Callable[[int], None] | None = None,
         observer: Callable[[int, "SimCarry"], None] | None = None,
+        telemetry_cb: Callable[[np.ndarray], None] | None = None,
     ) -> dict[str, Any]:
         """Step to completion. Returns host-side results:
 
@@ -723,6 +873,12 @@ class SimProgram:
         device carry — the periodic metrics-sampling hook (reading the carry
         forces a device sync, so observers should sample on a cadence, not
         every call).
+
+        ``telemetry_cb(block)`` receives each chunk's ``[chunk, K]``
+        per-tick counter block as host numpy (programs built with
+        ``telemetry=True`` only). The read piggybacks on the done-flag
+        poll: by the time the done scalar is host-visible the block is
+        materialized, so this is a copy, not an extra blocking sync.
         """
         import time as _time
 
@@ -734,8 +890,13 @@ class SimProgram:
         ticks = 0
         compile_secs = 0.0
         while ticks < max_ticks:
-            carry, done = fn(carry)
+            out = fn(carry)
+            carry, done = out[0], out[1]
             ticks += self.chunk
+            # THE one blocking device→host sync per chunk (tests count
+            # _poll_done calls to pin the telemetry plane's zero-extra-
+            # syncs contract).
+            done_host = _poll_done(done)
             if compile_secs == 0.0:
                 # init + first chunk = trace/lower + XLA compile (or a
                 # persistent-cache read — see utils/compile_cache) + one
@@ -745,16 +906,15 @@ class SimProgram:
                 # unconstrained per-group state leaves GSPMD shardings, so
                 # the chunk retraces at that fixed point (stable from then
                 # on — verified). That cost lands in run wall; the
-                # sim:plan precompile warms BOTH variants. D2H read, not
-                # block_until_ready — the latter may return early on
-                # remotely-tunneled backends (same workaround as bench.py)
-                np.asarray(done)
+                # sim:plan precompile warms BOTH variants.
                 compile_secs = _time.perf_counter() - t0
+            if self.telemetry and telemetry_cb is not None:
+                telemetry_cb(np.asarray(out[2]))
             if on_chunk is not None:
                 on_chunk(ticks)
             if observer is not None:
                 observer(ticks, carry)
-            if bool(done):  # one scalar device→host sync per chunk
+            if done_host:
                 break
             if cancel is not None and cancel.is_set():
                 break
@@ -782,5 +942,17 @@ class SimProgram:
             "bw_rate_change_backlogged": int(to_host(carry.bw_rate_changed)),
             "collisions": int(to_host(carry.collisions)),
             "collision_where": to_host(carry.collision_where).tolist(),
+            # cumulative message-flow totals — the per-tick telemetry
+            # rows must sum exactly to these (conservation: sent =
+            # enqueued + dropped + rejected; cal_depth = in-flight)
+            "msgs_delivered": _acc_total(to_host(carry.msgs_delivered)),
+            "msgs_sent": _acc_total(to_host(carry.msgs_sent)),
+            "msgs_enqueued": _acc_total(to_host(carry.msgs_enqueued)),
+            "msgs_dropped": _acc_total(to_host(carry.msgs_dropped)),
+            "msgs_rejected": _acc_total(to_host(carry.msgs_rejected)),
+            "cal_depth": int(to_host(carry.cal_depth)),
+            # device-resident carry footprint (eval_shape — no compile):
+            # always reported so memory is part of every run's record
+            "carry_bytes": self.estimate_carry_bytes(),
             "groups": self.groups,
         }

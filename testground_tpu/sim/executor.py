@@ -54,6 +54,17 @@ class SimJaxConfig:
     # instead of silently corrupting inbox slots (costs a per-tick sort +
     # gather, so off by default)
     validate: bool = False
+    # telemetry plane (docs/OBSERVABILITY.md): compile a per-tick counter
+    # block into the jitted tick and flush it once per chunk dispatch
+    # into the run's sim_timeseries.jsonl — message flow, calendar depth,
+    # sync occupancy, live instances per group. Piggybacks on the done-
+    # flag poll (zero extra host syncs); off by default because a
+    # 100k-tick run writes 100k jsonl rows
+    telemetry: bool = False
+    # opt-in jax.profiler trace for the whole run — the global switch
+    # beside the per-group composition flag (Group.profiles); writes the
+    # XLA op + host timeline under <run outputs>/profiles
+    profile: bool = False
     # whitelisted control-route service hosts (echo lanes past the instance
     # axis) — the ADDITIONAL_HOSTS analog (``local_docker.go:78``); plans
     # address them via ``env.host_index(name)``
@@ -146,6 +157,7 @@ def make_sim_program(
     chunk,
     hosts,
     validate,
+    telemetry,
 ):
     """The ONE construction site for a run's SimProgram. Every
     program-shaping option is a REQUIRED keyword: adding one here forces
@@ -164,6 +176,7 @@ def make_sim_program(
         chunk=chunk,
         hosts=hosts,
         validate=validate,
+        telemetry=telemetry,
     )
 
 
@@ -238,8 +251,6 @@ def _precheck_device_memory(prog, cfg, mesh, ow) -> None:
 def execute_sim_run(
     job: RunInput, ow: OutputWriter, cancel: threading.Event
 ) -> RunOutput:
-    from testground_tpu.utils.compile_cache import enable_compile_cache
-
     cfg = job.runner_config or SimJaxConfig()
     # Multi-host: the engine NEVER joins the cohort in-process — a member
     # death LOG(FATAL)s every joined process once the coordination
@@ -254,6 +265,49 @@ def execute_sim_run(
         from .cohort import run_in_cohort_child
 
         return run_in_cohort_child(job, cfg, ow, cancel)
+
+    outputs_root = job.env.dirs.outputs() if job.env is not None else None
+    run_dir = None
+    if outputs_root is not None:
+        run_dir = os.path.join(outputs_root, job.test_plan, job.run_id)
+        os.makedirs(run_dir, exist_ok=True)
+    # run-span tracing: structured host-side phase events (run → build →
+    # compile → chunk[i] → collect) as sdk/events.py-style JSON lines in
+    # the run's outputs dir — see docs/OBSERVABILITY.md
+    from .telemetry import SPAN_FILE, SpanTracer
+
+    spans = SpanTracer(
+        os.path.join(run_dir, SPAN_FILE)
+        if run_dir is not None and not job.disable_metrics
+        else None
+    )
+    spans.start(
+        "run", run_id=job.run_id, plan=job.test_plan, case=job.test_case
+    )
+    try:
+        return _execute_sim_run(
+            job, cfg, ow, cancel, outputs_root, run_dir, spans
+        )
+    except BaseException as e:
+        # failed runs keep their span record — those are exactly the
+        # ones an operator wants to inspect
+        spans.end("run", outcome="error", error=str(e)[:200])
+        raise
+    finally:
+        spans.close()
+
+
+def _execute_sim_run(
+    job: RunInput,
+    cfg,
+    ow: OutputWriter,
+    cancel: threading.Event,
+    outputs_root,
+    run_dir,
+    spans,
+) -> RunOutput:
+    from testground_tpu.utils.compile_cache import enable_compile_cache
+
     # the compiled XLA program is this framework's build artifact: route
     # compilation through the persistent cache so a precompiled build
     # (sim:plan) or any prior run of the same program skips XLA compile
@@ -283,13 +337,38 @@ def execute_sim_run(
                 "the wrong topology"
             )
 
+    from .telemetry import SIM_SERIES_FILE
+
     artifact = job.groups[0].artifact_path
+    spans.start("build")
     # per-run static narrowing from resolved params (SimTestcase.specialize)
     testcase, groups = load_and_specialize(
         artifact, job.test_case, job.groups, cfg.tick_ms
     )
     n = sum(g.count for g in groups)
     hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
+
+    # telemetry plane: the per-tick counter block is a PROGRAM-shaping
+    # option (it changes the traced chunk), so it must be decided before
+    # construction and broadcast to cohort followers. The composition's
+    # disable_metrics opt-out (the TEST_DISABLE_METRICS analog) wins over
+    # the runner config — same rule as spans and timeseries sampling.
+    # Disabled for ANY cohort config (coordinator_address set, even
+    # degenerate single-process ones): a leader-local per-chunk read of
+    # the block is not symmetric across processes, and the gate must be
+    # decidable STATICALLY so the sim:plan precompile warms the same
+    # program variant the run traces (sim_plan.py mirrors this rule).
+    telemetry_on = (
+        bool(getattr(cfg, "telemetry", False)) and not job.disable_metrics
+    )
+    if telemetry_on and getattr(cfg, "coordinator_address", ""):
+        ow.warn(
+            "sim:jax %s: telemetry disabled for the cohort config "
+            "(per-chunk leader-local device reads are not symmetric "
+            "across processes)",
+            job.run_id,
+        )
+        telemetry_on = False
 
     # ------------------------------------------------- multi-host cohort
     if multi:
@@ -328,9 +407,10 @@ def execute_sim_run(
                 "max_ticks": cfg.max_ticks,
                 "hosts": list(hosts),
                 # every program-shaping option must reach the followers —
-                # a validate mismatch would trace different programs and
-                # desync the cohort inside a collective
+                # a validate/telemetry mismatch would trace different
+                # programs and desync the cohort inside a collective
                 "validate": bool(getattr(cfg, "validate", False)),
+                "telemetry": telemetry_on,
             }
         )
         # readiness vote: a worker whose plans dir cannot satisfy the job
@@ -369,13 +449,28 @@ def execute_sim_run(
         chunk=cfg.chunk,
         hosts=hosts,
         validate=bool(getattr(cfg, "validate", False)),
+        telemetry=telemetry_on,
     )
     _precheck_device_memory(prog, cfg, mesh, ow)
+    # the device-resident carry footprint is ALWAYS part of the run
+    # record (log + journal + results), not just of the capacity check
+    carry_bytes = prog.estimate_carry_bytes()
+    ow.infof(
+        "sim:jax %s: device carry footprint %.2f MiB (%d bytes, "
+        "eval_shape-exact)",
+        job.run_id,
+        carry_bytes / 2**20,
+        carry_bytes,
+    )
+    spans.end("build", carry_bytes=carry_bytes, instances=n)
 
     t0 = time.time()
     last_report = [t0]
 
     def on_chunk(ticks: int) -> None:
+        spans.point(
+            "chunk", ticks=ticks, wall_secs=round(time.time() - t0, 6)
+        )
         now = time.time()
         if now - last_report[0] >= 5.0:
             last_report[0] = now
@@ -386,8 +481,6 @@ def execute_sim_run(
                 ticks * cfg.tick_ms / 1000.0,
                 now - t0,
             )
-
-    outputs_root = job.env.dirs.outputs() if job.env is not None else None
     # no outputs dir → nowhere to persist samples; disable_metrics is the
     # composition's opt-out (the TEST_DISABLE_METRICS analog) — either way
     # the hot loop must not pay the per-sample device→host sync. Multi-host
@@ -402,15 +495,37 @@ def execute_sim_run(
         getattr(cfg, "timeseries_every", 0) if ts_enabled else 0,
         ow,
     )
+    # Per-tick telemetry sink: blocks arrive once per chunk from the
+    # jitted program (engine telemetry_cb) and stream straight to the
+    # run's series file — memory stays bounded by one chunk and a
+    # crashed run keeps everything flushed so far.
+    row_ident = {
+        "run": job.run_id,
+        "plan": job.test_plan,
+        "case": job.test_case,
+    }
+    tele_writer = (
+        _SimTelemetryWriter(
+            tuple(g.id for g in groups),
+            row_ident,
+            os.path.join(run_dir, SIM_SERIES_FILE)
+            if run_dir is not None
+            else None,
+        )
+        if telemetry_on
+        else None
+    )
     # Profile capture — the pprof analog (``pkg/api/composition.go:153-162``
-    # → TestCaptureProfiles): any group requesting profiles makes the run
-    # record a jax.profiler trace (XLA ops + host timeline, viewable in
+    # → TestCaptureProfiles): any group requesting profiles — or the
+    # runner-config ``profile`` flag — makes the run record a
+    # jax.profiler trace (XLA ops + host timeline, viewable in
     # TensorBoard/Perfetto) into the run's outputs dir.
     profile_dir = None
-    if outputs_root is not None and any(g.profiles for g in job.groups):
-        profile_dir = os.path.join(
-            outputs_root, job.test_plan, job.run_id, "profiles"
-        )
+    if run_dir is not None and (
+        any(g.profiles for g in job.groups)
+        or bool(getattr(cfg, "profile", False))
+    ):
+        profile_dir = os.path.join(run_dir, "profiles")
         os.makedirs(profile_dir, exist_ok=True)
         ow.infof("capturing jax.profiler trace to %s", profile_dir)
 
@@ -431,8 +546,10 @@ def execute_sim_run(
             cancel=run_cancel,
             on_chunk=on_chunk,
             observer=recorder.observe if recorder.enabled else None,
+            telemetry_cb=tele_writer.on_block if tele_writer else None,
         )
 
+    spans.start("execute")
     if profile_dir is not None:
         import jax
 
@@ -441,6 +558,8 @@ def execute_sim_run(
     else:
         res = _run()
     wall = time.time() - t0
+    spans.point("compile", wall_secs=round(res.get("compile_secs", 0.0), 6))
+    spans.end("execute", ticks=res["ticks"])
     status = res["status"]
     ow.infof(
         "sim:jax %s: done — %d ticks in %.2fs wall (%.0f instance·ticks/s)",
@@ -488,6 +607,7 @@ def execute_sim_run(
         )
 
     # ------------------------------------------------ outcomes + outputs
+    spans.start("collect")
     result = Result.for_input(job)
     result.journal["events"] = {}
     write_outputs = (
@@ -527,24 +647,41 @@ def execute_sim_run(
             gid: _aggregate_metrics(m) for gid, m in metrics.items()
         }
 
+    # ------------------------------------------- sim telemetry time series
+    # per-tick counter rows were streamed chunk-wise into
+    # sim_timeseries.jsonl during the run; totals in the journal must
+    # equal the rows' sums (conservation — asserted by tests/the smoke
+    # target)
+    if tele_writer is not None:
+        tele_writer.close()
+        result.journal["telemetry"] = {
+            "rows": tele_writer.rows_written,
+            # only claim the series file when one was actually written
+            # (no outputs dir → rows were only counted)
+            **(
+                {"file": SIM_SERIES_FILE}
+                if tele_writer.path is not None
+                else {}
+            ),
+            "totals": {
+                "delivered": res["msgs_delivered"],
+                "sent": res["msgs_sent"],
+                "enqueued": res["msgs_enqueued"],
+                "dropped": res["msgs_dropped"],
+                "rejected": res["msgs_rejected"],
+                "in_flight": res["cal_depth"],
+            },
+        }
+
     # ------------------------------------------------ metric time series
     # final sample at the last tick, then persist the run's series — written
     # even above write_outputs_max (per-group reductions stay small)
     if recorder.enabled:
         recorder.sample(res["ticks"], res["states"], status)
-    if outputs_root is not None and recorder.rows:
-        run_dir = os.path.join(outputs_root, job.test_plan, job.run_id)
-        os.makedirs(run_dir, exist_ok=True)
+    full_rows: list[dict] = []
+    if run_dir is not None and recorder.rows:
         ts_path = os.path.join(run_dir, "timeseries.jsonl")
-        full_rows = [
-            {
-                "run": job.run_id,
-                "plan": job.test_plan,
-                "case": job.test_case,
-                **row,
-            }
-            for row in recorder.rows
-        ]
+        full_rows = [{**row_ident, **row} for row in recorder.rows]
         with open(ts_path, "w") as f:
             for row in full_rows:
                 f.write(json.dumps(row) + "\n")
@@ -552,19 +689,36 @@ def execute_sim_run(
             "samples": len(recorder.rows),
             "every_ticks": recorder.every,
         }
-        # optional InfluxDB mirror of the same rows (the reference batches
-        # SDK metrics into InfluxDB, ``local_docker.go:353``); best-effort
-        influx_endpoint = (
-            job.env.daemon.influxdb_endpoint if job.env is not None else ""
-        )
-        if influx_endpoint:
-            from testground_tpu.metrics.influx import push_rows
+    # optional InfluxDB mirror (the reference batches SDK metrics into
+    # InfluxDB, ``local_docker.go:353``); best-effort. Both families go:
+    # the plan-metric rows verbatim, and the sim telemetry rows expanded
+    # to the same viewer shape (measurement sim.<counter> — exactly what
+    # the dashboard renders, so Grafana sees the same series)
+    influx_endpoint = (
+        job.env.daemon.influxdb_endpoint if job.env is not None else ""
+    )
+    # base_ns = run start, NOT push time: stable per run, so re-pushes
+    # are idempotent and batches never collide
+    base_ns = int(t0 * 1e9)
+    if influx_endpoint and full_rows:
+        from testground_tpu.metrics.influx import push_rows
 
-            # base_ns = run start, NOT push time: stable per run, so
-            # re-pushes are idempotent and batches never collide
-            result.journal["influx"] = push_rows(
-                influx_endpoint, full_rows, base_ns=int(t0 * 1e9)
-            )
+        result.journal["influx"] = push_rows(
+            influx_endpoint, full_rows, base_ns=base_ns
+        )
+    has_tele_series = (
+        tele_writer is not None
+        and tele_writer.path is not None
+        and tele_writer.rows_written > 0
+    )
+    if influx_endpoint and has_tele_series:
+        # the sim.* family goes in its OWN bounded batches: a long run's
+        # per-tick series can exceed InfluxDB's request-size limit, and
+        # one oversized POST must not also lose the small plan-metric
+        # batch above
+        result.journal["influx_telemetry"] = _push_sim_series(
+            influx_endpoint, tele_writer.iter_rows(), base_ns
+        )
 
     for gi, g in enumerate(groups):
         st = status[g.offset : g.offset + g.count]
@@ -602,10 +756,22 @@ def execute_sim_run(
         "latency_clamped": res.get("latency_clamped", 0),
         "bw_queue_dropped": res.get("bw_queue_dropped", 0),
         "bw_rate_change_backlogged": res.get("bw_rate_change_backlogged", 0),
+        # always-on observability floor (telemetry plane totals + memory
+        # footprint): every run reports these whether or not the per-tick
+        # block was compiled in — the contract perf PRs report against
+        "msgs_delivered": res.get("msgs_delivered", 0),
+        "msgs_sent": res.get("msgs_sent", 0),
+        "msgs_enqueued": res.get("msgs_enqueued", 0),
+        "msgs_dropped": res.get("msgs_dropped", 0),
+        "msgs_rejected": res.get("msgs_rejected", 0),
+        "msgs_in_flight": res.get("cal_depth", 0),
+        "carry_bytes": res.get("carry_bytes", carry_bytes),
     }
     result.update_outcome()
     if cancel.is_set():
         result.outcome = Outcome.CANCELED
+    spans.end("collect")
+    spans.end("run", outcome=result.outcome.value, ticks=res["ticks"])
     return RunOutput(run_id=job.run_id, result=result)
 
 
@@ -687,6 +853,7 @@ def sim_worker_loop(
             chunk=spec["chunk"],
             hosts=tuple(spec.get("hosts", ())),
             validate=bool(spec.get("validate", False)),
+            telemetry=bool(spec.get("telemetry", False)),
         )
         res = prog.run(
             seed=spec["seed"],
@@ -703,6 +870,114 @@ def _tree_slice(state_group):
     """Per-group states are already host numpy pytrees; identity hook kept
     for future lazy device slicing."""
     return state_group
+
+
+# Influx lines per POST for the sim telemetry family — far under
+# InfluxDB's default 25 MB body cap (a line is ~100 bytes) while still
+# amortizing the HTTP round trip.
+_INFLUX_BATCH_LINES = 5000
+
+
+def _push_sim_series(endpoint: str, rows_iter, base_ns: int) -> dict:
+    """Expand streamed sim telemetry rows to viewer shape and push them
+    to Influx in bounded batches. Returns one merged journal dict
+    ({pushed, ok, batches, error?}) — a failed batch marks ok=False and
+    keeps going (best-effort, like every other push)."""
+    from testground_tpu.metrics.influx import push_rows
+    from testground_tpu.metrics.viewer import expand_sim_row
+
+    journal: dict = {"pushed": 0, "ok": True, "batches": 0}
+
+    def push(batch: list) -> None:
+        j = push_rows(endpoint, batch, base_ns=base_ns)
+        journal["pushed"] += j.get("pushed", 0)
+        journal["batches"] += 1
+        if not j.get("ok"):
+            journal["ok"] = False
+            journal.setdefault("error", j.get("error", "push failed"))
+
+    batch: list = []
+    for row in rows_iter:
+        batch.extend(expand_sim_row(row))
+        if len(batch) >= _INFLUX_BATCH_LINES:
+            push(batch)
+            batch = []
+    if batch:
+        push(batch)
+    return journal
+
+
+class _SimTelemetryWriter:
+    """Streams the chunk-flushed ``[chunk, K]`` telemetry blocks to the
+    run's series file as they arrive: each block decodes to at most
+    ``chunk`` jsonl rows and is written immediately, so host memory
+    stays bounded by one chunk regardless of run length and a crashed
+    run keeps every row flushed so far. The per-chunk cost is a few
+    hundred dict builds + a buffered write — microseconds against a
+    multi-ms device dispatch. With no outputs dir (``path=None``) the
+    writer only counts rows (and nothing downstream needs them: the
+    Influx mirror requires an env, which also provides the dir)."""
+
+    def __init__(self, group_ids: tuple, ident: dict, path: str | None):
+        self.group_ids = group_ids
+        self.ident = ident
+        self.path = path
+        self.rows_written = 0
+        self._f = None
+        if path is not None:
+            try:
+                self._f = open(path, "w")
+            except OSError:
+                self.path = None  # observe best-effort, never fail the run
+
+    def on_block(self, block) -> None:
+        from .telemetry import rows_from_blocks
+
+        rows = rows_from_blocks([block], self.group_ids)
+        self.rows_written += len(rows)
+        if self._f is not None:
+            # observability must never fail the run it observes (the
+            # SpanTracer rule): on ENOSPC etc., drop the file and keep
+            # counting — the journal then reports rows without a file
+            try:
+                for row in rows:
+                    self._f.write(json.dumps({**self.ident, **row}) + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+                self.path = None
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                self.path = None
+            finally:
+                self._f = None
+
+    def iter_rows(self):
+        """Re-read the written series (for the Influx mirror) — the
+        rows were streamed out, not retained. Unparseable lines are
+        skipped (best-effort, like the push itself)."""
+        if self.path is None:
+            return
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            return
 
 
 class _TimeSeriesRecorder:

@@ -164,6 +164,13 @@ class NetFeedback:
                ``validate=True``)
     collision_where: [2] int32 — (dst, slot) of the first collision this
                tick (undefined when collisions == 0)
+    sent:      int32 scalar — messages entering the transport this tick:
+               valid outbox entries plus duplicate-shaping copies, so the
+               flow conservation sent = enqueued + rejected + dropped
+               closes per tick (the telemetry plane's invariant)
+    enqueued:  int32 scalar — messages actually scattered into the
+               calendar this tick (survivors of filters, loss, bandwidth,
+               horizon/slot bounds)
     """
 
     rejected: jax.Array
@@ -172,6 +179,8 @@ class NetFeedback:
     backlog: jax.Array | None
     collisions: jax.Array
     collision_where: jax.Array
+    sent: jax.Array
+    enqueued: jax.Array
 
 
 @jax.tree_util.register_dataclass
@@ -412,6 +421,10 @@ def enqueue(
     pay_w = [payload[:, w, :].reshape(-1) for w in range(width)]  # W× [M]
     val_f = valid.reshape(-1)
     m = val_f.shape[0]
+    # telemetry: messages entering the transport (before any shaping or
+    # bounds masking — out-of-range dsts count as sent-then-dropped);
+    # duplicate-shaping copies are added below so conservation closes
+    sent = jnp.sum(val_f.astype(jnp.int32))
 
     def eg(plane):
         # per-message egress attribute: src_f == midx % n, so the gather
@@ -714,6 +727,8 @@ def enqueue(
                 backlog=new_backlog,
                 collisions=collisions,
                 collision_where=collision_where,
+                sent=sent,
+                enqueued=jnp.sum(val_f.astype(jnp.int32)),
             ),
         )
 
@@ -722,6 +737,7 @@ def enqueue(
         dup = val_f & (u("duplicate") * 100.0 < eg(DUPLICATE))
         if is_ctrl is not None:
             dup = dup & ~is_ctrl
+        sent = sent + jnp.sum(dup.astype(jnp.int32))
         dst2 = jnp.concatenate([dst_safe, dst_safe])
         pay2 = [jnp.concatenate([p, p]) for p in pay_w]
         src2 = jnp.concatenate([src_f, src_f])
@@ -828,6 +844,8 @@ def enqueue(
             backlog=new_backlog,
             collisions=jnp.int32(0),
             collision_where=jnp.zeros((2,), jnp.int32),
+            sent=sent,
+            enqueued=jnp.sum(val_s.astype(jnp.int32)),
         ),
     )
 

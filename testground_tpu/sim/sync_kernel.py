@@ -27,7 +27,13 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SyncState", "make_sync_state", "update_sync", "make_sub_window"]
+__all__ = [
+    "SyncState",
+    "make_sync_state",
+    "make_sub_window",
+    "sync_occupancy",
+    "update_sync",
+]
 
 
 @jax.tree_util.register_dataclass
@@ -130,6 +136,15 @@ def update_sync(
         cursors=cursors,
         dropped=dropped,
     )
+
+
+def sync_occupancy(sync: SyncState) -> tuple[jax.Array, jax.Array]:
+    """Scalar occupancy of the sync service for the telemetry plane:
+    (Σ state counters — total signals ever fired, i.e. barrier
+    occupancy; Σ stored topic-stream entries — publish occupancy).
+    Two tiny reductions over [S] / [T] vectors, safe to take every tick
+    inside the jitted loop."""
+    return jnp.sum(sync.counts), jnp.sum(sync.stream_len)
 
 
 def make_sub_window(

@@ -1,0 +1,170 @@
+"""Sim telemetry plane: host-side half of the per-tick counter block.
+
+The device-side half lives in the jitted tick (``sim/engine.py``): every
+tick appends one fixed-shape int32 counter vector to the chunk's scan
+output, so a CHUNK-tick dispatch returns a ``[chunk, K]`` block alongside
+the carry and the ``done`` flag. The host flushes that block once per
+chunk, piggybacking on the done-flag poll it already performs — the chunk
+result is materialized by the time the done scalar is host-visible, so
+reading the block is a device→host copy, **not** an additional blocking
+sync (the ``engine._poll_done`` contract; tests count its calls).
+
+This module owns everything about the block the host needs to agree on
+with the device: the column schema, the row decoding, and the run-span
+tracer that wraps the host-side phases (run → build → compile → chunk[i]
+→ collect) in ``sdk/events.py``-style JSON lines.
+
+Reference lineage: the counter rows are the sim analog of the runtime
+metric batches the reference ships to InfluxDB (``pkg/metrics/viewer.go``
+measurement tables); the span lines are the task-timeline events the
+reference scatters across daemon logs, made structured.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Iterable
+
+__all__ = [
+    "SIM_SERIES_FILE",
+    "SPAN_FILE",
+    "TELEMETRY_FIXED_COLUMNS",
+    "SpanTracer",
+    "rows_from_blocks",
+    "telemetry_totals",
+]
+
+# Per-run output file names (under <outputs>/<plan>/<run_id>/).
+SIM_SERIES_FILE = "sim_timeseries.jsonl"
+SPAN_FILE = "run_spans.jsonl"
+
+# Fixed leading columns of the device-side counter vector, in order.
+# Columns after these are one live-instance count per group (schema key
+# ``live`` in the decoded row, a {group_id: count} map). A padding row
+# (ticks scanned after global completion) carries tick = -1 and is
+# dropped by the decoder.
+#
+#   tick            the tick this row describes (scan-local, absolute)
+#   delivered       messages popped from the calendar into inboxes
+#   sent            outbox messages entering the transport (duplicate-
+#                   shaping copies count: conservation must close)
+#   enqueued        messages actually scattered into the calendar
+#   dropped         sent - enqueued - rejected (loss, DROP filters,
+#                   bandwidth, inbox-slot overflow, bad dst)
+#   rejected        messages suppressed by REJECT filters (fed back to
+#                   senders next tick)
+#   bytes_enqueued  enqueued × MSG_BYTES — the bandwidth-accounting wire
+#                   bytes admitted onto links this tick
+#   cal_depth       in-flight messages in the calendar AFTER this tick
+#                   (cumulative enqueued - delivered; no O(L·N) rescan)
+#   sync_signals    Σ of all sync state counters (barrier occupancy)
+#   sync_pubs       Σ of stored topic-stream entries (publish occupancy)
+TELEMETRY_FIXED_COLUMNS = (
+    "tick",
+    "delivered",
+    "sent",
+    "enqueued",
+    "dropped",
+    "rejected",
+    "bytes_enqueued",
+    "cal_depth",
+    "sync_signals",
+    "sync_pubs",
+)
+
+
+def rows_from_blocks(blocks: Iterable, group_ids: tuple) -> list[dict]:
+    """Decode flushed ``[chunk, K]`` counter blocks into jsonl-ready row
+    dicts (fixed columns flat, per-group live counts nested under
+    ``live``). Padding rows (tick < 0) are dropped."""
+    nfix = len(TELEMETRY_FIXED_COLUMNS)
+    rows: list[dict] = []
+    for block in blocks:
+        for vec in block:
+            tick = int(vec[0])
+            if tick < 0:  # post-completion padding inside the chunk
+                continue
+            row: dict[str, Any] = {
+                name: int(vec[i])
+                for i, name in enumerate(TELEMETRY_FIXED_COLUMNS)
+            }
+            row["live"] = {
+                gid: int(vec[nfix + gi]) for gi, gid in enumerate(group_ids)
+            }
+            rows.append(row)
+    return rows
+
+
+def telemetry_totals(rows: list[dict]) -> dict[str, int]:
+    """Sum the per-tick flow counters — what must equal the run's final
+    ``results()`` cumulative totals (the acceptance invariant the smoke
+    target and tests check)."""
+    return {
+        k: sum(int(r.get(k, 0)) for r in rows)
+        for k in ("delivered", "sent", "enqueued", "dropped", "rejected")
+    }
+
+
+class SpanTracer:
+    """Structured run-span events as ``sdk/events.py``-style JSON lines.
+
+    Every line is ``{"ts": <ns>, "event": {"type": ..., "span": ...}}``
+    so ``sdk.events.parse_event_line`` reads them back. Types:
+
+    - ``span_start`` / ``span_end`` — a named phase; ``span_end`` carries
+      ``wall_secs`` plus any attrs given at close (e.g. the build span
+      ends with ``carry_bytes``)
+    - ``point`` — an instant event (per-chunk progress, compile timing)
+
+    A ``SpanTracer(None)`` is a no-op sink so call sites need no
+    conditionals; failures are swallowed (observability must never fail
+    the run it observes).
+    """
+
+    def __init__(self, path: str | None):
+        self._path = path
+        self._f = None
+        self._open: dict[str, float] = {}
+        if path is not None:
+            try:
+                self._f = open(path, "a", encoding="utf-8")
+            except OSError:
+                self._f = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._f is not None
+
+    def _emit(self, event: dict) -> None:
+        if self._f is None:
+            return
+        try:
+            self._f.write(
+                json.dumps({"ts": time.time_ns(), "event": event}) + "\n"
+            )
+            self._f.flush()
+        except (OSError, ValueError):
+            pass
+
+    def start(self, span: str, **attrs) -> None:
+        self._open[span] = time.perf_counter()
+        self._emit({"type": "span_start", "span": span, **attrs})
+
+    def end(self, span: str, **attrs) -> None:
+        t0 = self._open.pop(span, None)
+        if t0 is not None:
+            attrs.setdefault(
+                "wall_secs", round(time.perf_counter() - t0, 6)
+            )
+        self._emit({"type": "span_end", "span": span, **attrs})
+
+    def point(self, name: str, **attrs) -> None:
+        self._emit({"type": "point", "span": name, **attrs})
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None

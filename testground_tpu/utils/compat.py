@@ -1,0 +1,16 @@
+"""Version compatibility shims — ONE home for stdlib fallbacks.
+
+``tomllib`` entered the stdlib in Python 3.11; on 3.10 the identical
+API ships as the third-party ``tomli`` (declared as a conditional
+dependency in pyproject). Import it from here so the fallback logic
+lives in exactly one place:
+
+    from testground_tpu.utils.compat import tomllib
+"""
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib  # type: ignore[no-redef]
+
+__all__ = ["tomllib"]

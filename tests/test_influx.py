@@ -65,6 +65,27 @@ class TestLineProtocol:
             == []
         )
 
+    def test_non_finite_drops_are_collected(self):
+        """Dropping a NaN/Inf field must not be silent: the collector
+        names the lost <measurement>.<field>, while plain non-field
+        values (strings) stay uncounted — they were never metrics."""
+        dropped = []
+        rows_to_lines(
+            [
+                {
+                    "plan": "p",
+                    "case": "c",
+                    "name": "m",
+                    "tick": 0,
+                    "ratio": float("inf"),
+                    "note": "not-a-field",
+                    "count": 3,
+                }
+            ],
+            dropped=dropped,
+        )
+        assert dropped == ["results.p-c.m.ratio"]
+
     def test_non_finite_fields_are_dropped(self):
         """inf/nan are invalid line protocol; a single bad field must not
         poison the batch (the POST carries every line of the run)."""
@@ -143,6 +164,25 @@ class TestPush:
         assert push_rows(endpoint, []) == {"pushed": 0, "ok": True}
         assert influx_server.captured == []
 
+    def test_push_journals_dropped_non_finite_fields(self, influx_server):
+        """A NaN/Inf field is dropped from the batch AND journaled (with
+        a logged warning) — never silently lost, never a 400 for the
+        whole single-POST batch."""
+        endpoint = f"http://127.0.0.1:{influx_server.server_address[1]}"
+        rows = [
+            dict(ROWS[0], bad=float("nan")),
+            dict(ROWS[1], worse=float("inf")),
+        ]
+        journal = push_rows(endpoint, rows)
+        assert journal["ok"] is True
+        assert journal["dropped_field_count"] == 2
+        assert journal["dropped_fields"] == [
+            "results.network-ping-pong.rtt_ticks.bad",
+            "results.network-ping-pong.rtt_ticks.worse",
+        ]
+        body = influx_server.captured[0][1]
+        assert "bad" not in body and "worse" not in body
+
     def test_push_failure_is_journaled_not_raised(self):
         journal = push_rows("http://127.0.0.1:1", ROWS, timeout=0.5)
         assert journal["ok"] is False
@@ -189,3 +229,58 @@ class TestSimRunPush:
         assert t.result["journal"]["influx"]["pushed"] > 0
         body = influx_server.captured[0][1]
         assert "results.benchmarks-netinit.time_to_network_init_ticks" in body
+
+    def test_sim_telemetry_family_is_mirrored(self, tg_home, influx_server):
+        """With telemetry on, the per-tick sim.* measurement family goes
+        to Influx alongside the plan metrics (docs/OBSERVABILITY.md) —
+        the same expanded shape the dashboard renders."""
+        from tests.test_sim_runner import run_sim
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.engine import Engine, EngineConfig, Outcome
+        from testground_tpu.sim.runner import SimJaxRunner
+
+        endpoint = f"http://127.0.0.1:{influx_server.server_address[1]}"
+        with open(os.path.join(tg_home, ".env.toml"), "w") as f:
+            f.write(f'[daemon]\ninfluxdb_endpoint = "{endpoint}"\n')
+        env = EnvConfig.load()
+        e = Engine(
+            EngineConfig(
+                env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+            )
+        )
+        e.start_workers()
+        try:
+            t = run_sim(
+                e,
+                "network",
+                "ping-pong",
+                instances=2,
+                run_params={"telemetry": True, "chunk": 16},
+            )
+        finally:
+            e.stop()
+        assert t.outcome() == Outcome.SUCCESS
+        # the sim family pushes in its own bounded batches, separate
+        # from (and after) the plan-metric batch — scan every POST
+        assert t.result["journal"]["influx"]["ok"] is True
+        assert t.result["journal"]["influx_telemetry"]["ok"] is True
+        assert t.result["journal"]["influx_telemetry"]["batches"] >= 1
+        body = "\n".join(b for _, b in influx_server.captured)
+        delivered = [
+            l
+            for l in body.splitlines()
+            if l.startswith("results.network-ping-pong.sim.delivered,")
+        ]
+        live = [
+            l
+            for l in body.splitlines()
+            if l.startswith("results.network-ping-pong.sim.live,")
+        ]
+        assert delivered and all(",group_id=_run " in l for l in delivered)
+        assert live and all(",group_id=all " in l for l in live)
+        # per-tick sim rows: one line per counter per tick
+        assert len(delivered) == t.result["journal"]["telemetry"]["rows"]
+        # plan metrics went in their own first batch, unmixed with sim.*
+        first = influx_server.captured[0][1]
+        assert "pingpong.rtt" in first and "sim." not in first

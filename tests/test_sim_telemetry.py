@@ -1,0 +1,551 @@
+"""Sim telemetry plane (docs/OBSERVABILITY.md): per-tick device-side
+counters flushed once per chunk, run-span tracing, the ``tg stats``
+surface, and the zero-extra-host-syncs contract.
+
+The reference ships runtime metrics to InfluxDB and a dashboard viewer
+(``pkg/metrics/viewer.go``); here the jitted engine itself emits the
+counter block, so these tests pin (a) the chunk-flush row schema, (b)
+exact conservation against the run's final ``results()`` totals, and (c)
+that telemetry adds NO blocking device→host sync beyond the done-flag
+poll the loop already pays.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from testground_tpu.api import RunGroup
+from testground_tpu.config import EnvConfig
+from testground_tpu.sim import engine as engine_mod
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import load_sim_testcases
+from testground_tpu.sim.telemetry import (
+    SIM_SERIES_FILE,
+    SPAN_FILE,
+    TELEMETRY_FIXED_COLUMNS,
+    rows_from_blocks,
+    telemetry_totals,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def make_groups(*counts, params=None):
+    return build_groups(
+        [
+            RunGroup(id=f"g{i}", instances=c, parameters=dict(params or {}))
+            for i, c in enumerate(counts)
+        ]
+    )
+
+
+def plan_case(plan, case):
+    return load_sim_testcases(os.path.join(PLANS, plan))[case]()
+
+
+def collect_rows(prog, **run_kw):
+    blocks = []
+    res = prog.run(telemetry_cb=blocks.append, **run_kw)
+    return res, rows_from_blocks(blocks, tuple(g.id for g in prog.groups))
+
+
+class TestChunkFlushSchema:
+    def test_row_schema_and_conservation(self):
+        """Every decoded row carries the fixed columns plus a per-group
+        live map, ticks are contiguous from 0, and the per-tick sums
+        equal the run's cumulative results() totals exactly (the
+        acceptance invariant)."""
+        prog = SimProgram(
+            plan_case("network", "ping-pong"),
+            make_groups(4),
+            chunk=16,
+            telemetry=True,
+        )
+        res, rows = collect_rows(prog, max_ticks=512)
+        assert rows, "telemetry produced no rows"
+        for row in rows:
+            for col in TELEMETRY_FIXED_COLUMNS:
+                assert col in row, f"missing column {col}"
+                assert isinstance(row[col], int)
+            assert set(row["live"]) == {"g0"}
+        assert [r["tick"] for r in rows] == list(range(len(rows)))
+        totals = telemetry_totals(rows)
+        assert totals["delivered"] == res["msgs_delivered"]
+        assert totals["sent"] == res["msgs_sent"]
+        assert totals["enqueued"] == res["msgs_enqueued"]
+        assert totals["dropped"] == res["msgs_dropped"]
+        assert totals["rejected"] == res["msgs_rejected"]
+        # conservation: sent = enqueued + dropped + rejected, and the
+        # calendar drains fully on a completed run
+        assert (
+            totals["sent"]
+            == totals["enqueued"] + totals["dropped"] + totals["rejected"]
+        )
+        assert rows[-1]["cal_depth"] == res["cal_depth"] == 0
+
+    def test_live_counts_track_completion(self):
+        """live_<group> is the running-instance count — it must step
+        down as instances freeze (terminal status) and reach 0 by the
+        last row."""
+        prog = SimProgram(
+            plan_case("network", "ping-pong"),
+            make_groups(8),
+            chunk=8,
+            telemetry=True,
+        )
+        res, rows = collect_rows(prog, max_ticks=512)
+        assert (res["status"] == 1).all()
+        live = [r["live"]["g0"] for r in rows]
+        assert live[0] == 8
+        assert live[-1] == 0
+        assert all(a >= b for a, b in zip(live, live[1:]))
+
+    def test_padding_rows_dropped_and_schema_matches_program(self):
+        prog = SimProgram(
+            plan_case("placebo", "ok"), make_groups(3), chunk=32,
+            telemetry=True,
+        )
+        assert prog.telemetry_schema() == TELEMETRY_FIXED_COLUMNS + (
+            "live_g0",
+        )
+        res, rows = collect_rows(prog, max_ticks=64)
+        # placebo:ok finishes at tick 0: exactly one real row out of a
+        # 32-tick chunk — the 31 padding rows (tick = -1) are dropped
+        assert len(rows) == 1 and rows[0]["tick"] == 0
+
+    def test_sharded_matches_unsharded(self):
+        import jax
+
+        devs = jax.devices()
+        assert len(devs) == 8
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+
+        def run(mesh_):
+            prog = SimProgram(
+                plan_case("network", "ping-pong"),
+                make_groups(16),
+                chunk=16,
+                mesh=mesh_,
+                telemetry=True,
+            )
+            return collect_rows(prog, max_ticks=512)
+
+        (_, rows_u), (_, rows_s) = run(None), run(mesh)
+        assert rows_u == rows_s
+
+
+class TestCounterCorrectness:
+    """Exact counter values under drops, rejects, and frozen instances —
+    deterministic single-message scenarios, not statistical checks."""
+
+    def test_reject_drop_and_delivered_exact(self):
+        from testground_tpu.sim.api import (
+            FILTER_ACCEPT,
+            FILTER_DROP,
+            FILTER_REJECT,
+            RUNNING,
+            SUCCESS,
+            Outbox,
+            SimTestcase,
+        )
+        import jax.numpy as jnp
+
+        class Filtered(SimTestcase):
+            """Instance 0 sends one message per dst ∈ {1, 2, 3} at tick
+            1: dst 1 is REJECTed, dst 2 DROPped, dst 3 delivered."""
+
+            SHAPING = ("latency", "filter_rules")
+            FILTER_RULES = 2
+            MSG_WIDTH = 1
+            OUT_MSGS = 3
+            IN_MSGS = 4
+            MAX_LINK_TICKS = 8
+
+            def step(self, env, state, inbox, sync, t):
+                is_sender = env.global_seq == 0
+                ob = Outbox(
+                    dst=jnp.asarray([1, 2, 3], jnp.int32),
+                    payload=jnp.ones((3, 1), jnp.int32),
+                    valid=jnp.full((3,), (t == 1) & is_sender, bool),
+                )
+                return self.out(
+                    state,
+                    status=jnp.where(t >= 4, SUCCESS, RUNNING),
+                    outbox=ob,
+                    net_rules=self.filter_rules(
+                        (1, 2, FILTER_REJECT), (2, 3, FILTER_DROP)
+                    ),
+                    net_rules_valid=(t == 0) & is_sender,
+                )
+
+        prog = SimProgram(
+            Filtered(), make_groups(4), chunk=8, telemetry=True
+        )
+        res, rows = collect_rows(prog, max_ticks=32)
+        assert (res["status"] == 1).all()
+        by_tick = {r["tick"]: r for r in rows}
+        # tick 1: 3 sent, 1 enqueued, 1 rejected, 1 dropped
+        assert by_tick[1]["sent"] == 3
+        assert by_tick[1]["enqueued"] == 1
+        assert by_tick[1]["rejected"] == 1
+        assert by_tick[1]["dropped"] == 1
+        assert by_tick[1]["cal_depth"] == 1
+        assert by_tick[1]["bytes_enqueued"] == 256
+        # tick 2: the accepted message arrives
+        assert by_tick[2]["delivered"] == 1
+        assert by_tick[2]["cal_depth"] == 0
+        assert res["msgs_delivered"] == 1
+        assert res["msgs_rejected"] == 1
+        assert res["msgs_dropped"] == 1
+
+    def test_frozen_instances_send_nothing(self):
+        """A terminal (frozen) instance's sends are masked: after the
+        senders finish, the sent counter must go to zero even though the
+        step function keeps emitting an outbox."""
+        from testground_tpu.sim.api import (
+            RUNNING,
+            SUCCESS,
+            Outbox,
+            SimTestcase,
+        )
+        import jax.numpy as jnp
+
+        class EagerSender(SimTestcase):
+            SHAPING = ("latency",)
+            MSG_WIDTH = 1
+            IN_MSGS = 4
+            MAX_LINK_TICKS = 8
+
+            def step(self, env, state, inbox, sync, t):
+                # everyone "sends every tick" — but terminates at tick 2
+                # except instance 3, which lingers until tick 5
+                dst = jnp.mod(env.global_seq + 1, 4)
+                ob = Outbox.single(dst, jnp.asarray([1]), True, 1, 1)
+                done_at = jnp.where(env.global_seq == 3, 5, 2)
+                return self.out(
+                    state,
+                    status=jnp.where(t >= done_at, SUCCESS, RUNNING),
+                    outbox=ob,
+                )
+
+        prog = SimProgram(
+            EagerSender(), make_groups(4), chunk=8, telemetry=True
+        )
+        res, rows = collect_rows(prog, max_ticks=32)
+        by_tick = {r["tick"]: r for r in rows}
+        assert by_tick[1]["sent"] == 4  # everyone still live
+        assert by_tick[3]["sent"] == 1  # only instance 3 survives tick 2
+        assert by_tick[3]["live"]["g0"] == 1
+        # totals: ticks 0-2 × 4 senders + ticks 3-5 × 1 sender
+        assert res["msgs_sent"] == 3 * 4 + 3 * 1
+        # instance 3's terminal-tick send (tick 5) is enqueued but the
+        # run completes before its delivery tick — cal_depth reports
+        # exactly that stranded in-flight message
+        assert res["cal_depth"] == 1
+        assert res["msgs_enqueued"] - res["msgs_delivered"] == 1
+
+    def test_sync_occupancy_columns(self):
+        from testground_tpu.sim.api import (
+            RUNNING,
+            SUCCESS,
+            SimTestcase,
+        )
+        import jax.numpy as jnp
+
+        class Signaller(SimTestcase):
+            STATES = ["ready"]
+            TOPICS = ["news"]
+            SHAPING = ("latency",)
+            MAX_LINK_TICKS = 8
+
+            def step(self, env, state, inbox, sync, t):
+                return self.out(
+                    state,
+                    status=jnp.where(t >= 2, SUCCESS, RUNNING),
+                    signals=jnp.where(
+                        t == 0, self.signal("ready"), jnp.zeros((1,), jnp.int32)
+                    ),
+                    pub_payload=jnp.zeros((1, self.PUB_WIDTH), jnp.int32),
+                    pub_valid=jnp.asarray([t == 1]),
+                )
+
+        prog = SimProgram(
+            Signaller(), make_groups(5), chunk=8, telemetry=True
+        )
+        res, rows = collect_rows(prog, max_ticks=16)
+        by_tick = {r["tick"]: r for r in rows}
+        assert by_tick[0]["sync_signals"] == 5  # every instance signalled
+        assert by_tick[0]["sync_pubs"] == 0
+        assert by_tick[1]["sync_pubs"] == 5  # every instance published
+        assert by_tick[2]["sync_signals"] == 5  # occupancy, not a rate
+
+
+class TestZeroExtraSyncs:
+    def test_telemetry_adds_no_host_syncs(self, monkeypatch):
+        """The acceptance contract: one blocking device→host sync per
+        chunk (the done-flag poll), telemetry on or off. The counter
+        block rides the same dispatch result and is read after the poll
+        — a copy, not a sync."""
+        calls = {"n": 0}
+        real = engine_mod._poll_done
+
+        def counting(done):
+            calls["n"] += 1
+            return real(done)
+
+        monkeypatch.setattr(engine_mod, "_poll_done", counting)
+
+        def run(telemetry):
+            calls["n"] = 0
+            prog = SimProgram(
+                plan_case("network", "ping-pong"),
+                make_groups(4),
+                chunk=16,
+                telemetry=telemetry,
+            )
+            blocks = []
+            res = prog.run(
+                max_ticks=512,
+                telemetry_cb=blocks.append if telemetry else None,
+            )
+            chunks = res["ticks"] // 16
+            return calls["n"], chunks, blocks
+
+        syncs_off, chunks_off, _ = run(False)
+        syncs_on, chunks_on, blocks = run(True)
+        assert chunks_on == chunks_off
+        assert syncs_off == chunks_off  # exactly one poll per dispatch
+        assert syncs_on == syncs_off  # telemetry adds ZERO syncs
+        assert len(blocks) == chunks_on  # yet every chunk flushed
+
+
+@pytest.fixture()
+def sim_engine(tg_home):
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.engine import Engine, EngineConfig
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    env = EnvConfig.load()
+    e = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    e.start_workers()
+    yield e
+    e.stop()
+
+
+class TestRunArtifacts:
+    def test_run_writes_series_spans_and_journal(self, sim_engine):
+        """End-to-end through the engine: telemetry=true produces a
+        schema-valid sim_timeseries.jsonl whose sums match the journal
+        totals, a parseable run_spans.jsonl, and the journal's always-on
+        observability floor (msgs_*, carry_bytes)."""
+        from tests.test_sim_runner import run_sim
+        from testground_tpu.engine import Outcome
+        from testground_tpu.sdk.events import parse_event_line
+
+        t = run_sim(
+            sim_engine,
+            "network",
+            "ping-pong",
+            instances=2,
+            run_params={"telemetry": True, "chunk": 16},
+        )
+        assert t.outcome() == Outcome.SUCCESS
+        journal = t.result["journal"]
+        sim = journal["sim"]
+        # always-on floor: totals + memory are present without opt-ins
+        assert sim["carry_bytes"] > 0
+        assert sim["msgs_delivered"] > 0
+        run_dir = os.path.join(
+            sim_engine.env.dirs.outputs(), "network", t.id
+        )
+        rows = [
+            json.loads(line)
+            for line in open(os.path.join(run_dir, SIM_SERIES_FILE))
+        ]
+        assert journal["telemetry"]["rows"] == len(rows)
+        for row in rows:
+            assert row["run"] == t.id
+            assert row["plan"] == "network"
+            assert row["case"] == "ping-pong"
+            for col in TELEMETRY_FIXED_COLUMNS:
+                assert isinstance(row[col], int)
+            assert isinstance(row["live"], dict)
+        assert (
+            sum(r["delivered"] for r in rows) == sim["msgs_delivered"]
+        )
+        assert sum(r["dropped"] for r in rows) == sim["msgs_dropped"]
+        assert journal["telemetry"]["totals"]["delivered"] == sim[
+            "msgs_delivered"
+        ]
+        # run-span tracing: every line parses as an sdk event; the core
+        # phases are present and the build span reports the carry bytes
+        events = []
+        for line in open(os.path.join(run_dir, SPAN_FILE)):
+            parsed = parse_event_line(line)
+            assert parsed is not None, line
+            events.append(parsed[1])
+        spans = {
+            (e["type"], e["span"])
+            for e in events
+            if e["type"].startswith("span") or e["type"] == "point"
+        }
+        for phase in ("run", "build", "execute", "collect"):
+            assert ("span_start", phase) in spans
+            assert ("span_end", phase) in spans
+        assert ("point", "chunk") in spans
+        assert ("point", "compile") in spans
+        build_end = next(
+            e
+            for e in events
+            if e["type"] == "span_end" and e["span"] == "build"
+        )
+        assert build_end["carry_bytes"] == sim["carry_bytes"]
+
+    def test_disable_metrics_wins_over_telemetry_flag(self, tg_home):
+        """The composition's disable_metrics opt-out suppresses the
+        whole plane — series file, journal section, spans — even with
+        runner config telemetry = true (same rule as plan-metric
+        sampling)."""
+        import threading
+
+        from testground_tpu.api import RunInput
+        from testground_tpu.engine import Outcome
+        from testground_tpu.rpc import discard_writer
+        from testground_tpu.sim.executor import (
+            SimJaxConfig,
+            execute_sim_run,
+        )
+
+        env = EnvConfig.load()
+        job = RunInput(
+            run_id="nometrics",
+            test_plan="placebo",
+            test_case="ok",
+            total_instances=2,
+            groups=[
+                RunGroup(
+                    id="all",
+                    instances=2,
+                    artifact_path=os.path.join(PLANS, "placebo"),
+                    parameters={},
+                )
+            ],
+            env=env,
+            disable_metrics=True,
+        )
+        job.runner_config = SimJaxConfig(telemetry=True, chunk=8)
+        out = execute_sim_run(job, discard_writer(), threading.Event())
+        assert out.result.outcome == Outcome.SUCCESS
+        run_dir = os.path.join(env.dirs.outputs(), "placebo", "nometrics")
+        assert not os.path.exists(os.path.join(run_dir, SIM_SERIES_FILE))
+        assert not os.path.exists(os.path.join(run_dir, SPAN_FILE))
+        assert "telemetry" not in out.result.journal
+
+    def test_telemetry_off_writes_no_series(self, sim_engine):
+        from tests.test_sim_runner import run_sim
+
+        t = run_sim(sim_engine, "placebo", "ok", instances=2)
+        run_dir = os.path.join(
+            sim_engine.env.dirs.outputs(), "placebo", t.id
+        )
+        assert not os.path.exists(os.path.join(run_dir, SIM_SERIES_FILE))
+        assert "telemetry" not in t.result["journal"]
+        # the observability floor is still there
+        assert t.result["journal"]["sim"]["carry_bytes"] > 0
+
+
+class TestStatsSurface:
+    @pytest.fixture()
+    def daemon(self, tg_home):
+        from testground_tpu.daemon import Daemon
+
+        d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+        d.start()
+        yield d
+        d.stop()
+
+    @pytest.fixture()
+    def finished_task(self, daemon):
+        from testground_tpu.client import Client
+
+        client = Client(daemon.address)
+        client.import_plan(os.path.join(PLANS, "network"))
+        task_id = client.run(
+            {
+                "global": {
+                    "plan": "network",
+                    "case": "ping-pong",
+                    "builder": "sim:plan",
+                    "runner": "sim:jax",
+                    "total_instances": 2,
+                    "run_config": {"telemetry": True, "chunk": 16},
+                },
+                "groups": [{"id": "all", "instances": {"count": 2}}],
+            }
+        )
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            t = client.status(task_id)
+            if t["states"][-1]["state"] in ("complete", "canceled"):
+                assert t["outcome"] == "success"
+                return task_id
+            time.sleep(0.2)
+        raise TimeoutError(task_id)
+
+    def test_stats_route_and_client(self, daemon, finished_task):
+        from testground_tpu.client import Client
+
+        data = Client(daemon.address).stats(finished_task)
+        assert data["task_id"] == finished_task
+        assert data["plan"] == "network" and data["case"] == "ping-pong"
+        assert data["outcome"] == "success"
+        assert data["sim"]["msgs_delivered"] > 0
+        assert data["sim"]["carry_bytes"] > 0
+        assert data["telemetry"]["rows"] > 0
+        assert data["events"]["all"]["success"] == 2
+
+    def test_stats_route_404s_unknown_task(self, daemon):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                daemon.address + "/stats?task_id=ghost", timeout=30
+            )
+        assert ei.value.code == 404
+
+    def test_cli_stats_renders_summary(self, daemon, finished_task, capsys):
+        """``tg stats <task>`` against the daemon renders the telemetry
+        table (the acceptance criterion's CLI half)."""
+        from testground_tpu.cli.main import main
+
+        rc = main(["--endpoint", daemon.address, "stats", finished_task])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "messages" in out
+        assert "delivered=" in out
+        assert "network:ping-pong" in out
+        assert "per-tick rows" in out
+
+    def test_cli_status_telemetry_flag(self, daemon, finished_task, capsys):
+        from testground_tpu.cli.main import main
+
+        rc = main(
+            [
+                "--endpoint",
+                daemon.address,
+                "status",
+                "-t",
+                finished_task,
+                "--telemetry",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Telemetry:" in out and "delivered=" in out

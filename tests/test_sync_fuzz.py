@@ -5,8 +5,13 @@ topic streams with per-instance cursors, and overflow accounting."""
 
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property-based tier needs hypothesis"
+)
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from testground_tpu.sim.sync_kernel import (
     make_sub_window,

@@ -5,7 +5,7 @@ index, split, load_resource, trim markers."""
 
 import os
 import time
-import tomllib
+from testground_tpu.utils.compat import tomllib
 
 import pytest
 

@@ -1,6 +1,6 @@
 """TOML emitter round-trip tests."""
 
-import tomllib
+from testground_tpu.utils.compat import tomllib
 
 import pytest
 
