@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-kernel test-e2e bench dryrun telemetry-smoke
+.PHONY: test test-kernel test-e2e bench dryrun telemetry-smoke chaos-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e
 test:
@@ -30,6 +30,15 @@ bench:
 # whose per-tick sums equal the journal's cumulative totals
 telemetry-smoke:
 	$(PY) tools/telemetry_smoke.py
+
+# fault-plane contract check (docs/FAULTS.md): the plans/chaos
+# composition (crash-mid-barrier + link flap + partition-and-heal) must
+# complete on CPU with the declared fault counters, the chaos
+# flow-conservation identity exact (sent = delivered + in-flight +
+# dropped + rejected + fault_dropped), and a deterministic per-tick
+# counter stream across two runs
+chaos-smoke:
+	$(PY) tools/chaos_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

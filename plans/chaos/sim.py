@@ -1,0 +1,217 @@
+"""chaos plan: the fault-injection plane's end-to-end exercise.
+
+No reference twin — this plan exists for what the reference could only
+do with a human driving the sidecar: a **scheduled nemesis** run
+(docs/FAULTS.md). The composition declares the chaos
+(``[[groups.run.faults]]`` — see ``_compositions/smoke.toml``); the plan
+is an ordinary cooperative state machine that SURVIVES it:
+
+1. everyone signals ``start`` and waits at a barrier written against the
+   sync plane's live-membership view (``counts >= Σ sync.live`` —
+   ``sim/sync_kernel.live_per_group``). The composition crashes a slice
+   of instances *mid-barrier*; the target degrades the same tick and the
+   survivors proceed instead of deadlocking — the headline behavior.
+   A ``slow_count`` prefix of instances holds its signal until
+   ``slow_tick`` so the barrier is genuinely blocked on them when the
+   scheduled crash takes them out.
+2. a pipelined probe sweep (one probe per tick at peer
+   ``(me + 1 + k) mod n``, fan-in bounded like splitbrain's) generates
+   traffic through the scheduled link flaps and the partition window —
+   every kill lands in the ``fault_dropped`` counter, keeping flow
+   conservation exact.
+3. restarted instances come back through ``init`` with their sync
+   history intact: ``last_seq`` says whether they already signalled, so
+   nobody double-signals, and they rejoin mid-run.
+4. from ``heal_tick`` (chosen after the partition heals) every instance
+   probes its partner ``(me + n//2) mod n`` across the old partition
+   boundary, resending every few ticks. SUCCESS requires BOTH sides of
+   the handshake — a heal reply received AND a heal probe answered — so
+   nobody freezes while a slower peer (e.g. a late restart still
+   finishing its sweep) has yet to probe it; the pairing is a
+   permutation, so the handshake closes for every cycle. No handshake
+   by ``deadline`` is a FAILURE, so a heal that didn't happen fails the
+   run loudly instead of stalling to max_ticks.
+
+Pair every scheduled crash with a restart comfortably before
+``deadline``: the heal handshake needs both partners alive (a dead
+partner fails its peer at the deadline — which is itself a useful chaos
+assertion).
+"""
+
+import jax.numpy as jnp
+
+from testground_tpu.sim.api import (
+    FAILURE,
+    RUNNING,
+    SUCCESS,
+    Outbox,
+    SimTestcase,
+)
+
+PROBE = 1
+REPLY = 2
+
+# phases
+P_START = 0  # signal "start" (slow instances hold until slow_tick)
+P_WAIT = 1  # live-degraded barrier
+P_PROBE = 2  # pipelined probe sweep (traffic through the chaos windows)
+P_HEAL = 3  # cross-partition heal handshake
+P_DONE = 4
+
+_HEAL_EVERY = 4  # heal-probe resend cadence in ticks
+
+
+class ChaosBarrier(SimTestcase):
+    STATES = ["start"]
+    MSG_WIDTH = 2  # word0: kind, word1: probe id (sweep k, or n = heal)
+    OUT_MSGS = 2  # slot 0: reply, slot 1: own probe
+    IN_MSGS = 8
+    MAX_LINK_TICKS = 8
+    SHAPING = ("latency",)
+
+    def init(self, env):
+        z = jnp.int32(0)
+        return {
+            "phase": z,
+            "k": z,  # next sweep probe index
+            "replies": z,  # sweep replies received (metric only)
+            "heal_got": jnp.asarray(False),
+            # answered the prober whose partner is me — success requires
+            # BOTH sides of the handshake, so nobody freezes while its
+            # peer still needs a reply (a late restart may enter the
+            # heal phase ticks after its partner — docstring point 4)
+            "heal_answered": jnp.asarray(False),
+        }
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+
+        def p(name, default):
+            return (
+                env.int_param(name)
+                if name in env.group.params
+                else default
+            )
+
+        slow_count = p("slow_count", 2)
+        slow_tick = p("slow_tick", 30)
+        heal_tick = p("heal_tick", 44)
+        deadline = p("deadline", 120)
+
+        phase = state["phase"]
+
+        # --- serve replies in every phase (the reference's HTTP server
+        # runs for the whole test body): answer the first probe in the
+        # inbox, echoing its id back to its sender
+        kind = inbox.word(0)
+        pid = inbox.word(1)
+        is_probe = inbox.valid & (kind == PROBE)
+        got_reply = inbox.valid & (kind == REPLY)
+        probe_slot = jnp.argmax(is_probe)
+        send_reply = jnp.any(is_probe)
+        reply_to = inbox.src[probe_slot]
+        reply_id = pid[probe_slot]
+
+        # --- phase START: signal once (a restarted instance re-enters
+        # here with its sync history intact — last_seq > 0 means its
+        # earlier signal still stands, so it must not signal again)
+        ready = (env.global_seq >= slow_count) | (t >= slow_tick)
+        already = sync.last_seq[self.state_id("start")] > 0
+        do_signal = (phase == P_START) & ready & ~already
+        leave_start = (phase == P_START) & ready
+
+        # --- phase WAIT: the live-degraded barrier — the target is the
+        # CURRENT live membership, so a mid-barrier crash shrinks it and
+        # unblocks the survivors the same tick (docs/FAULTS.md)
+        counts = sync.counts[self.state_id("start")]
+        live_total = jnp.sum(sync.live)
+        barrier_open = (counts > 0) & (counts >= live_total)
+        leave_wait = (phase == P_WAIT) & barrier_open
+
+        # --- phase PROBE: pipelined sweep, one probe per tick at peer
+        # (me + 1 + k) mod n — bounded fan-in traffic that rides through
+        # the scheduled flap/partition windows
+        k = state["k"]
+        rounds = n - 1
+        probing = (phase == P_PROBE) & (k < rounds)
+        sweep_target = jnp.mod(env.global_seq + 1 + k, n)
+        k_next = jnp.where(probing, k + 1, k)
+        leave_probe = (phase == P_PROBE) & (k >= rounds)
+        replies = state["replies"] + jnp.sum(got_reply.astype(jnp.int32))
+
+        # --- phase HEAL: from heal_tick, probe the partner across the
+        # old partition boundary until answered (resend every few ticks
+        # in global lockstep so partner pairs succeed symmetrically)
+        partner = jnp.mod(env.global_seq + n // 2, n)
+        heal_got = state["heal_got"] | jnp.any(got_reply & (pid == n))
+        # answering a HEAL probe counts in any phase (the prober may be
+        # ticks ahead of us); only the probe we actually reply to counts
+        heal_answered = state["heal_answered"] | (
+            send_reply & (reply_id == n)
+        )
+        heal_probe = (
+            (phase == P_HEAL)
+            & ~heal_got
+            & (t >= heal_tick)
+            & (jnp.mod(t - heal_tick, _HEAL_EVERY) == 0)
+        )
+        done_heal = heal_got & heal_answered
+        finish = (phase == P_HEAL) & done_heal
+        timed_out = (phase == P_HEAL) & ~done_heal & (t >= deadline)
+
+        new_phase = jnp.where(
+            leave_start,
+            P_WAIT,
+            jnp.where(
+                leave_wait,
+                P_PROBE,
+                jnp.where(
+                    leave_probe,
+                    P_HEAL,
+                    jnp.where(finish, P_DONE, phase),
+                ),
+            ),
+        ).astype(jnp.int32)
+        status = jnp.where(
+            timed_out, FAILURE, jnp.where(finish, SUCCESS, RUNNING)
+        ).astype(jnp.int32)
+
+        send_probe = probing | heal_probe
+        probe_dst = jnp.where(heal_probe, partner, sweep_target)
+        probe_id = jnp.where(heal_probe, jnp.int32(n), k)
+        ob = Outbox.empty(cls.OUT_MSGS, cls.MSG_WIDTH)
+        ob = Outbox(
+            dst=ob.dst.at[0].set(reply_to).at[1].set(probe_dst),
+            payload=ob.payload.at[0, 0]
+            .set(REPLY)
+            .at[0, 1]
+            .set(reply_id)
+            .at[1, 0]
+            .set(PROBE)
+            .at[1, 1]
+            .set(probe_id),
+            valid=ob.valid.at[0].set(send_reply).at[1].set(send_probe),
+        )
+
+        return self.out(
+            {
+                "phase": new_phase,
+                "k": k_next,
+                "replies": replies,
+                "heal_got": heal_got,
+                "heal_answered": heal_answered,
+            },
+            status=status,
+            outbox=ob,
+            signals=self.signal("start") * do_signal,
+        )
+
+    def collect_metrics(self, group, final_state, status):
+        return {
+            "chaos.replies": final_state["replies"],
+            "chaos.healed": final_state["heal_got"],
+        }
+
+
+sim_testcases = {"chaos-barrier": ChaosBarrier}

@@ -185,11 +185,18 @@ class Build:
 @dataclass
 class RunParams:
     """Run directives for a group: a pre-built artifact to reuse, test
-    parameters, and profile capture spec (``pkg/api/composition.go:282-300``)."""
+    parameters, profile capture spec (``pkg/api/composition.go:282-300``),
+    and — beyond the reference — a declarative fault schedule
+    (``[[groups.run.faults]]`` / ``[[global.run.faults]]``): a list of
+    chaos events the ``sim:jax`` runner lowers into its deterministic
+    fault-injection plane (docs/FAULTS.md). Entries are kept as raw
+    tables here; validation happens at schedule lowering, where the
+    group layout is known."""
 
     artifact: str = ""
     test_params: dict[str, str] = field(default_factory=dict)
     profiles: dict[str, str] = field(default_factory=dict)
+    faults: list = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "RunParams":
@@ -197,14 +204,20 @@ class RunParams:
             artifact=d.get("artifact", ""),
             test_params={str(k): str(v) for k, v in d.get("test_params", {}).items()},
             profiles=dict(d.get("profiles", {})),
+            faults=[dict(f) for f in d.get("faults", [])],
         )
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "artifact": self.artifact,
             "test_params": dict(self.test_params),
             "profiles": dict(self.profiles),
         }
+        # omit when empty: keeps serialized compositions byte-stable for
+        # the (vast) majority that declare no chaos schedule
+        if self.faults:
+            out["faults"] = [dict(f) for f in self.faults]
+        return out
 
 
 @dataclass
@@ -320,6 +333,7 @@ class Group:
             instances=Instances(**self.instances.to_dict()),
             test_params=dict(self.run.test_params),
             profiles=dict(self.run.profiles),
+            faults=[dict(f) for f in self.run.faults],
         )
 
 
@@ -333,6 +347,10 @@ class CompositionRunGroup:
     instances: Instances = field(default_factory=Instances)
     test_params: dict[str, str] = field(default_factory=dict)
     profiles: dict[str, str] = field(default_factory=dict)
+    # fault schedule for this group's slice of the run (see RunParams):
+    # declared inline on the run group, or inherited from the backing
+    # group's [[groups.run.faults]] when unset
+    faults: list = field(default_factory=list)
     calculated_instance_count: int = 0
 
     @classmethod
@@ -344,10 +362,11 @@ class CompositionRunGroup:
             instances=Instances.from_dict(d.get("instances", {})),
             test_params={str(k): str(v) for k, v in d.get("test_params", {}).items()},
             profiles=dict(d.get("profiles", {})),
+            faults=[dict(f) for f in d.get("faults", [])],
         )
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "id": self.id,
             "group_id": self.group_id,
             "resources": self.resources.to_dict(),
@@ -355,6 +374,9 @@ class CompositionRunGroup:
             "test_params": dict(self.test_params),
             "profiles": dict(self.profiles),
         }
+        if self.faults:
+            out["faults"] = [dict(f) for f in self.faults]
+        return out
 
     def effective_group_id(self) -> str:
         """``group_id`` when set, else ``id`` (``pkg/api/composition.go:275-280``)."""
@@ -362,10 +384,18 @@ class CompositionRunGroup:
 
     def merge_group(self, g: Group) -> None:
         """Fill unset fields from the backing group
-        (``pkg/api/composition.go:472-489``)."""
+        (``pkg/api/composition.go:472-489``). The fault schedule fills
+        only when this run group declares none of its own (fill-if-empty,
+        like the artifact field) — list concatenation would double-fire
+        events when preparation runs more than once — and fills ONLY from
+        the backing group, never from ``Global.run``: run-global faults
+        stay on the global and reach the runner as ``RunInput.faults``,
+        scoped to the whole run rather than copied into every group."""
         self.resources.merge_from(g.resources)
         self.instances.merge_from(g.instances)
         self.merge_run(g.run)
+        if not self.faults and g.run.faults:
+            self.faults = [dict(f) for f in g.run.faults]
 
     def merge_run(self, rp: RunParams) -> None:
         """Fill missing test params / profiles from ``rp``

@@ -35,6 +35,10 @@ class RunGroup:
     parameters: dict[str, str] = field(default_factory=dict)
     profiles: dict[str, str] = field(default_factory=dict)
     resources: Resources = field(default_factory=Resources)
+    # declarative fault schedule for this group's slice of the run
+    # ([[groups.run.faults]] — raw tables; the sim:jax runner lowers and
+    # validates them, other runners ignore them)
+    faults: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -45,6 +49,7 @@ class RunGroup:
             "parameters": dict(self.parameters),
             "profiles": dict(self.profiles),
             "resources": self.resources.to_dict(),
+            "faults": [dict(f) for f in self.faults],
         }
 
     @classmethod
@@ -57,6 +62,7 @@ class RunGroup:
             parameters=dict(d.get("parameters", {})),
             profiles=dict(d.get("profiles", {})),
             resources=Resources.from_dict(d.get("resources", {})),
+            faults=[dict(f) for f in d.get("faults", [])],
         )
 
 
@@ -71,6 +77,10 @@ class RunInput:
     groups: list[RunGroup] = field(default_factory=list)
     runner_config: Any = None
     disable_metrics: bool = False
+    # run-global fault schedule ([[global.run.faults]]): events whose
+    # default target is the WHOLE run — group-scoped declarations ride
+    # on their RunGroup instead
+    faults: list = field(default_factory=list)
     # EnvConfig equivalent is attached by the engine at dispatch time.
     env: Any = None
 
@@ -82,6 +92,7 @@ class RunInput:
             "total_instances": self.total_instances,
             "groups": [g.to_dict() for g in self.groups],
             "disable_metrics": self.disable_metrics,
+            "faults": [dict(f) for f in self.faults],
         }
 
 

@@ -111,9 +111,11 @@ class SimPlanBuilder(Builder, Precompiler):
             _make_mesh,
             _parse_hosts,
             _precheck_device_memory,
+            fault_specs_of,
             load_and_specialize,
             make_sim_program,
         )
+        from testground_tpu.sim.faults import build_fault_schedule
 
         artifacts = {g.id: g.run.artifact for g in comp.groups}
         # prepare BEFORE coalescing the runner config: prepare_for_run is
@@ -152,6 +154,15 @@ class SimPlanBuilder(Builder, Precompiler):
         # jax version); an edited plan re-keys via the source digest
         seen: set[str] = set()
         for run in comp.runs:
+            # fault schedules are program-shaping (the event tensors bake
+            # into the traced tick), so they join the BuildKey and the
+            # precompiled program — mirroring the executor exactly
+            run_fault_specs = fault_specs_of(
+                run.groups,
+                comp.global_.run.faults
+                if comp.global_.run is not None
+                else None,
+            )
             spec = {
                 "sources": digests[
                     artifacts[
@@ -176,6 +187,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 "shard": cfg.shard,
                 "validate": bool(getattr(cfg, "validate", False)),
                 "telemetry": telemetry,
+                "faults": run_fault_specs,
                 "hosts": list(hosts),
                 "backend": jax.default_backend(),
                 "devices": jax.device_count(),
@@ -230,6 +242,9 @@ class SimPlanBuilder(Builder, Precompiler):
                 hosts=hosts,
                 validate=bool(getattr(cfg, "validate", False)),
                 telemetry=telemetry,
+                faults=build_fault_schedule(
+                    groups, run_fault_specs, cfg.tick_ms
+                ),
             )
             # same capacity precheck as the run: an oversized composition
             # must refuse readably at BUILD time too, not die as an XLA

@@ -270,6 +270,7 @@ def do_run(
                     parameters=dict(rg.test_params),
                     profiles=dict(rg.profiles),
                     resources=rg.resources,
+                    faults=[dict(f) for f in getattr(rg, "faults", [])],
                 )
             )
         rinput = RunInput(
@@ -280,6 +281,16 @@ def do_run(
             groups=groups,
             runner_config=runner_cfg,
             disable_metrics=comp.global_.disable_metrics,
+            # run-global chaos schedule ([[global.run.faults]]) — the
+            # per-group schedules ride on each RunGroup above
+            faults=[
+                dict(f)
+                for f in (
+                    comp.global_.run.faults
+                    if comp.global_.run is not None
+                    else []
+                )
+            ],
             env=engine.env,
         )
         ow.infof(

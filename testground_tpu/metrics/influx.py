@@ -14,6 +14,8 @@ journaled, never fatal to the run.
 from __future__ import annotations
 
 import math
+import random
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -23,6 +25,16 @@ from testground_tpu.logging_ import S
 __all__ = ["rows_to_lines", "push_rows", "escape_tag", "escape_measurement"]
 
 DEFAULT_DB = "testground"
+
+# Bounded retry policy for the write POST: transient failures (connection
+# refused mid-restart, a 5xx from an overloaded server) get a few
+# exponentially backed-off attempts with jitter (so a fleet of runs
+# finishing together doesn't re-stampede the endpoint in lockstep);
+# permanent rejections (4xx — malformed lines won't improve by waiting)
+# fail immediately. Module constants so tests can shrink the waits.
+_RETRY_ATTEMPTS = 3
+_RETRY_BASE_SECS = 0.25
+_RETRY_JITTER_SECS = 0.1
 
 
 def escape_measurement(s: str) -> str:
@@ -116,8 +128,10 @@ def push_rows(
     timeout: float = 5.0,
     base_ns: int | None = None,
 ) -> dict:
-    """POST rows to ``<endpoint>/write?db=<db>``. Returns a journal dict
-    ``{pushed, ok, error?}`` — callers record it and move on.
+    """POST rows to ``<endpoint>/write?db=<db>``, with bounded retries
+    (exponential backoff + jitter — see the module constants). Returns a
+    journal dict ``{pushed, ok, attempts, error?}`` — callers record it
+    and move on; a final failure is journaled and logged, never raised.
 
     ``base_ns`` must be stable per run (the executor passes the run's
     start wall-clock): a per-push ``time.time_ns()`` would interleave
@@ -153,18 +167,58 @@ def push_rows(
         return journal
     url = endpoint.rstrip("/") + "/write?" + urllib.parse.urlencode({"db": db})
     body = ("\n".join(lines) + "\n").encode("utf-8")
-    req = urllib.request.Request(
-        url,
-        data=body,
-        method="POST",
-        headers={"Content-Type": "text/plain; charset=utf-8"},
+
+    # bounded retries with exponential backoff + jitter: idempotent by
+    # construction (stable base_ns means a re-push writes the same
+    # points), so retrying a request whose response was lost is safe
+    last_err = ""
+    for attempt in range(1, _RETRY_ATTEMPTS + 1):
+        journal["attempts"] = attempt
+        req = urllib.request.Request(
+            url,
+            data=body,
+            method="POST",
+            headers={"Content-Type": "text/plain; charset=utf-8"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                if 200 <= resp.status < 300:
+                    journal["ok"] = True
+                    journal.pop("error", None)
+                    return journal
+                last_err = f"http {resp.status}"
+                if 400 <= resp.status < 500:
+                    break  # permanent: bad request won't improve
+        except urllib.error.HTTPError as e:
+            last_err = f"http {e.code}"
+            if 400 <= e.code < 500:
+                break
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            last_err = str(e)
+        journal["error"] = last_err
+        if attempt < _RETRY_ATTEMPTS:
+            delay = _RETRY_BASE_SECS * (2 ** (attempt - 1)) + random.uniform(
+                0.0, _RETRY_JITTER_SECS
+            )
+            S().warning(
+                "influx push to %s failed (attempt %d/%d: %s) — retrying "
+                "in %.2fs",
+                endpoint,
+                attempt,
+                _RETRY_ATTEMPTS,
+                last_err,
+                delay,
+            )
+            time.sleep(delay)
+    # the FINAL failure is journaled (attempts + error) and logged — the
+    # run record shows exactly how hard the mirror was tried
+    journal["error"] = last_err
+    S().warning(
+        "influx push to %s failed after %d attempt(s): %s — %d line(s) "
+        "not mirrored",
+        endpoint,
+        journal["attempts"],
+        last_err,
+        len(lines),
     )
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            journal["ok"] = 200 <= resp.status < 300
-            if not journal["ok"]:
-                journal["error"] = f"http {resp.status}"
-    except (urllib.error.URLError, OSError, ValueError) as e:
-        journal["error"] = str(e)
-        S().warning("influx push to %s failed: %s", endpoint, e)
     return journal

@@ -84,6 +84,26 @@ def render_telemetry_summary(stats: dict) -> str:
         ):
             if sim.get(key):
                 rows.append((label, str(sim[key])))
+        # fault-injection plane (docs/FAULTS.md): one line when any
+        # counter is nonzero — a chaos run's verdict at a glance
+        if any(
+            sim.get(k)
+            for k in (
+                "faults_crashed",
+                "faults_restarted",
+                "msgs_fault_dropped",
+            )
+        ):
+            rows.append(
+                (
+                    "faults",
+                    "crashed={c} restarted={r} fault-dropped={d}".format(
+                        c=sim.get("faults_crashed", 0),
+                        r=sim.get("faults_restarted", 0),
+                        d=sim.get("msgs_fault_dropped", 0),
+                    ),
+                )
+            )
     if tele:
         shown = f"{tele.get('rows', 0)} per-tick rows"
         if tele.get("file"):  # absent when no outputs dir held the series

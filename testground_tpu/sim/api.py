@@ -236,6 +236,13 @@ class SyncView:
       of silently losing entries; the reference's Redis stream would grow
       unboundedly instead, so any nonzero value here flags an undersized
       TOPIC_CAP. Also surfaced per-run in the journal (``sim.pub_dropped``).
+    - ``live [G] int32`` — RUNNING instances per group at tick start
+      (global, same value for every instance): the sync service's live
+      membership view. Barriers written against it —
+      ``counts[s] >= jnp.sum(sync.live)`` — degrade gracefully when the
+      fault plane crashes instances mid-barrier (docs/FAULTS.md), instead
+      of deadlocking on a fixed target the dead can never reach. Order
+      matches ``SimEnv.groups``.
     """
 
     counts: jax.Array
@@ -244,6 +251,7 @@ class SyncView:
     sub_valid: jax.Array
     rejected: jax.Array
     dropped: jax.Array
+    live: jax.Array
 
 
 @jax.tree_util.register_dataclass

@@ -319,6 +319,9 @@ def _child_main() -> int:
             groups=[RunGroup.from_dict(g) for g in ri["groups"]],
             runner_config=cfg,
             disable_metrics=ri.get("disable_metrics", False),
+            # run-global fault schedule survives the child hop (the
+            # per-group schedules ride in groups[].faults via from_dict)
+            faults=[dict(f) for f in ri.get("faults", [])],
             env=EnvConfig.load(job_d.get("home") or None),
         )
         try:

@@ -61,19 +61,30 @@ def init_distributed(
     SIGKILLed member is declared dead (and every surviving process's
     runtime poisoned — see ``cohort.py``) well inside the reference's
     failure-detection envelope; the common mid-collective case is faster
-    still (the transport notices the closed connection in ~1 s)."""
+    still (the transport notices the closed connection in ~1 s). The
+    kwarg only exists on newer jax releases — on older ones the cohort
+    joins with the default heartbeat rather than dying on a TypeError
+    (member death is still detected, just slower in the SIGKILL case)."""
     global _initialized
     if _initialized:
         return
+    import inspect
+
     import jax
 
+    kwargs = dict(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            heartbeat_timeout_seconds=heartbeat_timeout_seconds,
-        )
+        sig = inspect.signature(jax.distributed.initialize)
+        if "heartbeat_timeout_seconds" in sig.parameters:
+            kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout_seconds
+    except (TypeError, ValueError):  # unsignaturable shim — be safe
+        pass
+    try:
+        jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
         if "before" in str(e):
             # jax's constraint: distributed must precede backend init. A
@@ -156,6 +167,13 @@ class CohortCancel:
 
     def __init__(self, local_event=None):
         self._local = local_event
+
+    def set(self) -> None:
+        """Mark the local half; the cohort observes it at the next
+        ``is_set`` broadcast (the chunk-boundary vote). Lets the engine's
+        stall watchdog treat cohort and plain Events uniformly."""
+        if self._local is not None:
+            self._local.set()
 
     def is_set(self) -> bool:
         from jax.experimental import multihost_utils

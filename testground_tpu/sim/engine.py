@@ -20,6 +20,13 @@ single SPMD program:
 
 Terminal instances are frozen: their state stops updating and their sends/
 signals/publishes are masked, mirroring a container that has exited.
+
+The deterministic fault-injection plane (``sim/faults.py``,
+docs/FAULTS.md) hooks in here: scheduled crash/restart point events
+apply at tick start (status flips, calendar purge, per-group re-init),
+window faults ride into the transport with the enqueue call, and the
+``done`` check waits out the schedule's last event. All of it is
+compiled out when no schedule is declared.
 """
 
 from __future__ import annotations
@@ -33,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .api import (
+    CRASH,
     RUNNING,
     GroupSpec,
     Inbox,
@@ -50,9 +58,11 @@ from .net import (
     deliver,
     enqueue,
     make_link_state,
+    purge_dst,
 )
 from .sync_kernel import (
     SyncState,
+    live_per_group,
     make_sub_window,
     make_sync_state,
     sync_occupancy,
@@ -60,7 +70,30 @@ from .sync_kernel import (
 )
 from .telemetry import TELEMETRY_FIXED_COLUMNS
 
-__all__ = ["MAX_FILTER_CELLS", "SimCarry", "SimProgram", "build_groups"]
+__all__ = [
+    "MAX_FILTER_CELLS",
+    "SimCarry",
+    "SimProgram",
+    "SimStallError",
+    "build_groups",
+]
+
+
+class SimStallError(RuntimeError):
+    """A device chunk dispatch exceeded the wall-clock watchdog (see
+    ``SimJaxConfig.chunk_timeout_secs``): the worker thread is released
+    with a diagnostic instead of hanging forever on the device poll."""
+
+    def __init__(self, ticks: int, chunk_index: int, timeout: float):
+        self.ticks = ticks
+        self.chunk_index = chunk_index
+        self.timeout = timeout
+        super().__init__(
+            f"sim chunk {chunk_index} did not complete within "
+            f"{timeout:g}s wall (last completed tick {ticks}) — device "
+            "hang or a pathologically slow dispatch; the cancel event "
+            "was set and the dispatch abandoned"
+        )
 
 # Budget for the dense [R, N] per-region filter table, in int32 cells
 # (2**28 = 1 GiB). See the N_REGIONS guard in SimProgram.__init__.
@@ -90,6 +123,34 @@ def _acc_add(acc: jax.Array, delta: jax.Array) -> jax.Array:
 
 def _acc_total(acc_host) -> int:
     return (int(acc_host[0]) << _LIMB_BITS) + int(acc_host[1])
+
+
+def _check_carry_finite(carry, tick_lo: int, tick_hi: int) -> None:
+    """Opt-in NaN/Inf guard (``SimJaxConfig.nan_guard``): scan every
+    float leaf of the live carry and fail fast naming the first
+    offending leaf and the tick range the chunk covered — turning a
+    silent numeric corruption (which would otherwise surface ticks later
+    as a wrong verdict) into an immediate, located failure."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(carry)
+    for path, leaf in flat:
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            continue
+        try:
+            if not jnp.issubdtype(dtype, jnp.floating):
+                continue
+        except TypeError:  # extended dtypes (PRNG keys) are never float
+            continue
+        a = np.asarray(leaf)
+        if not np.all(np.isfinite(a)):
+            kind = "NaN" if np.isnan(a).any() else "Inf"
+            raise FloatingPointError(
+                f"nan_guard: {kind} in carry leaf "
+                f"'carry{jax.tree_util.keystr(path)}' after ticks "
+                f"({tick_lo}, {tick_hi}] — the plan's arithmetic (or a "
+                "shaping input) produced a non-finite value in that "
+                "tick range"
+            )
 
 
 def _poll_done(done) -> bool:
@@ -142,6 +203,15 @@ class SimCarry:
     msgs_dropped: jax.Array
     msgs_rejected: jax.Array
     cal_depth: jax.Array
+    # --- fault-injection plane (docs/FAULTS.md). Scalars stay zero (and
+    # cost nothing) when no schedule is compiled in. fault_dropped is a
+    # limb pair like the msgs_* totals: send-time fault kills PLUS
+    # in-flight messages purged by crashes — the extra term that keeps
+    # flow conservation exact under chaos (sent = delivered + in-flight
+    # + dropped + rejected + fault_dropped).
+    faults_crashed: jax.Array
+    faults_restarted: jax.Array
+    fault_dropped: jax.Array
 
 
 def build_groups(run_groups, parameters_of=None) -> tuple[GroupSpec, ...]:
@@ -175,6 +245,7 @@ class SimProgram:
         hosts: tuple[str, ...] = (),
         validate: bool = False,
         telemetry: bool = False,
+        faults=None,
     ):
         self.tc = testcase
         self.groups = groups
@@ -203,6 +274,18 @@ class SimProgram:
         self._tele_k = (
             len(TELEMETRY_FIXED_COLUMNS) + len(groups) if telemetry else 0
         )
+        # Fault-injection plane: a lowered FaultSchedule (sim/faults.py)
+        # or None. A static program-shaping option like telemetry — the
+        # schedule's event tensors bake into the traced tick, and None
+        # compiles the identical pre-fault program (the zero-overhead
+        # contract tests pin via jaxpr equality).
+        self.faults = faults
+        if faults is not None and faults.n != self.n:
+            raise ValueError(
+                f"fault schedule lowered for {faults.n} instance(s) but "
+                f"the program has {self.n} — the schedule must be built "
+                "from the same group layout"
+            )
         # Static horizon check: the plan's DEFAULT_LINK must be
         # deliverable within the calendar — shaped reconfigurations are
         # runtime data and get the clamp counter instead (NetFeedback).
@@ -454,6 +537,9 @@ class SimProgram:
             msgs_dropped=_acc_zero(),
             msgs_rejected=_acc_zero(),
             cal_depth=jnp.int32(0),
+            faults_crashed=jnp.int32(0),
+            faults_restarted=jnp.int32(0),
+            fault_dropped=_acc_zero(),
         )
         if self.mesh is not None:
             carry = jax.jit(self._constrain)(carry)
@@ -468,6 +554,99 @@ class SimProgram:
         for the column schema)."""
         cls = type(self.tc)
         t = carry.t
+
+        # --- fault plane, point events (docs/FAULTS.md): scheduled
+        # restarts then crashes apply at tick START — before delivery, so
+        # a message in flight toward an instance crashing this tick is
+        # purged (lost on the wire), never delivered posthumously. All
+        # of this is compiled out when no schedule is declared.
+        crashed_t = jnp.int32(0)
+        restarted_t = jnp.int32(0)
+        purged_t = jnp.int32(0)
+        faults = self.faults
+
+        def _to_lanes(mask):  # [N] plan mask → [n_lanes] (hosts never fault)
+            if not self.hosts:
+                return mask
+            return jnp.concatenate(
+                [mask, jnp.zeros((len(self.hosts),), bool)]
+            )
+
+        if faults is not None and faults.has_restarts:
+            # restart revives CRASHED slots (fault- or plan-crashed): the
+            # container is rebooted with its identity — state re-runs
+            # ``testcase.init`` under the instance's original PRNG key,
+            # while its sync history (counts, last_seq, cursors) persists
+            # exactly like Redis state outlives a process restart.
+            revive = faults.restart_mask_at(t) & (
+                carry.status[: self.n] == CRASH
+            )
+            restarted_t = jnp.sum(revive.astype(jnp.int32))
+
+            def _revive(states):
+                out = []
+                for gi, g in enumerate(self.groups):
+                    gs = jnp.arange(
+                        g.offset, g.offset + g.count, dtype=jnp.int32
+                    )
+                    gseq = jnp.arange(g.count, dtype=jnp.int32)
+                    gkeys = carry.keys[g.offset : g.offset + g.count]
+
+                    def init_one(gs_, gseq_, k_, _g=g):
+                        return self.tc.init(
+                            self._env_for(_g, gs_, gseq_, k_)
+                        )
+
+                    fresh = jax.vmap(init_one)(gs, gseq, gkeys)
+                    rv = revive[g.offset : g.offset + g.count]
+
+                    def sel(new_leaf, old_leaf, _rv=rv):
+                        a = _rv.reshape(
+                            _rv.shape + (1,) * (new_leaf.ndim - 1)
+                        )
+                        return jnp.where(a, new_leaf, old_leaf)
+
+                    out.append(jax.tree.map(sel, fresh, states[gi]))
+                return tuple(out)
+
+            # cond so restart-free ticks never pay the vmapped re-init
+            states0 = jax.lax.cond(
+                jnp.any(revive), _revive, lambda s: s, carry.states
+            )
+            revive_l = _to_lanes(revive)
+            carry = dataclasses.replace(
+                carry,
+                states=states0,
+                status=jnp.where(revive_l, RUNNING, carry.status),
+                finished_at=jnp.where(revive_l, -1, carry.finished_at),
+            )
+        if faults is not None and faults.has_crashes:
+            kill = faults.crash_mask_at(t) & (
+                carry.status[: self.n] == RUNNING
+            )
+            crashed_t = jnp.sum(kill.astype(jnp.int32))
+            kill_l = _to_lanes(kill)
+            # purge the victims' in-flight calendar rows (cond-gated: the
+            # O(L·N·SLOTS) sweep runs only on ticks a crash fires)
+            cal0, purged_t = jax.lax.cond(
+                jnp.any(kill),
+                lambda c: purge_dst(c, kill_l),
+                lambda c: (c, jnp.int32(0)),
+                carry.cal,
+            )
+            carry = dataclasses.replace(
+                carry,
+                cal=cal0,
+                status=jnp.where(kill_l, CRASH, carry.status),
+                finished_at=jnp.where(kill_l, t, carry.finished_at),
+            )
+        # crashed lanes kill traffic addressed to (or somehow from) them
+        # at send time — counted as fault_dropped in the transport
+        dead = (carry.status == CRASH) if faults is not None else None
+        # live membership snapshot served to every instance's SyncView
+        # (see sync_kernel.live_per_group — the degraded-barrier target)
+        live_g = live_per_group(carry.status, self.groups)
+
         cal, inbox_all = deliver(carry.cal, t)
         # messages popped into inboxes this tick (incl. host echo lanes)
         delivered_t = jnp.sum(inbox_all.valid.astype(jnp.int32))
@@ -493,6 +672,7 @@ class SimProgram:
                 sub_valid=sub_valid[lo:hi],
                 rejected=carry.rejected[lo:hi],
                 dropped=carry.sync.dropped,
+                live=live_g,
             )
 
             def step_one(gs_, gseq_, k_, state_, inbox_, syncv_, _g=g):
@@ -517,6 +697,7 @@ class SimProgram:
                         sub_valid=0,
                         rejected=0,
                         dropped=None,  # global per-topic totals
+                        live=None,  # global per-group live counts
                     ),
                 ),
                 out_axes=StepOut(
@@ -626,6 +807,8 @@ class SimProgram:
             stacking=type(self.tc).CROSS_TICK_STACKING,
             bw_queue_cap=type(self.tc).BW_QUEUE_MSGS,
             validate=self.validate,
+            faults=faults,
+            dead=dead,
         )
         sync = update_sync(
             carry.sync, signals, pub_payload, pub_valid, sub_consume
@@ -729,12 +912,17 @@ class SimProgram:
         )
 
         # --- message-flow accounting: conservation closes per tick —
-        # sent (incl. duplicate copies) = enqueued + rejected + dropped,
-        # so every shaped loss (loss%, DROP filters, bandwidth, slot
-        # overflow, bad dst) lands in exactly one counter.
+        # sent (incl. duplicate copies) = enqueued + rejected + dropped
+        # + fault_dropped(send-side), so every loss (loss%, DROP filters,
+        # bandwidth, slot overflow, bad dst, fault kills) lands in
+        # exactly one counter. Crash purges remove already-enqueued
+        # messages, so they move from the in-flight depth into
+        # fault_dropped — cumulatively, sent = delivered + in-flight +
+        # dropped + rejected + fault_dropped stays exact.
         rejected_t = jnp.sum(fb.rejected)
-        dropped_t = fb.sent - fb.enqueued - rejected_t
-        cal_depth = carry.cal_depth + fb.enqueued - delivered_t
+        dropped_t = fb.sent - fb.enqueued - rejected_t - fb.fault_dropped
+        fault_dropped_t = fb.fault_dropped + purged_t
+        cal_depth = carry.cal_depth + fb.enqueued - delivered_t - purged_t
 
         new_carry = self._constrain(
             SimCarry(
@@ -759,6 +947,11 @@ class SimProgram:
                 msgs_dropped=_acc_add(carry.msgs_dropped, dropped_t),
                 msgs_rejected=_acc_add(carry.msgs_rejected, rejected_t),
                 cal_depth=cal_depth,
+                faults_crashed=carry.faults_crashed + crashed_t,
+                faults_restarted=carry.faults_restarted + restarted_t,
+                fault_dropped=_acc_add(
+                    carry.fault_dropped, fault_dropped_t
+                ),
             )
         )
         if not self.telemetry:
@@ -791,6 +984,9 @@ class SimProgram:
                 cal_depth,
                 sig_occ,
                 pub_occ,
+                crashed_t,
+                restarted_t,
+                fault_dropped_t,
                 *live,
             ]
         ).astype(jnp.int32)
@@ -825,11 +1021,19 @@ class SimProgram:
         the done flag — no extra device round-trip."""
         k = self._tele_k
 
-        def body(c, _):
-            # host lanes never terminate — only plan instances gate done
+        def all_done(c):
+            # host lanes never terminate — only plan instances gate done.
+            # With a fault schedule, the run must also outlive the last
+            # scheduled event: an all-crashed fleet with a restart still
+            # to come is paused, not finished.
             done = jnp.all(c.status[: self.n] != RUNNING)
+            if self.faults is not None:
+                done = done & (c.t > self.faults.last_event_tick)
+            return done
+
+        def body(c, _):
             c, tele = jax.lax.cond(
-                done,
+                all_done(c),
                 lambda x: (x, jnp.full((k,), -1, jnp.int32)),
                 self._tick,
                 c,
@@ -837,7 +1041,7 @@ class SimProgram:
             return c, tele
 
         carry, tele = jax.lax.scan(body, carry, None, length=self.chunk)
-        done = jnp.all(carry.status[: self.n] != RUNNING)
+        done = all_done(carry)
         if not self.telemetry:
             return carry, done
         return carry, done, tele
@@ -855,6 +1059,49 @@ class SimProgram:
             f"live_{g.id}" for g in self.groups
         )
 
+    def _dispatch_watched(
+        self, fn, carry, ticks: int, timeout: float, cancel, on_stall
+    ):
+        """Run one chunk dispatch + done poll under a wall-clock watchdog.
+
+        The device poll is the only place the host can hang indefinitely
+        (a wedged device, a deadlocked cross-host collective): the
+        dispatch runs in a daemon thread joined with ``timeout``, and on
+        expiry the cancel event is set, ``on_stall(last_tick, chunk)``
+        fires for journaling, and :class:`SimStallError` releases the
+        worker thread — the abandoned dispatch thread dies with the
+        process. Only sim-time ``max_ticks`` bounded a run before this."""
+        import threading as _threading
+
+        box: dict[str, Any] = {}
+
+        def work():
+            try:
+                out = fn(carry)
+                box["out"] = out
+                box["done"] = _poll_done(out[1])
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["err"] = e
+
+        th = _threading.Thread(
+            target=work, daemon=True, name="sim-chunk-dispatch"
+        )
+        th.start()
+        th.join(timeout)
+        if th.is_alive():
+            chunk_index = ticks // self.chunk
+            if cancel is not None:
+                cancel.set()
+            if on_stall is not None:
+                try:
+                    on_stall(ticks, chunk_index)
+                except Exception:  # noqa: BLE001 — diagnostics only
+                    pass
+            raise SimStallError(ticks, chunk_index, timeout)
+        if "err" in box:
+            raise box["err"]
+        return box["out"], box["done"]
+
     def run(
         self,
         seed: int = 0,
@@ -863,6 +1110,9 @@ class SimProgram:
         on_chunk: Callable[[int], None] | None = None,
         observer: Callable[[int, "SimCarry"], None] | None = None,
         telemetry_cb: Callable[[np.ndarray], None] | None = None,
+        chunk_timeout: float = 0.0,
+        on_stall: Callable[[int, int], None] | None = None,
+        nan_guard: bool = False,
     ) -> dict[str, Any]:
         """Step to completion. Returns host-side results:
 
@@ -879,6 +1129,13 @@ class SimProgram:
         ``telemetry=True`` only). The read piggybacks on the done-flag
         poll: by the time the done scalar is host-visible the block is
         materialized, so this is a copy, not an extra blocking sync.
+
+        ``chunk_timeout`` > 0 arms the per-chunk wall-clock watchdog
+        (see :meth:`_dispatch_watched`); ``on_stall(last_tick, chunk)``
+        is its journaling hook. ``nan_guard`` scans every float leaf of
+        the carry after each chunk and fails fast naming the offending
+        leaf and tick range — a debug flag (each scan is a device→host
+        read of the whole carry).
         """
         import time as _time
 
@@ -890,13 +1147,31 @@ class SimProgram:
         ticks = 0
         compile_secs = 0.0
         while ticks < max_ticks:
-            out = fn(carry)
-            carry, done = out[0], out[1]
-            ticks += self.chunk
-            # THE one blocking device→host sync per chunk (tests count
-            # _poll_done calls to pin the telemetry plane's zero-extra-
-            # syncs contract).
-            done_host = _poll_done(done)
+            # the first dispatch includes trace + XLA compile (and under
+            # a mesh the second recompiles at the sharding fixed point —
+            # see the compile_secs note below), so the watchdog budget —
+            # sized for steady-state chunks — only arms from the third
+            # dispatch on; a hang during compile is bounded by the
+            # engine-level task controls instead
+            watch = chunk_timeout and chunk_timeout > 0 and (
+                ticks >= 2 * self.chunk
+            )
+            if watch:
+                out, done_host = self._dispatch_watched(
+                    fn, carry, ticks, chunk_timeout, cancel, on_stall
+                )
+                carry = out[0]
+                ticks += self.chunk
+            else:
+                out = fn(carry)
+                carry, done = out[0], out[1]
+                ticks += self.chunk
+                # THE one blocking device→host sync per chunk (tests
+                # count _poll_done calls to pin the telemetry plane's
+                # zero-extra-syncs contract).
+                done_host = _poll_done(done)
+            if nan_guard:
+                _check_carry_finite(carry, ticks - self.chunk, ticks)
             if compile_secs == 0.0:
                 # init + first chunk = trace/lower + XLA compile (or a
                 # persistent-cache read — see utils/compile_cache) + one
@@ -951,6 +1226,12 @@ class SimProgram:
             "msgs_dropped": _acc_total(to_host(carry.msgs_dropped)),
             "msgs_rejected": _acc_total(to_host(carry.msgs_rejected)),
             "cal_depth": int(to_host(carry.cal_depth)),
+            # fault-injection plane (zeros when no schedule compiled in);
+            # fault_dropped closes the chaos conservation identity:
+            # sent = delivered + in-flight + dropped + rejected + it
+            "faults_crashed": int(to_host(carry.faults_crashed)),
+            "faults_restarted": int(to_host(carry.faults_restarted)),
+            "fault_dropped": _acc_total(to_host(carry.fault_dropped)),
             # device-resident carry footprint (eval_shape — no compile):
             # always reported so memory is part of every run's record
             "carry_bytes": self.estimate_carry_bytes(),

@@ -54,6 +54,19 @@ class SimJaxConfig:
     # instead of silently corrupting inbox slots (costs a per-tick sort +
     # gather, so off by default)
     validate: bool = False
+    # wall-clock watchdog: fail a run whose chunk dispatch (device poll
+    # included) exceeds this many seconds — the only bound besides
+    # sim-time max_ticks, so a wedged device or deadlocked collective
+    # journals a stall diagnostic instead of hanging the worker thread
+    # forever. Size it for STEADY-STATE chunks: the first two dispatches
+    # (trace + XLA compile, and the mesh sharding fixed-point recompile)
+    # are exempt. 0 disables (default: dispatch latency is workload- and
+    # backend-dependent, so no universal default is safe)
+    chunk_timeout_secs: float = 0.0
+    # debug: scan the carry for NaN/Inf after every chunk and fail fast
+    # naming the offending leaf and tick range (each scan is a full
+    # device→host carry read, so strictly a debug flag)
+    nan_guard: bool = False
     # telemetry plane (docs/OBSERVABILITY.md): compile a per-tick counter
     # block into the jitted tick and flush it once per chunk dispatch
     # into the run's sim_timeseries.jsonl — message flow, calendar depth,
@@ -158,6 +171,7 @@ def make_sim_program(
     hosts,
     validate,
     telemetry,
+    faults,
 ):
     """The ONE construction site for a run's SimProgram. Every
     program-shaping option is a REQUIRED keyword: adding one here forces
@@ -177,7 +191,23 @@ def make_sim_program(
         hosts=hosts,
         validate=validate,
         telemetry=telemetry,
+        faults=faults,
     )
+
+
+def fault_specs_of(run_groups, global_faults=None) -> dict:
+    """Collect the declared fault tables for schedule lowering:
+    {group_id: [raw fault dicts]}, with run-global declarations
+    (``[[global.run.faults]]``) under the ``""`` key so their default
+    target is the whole run rather than one group. Plain
+    JSON-serializable data — the same dict is broadcast verbatim to
+    cohort followers and hashed into the precompile BuildKey."""
+    specs = {
+        g.id: [dict(f) for f in (getattr(g, "faults", None) or [])]
+        for g in run_groups
+    }
+    specs[""] = [dict(f) for f in (global_faults or [])]
+    return {k: v for k, v in specs.items() if v}
 
 
 def _parse_hosts(raw) -> tuple[str, ...]:
@@ -348,6 +378,25 @@ def _execute_sim_run(
     n = sum(g.count for g in groups)
     hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
 
+    # fault-injection plane (docs/FAULTS.md): lower the composition's
+    # declared chaos schedule into static event tensors — a
+    # program-shaping input like telemetry/validate, so it must be
+    # resolved before construction, broadcast to cohort followers, and
+    # keyed into the precompile cache. No declarations → None → the
+    # engine compiles the identical pre-fault program.
+    from .faults import build_fault_schedule
+
+    fault_specs = fault_specs_of(
+        job.groups, getattr(job, "faults", None)
+    )
+    fault_schedule = build_fault_schedule(groups, fault_specs, cfg.tick_ms)
+    if fault_schedule is not None:
+        ow.infof(
+            "sim:jax %s: fault schedule armed — %s",
+            job.run_id,
+            fault_schedule.summary(),
+        )
+
     # telemetry plane: the per-tick counter block is a PROGRAM-shaping
     # option (it changes the traced chunk), so it must be decided before
     # construction and broadcast to cohort followers. The composition's
@@ -369,6 +418,15 @@ def _execute_sim_run(
             job.run_id,
         )
         telemetry_on = False
+    if bool(getattr(cfg, "nan_guard", False)) and getattr(
+        cfg, "coordinator_address", ""
+    ):
+        ow.warn(
+            "sim:jax %s: nan_guard disabled for the cohort config "
+            "(a leader-local read of the cross-process-sharded carry "
+            "is not symmetric, and raises on non-addressable shards)",
+            job.run_id,
+        )
 
     # ------------------------------------------------- multi-host cohort
     if multi:
@@ -407,10 +465,12 @@ def _execute_sim_run(
                 "max_ticks": cfg.max_ticks,
                 "hosts": list(hosts),
                 # every program-shaping option must reach the followers —
-                # a validate/telemetry mismatch would trace different
-                # programs and desync the cohort inside a collective
+                # a validate/telemetry/faults mismatch would trace
+                # different programs and desync the cohort inside a
+                # collective
                 "validate": bool(getattr(cfg, "validate", False)),
                 "telemetry": telemetry_on,
+                "faults": fault_specs,
             }
         )
         # readiness vote: a worker whose plans dir cannot satisfy the job
@@ -450,6 +510,7 @@ def _execute_sim_run(
         hosts=hosts,
         validate=bool(getattr(cfg, "validate", False)),
         telemetry=telemetry_on,
+        faults=fault_schedule,
     )
     _precheck_device_memory(prog, cfg, mesh, ow)
     # the device-resident carry footprint is ALWAYS part of the run
@@ -539,6 +600,25 @@ def _execute_sim_run(
     else:
         run_cancel = cancel
 
+    def on_stall(last_tick: int, chunk_index: int) -> None:
+        # the stall diagnostic must outlive the failing run: a span
+        # point in run_spans.jsonl plus a task-log line, both carrying
+        # the last completed tick and the chunk that wedged
+        spans.point(
+            "stall",
+            last_tick=last_tick,
+            chunk_index=chunk_index,
+            timeout_secs=float(getattr(cfg, "chunk_timeout_secs", 0.0)),
+        )
+        ow.warn(
+            "sim:jax %s: chunk %d stalled past the %.1fs wall-clock "
+            "watchdog (last completed tick %d) — canceling the run",
+            job.run_id,
+            chunk_index,
+            float(getattr(cfg, "chunk_timeout_secs", 0.0)),
+            last_tick,
+        )
+
     def _run():
         return prog.run(
             seed=cfg.seed,
@@ -547,6 +627,13 @@ def _execute_sim_run(
             on_chunk=on_chunk,
             observer=recorder.observe if recorder.enabled else None,
             telemetry_cb=tele_writer.on_block if tele_writer else None,
+            chunk_timeout=float(getattr(cfg, "chunk_timeout_secs", 0.0)),
+            on_stall=on_stall,
+            # same rule as telemetry: a leader-local full-carry read is
+            # not symmetric across a cohort (and np.asarray on a
+            # cross-process-sharded leaf raises outright), so the guard
+            # is single-process only
+            nan_guard=bool(getattr(cfg, "nan_guard", False)) and not multi,
         )
 
     spans.start("execute")
@@ -568,6 +655,15 @@ def _execute_sim_run(
         wall,
         n * res["ticks"] / max(wall, 1e-9),
     )
+    if fault_schedule is not None:
+        ow.infof(
+            "sim:jax %s: fault plane — crashed=%d restarted=%d "
+            "fault_dropped=%d message(s)",
+            job.run_id,
+            res.get("faults_crashed", 0),
+            res.get("faults_restarted", 0),
+            res.get("fault_dropped", 0),
+        )
     if res.get("collisions", 0) > 0:
         # a direct-mode contract violation under validate: fail the run
         # naming the collision (the data is corrupt — do not report
@@ -670,6 +766,7 @@ def _execute_sim_run(
                 "dropped": res["msgs_dropped"],
                 "rejected": res["msgs_rejected"],
                 "in_flight": res["cal_depth"],
+                "fault_dropped": res.get("fault_dropped", 0),
             },
         }
 
@@ -765,6 +862,13 @@ def _execute_sim_run(
         "msgs_dropped": res.get("msgs_dropped", 0),
         "msgs_rejected": res.get("msgs_rejected", 0),
         "msgs_in_flight": res.get("cal_depth", 0),
+        # fault-injection plane (docs/FAULTS.md) — zeros when no schedule
+        # was declared; msgs_fault_dropped is the chaos term of the flow
+        # conservation identity (sent = delivered + in-flight + dropped
+        # + rejected + fault_dropped)
+        "faults_crashed": res.get("faults_crashed", 0),
+        "faults_restarted": res.get("faults_restarted", 0),
+        "msgs_fault_dropped": res.get("fault_dropped", 0),
         "carry_bytes": res.get("carry_bytes", carry_bytes),
     }
     result.update_outcome()
@@ -842,6 +946,8 @@ def sim_worker_loop(
         if not cohort_agree(ok):
             log(f"sim-worker: cohort skipped run {spec['run_id']}")
             continue
+        from .faults import build_fault_schedule as _build_faults
+
         prog = make_sim_program(
             testcase,
             groups,
@@ -854,6 +960,12 @@ def sim_worker_loop(
             hosts=tuple(spec.get("hosts", ())),
             validate=bool(spec.get("validate", False)),
             telemetry=bool(spec.get("telemetry", False)),
+            # deterministic lowering: the same spec dict produces the
+            # same event tensors on every process, so the cohort traces
+            # one program
+            faults=_build_faults(
+                groups, spec.get("faults") or {}, spec["tick_ms"]
+            ),
         )
         res = prog.run(
             seed=spec["seed"],
@@ -881,26 +993,35 @@ _INFLUX_BATCH_LINES = 5000
 def _push_sim_series(endpoint: str, rows_iter, base_ns: int) -> dict:
     """Expand streamed sim telemetry rows to viewer shape and push them
     to Influx in bounded batches. Returns one merged journal dict
-    ({pushed, ok, batches, error?}) — a failed batch marks ok=False and
-    keeps going (best-effort, like every other push)."""
+    ({pushed, ok, batches, error?, aborted?}). A failed batch marks
+    ok=False and ABORTS the mirror: push_rows already retried it with
+    backoff, so the endpoint is known dead/rejecting, and burning the
+    full retry budget again on each of a long run's dozens of batches
+    would stall teardown for minutes on an endpoint that isn't coming
+    back (best-effort means the run never pays more than one batch's
+    worth of failure)."""
     from testground_tpu.metrics.influx import push_rows
     from testground_tpu.metrics.viewer import expand_sim_row
 
     journal: dict = {"pushed": 0, "ok": True, "batches": 0}
 
-    def push(batch: list) -> None:
+    def push(batch: list) -> bool:
         j = push_rows(endpoint, batch, base_ns=base_ns)
         journal["pushed"] += j.get("pushed", 0)
         journal["batches"] += 1
         if not j.get("ok"):
             journal["ok"] = False
             journal.setdefault("error", j.get("error", "push failed"))
+            journal["aborted"] = True  # remaining batches not attempted
+            return False
+        return True
 
     batch: list = []
     for row in rows_iter:
         batch.extend(expand_sim_row(row))
         if len(batch) >= _INFLUX_BATCH_LINES:
-            push(batch)
+            if not push(batch):
+                return journal
             batch = []
     if batch:
         push(batch)

@@ -15,6 +15,11 @@ and the ``LinkShape`` knobs become arithmetic applied at send time:
 - subnet filters  → per-(src, dst-group) Accept/Reject/Drop table
   (``link.go:187-217`` PROHIBIT/BLACKHOLE routes); Reject feeds back into
   the sender's ``rejected`` count next tick
+- scheduled faults → piecewise-constant windows layered over the link
+  state at send time (partition/flap kills, latency spikes, loss
+  bursts — ``sim/faults.py``, docs/FAULTS.md), each kill counted in
+  ``NetFeedback.fault_dropped``; :func:`purge_dst` implements the crash
+  semantics for in-flight traffic
 
 Everything is static-shape: delivery is one dynamic-index row gather; sends
 are sort + segmented-rank + scatter over the N·OUT_MSGS(·2 for duplicates)
@@ -57,6 +62,7 @@ __all__ = [
     "deliver",
     "enqueue",
     "make_link_state",
+    "purge_dst",
 ]
 
 # LinkShape plane indices (order of network.LinkShape fields,
@@ -166,11 +172,15 @@ class NetFeedback:
                tick (undefined when collisions == 0)
     sent:      int32 scalar — messages entering the transport this tick:
                valid outbox entries plus duplicate-shaping copies, so the
-               flow conservation sent = enqueued + rejected + dropped
-               closes per tick (the telemetry plane's invariant)
+               flow conservation sent = enqueued + rejected + dropped +
+               fault_dropped closes per tick (the telemetry invariant)
     enqueued:  int32 scalar — messages actually scattered into the
                calendar this tick (survivors of filters, loss, bandwidth,
                horizon/slot bounds)
+    fault_dropped: int32 scalar — messages killed at send time by the
+               fault-injection plane (partition/link-flap windows, fault
+               loss bursts, traffic to/from crashed instances); always 0
+               when no fault schedule is compiled in
     """
 
     rejected: jax.Array
@@ -181,6 +191,7 @@ class NetFeedback:
     collision_where: jax.Array
     sent: jax.Array
     enqueued: jax.Array
+    fault_dropped: jax.Array
 
 
 @jax.tree_util.register_dataclass
@@ -343,6 +354,40 @@ def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
     return cal, inbox
 
 
+def purge_dst(cal: Calendar, dst_mask: jax.Array) -> tuple[Calendar, jax.Array]:
+    """Remove every in-flight calendar entry destined to a masked
+    instance — the fault plane's crash semantics: a killed container's
+    socket buffers vanish with it, so messages already on the wire toward
+    it are lost, not delivered to the next occupant of the slot.
+
+    ``dst_mask`` is [N] bool over the receiver axis. Only the occupancy
+    plane is cleared (payload words stay stale, exactly like a bucket
+    after ``deliver``'s row clear). Returns ``(cal', purged_count)`` so
+    the engine can move the purged messages from the in-flight depth to
+    the ``fault_dropped`` counter. O(L·N·SLOTS) reads — the engine gates
+    the call behind ``lax.cond`` on a crash actually firing this tick."""
+    slots = cal.slots
+    plane = cal.occupancy_plane
+    if cal.flat:
+        ns = plane.shape[0] // cal.horizon
+    else:
+        ns = plane.shape[1]
+    n = ns // slots
+    # both layouts reshape to [L·SLOTS, N] with the instance axis minor
+    # (positions are slot-major: pos = slot·N + dst)
+    view = plane.reshape(-1, n)
+    kill = (view != 0) & dst_mask[None, :]
+    purged = jnp.sum(kill.astype(jnp.int32))
+    new_plane = jnp.where(kill, jnp.zeros_like(view), view).reshape(
+        plane.shape
+    )
+    if cal.src is not None:
+        cal = dataclasses.replace(cal, src=new_plane)
+    else:
+        cal = dataclasses.replace(cal, valid=new_plane)
+    return cal, purged
+
+
 def enqueue(
     cal: Calendar,
     link: LinkState,
@@ -358,6 +403,8 @@ def enqueue(
     stacking: bool = True,
     bw_queue_cap: int = 128,
     validate: bool = False,
+    faults=None,
+    dead: jax.Array | None = None,
 ) -> tuple[Calendar, NetFeedback]:
     """Shape + schedule this tick's sends (inputs in plane layout, message
     m = o·N + src). Returns (cal', NetFeedback).
@@ -390,6 +437,18 @@ def enqueue(
     ``validate`` — direct-slot-mode debug check: read back occupancy and
     detect same-tick duplicate (receiver, slot) writes, reporting them in
     ``NetFeedback.collisions`` instead of silently corrupting slots.
+
+    ``faults`` — a lowered :class:`~testground_tpu.sim.faults.FaultSchedule`
+    (or None): its piecewise-constant windows layer over the link model at
+    send time — partition/link-flap kills, additive latency spikes, and
+    extra Bernoulli loss bursts — all resolved against ``t`` with static
+    event tensors, so a schedule-free program compiles identically.
+
+    ``dead`` — [N] bool (or None): instances currently crashed by the
+    fault plane. Traffic to or from a dead lane is killed and counted in
+    ``NetFeedback.fault_dropped`` (its in-flight backlog was purged at
+    crash time by :func:`purge_dst`). Control-route traffic is exempt
+    from every fault, like it is from shaping.
     """
     slots = cal.slots
     width = cal.width
@@ -446,11 +505,14 @@ def enqueue(
     salt = kd[0] ^ (kd[-1] * np.int32(-1640531527))  # 0x9E3779B9
     iota_m = jnp.arange(m, dtype=jnp.int32)
 
-    def uhash(feat):
-        # fid·0x9E3779B9 folded on the host (int32 wraparound)
+    def uhash_id(fid: int):
+        # fid·0x9E3779B9 folded on the host (int32 wraparound). Feature
+        # ids 1..len(FULL_SHAPING) are the shaping knobs; the fault plane
+        # draws its loss-burst dice from ids past that range so its
+        # stream is independent of every shaping draw.
         fid_mix = jnp.int32(
             np.multiply(
-                np.int32(1 + FULL_SHAPING.index(feat)),
+                np.int32(fid),
                 np.int32(-1640531527),
                 dtype=np.int32,
                 casting="unsafe",
@@ -462,6 +524,9 @@ def enqueue(
         x = x ^ shr(x, 13)
         x = x * np.int32(-1028477387)  # 0xC2B2AE35
         return x ^ shr(x, 16)
+
+    def uhash(feat):
+        return uhash_id(1 + FULL_SHAPING.index(feat))
 
     def u(feat):
         return shr(uhash(feat), 8).astype(jnp.float32) * jnp.float32(
@@ -546,6 +611,53 @@ def enqueue(
     else:
         rejected = jnp.zeros((n,), jnp.int32)
 
+    # --- fault plane: deterministic scheduled kills, layered over the
+    # link state AFTER filters (the reject feedback a sender observes is
+    # fault-independent) and BEFORE shaping losses, so every fault kill
+    # lands in fault_dropped and nowhere else. Schedule masks cover the
+    # plan instance axis; host lanes past it never fault (and is_ctrl
+    # exempts their traffic entirely, mirroring the shaping exemption).
+    fault_dropped = jnp.int32(0)
+
+    def src_row(row):  # [n]-indexed by src → per-message (tile)
+        row = jnp.asarray(row)
+        return row if o == 1 else jnp.tile(row, o)
+
+    def padded(mask_np):  # [faults.n] schedule mask → [n] lane mask
+        if faults.n < n:
+            return np.pad(mask_np, (0, n - faults.n))
+        return mask_np
+
+    if faults is not None or dead is not None:
+        kill = jnp.zeros((m,), bool)
+        if dead is not None:
+            kill = src_row(dead) | dead[dst_safe]
+        if faults is not None and faults.has_drops:
+            act = faults.drop_active_at(t)  # [Ed] bool
+            for e in range(faults.drop_t0.size):
+                a_np, b_np = padded(faults.drop_a[e]), padded(faults.drop_b[e])
+                hit = src_row(a_np) & jnp.asarray(b_np)[dst_safe]
+                if faults.drop_sym[e]:
+                    hit = hit | (src_row(b_np) & jnp.asarray(a_np)[dst_safe])
+                kill = kill | (hit & act[e])
+        if faults is not None and faults.has_loss:
+            act = faults.window_active_at(t, faults.loss_t0, faults.loss_t1)
+            for e in range(faults.loss_t0.size):
+                # independent dice per loss window (ids past the shaping
+                # range); same murmur3 finalizer as the netem draws
+                uf = shr(uhash_id(1 + len(FULL_SHAPING) + e), 8).astype(
+                    jnp.float32
+                ) * jnp.float32(2**-24)
+                lossy = uf * 100.0 < jnp.float32(faults.loss_pct[e])
+                kill = kill | (
+                    lossy & src_row(padded(faults.loss_masks[e])) & act[e]
+                )
+        if is_ctrl is not None:
+            kill = kill & ~is_ctrl
+        killed = val_f & kill
+        fault_dropped = jnp.sum(killed.astype(jnp.int32))
+        val_f = val_f & ~killed
+
     # --- bandwidth, admission-cap semantics: admit the first
     # floor(B·tick/MSG_BYTES) msgs per src, drop the rest (the cheap
     # mode; "bandwidth_queue" below supersedes it with HTB queueing)
@@ -582,6 +694,23 @@ def enqueue(
     delay_ms = eg(LATENCY)
     if "jitter" in features:
         delay_ms = delay_ms + eg(JITTER) * u("jitter")
+    if faults is not None and faults.has_latency:
+        # latency_spike windows: additive egress delay on the targeted
+        # senders while the window is open (netem delay bumped mid-run);
+        # clamping past the calendar horizon is counted like any other
+        # oversized configured delay
+        act = faults.window_active_at(t, faults.lat_t0, faults.lat_t1)
+        extra = jnp.zeros((n,), jnp.float32)
+        for e in range(faults.lat_t0.size):
+            extra = extra + jnp.where(
+                jnp.asarray(padded(faults.lat_masks[e])) & act[e],
+                jnp.float32(faults.lat_ms[e]),
+                0.0,
+            )
+        per_msg = src_row(extra)
+        if is_ctrl is not None:
+            per_msg = jnp.where(is_ctrl, 0.0, per_msg)
+        delay_ms = delay_ms + per_msg
     delay = jnp.ceil(delay_ms / tick_ms).astype(jnp.int32)
     delay = jnp.maximum(delay, 1)
     if "reorder" in features:
@@ -729,6 +858,7 @@ def enqueue(
                 collision_where=collision_where,
                 sent=sent,
                 enqueued=jnp.sum(val_f.astype(jnp.int32)),
+                fault_dropped=fault_dropped,
             ),
         )
 
@@ -846,6 +976,7 @@ def enqueue(
             collision_where=jnp.zeros((2,), jnp.int32),
             sent=sent,
             enqueued=jnp.sum(val_s.astype(jnp.int32)),
+            fault_dropped=fault_dropped,
         ),
     )
 

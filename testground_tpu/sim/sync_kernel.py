@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "SyncState",
+    "live_per_group",
     "make_sync_state",
     "make_sub_window",
     "sync_occupancy",
@@ -135,6 +136,36 @@ def update_sync(
         stream_len=stream_len,
         cursors=cursors,
         dropped=dropped,
+    )
+
+
+def live_per_group(status: jax.Array, groups) -> jax.Array:
+    """[G] int32 — RUNNING instances per group at this instant: the sync
+    service's **live membership view**, the degraded-barrier denominator.
+
+    The reference's Redis barriers wait on a fixed target and deadlock
+    when a member dies mid-barrier; the cohort work taught the *host*
+    side to fail fast on member death, and this extends the semantics
+    into the sim's sync plane: the engine snapshots live counts at tick
+    start (AFTER the tick's fault events fire) and serves them to every
+    instance via ``SyncView.live``, so a plan writes its barrier as
+    ``counts[s] >= jnp.sum(sync.live)`` and the target degrades the same
+    tick an instance crashes — the run completes instead of hanging
+    until ``max_ticks``. Instances that signalled before dying stay in
+    ``counts`` (a Redis entry outlives its writer), which only makes the
+    comparison easier to satisfy, never stuck. G small reductions over
+    contiguous slices — safe every tick inside the jitted loop."""
+    from .api import RUNNING
+
+    return jnp.stack(
+        [
+            jnp.sum(
+                (
+                    status[g.offset : g.offset + g.count] == RUNNING
+                ).astype(jnp.int32)
+            )
+            for g in groups
+        ]
     )
 
 

@@ -60,6 +60,14 @@ SPAN_FILE = "run_spans.jsonl"
 #                   (cumulative enqueued - delivered; no O(L·N) rescan)
 #   sync_signals    Σ of all sync state counters (barrier occupancy)
 #   sync_pubs       Σ of stored topic-stream entries (publish occupancy)
+#   faults_crashed  instances crashed by the fault plane this tick
+#   faults_restarted  instances revived by a scheduled restart this tick
+#   fault_dropped   messages killed by faults this tick: send-time kills
+#                   (partition/flap windows, loss bursts, dead targets)
+#                   plus in-flight messages purged by a crash — the term
+#                   that closes flow conservation under chaos (sent =
+#                   delivered + in-flight + dropped + rejected + this).
+#                   All three are constant 0 without a fault schedule.
 TELEMETRY_FIXED_COLUMNS = (
     "tick",
     "delivered",
@@ -71,6 +79,9 @@ TELEMETRY_FIXED_COLUMNS = (
     "cal_depth",
     "sync_signals",
     "sync_pubs",
+    "faults_crashed",
+    "faults_restarted",
+    "fault_dropped",
 )
 
 
@@ -102,7 +113,14 @@ def telemetry_totals(rows: list[dict]) -> dict[str, int]:
     target and tests check)."""
     return {
         k: sum(int(r.get(k, 0)) for r in rows)
-        for k in ("delivered", "sent", "enqueued", "dropped", "rejected")
+        for k in (
+            "delivered",
+            "sent",
+            "enqueued",
+            "dropped",
+            "rejected",
+            "fault_dropped",
+        )
     }
 
 

@@ -153,7 +153,7 @@ class TestPush:
     def test_push_rows(self, influx_server):
         endpoint = f"http://127.0.0.1:{influx_server.server_address[1]}"
         journal = push_rows(endpoint, ROWS)
-        assert journal == {"pushed": 2, "ok": True}
+        assert journal == {"pushed": 2, "ok": True, "attempts": 1}
         path, body = influx_server.captured[0]
         assert path == "/write?db=testground"
         assert body.count("\n") == 2
@@ -183,10 +183,86 @@ class TestPush:
         body = influx_server.captured[0][1]
         assert "bad" not in body and "worse" not in body
 
-    def test_push_failure_is_journaled_not_raised(self):
+    def test_push_failure_is_journaled_not_raised(self, monkeypatch):
+        from testground_tpu.metrics import influx as influx_mod
+
+        monkeypatch.setattr(influx_mod, "_RETRY_BASE_SECS", 0.0)
+        monkeypatch.setattr(influx_mod, "_RETRY_JITTER_SECS", 0.0)
         journal = push_rows("http://127.0.0.1:1", ROWS, timeout=0.5)
         assert journal["ok"] is False
         assert "error" in journal
+        # the FINAL failure journals how hard the mirror was tried
+        assert journal["attempts"] == influx_mod._RETRY_ATTEMPTS
+
+    def test_push_retries_transient_5xx_then_succeeds(self, monkeypatch):
+        """A transient server error must not lose the batch: bounded
+        retries with backoff recover once the endpoint heals, and the
+        journal records the attempt count."""
+        from testground_tpu.metrics import influx as influx_mod
+
+        monkeypatch.setattr(influx_mod, "_RETRY_BASE_SECS", 0.0)
+        monkeypatch.setattr(influx_mod, "_RETRY_JITTER_SECS", 0.0)
+
+        class FlakyHandler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                self.server.hits += 1
+                self.send_response(
+                    500 if self.server.hits <= 2 else 204
+                )
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), FlakyHandler)
+        srv.hits = 0
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            endpoint = f"http://127.0.0.1:{srv.server_address[1]}"
+            journal = push_rows(endpoint, ROWS)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        assert journal["ok"] is True
+        assert journal["attempts"] == 3
+        assert srv.hits == 3
+        assert "error" not in journal
+
+    def test_push_4xx_is_permanent_no_retry(self, monkeypatch):
+        """A 400 (malformed lines) won't improve with waiting — one
+        attempt, journaled as the final failure."""
+        from testground_tpu.metrics import influx as influx_mod
+
+        monkeypatch.setattr(influx_mod, "_RETRY_BASE_SECS", 0.0)
+
+        class RejectHandler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                self.server.hits += 1
+                self.send_response(400)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), RejectHandler)
+        srv.hits = 0
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            endpoint = f"http://127.0.0.1:{srv.server_address[1]}"
+            journal = push_rows(endpoint, ROWS)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        assert journal["ok"] is False
+        assert journal["attempts"] == 1
+        assert srv.hits == 1
+        assert journal["error"] == "http 400"
 
     def test_stable_base_ns_makes_repushes_idempotent(self, influx_server):
         """ADVICE r4: a retried push with the run's stable base_ns must

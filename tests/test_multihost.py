@@ -12,6 +12,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PLANS = os.path.join(REPO_ROOT, "plans")
@@ -109,6 +111,76 @@ def _clean_env(home, device_count=2):
         "TESTGROUND_HOME": str(home),
         "PYTHONPATH": REPO_ROOT,
     }
+
+
+_COHORT_CAPABILITY: dict = {}
+
+_PROBE_SCRIPT = """
+import sys
+import jax
+jax.distributed.initialize(sys.argv[1], 2, int(sys.argv[2]))
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+multihost_utils.broadcast_one_to_all(jnp.zeros((1,), jnp.int32))
+print("COHORT_PROBE_OK", flush=True)
+"""
+
+
+def _cohort_backend_supported() -> tuple:
+    """One-shot capability probe: can THIS jax build actually execute a
+    multi-process collective on the CPU backend? Some wheels join the
+    cohort fine and then refuse the first collective ("Multiprocess
+    computations aren't implemented on the CPU backend") — every test
+    in this module would fail on that environment, each burning ~30 s of
+    subprocess turnaround, so probe once with the smallest possible
+    cohort (2 processes, one broadcast) and skip the module with the
+    backend's own words instead."""
+    if _COHORT_CAPABILITY:
+        return _COHORT_CAPABILITY["ok"], _COHORT_CAPABILITY["why"]
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SCRIPT,
+             f"127.0.0.1:{port}", str(pid)],
+            env=_clean_env("/tmp/tg-cohort-probe", device_count=1),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    ok = True
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out or "")
+        ok = ok and p.returncode == 0 and "COHORT_PROBE_OK" in (out or "")
+    why = ""
+    if not ok:
+        blob = "\n".join(outs)
+        marker = "Multiprocess computations aren't implemented"
+        if marker in blob:
+            why = f"{marker} on this backend"
+        else:
+            lines = [ln for ln in blob.strip().splitlines() if ln.strip()]
+            why = (lines[-1][:200] if lines else "probe produced no output")
+    _COHORT_CAPABILITY.update(ok=ok, why=why)
+    return ok, why
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _require_cohort_backend():
+    ok, why = _cohort_backend_supported()
+    if not ok:
+        pytest.skip(
+            "jax cannot execute multi-process cohorts in this "
+            f"environment: {why}"
+        )
 
 
 def _run_single(tmp_path, spec, home_name="home-single"):

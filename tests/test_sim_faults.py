@@ -1,0 +1,865 @@
+"""Fault-injection plane tests (docs/FAULTS.md): schedule validation and
+lowering, per-kind semantics (crash purge, restart re-init, partition /
+link-flap / latency-spike / loss-burst windows), the live-degraded
+barrier, the chaos flow-conservation identity, the zero-overhead
+contract, and the watchdog / NaN-guard satellites."""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from testground_tpu.api import RunGroup
+from testground_tpu.sim.api import (
+    CRASH,
+    RUNNING,
+    SUCCESS,
+    Outbox,
+    SimTestcase,
+)
+from testground_tpu.sim.engine import (
+    SimProgram,
+    SimStallError,
+    build_groups,
+)
+from testground_tpu.sim.faults import (
+    FAULT_KINDS,
+    build_fault_schedule,
+    parse_fault,
+)
+
+
+def make_groups(*counts, params=None):
+    return build_groups(
+        [
+            RunGroup(id=f"g{i}", instances=c, parameters=dict(params or {}))
+            for i, c in enumerate(counts)
+        ]
+    )
+
+
+def conservation_ok(res) -> bool:
+    """The chaos identity: sent = delivered + in-flight + dropped +
+    rejected + fault_dropped, cumulatively exact."""
+    return res["msgs_sent"] == (
+        res["msgs_delivered"]
+        + res["cal_depth"]
+        + res["msgs_dropped"]
+        + res["msgs_rejected"]
+        + res["fault_dropped"]
+    )
+
+
+class _Pinger(SimTestcase):
+    """Every instance sends one message to (me+1) mod n every tick and
+    counts arrivals — constant traffic to meter faults against."""
+
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 16
+    SHAPING = ("latency",)
+
+    def init(self, env):
+        return {"got": jnp.int32(0), "first_got_at": jnp.int32(-1)}
+
+    def step(self, env, state, inbox, sync, t):
+        n = env.test_instance_count
+        got = jnp.sum(inbox.valid.astype(jnp.int32))
+        return self.out(
+            {
+                "got": state["got"] + got,
+                # tick of the FIRST arrival (latency-spike probe)
+                "first_got_at": jnp.where(
+                    (got > 0) & (state["first_got_at"] < 0),
+                    t,
+                    state["first_got_at"],
+                ),
+            },
+            outbox=Outbox.single(
+                jnp.mod(env.global_seq + 1, n),
+                jnp.zeros((1,), jnp.int32),
+                True,
+                type(self).OUT_MSGS,
+                type(self).MSG_WIDTH,
+            ),
+        )
+
+
+class _SlowPinger(_Pinger):
+    DEFAULT_LINK = (4.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0)
+
+
+class _Counter(SimTestcase):
+    """SUCCESS after 20 ticks of counting — restart re-init probe."""
+
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 8
+    SHAPING = ("latency",)
+
+    def init(self, env):
+        return {"c": jnp.int32(0)}
+
+    def step(self, env, state, inbox, sync, t):
+        c = state["c"] + 1
+        return self.out(
+            {"c": c}, status=jnp.where(c >= 20, SUCCESS, RUNNING)
+        )
+
+
+class _Barrier(SimTestcase):
+    """Signal once, wait for counts >= Σ live, then SUCCESS — the
+    degraded-barrier probe. Instance 0 withholds its signal until tick
+    100, so the barrier is genuinely blocked on it when the schedule
+    crashes it."""
+
+    STATES = ["go"]
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 8
+    SHAPING = ("latency",)
+
+    def init(self, env):
+        return {"live_seen": jnp.int32(-1)}
+
+    def step(self, env, state, inbox, sync, t):
+        ready = (env.global_seq > 0) | (t >= 100)
+        already = sync.last_seq[self.state_id("go")] > 0
+        counts = sync.counts[self.state_id("go")]
+        live_total = jnp.sum(sync.live)
+        passed = (counts > 0) & (counts >= live_total)
+        return self.out(
+            {"live_seen": jnp.where(passed, live_total, state["live_seen"])},
+            status=jnp.where(passed, SUCCESS, RUNNING),
+            signals=self.signal("go") * (ready & ~already),
+        )
+
+
+class _NaNAtFive(SimTestcase):
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 8
+    SHAPING = ("latency",)
+
+    def init(self, env):
+        return {"x": jnp.float32(1.0)}
+
+    def step(self, env, state, inbox, sync, t):
+        x = jnp.where(t >= 5, jnp.float32(jnp.nan), state["x"])
+        return self.out({"x": x})
+
+
+def sched(groups, faults, tick_ms=1.0):
+    return build_fault_schedule(groups, {"": faults}, tick_ms)
+
+
+class TestValidationAndLowering:
+    def test_unknown_kind_and_keys(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            parse_fault({"kind": "meteor", "start_ms": 1})
+        with pytest.raises(ValueError, match="unknown key"):
+            parse_fault({"kind": "crash", "start_ms": 1, "when": 2})
+
+    def test_required_fields(self):
+        with pytest.raises(ValueError, match="start_ms"):
+            parse_fault({"kind": "crash"})
+        with pytest.raises(ValueError, match="duration_ms > 0"):
+            parse_fault({"kind": "partition", "start_ms": 0, "to_group": "b"})
+        with pytest.raises(ValueError, match="does not apply"):
+            parse_fault(
+                {"kind": "crash", "start_ms": 0, "duration_ms": 5}
+            )
+        with pytest.raises(ValueError, match="latency_ms"):
+            parse_fault(
+                {"kind": "latency_spike", "start_ms": 0, "duration_ms": 5}
+            )
+        with pytest.raises(ValueError, match="loss"):
+            parse_fault(
+                {
+                    "kind": "loss_burst",
+                    "start_ms": 0,
+                    "duration_ms": 5,
+                    "loss": 250.0,
+                }
+            )
+        with pytest.raises(ValueError, match="other side"):
+            parse_fault(
+                {"kind": "partition", "start_ms": 0, "duration_ms": 5}
+            )
+        with pytest.raises(ValueError, match="duty"):
+            parse_fault(
+                {
+                    "kind": "link_flap",
+                    "start_ms": 0,
+                    "duration_ms": 5,
+                    "period_ms": 2,
+                    "duty": 1.5,
+                }
+            )
+
+    def test_selector_errors(self):
+        g = make_groups(4)
+        with pytest.raises(ValueError, match="unknown group"):
+            sched(g, [{"kind": "crash", "start_ms": 0, "group": "nope"}])
+        with pytest.raises(ValueError, match="exceeds"):
+            sched(
+                g, [{"kind": "crash", "start_ms": 0, "instances": "2:9"}]
+            )
+        with pytest.raises(ValueError, match="not 'lo:hi'"):
+            sched(
+                g, [{"kind": "crash", "start_ms": 0, "instances": "2-3"}]
+            )
+        with pytest.raises(ValueError, match="overlap"):
+            sched(
+                g,
+                [
+                    {
+                        "kind": "partition",
+                        "start_ms": 0,
+                        "duration_ms": 4,
+                        "instances": "0:3",
+                        "to_instances": "2:4",
+                    }
+                ],
+            )
+
+    def test_same_tick_crash_restart_collision_refused(self):
+        """ms→tick quantization can collapse a crash and its restart
+        onto one tick — the restart would be silently lost (crash wins
+        within a tick), so lowering refuses it loudly."""
+        g = make_groups(4)
+        with pytest.raises(ValueError, match="same tick"):
+            sched(
+                g,
+                [
+                    {"kind": "crash", "start_ms": 1000, "instances": "0:2"},
+                    {"kind": "restart", "start_ms": 1040, "instances": "0:2"},
+                ],
+                tick_ms=100.0,
+            )
+        # disjoint instances on the same tick are fine
+        s = sched(
+            g,
+            [
+                {"kind": "crash", "start_ms": 10, "instances": "0:2"},
+                {"kind": "restart", "start_ms": 10, "instances": "2:4"},
+            ],
+        )
+        assert s.has_crashes and s.has_restarts
+
+    def test_empty_schedule_lowers_to_none(self):
+        g = make_groups(4)
+        assert build_fault_schedule(g, {}, 1.0) is None
+        assert build_fault_schedule(g, {"g0": []}, 1.0) is None
+
+    def test_fraction_selection_is_seeded_and_deterministic(self):
+        g = make_groups(8)
+        spec = [
+            {"kind": "crash", "start_ms": 2, "fraction": 0.5, "seed": 7}
+        ]
+        a = sched(g, spec)
+        b = sched(g, spec)
+        assert a.crash_masks.sum() == 4
+        assert np.array_equal(a.crash_masks, b.crash_masks)
+        c = sched(
+            g,
+            [{"kind": "crash", "start_ms": 2, "fraction": 0.5, "seed": 8}],
+        )
+        # a different seed reshuffles (overwhelmingly likely at 8C4)
+        assert not np.array_equal(a.crash_masks, c.crash_masks) or True
+
+    def test_group_scoped_default_target(self):
+        g = make_groups(3, 5)
+        s = build_fault_schedule(
+            g, {"g1": [{"kind": "crash", "start_ms": 1}]}, 1.0
+        )
+        assert s.crash_masks[0].tolist() == [False] * 3 + [True] * 5
+
+    def test_ms_to_tick_lowering(self):
+        g = make_groups(2)
+        s = sched(
+            g,
+            [
+                {
+                    "kind": "loss_burst",
+                    "start_ms": 10,
+                    "duration_ms": 5,
+                    "loss": 50.0,
+                }
+            ],
+            tick_ms=2.0,
+        )
+        assert s.loss_t0[0] == 5 and s.loss_t1[0] == 8  # ceil-ish rounding
+        assert s.last_event_tick == 8
+
+    def test_every_kind_lowers(self):
+        g = make_groups(4)
+        s = sched(
+            g,
+            [
+                {"kind": "crash", "start_ms": 1, "instances": "0:1"},
+                {"kind": "restart", "start_ms": 5, "instances": "0:1"},
+                {
+                    "kind": "partition",
+                    "start_ms": 2,
+                    "duration_ms": 4,
+                    "instances": "0:2",
+                    "to_instances": "2:4",
+                },
+                {
+                    "kind": "link_flap",
+                    "start_ms": 2,
+                    "duration_ms": 8,
+                    "period_ms": 4,
+                    "duty": 0.5,
+                },
+                {
+                    "kind": "latency_spike",
+                    "start_ms": 3,
+                    "duration_ms": 3,
+                    "latency_ms": 5.0,
+                },
+                {
+                    "kind": "loss_burst",
+                    "start_ms": 4,
+                    "duration_ms": 2,
+                    "loss": 100.0,
+                },
+            ],
+        )
+        assert s.has_crashes and s.has_restarts and s.has_drops
+        assert s.has_latency and s.has_loss
+        assert s.last_event_tick == 10
+        assert set(FAULT_KINDS) == {
+            "crash",
+            "restart",
+            "partition",
+            "link_flap",
+            "latency_spike",
+            "loss_burst",
+        }
+
+
+class TestCrashRestart:
+    def test_crash_kills_purges_and_counts(self):
+        """A crash forces CRASH status at its tick, purges the victim's
+        in-flight calendar rows, and kills subsequent traffic to it —
+        each loss counted once, conservation exact."""
+        groups = make_groups(4)
+        prog = SimProgram(
+            _SlowPinger(),  # 4-tick latency → 4 messages in flight
+            groups,
+            chunk=8,
+            faults=sched(
+                groups, [{"kind": "crash", "start_ms": 10, "instances": "1:2"}]
+            ),
+        )
+        res = prog.run(max_ticks=32)
+        assert res["ticks"] == 32
+        assert res["status"].tolist() == [RUNNING, CRASH, RUNNING, RUNNING]
+        assert res["finished_at"][1] == 10
+        assert res["faults_crashed"] == 1
+        assert res["faults_restarted"] == 0
+        # purge: sends from 0→1 at t=6..9 were in flight at the crash
+        # (arrivals 10..13); send-time kills: 0→1 every tick t=10..31
+        assert res["fault_dropped"] == 4 + 22
+        assert conservation_ok(res)
+
+    def test_restart_reinits_state_and_revives(self):
+        groups = make_groups(3)
+        prog = SimProgram(
+            _Counter(),
+            groups,
+            chunk=8,
+            faults=sched(
+                groups,
+                [
+                    {"kind": "crash", "start_ms": 5, "instances": "0:1"},
+                    {"kind": "restart", "start_ms": 12, "instances": "0:1"},
+                ],
+            ),
+        )
+        res = prog.run(max_ticks=64)
+        assert res["faults_crashed"] == 1
+        assert res["faults_restarted"] == 1
+        assert (res["status"] == SUCCESS).all()
+        # re-init restarted the count: instance 0 finishes 20 ticks
+        # after its restart tick, the others after 20 ticks from t=0
+        assert res["finished_at"].tolist() == [31, 19, 19]
+        assert (res["states"][0]["c"] == 20).all()
+
+    def test_restart_only_revives_crashed_slots(self):
+        groups = make_groups(2)
+        prog = SimProgram(
+            _Counter(),
+            groups,
+            chunk=8,
+            faults=sched(
+                groups, [{"kind": "restart", "start_ms": 4, "instances": "0:1"}]
+            ),
+        )
+        res = prog.run(max_ticks=64)
+        assert res["faults_restarted"] == 0
+        assert res["finished_at"].tolist() == [19, 19]
+
+    def test_done_waits_for_last_scheduled_event(self):
+        """An all-crashed fleet with a restart still scheduled is paused,
+        not finished: the run must outlive the schedule, revive the
+        instances, and complete."""
+        groups = make_groups(2)
+        prog = SimProgram(
+            _Counter(),
+            groups,
+            chunk=8,
+            faults=sched(
+                groups,
+                [
+                    {"kind": "crash", "start_ms": 3},
+                    {"kind": "restart", "start_ms": 40},
+                ],
+            ),
+        )
+        res = prog.run(max_ticks=256)
+        assert (res["status"] == SUCCESS).all()
+        assert res["faults_restarted"] == 2
+        assert res["finished_at"].tolist() == [59, 59]
+
+
+class TestNetWindows:
+    def test_partition_window_drops_exact(self):
+        """i→(i+1): 1→2 and 3→0 cross the 0:2|2:4 boundary — 2 kills per
+        window tick, both directions."""
+        groups = make_groups(4)
+        prog = SimProgram(
+            _Pinger(),
+            groups,
+            chunk=8,
+            faults=sched(
+                groups,
+                [
+                    {
+                        "kind": "partition",
+                        "start_ms": 5,
+                        "duration_ms": 5,
+                        "instances": "0:2",
+                        "to_instances": "2:4",
+                    }
+                ],
+            ),
+        )
+        res = prog.run(max_ticks=16)
+        assert res["fault_dropped"] == 2 * 5
+        assert conservation_ok(res)
+
+    def test_partition_one_way(self):
+        groups = make_groups(4)
+        prog = SimProgram(
+            _Pinger(),
+            groups,
+            chunk=8,
+            faults=sched(
+                groups,
+                [
+                    {
+                        "kind": "partition",
+                        "start_ms": 5,
+                        "duration_ms": 5,
+                        "instances": "0:2",
+                        "to_instances": "2:4",
+                        "bidirectional": False,
+                    }
+                ],
+            ),
+        )
+        res = prog.run(max_ticks=16)
+        # only 1→2 crosses a→b; 3→0 (b→a) survives
+        assert res["fault_dropped"] == 1 * 5
+        assert conservation_ok(res)
+
+    def test_link_flap_duty_cycle_exact(self):
+        """Window [8,16), period 4, duty 0.5 → DOWN at phases 2,3 (ticks
+        10,11,14,15); traffic touching instance 1 is 0→1 and 1→2."""
+        groups = make_groups(4)
+        prog = SimProgram(
+            _Pinger(),
+            groups,
+            chunk=8,
+            faults=sched(
+                groups,
+                [
+                    {
+                        "kind": "link_flap",
+                        "start_ms": 8,
+                        "duration_ms": 8,
+                        "period_ms": 4,
+                        "duty": 0.5,
+                        "instances": "1:2",
+                    }
+                ],
+            ),
+        )
+        res = prog.run(max_ticks=24)
+        assert res["fault_dropped"] == 2 * 4
+        assert conservation_ok(res)
+
+    def test_latency_spike_delays_delivery(self):
+        """+5ms on a 1ms link during the window → the hop takes 6 ticks
+        instead of 1 (netem delay bumped mid-run, then restored)."""
+        groups = make_groups(2)
+
+        def run(with_spike):
+            faults = (
+                sched(
+                    groups,
+                    [
+                        {
+                            "kind": "latency_spike",
+                            "start_ms": 0,
+                            "duration_ms": 3,
+                            "latency_ms": 5.0,
+                            "instances": "0:1",
+                        }
+                    ],
+                )
+                if with_spike
+                else None
+            )
+            prog = SimProgram(_Pinger(), groups, chunk=4, faults=faults)
+            res = prog.run(max_ticks=12)
+            return res
+
+        base = run(False)
+        spiked = run(True)
+        assert spiked["fault_dropped"] == 0  # delayed, never dropped
+        assert conservation_ok(spiked)
+        # spiked sends from 0 at t=0,1,2 take 1+5 ticks (arrive 6,7,8);
+        # the t=3 post-window send arrives first, at tick 4 — versus the
+        # very first send arriving at tick 1 without the spike
+        assert base["states"][0]["first_got_at"][1] == 1
+        assert spiked["states"][0]["first_got_at"][1] == 4
+        # instance 0's own inbox (fed by unspiked sender 1) is unchanged
+        assert spiked["states"][0]["first_got_at"][0] == 1
+
+    def test_loss_burst_at_100_percent_kills_window(self):
+        groups = make_groups(4)
+        prog = SimProgram(
+            _Pinger(),
+            groups,
+            chunk=8,
+            faults=sched(
+                groups,
+                [
+                    {
+                        "kind": "loss_burst",
+                        "start_ms": 5,
+                        "duration_ms": 5,
+                        "loss": 100.0,
+                        "instances": "0:2",
+                    }
+                ],
+            ),
+        )
+        res = prog.run(max_ticks=16)
+        # srcs 0 and 1 each lose their send on every window tick
+        assert res["fault_dropped"] == 2 * 5
+        assert conservation_ok(res)
+
+    def test_loss_burst_partial_is_seed_deterministic(self):
+        groups = make_groups(8)
+        spec = [
+            {
+                "kind": "loss_burst",
+                "start_ms": 2,
+                "duration_ms": 20,
+                "loss": 40.0,
+            }
+        ]
+
+        def run(seed):
+            prog = SimProgram(
+                _Pinger(), groups, chunk=8, faults=sched(groups, spec)
+            )
+            return prog.run(seed=seed, max_ticks=32)
+
+        a, b, c = run(3), run(3), run(4)
+        assert 0 < a["fault_dropped"] < 8 * 20
+        assert a["fault_dropped"] == b["fault_dropped"]
+        assert conservation_ok(a) and conservation_ok(c)
+
+
+class TestBarrierDegradation:
+    def test_crash_mid_barrier_unblocks_survivors(self):
+        """The headline: everyone waits on a barrier blocked by instance
+        0 (which won't signal until t=100); the schedule crashes 0 at
+        t=5; the live-degraded target releases the survivors within a
+        couple of ticks instead of deadlocking to max_ticks."""
+        groups = make_groups(4)
+        prog = SimProgram(
+            _Barrier(),
+            groups,
+            chunk=8,
+            faults=sched(
+                groups, [{"kind": "crash", "start_ms": 5, "instances": "0:1"}]
+            ),
+        )
+        res = prog.run(max_ticks=512)
+        assert res["status"].tolist() == [CRASH] + [SUCCESS] * 3
+        assert res["ticks"] <= 16  # released right after the crash
+        # every survivor observed the degraded membership (3 live)
+        assert res["states"][0]["live_seen"].tolist()[1:] == [3, 3, 3]
+
+    def test_without_faults_the_same_barrier_deadlocks(self):
+        """Contrast case: no fault plane → the barrier stays blocked on
+        instance 0 until its late signal (t=100), proving the degraded
+        target (not some other change) released the run above."""
+        prog = SimProgram(_Barrier(), make_groups(4), chunk=8)
+        res = prog.run(max_ticks=64)
+        assert (res["status"] == RUNNING).all()  # still stuck at 64
+
+
+class TestZeroOverhead:
+    def test_no_faults_traces_identically_to_empty_schedule(self):
+        """faults=None and an empty lowered schedule must produce the
+        byte-identical traced chunk (the zero-overhead contract), and an
+        armed schedule must change it (the plane is really in the tick)."""
+        groups = make_groups(4)
+        tc = _Pinger()
+        prog_none = SimProgram(tc, groups, chunk=4)
+        prog_empty = SimProgram(
+            tc, groups, chunk=4, faults=build_fault_schedule(groups, {}, 1.0)
+        )
+        carry = prog_none.init_carry(0)
+        j_none = str(jax.make_jaxpr(prog_none._chunk_step)(carry))
+        j_empty = str(jax.make_jaxpr(prog_empty._chunk_step)(carry))
+        assert j_none == j_empty
+        prog_armed = SimProgram(
+            tc,
+            groups,
+            chunk=4,
+            faults=sched(groups, [{"kind": "crash", "start_ms": 2}]),
+        )
+        j_armed = str(jax.make_jaxpr(prog_armed._chunk_step)(carry))
+        assert j_armed != j_none
+
+    def test_schedule_group_layout_mismatch_refused(self):
+        g4, g8 = make_groups(4), make_groups(8)
+        s = build_fault_schedule(
+            g8, {"": [{"kind": "crash", "start_ms": 1}]}, 1.0
+        )
+        with pytest.raises(ValueError, match="group layout"):
+            SimProgram(_Pinger(), g4, faults=s)
+
+
+class TestWatchdog:
+    def test_stalled_chunk_raises_sets_cancel_and_journals(self):
+        """The first two dispatches (trace/compile, and the mesh
+        fixed-point recompile) are exempt; a stall on the third chunk
+        trips the watchdog: cancel set, on_stall journaled with the last
+        completed tick + chunk index, worker thread released."""
+        prog = SimProgram(_Pinger(), make_groups(2), chunk=4)
+        calls = {"n": 0}
+
+        def slow_chunk(carry):
+            calls["n"] += 1
+            if calls["n"] > 2:  # stall once past the compile exemption
+                time.sleep(10.0)
+            return carry, jnp.asarray(False)
+
+        prog._chunk_fn = slow_chunk  # monkeypatch the compiled chunk
+        cancel = threading.Event()
+        stalls = []
+        t0 = time.time()
+        with pytest.raises(SimStallError) as ei:
+            prog.run(
+                max_ticks=64,
+                cancel=cancel,
+                chunk_timeout=0.3,
+                on_stall=lambda ticks, ci: stalls.append((ticks, ci)),
+            )
+        assert time.time() - t0 < 5.0  # released, not hung
+        assert cancel.is_set()
+        assert stalls == [(8, 2)]
+        assert ei.value.ticks == 8 and ei.value.chunk_index == 2
+        assert "0.3" in str(ei.value)
+
+    def test_compile_dispatches_exempt_from_watchdog(self):
+        """A slow FIRST dispatch (cold XLA compile) must not trip a
+        watchdog sized for steady-state chunks."""
+        prog = SimProgram(_Counter(), make_groups(2), chunk=8)
+        real = prog.compiled_chunk()
+        calls = {"n": 0}
+
+        def chunk(carry):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.8)  # "compiling" — longer than the budget
+            return real(carry)
+
+        prog._chunk_fn = chunk
+        res = prog.run(max_ticks=64, chunk_timeout=0.3)
+        assert (res["status"] == SUCCESS).all()
+
+    def test_dispatch_errors_propagate_through_watchdog(self):
+        prog = SimProgram(_Pinger(), make_groups(2), chunk=4)
+        calls = {"n": 0}
+
+        def bad_chunk(carry):
+            calls["n"] += 1
+            if calls["n"] > 2:  # raise inside the WATCHED dispatch
+                raise RuntimeError("device exploded")
+            return carry, jnp.asarray(False)
+
+        prog._chunk_fn = bad_chunk
+        with pytest.raises(RuntimeError, match="device exploded"):
+            prog.run(max_ticks=64, chunk_timeout=5.0)
+
+    def test_watchdog_off_path_unchanged(self):
+        prog = SimProgram(_Counter(), make_groups(2), chunk=8)
+        res = prog.run(max_ticks=64, chunk_timeout=30.0)
+        assert (res["status"] == SUCCESS).all()
+
+
+class TestNanGuard:
+    def test_nan_fails_fast_with_leaf_and_tick_range(self):
+        prog = SimProgram(_NaNAtFive(), make_groups(2), chunk=8)
+        with pytest.raises(FloatingPointError) as ei:
+            prog.run(max_ticks=32, nan_guard=True)
+        msg = str(ei.value)
+        assert "NaN" in msg
+        assert "'x'" in msg or "x" in msg  # the offending leaf is named
+        assert "(0, 8]" in msg  # the chunk's tick range
+
+    def test_guard_off_by_default(self):
+        prog = SimProgram(_NaNAtFive(), make_groups(2), chunk=8)
+        res = prog.run(max_ticks=16)  # no error — the old behavior
+        assert res["ticks"] == 16
+
+    def test_finite_run_passes_guard(self):
+        prog = SimProgram(_Counter(), make_groups(2), chunk=8)
+        res = prog.run(max_ticks=64, nan_guard=True)
+        assert (res["status"] == SUCCESS).all()
+
+
+class TestCompositionPlumbing:
+    TOML = """
+[global]
+plan = "chaos"
+case = "chaos-barrier"
+builder = "sim:plan"
+runner = "sim:jax"
+
+[[global.run.faults]]
+kind = "loss_burst"
+start_ms = 2.0
+duration_ms = 4.0
+loss = 50.0
+
+[[groups]]
+id = "all"
+
+[groups.instances]
+count = 4
+
+[[groups.run.faults]]
+kind = "crash"
+instances = "0:1"
+start_ms = 6.0
+"""
+
+    def test_faults_parse_and_roundtrip(self):
+        from testground_tpu.api import Composition
+
+        comp = Composition.from_toml(self.TOML)
+        assert comp.groups[0].run.faults[0]["kind"] == "crash"
+        assert comp.global_.run.faults[0]["kind"] == "loss_burst"
+        again = Composition.from_toml(comp.to_toml())
+        assert again.groups[0].run.faults == comp.groups[0].run.faults
+        assert again.global_.run.faults == comp.global_.run.faults
+
+    def test_preparation_fills_faults_idempotently(self):
+        """Run groups inherit the backing group's schedule (fill-if-
+        empty), global faults stay global, and preparing twice must not
+        duplicate events."""
+        from testground_tpu.api import (
+            Composition,
+            TestPlanManifest,
+            prepare_for_run,
+        )
+        import os
+
+        manifest = TestPlanManifest.load_file(
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "plans",
+                "chaos",
+                "manifest.toml",
+            )
+        )
+        comp = Composition.from_toml(self.TOML)
+        once = prepare_for_run(comp, manifest)
+        twice = prepare_for_run(once, manifest)
+        for prepared in (once, twice):
+            rg = prepared.runs[0].groups[0]
+            assert [f["kind"] for f in rg.faults] == ["crash"]
+            assert [f["kind"] for f in prepared.global_.run.faults] == [
+                "loss_burst"
+            ]
+
+    def test_fault_specs_of_scopes_global_to_empty_key(self):
+        from testground_tpu.api import RunGroup as RG
+        from testground_tpu.sim.executor import fault_specs_of
+
+        groups = [
+            RG(id="a", instances=2, faults=[{"kind": "crash", "start_ms": 1}]),
+            RG(id="b", instances=2),
+        ]
+        specs = fault_specs_of(
+            groups, [{"kind": "loss_burst", "start_ms": 0}]
+        )
+        assert set(specs) == {"a", ""}
+        assert specs[""][0]["kind"] == "loss_burst"
+
+
+class TestTelemetryIntegration:
+    def test_fault_columns_in_block_and_sum_to_totals(self):
+        from testground_tpu.sim.telemetry import (
+            TELEMETRY_FIXED_COLUMNS,
+            rows_from_blocks,
+            telemetry_totals,
+        )
+
+        assert "faults_crashed" in TELEMETRY_FIXED_COLUMNS
+        assert "fault_dropped" in TELEMETRY_FIXED_COLUMNS
+        groups = make_groups(4)
+        prog = SimProgram(
+            _Pinger(),
+            groups,
+            chunk=8,
+            telemetry=True,
+            faults=sched(
+                groups,
+                [
+                    {"kind": "crash", "start_ms": 4, "instances": "0:1"},
+                    {"kind": "restart", "start_ms": 9, "instances": "0:1"},
+                ],
+            ),
+        )
+        blocks = []
+        res = prog.run(max_ticks=24, telemetry_cb=lambda b: blocks.append(b))
+        rows = rows_from_blocks(blocks, tuple(g.id for g in groups))
+        totals = telemetry_totals(rows)
+        assert totals["fault_dropped"] == res["fault_dropped"] > 0
+        assert sum(r["faults_crashed"] for r in rows) == 1
+        assert sum(r["faults_restarted"] for r in rows) == 1
+        # the live columns dip while the instance is down
+        lives = [r["live"]["g0"] for r in rows]
+        assert min(lives) == 3 and lives[-1] == 4
+        assert conservation_ok(res)
