@@ -189,14 +189,18 @@ class RunParams:
     and — beyond the reference — a declarative fault schedule
     (``[[groups.run.faults]]`` / ``[[global.run.faults]]``): a list of
     chaos events the ``sim:jax`` runner lowers into its deterministic
-    fault-injection plane (docs/FAULTS.md). Entries are kept as raw
-    tables here; validation happens at schedule lowering, where the
-    group layout is known."""
+    fault-injection plane (docs/FAULTS.md), plus a flight-recorder
+    sampling table (``[groups.run.trace]`` / ``[global.run.trace]``,
+    docs/OBSERVABILITY.md) selecting which instances the sim engine
+    records per-tick lifecycle events for. Entries are kept as raw
+    tables here; validation happens at lowering, where the group layout
+    is known."""
 
     artifact: str = ""
     test_params: dict[str, str] = field(default_factory=dict)
     profiles: dict[str, str] = field(default_factory=dict)
     faults: list = field(default_factory=list)
+    trace: dict = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: dict) -> "RunParams":
@@ -205,6 +209,7 @@ class RunParams:
             test_params={str(k): str(v) for k, v in d.get("test_params", {}).items()},
             profiles=dict(d.get("profiles", {})),
             faults=[dict(f) for f in d.get("faults", [])],
+            trace=dict(d.get("trace", {})),
         )
 
     def to_dict(self) -> dict:
@@ -214,9 +219,11 @@ class RunParams:
             "profiles": dict(self.profiles),
         }
         # omit when empty: keeps serialized compositions byte-stable for
-        # the (vast) majority that declare no chaos schedule
+        # the (vast) majority that declare no chaos schedule or trace
         if self.faults:
             out["faults"] = [dict(f) for f in self.faults]
+        if self.trace:
+            out["trace"] = dict(self.trace)
         return out
 
 
@@ -334,6 +341,7 @@ class Group:
             test_params=dict(self.run.test_params),
             profiles=dict(self.run.profiles),
             faults=[dict(f) for f in self.run.faults],
+            trace=dict(self.run.trace),
         )
 
 
@@ -351,6 +359,8 @@ class CompositionRunGroup:
     # declared inline on the run group, or inherited from the backing
     # group's [[groups.run.faults]] when unset
     faults: list = field(default_factory=list)
+    # flight-recorder sampling table, same inheritance rule as faults
+    trace: dict = field(default_factory=dict)
     calculated_instance_count: int = 0
 
     @classmethod
@@ -363,6 +373,7 @@ class CompositionRunGroup:
             test_params={str(k): str(v) for k, v in d.get("test_params", {}).items()},
             profiles=dict(d.get("profiles", {})),
             faults=[dict(f) for f in d.get("faults", [])],
+            trace=dict(d.get("trace", {})),
         )
 
     def to_dict(self) -> dict:
@@ -376,6 +387,8 @@ class CompositionRunGroup:
         }
         if self.faults:
             out["faults"] = [dict(f) for f in self.faults]
+        if self.trace:
+            out["trace"] = dict(self.trace)
         return out
 
     def effective_group_id(self) -> str:
@@ -396,6 +409,11 @@ class CompositionRunGroup:
         self.merge_run(g.run)
         if not self.faults and g.run.faults:
             self.faults = [dict(f) for f in g.run.faults]
+        # trace follows the faults rule exactly: fill-if-empty from the
+        # backing group; [global.run.trace] reaches the runner as
+        # RunInput.trace, scoped to the whole run
+        if not self.trace and g.run.trace:
+            self.trace = dict(g.run.trace)
 
     def merge_run(self, rp: RunParams) -> None:
         """Fill missing test params / profiles from ``rp``

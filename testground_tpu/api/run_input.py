@@ -39,6 +39,9 @@ class RunGroup:
     # ([[groups.run.faults]] — raw tables; the sim:jax runner lowers and
     # validates them, other runners ignore them)
     faults: list = field(default_factory=list)
+    # flight-recorder sampling table for this group's slice
+    # ([groups.run.trace] — raw table, lowered by the sim:jax runner)
+    trace: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -50,6 +53,7 @@ class RunGroup:
             "profiles": dict(self.profiles),
             "resources": self.resources.to_dict(),
             "faults": [dict(f) for f in self.faults],
+            "trace": dict(self.trace),
         }
 
     @classmethod
@@ -63,6 +67,7 @@ class RunGroup:
             profiles=dict(d.get("profiles", {})),
             resources=Resources.from_dict(d.get("resources", {})),
             faults=[dict(f) for f in d.get("faults", [])],
+            trace=dict(d.get("trace", {})),
         )
 
 
@@ -81,6 +86,9 @@ class RunInput:
     # default target is the WHOLE run — group-scoped declarations ride
     # on their RunGroup instead
     faults: list = field(default_factory=list)
+    # run-global flight-recorder table ([global.run.trace]): selectors
+    # whose default target is the WHOLE run
+    trace: dict = field(default_factory=dict)
     # EnvConfig equivalent is attached by the engine at dispatch time.
     env: Any = None
 
@@ -93,6 +101,7 @@ class RunInput:
             "groups": [g.to_dict() for g in self.groups],
             "disable_metrics": self.disable_metrics,
             "faults": [dict(f) for f in self.faults],
+            "trace": dict(self.trace),
         }
 
 

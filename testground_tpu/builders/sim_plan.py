@@ -114,8 +114,10 @@ class SimPlanBuilder(Builder, Precompiler):
             fault_specs_of,
             load_and_specialize,
             make_sim_program,
+            trace_specs_of,
         )
         from testground_tpu.sim.faults import build_fault_schedule
+        from testground_tpu.sim.trace import build_trace_plan
 
         artifacts = {g.id: g.run.artifact for g in comp.groups}
         # prepare BEFORE coalescing the runner config: prepare_for_run is
@@ -163,6 +165,21 @@ class SimPlanBuilder(Builder, Precompiler):
                 if comp.global_.run is not None
                 else None,
             )
+            # the flight-recorder plan is program-shaping too, and its
+            # gate mirrors the executor's: disable_metrics and cohort
+            # configs run trace-free, so a build under either must
+            # precompile the no-trace variant
+            run_trace_specs = (
+                trace_specs_of(
+                    run.groups,
+                    comp.global_.run.trace
+                    if comp.global_.run is not None
+                    else None,
+                )
+                if not comp.global_.disable_metrics
+                and not getattr(cfg, "coordinator_address", "")
+                else {}
+            )
             spec = {
                 "sources": digests[
                     artifacts[
@@ -188,6 +205,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 "validate": bool(getattr(cfg, "validate", False)),
                 "telemetry": telemetry,
                 "faults": run_fault_specs,
+                "trace": run_trace_specs,
                 "hosts": list(hosts),
                 "backend": jax.default_backend(),
                 "devices": jax.device_count(),
@@ -245,6 +263,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 faults=build_fault_schedule(
                     groups, run_fault_specs, cfg.tick_ms
                 ),
+                trace=build_trace_plan(groups, run_trace_specs),
             )
             # same capacity precheck as the run: an oversized composition
             # must refuse readably at BUILD time too, not die as an XLA

@@ -782,13 +782,21 @@ def register_stats(sub) -> None:
     p = sub.add_parser(
         "stats",
         help="show a completed task's sim telemetry summary "
-        "(message flow, timings, memory footprint — docs/OBSERVABILITY.md)",
+        "(message flow, latency, timings, memory — docs/OBSERVABILITY.md)",
     )
     p.add_argument("task", help="task id")
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the raw stats payload as JSON (machine-readable; the "
+        "same shape as GET /stats)",
+    )
     p.set_defaults(func=stats_cmd)
 
 
 def stats_cmd(args) -> int:
+    import json
+
     from testground_tpu.client import RemoteEngine
     from testground_tpu.runners.pretty import render_telemetry_summary
 
@@ -801,7 +809,114 @@ def stats_cmd(args) -> int:
             if t is None:
                 raise KeyError(f"unknown task {args.task}")
             data = t.stats_payload()
-        print(render_telemetry_summary(data))
+        if getattr(args, "json", False):
+            print(json.dumps(data, indent=2, sort_keys=True))
+        else:
+            print(render_telemetry_summary(data))
+        return 0
+    finally:
+        engine.stop()
+
+
+def register_trace(sub) -> None:
+    p = sub.add_parser(
+        "trace",
+        help="show a task's flight-recorder events (per-instance "
+        "message-lifecycle timeline — docs/OBSERVABILITY.md); enable "
+        "recording with [global.run.trace] / [groups.run.trace]",
+    )
+    p.add_argument("task", help="task id")
+    p.add_argument(
+        "-n",
+        "--limit",
+        type=int,
+        default=0,
+        help="print at most N events (default: all)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the raw events as JSON lines (the sim_trace.jsonl "
+        "rows) instead of the aligned timeline",
+    )
+    p.set_defaults(func=trace_cmd)
+
+
+def _render_trace_event(ev: dict) -> str:
+    kind = ev.get("event", "?")
+    who = f"{ev.get('group', '?')}/i{ev.get('instance', '?')}"
+    if kind == "status":
+        what = f"status {ev.get('prev', '?')} → {ev.get('status', '?')}"
+    elif kind == "signal":
+        what = f"signal state {ev.get('state', '?')}"
+    elif kind == "send":
+        what = f"send → i{ev.get('dst', '?')} ({ev.get('fate', '?')})"
+    elif kind == "deliver":
+        what = f"deliver ← i{ev.get('src', '?')}"
+    else:
+        what = kind
+    return f"t={ev.get('tick', '?'):>6}  {who:<16}  {what}"
+
+
+def trace_cmd(args) -> int:
+    import json
+
+    from testground_tpu.client import RemoteEngine
+
+    engine = _engine(args)
+    try:
+        if isinstance(engine, RemoteEngine):
+            data = engine.task_trace(args.task, limit=args.limit)
+            summary, events = data.get("trace", {}), data.get("events", [])
+        else:
+            t = engine.get_task(args.task)
+            if t is None:
+                raise KeyError(f"unknown task {args.task}")
+            from testground_tpu.sim.trace import read_trace_events
+
+            journal = (
+                t.result.get("journal", {})
+                if isinstance(t.result, dict)
+                else {}
+            )
+            summary = journal.get("trace", {})
+            events = read_trace_events(
+                engine.env.dirs.outputs(), t.plan, t.id, limit=args.limit
+            )
+        if not summary and not events:
+            # same message AND exit code with or without --json — a CI
+            # pipe must not read an empty stream as a recorded trace
+            print(
+                f"no flight-recorder trace for task {args.task} — enable "
+                "it with [global.run.trace] in the composition "
+                "(docs/OBSERVABILITY.md)",
+                file=sys.stderr,
+            )
+            return 1
+        if isinstance(engine, RemoteEngine) and data.get("truncated"):
+            print(
+                f"warning: daemon capped the response at "
+                f"{data.get('limit')} events — fetch the full stream "
+                "via GET /artifact?name=sim_trace.jsonl",
+                file=sys.stderr,
+            )
+        if getattr(args, "json", False):
+            for ev in events:
+                print(json.dumps(ev))
+            return 0
+        print(
+            "trace: {e} event(s) from {i} instance(s)".format(
+                e=summary.get("events", len(events)),
+                i=summary.get("instances", "?"),
+            )
+            + (
+                f" — {summary['events_file']} loads in Perfetto"
+                if summary.get("events_file")
+                else ""
+            )
+        )
+        for ev in events:
+            print(_render_trace_event(ev))
         return 0
     finally:
         engine.stop()

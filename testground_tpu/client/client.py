@@ -176,6 +176,15 @@ class Client:
         backend): identity + the journal's sim/telemetry/events sections."""
         return self._get_json("/stats", {"task_id": task_id})
 
+    def trace(self, task_id: str, limit: int = 0) -> dict:
+        """GET /trace — a task's flight-recorder events (the ``tg trace``
+        backend): the journal's trace summary plus the recorded
+        ``sim_trace.jsonl`` events (``limit`` > 0 truncates)."""
+        params = {"task_id": task_id}
+        if limit:
+            params["limit"] = str(limit)
+        return self._get_json("/trace", params)
+
     def logs(self, task_id: str, follow: bool = False) -> Iterator[str]:
         return self._post_stream(
             "/logs", {"task_id": task_id, "follow": follow}
@@ -298,6 +307,12 @@ class RemoteEngine:
         of ``tg stats``; in-process engines assemble the same payload
         via Task.stats_payload)."""
         return self.client.stats(task_id)
+
+    def task_trace(self, task_id: str, limit: int = 0) -> dict:
+        """One round trip to the daemon's /trace route (the remote half
+        of ``tg trace``; in-process engines read the run outputs via
+        sim.trace.read_trace_events)."""
+        return self.client.trace(task_id, limit=limit)
 
     def tasks(
         self, states=None, types=None, before=None, after=None, limit=0, **_

@@ -6,8 +6,8 @@ surface (``daemon.go:83-101``) and bearer-token auth (``daemon.go:49-70``):
 
     POST /run /build /tasks /status /logs /outputs /terminate
          /healthcheck /kill /delete /build/purge /plan/import
-    GET  / /tasks /logs /outputs /journal /stats /data /dashboard
-         /describe /kill /delete
+    GET  / /tasks /logs /outputs /journal /stats /trace /artifact /data
+         /dashboard /describe /kill /delete
 
 The GET tier is the reference's web-dashboard surface (``daemon.go:83-91``,
 ``dashboard.go:44-75``): ``/journal`` returns a task's result journal,
@@ -132,6 +132,8 @@ class _Handler(BaseHTTPRequestHandler):
             "/tasks": lambda: self._tasks(q),
             "/journal": lambda: self._journal(q),
             "/stats": lambda: self._stats(q),
+            "/trace": lambda: self._trace(q),
+            "/artifact": lambda: self._artifact(q),
             "/data": lambda: self._data(q),
             "/dashboard": lambda: self._dashboard(q),
             "/describe": lambda: self._describe(q),
@@ -462,6 +464,122 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_json(f"unknown task {task_id}", 404)
         self._send_json(t.stats_payload())
 
+    # Event cap for one /trace JSON response (sim_trace.jsonl itself is
+    # unbounded; the full file streams via /artifact).
+    _TRACE_EVENTS_MAX = 50_000
+
+    def _trace(self, q: dict) -> None:
+        """GET /trace?task_id=[&limit=] — the task's flight-recorder
+        events (``sim_trace.jsonl``, read back from the outputs tree —
+        every run dir of a multi-``[[runs]]`` task contributes) plus the
+        journal's trace summary: the ``tg trace`` backend
+        (docs/OBSERVABILITY.md). Responses cap at ``_TRACE_EVENTS_MAX``
+        events; fetch the whole stream via ``/artifact``."""
+        from testground_tpu.sim.trace import read_trace_events
+
+        task_id = q.get("task_id", "")
+        t = self.engine.get_task(task_id)
+        if t is None:
+            return self._send_error_json(f"unknown task {task_id}", 404)
+        journal = (
+            t.result.get("journal", {}) if isinstance(t.result, dict) else {}
+        )
+        try:
+            limit = int(q.get("limit") or 0)
+        except (TypeError, ValueError):
+            return self._send_error_json("invalid limit", 400)
+        # a JSON response must stay bounded — sim_trace.jsonl is not
+        # (see /artifact, which streams the whole file): an absent/0
+        # limit gets the server-side default instead of a full slurp
+        limit = (
+            self._TRACE_EVENTS_MAX
+            if limit <= 0
+            else min(limit, self._TRACE_EVENTS_MAX)
+        )
+        # read one past the limit so an exactly-limit-sized stream is
+        # not falsely reported as truncated
+        events = read_trace_events(
+            self.engine.env.dirs.outputs(), t.plan, task_id, limit=limit + 1
+        )
+        payload = {
+            "task_id": task_id,
+            "trace": journal.get("trace", {}),
+            "events": events[:limit],
+        }
+        if len(events) > limit:
+            # never silently incomplete: a capped response says so, and
+            # points at the full stream
+            payload["truncated"] = True
+            payload["limit"] = limit
+        self._send_json(payload)
+
+    # Observability artifacts a dashboard task page may link: file names
+    # are a closed whitelist (never client paths) and the run dir must
+    # belong to the task, so the route cannot read outside the task's
+    # outputs.
+    _ARTIFACT_FILES = (
+        "timeseries.jsonl",
+        "sim_timeseries.jsonl",
+        "sim_latency.jsonl",
+        "run_spans.jsonl",
+        "sim_trace.jsonl",
+        "trace_events.json",
+    )
+
+    def _artifact(self, q: dict) -> None:
+        """GET /artifact?task_id=&name=[&run=] — serve one whitelisted
+        observability artifact from a task's run outputs dir (the
+        dashboard's trace/telemetry links)."""
+        task_id = q.get("task_id", "")
+        t = self.engine.get_task(task_id)
+        if t is None:
+            return self._send_error_json(f"unknown task {task_id}", 404)
+        name = q.get("name", "")
+        if name not in self._ARTIFACT_FILES:
+            return self._send_error_json(
+                f"unknown artifact {name!r}; serving only "
+                f"{list(self._ARTIFACT_FILES)}",
+                400,
+            )
+        rid = q.get("run", task_id)
+        if rid != os.path.basename(rid) or not (
+            rid == task_id or rid.startswith(task_id + "-")
+        ):
+            return self._send_error_json(f"invalid run id {rid!r}", 400)
+        path = os.path.join(
+            self.engine.env.dirs.outputs(), t.plan, rid, name
+        )
+        if not os.path.isfile(path):
+            return self._send_error_json(
+                f"artifact {name} not found for run {rid}", 404
+            )
+        # stream, never slurp: sim_trace.jsonl is unbounded by design (a
+        # long traced run can reach GBs) and the daemon owns every
+        # running task — one dashboard click must not balloon its RSS.
+        # Copy EXACTLY the declared length: the file may still be
+        # growing (a RUNNING traced task flushes every chunk), and extra
+        # bytes past Content-Length would corrupt the keep-alive
+        # connection's framing for the next pipelined response.
+        size = os.path.getsize(path)
+        self.send_response(200)
+        self.send_header(
+            "Content-Type",
+            "application/json"
+            if name.endswith(".json")
+            else "application/x-ndjson",
+        )
+        self.send_header("Content-Length", str(size))
+        self.end_headers()
+        with open(path, "rb") as f:
+            remaining = size
+            while remaining > 0:
+                chunk = f.read(min(1 << 16, remaining))
+                if not chunk:  # file truncated underneath us: pad out
+                    self.wfile.write(b" " * remaining)
+                    break
+                self.wfile.write(chunk)
+                remaining -= len(chunk)
+
     def _data(self, q: dict) -> None:
         """GET /data?task_id=&metric= — one measurement's sampled rows
         (``daemon.go:83`` dataHandler; rows are the InfluxDB-table analog).
@@ -551,6 +669,7 @@ class _Handler(BaseHTTPRequestHandler):
         # (supervisor run_id framing); one link per run, else one for the
         # single-run task
         output_links = ""
+        artifact_links = ""
         if t.runner:  # build tasks have no run outputs
             run_results = (
                 t.result.get("runs") if isinstance(t.result, dict) else None
@@ -567,14 +686,49 @@ class _Handler(BaseHTTPRequestHandler):
                 f'{esc(rid)}">{label}</a>'
                 for label, rid in links
             )
+            # telemetry / trace artifacts actually present in the run
+            # dir(s) — served by /artifact (whitelisted file names)
+            per_run = []
+            for _, rid in links:
+                run_dir = os.path.join(
+                    self.engine.env.dirs.outputs(), t.plan, rid
+                )
+                present = [
+                    name
+                    for name in self._ARTIFACT_FILES
+                    if os.path.isfile(os.path.join(run_dir, name))
+                ]
+                if not present:
+                    continue
+                tag = (
+                    f" [{esc(rid)}]"
+                    if rid != task_id
+                    else ""
+                )
+                per_run.append(
+                    " · ".join(
+                        f'<a href="/artifact?task_id={esc(task_id)}'
+                        f"&amp;run={esc(rid)}&amp;name={esc(name)}\">"
+                        f"{esc(name)}</a>"
+                        for name in present
+                    )
+                    + tag
+                )
+            if per_run:
+                artifact_links = (
+                    "<p>artifacts: " + " &nbsp;|&nbsp; ".join(per_run) + "</p>"
+                )
         header = (
             f"<p>task <code>{esc(task_id)}</code> — "
             f"{esc(t.plan)}:{esc(t.case)} — state {esc(t.state().state.value)}, "
             f"outcome {esc(t.outcome().value)} — "
             f'<a href="/journal?task_id={esc(task_id)}">journal</a> · '
+            f'<a href="/stats?task_id={esc(task_id)}">stats</a> · '
+            f'<a href="/trace?task_id={esc(task_id)}">trace</a> · '
             f'<a href="/logs?task_id={esc(task_id)}">logs</a>'
             + output_links
             + "</p>"
+            + artifact_links
         )
         self._send_html(
             _page(f"{t.plan}:{t.case}", header + "".join(sections))

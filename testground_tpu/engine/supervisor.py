@@ -271,6 +271,7 @@ def do_run(
                     profiles=dict(rg.profiles),
                     resources=rg.resources,
                     faults=[dict(f) for f in getattr(rg, "faults", [])],
+                    trace=dict(getattr(rg, "trace", {}) or {}),
                 )
             )
         rinput = RunInput(
@@ -291,6 +292,12 @@ def do_run(
                     else []
                 )
             ],
+            # run-global flight-recorder table ([global.run.trace])
+            trace=dict(
+                comp.global_.run.trace
+                if comp.global_.run is not None
+                else {}
+            ),
             env=engine.env,
         )
         ow.infof(

@@ -200,6 +200,9 @@ class Task:
             "outcome": self.outcome().value,
             "sim": journal.get("sim", {}),
             "telemetry": journal.get("telemetry", {}),
+            # flight-recorder summary (docs/OBSERVABILITY.md) — the
+            # events themselves are served by `tg trace` / GET /trace
+            "trace": journal.get("trace", {}),
             "events": journal.get("events", {}),
         }
 

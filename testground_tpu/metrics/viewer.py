@@ -34,9 +34,14 @@ __all__ = ["Row", "Viewer", "clean", "expand_sim_row", "measurement_name"]
 # (``viewer.go:13-22``).
 TAGS_IGNORE = {"plan", "case", "group_id", "run"}
 
-# The sim telemetry plane's per-run series file name — the writer owns
-# the constant (sim/telemetry.py has no jax dependency).
-from testground_tpu.sim.telemetry import SIM_SERIES_FILE  # noqa: E402
+# The sim telemetry plane's per-run series file names — the writer owns
+# the constants (sim/telemetry.py has no jax dependency). LATENCY_FILE
+# rows are already viewer-shaped (group_id/name/count/mean/min/max):
+# the ``sim.latency.p50/p95/p99`` measurement family, per group.
+from testground_tpu.sim.telemetry import (  # noqa: E402
+    LATENCY_FILE,
+    SIM_SERIES_FILE,
+)
 
 # Keys of a sim telemetry row that identify rather than measure.
 _SIM_IDENTITY = {"run", "plan", "case", "tick"}
@@ -116,34 +121,36 @@ class Viewer:
 
     def _run_dirs(self, plan: str):
         """Yield (run_id, plan-metric series path | None, sim telemetry
-        series path | None) for every run dir carrying either family."""
+        series path | None, latency summary path | None) for every run
+        dir carrying any of the three families."""
         root = os.path.join(self.env.dirs.outputs(), plan)
         if not os.path.isdir(root):
             return
         for run_id in sorted(os.listdir(root)):
             ts = os.path.join(root, run_id, "timeseries.jsonl")
             sim = os.path.join(root, run_id, SIM_SERIES_FILE)
+            lat = os.path.join(root, run_id, LATENCY_FILE)
             ts_ok = os.path.isfile(ts)
             sim_ok = os.path.isfile(sim)
-            if ts_ok or sim_ok:
-                yield run_id, (ts if ts_ok else None), (
-                    sim if sim_ok else None
+            lat_ok = os.path.isfile(lat)
+            if ts_ok or sim_ok or lat_ok:
+                yield (
+                    run_id,
+                    ts if ts_ok else None,
+                    sim if sim_ok else None,
+                    lat if lat_ok else None,
                 )
 
     @staticmethod
     def _read_jsonl(path: str):
-        with open(path, "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except json.JSONDecodeError:
-                    continue
+        # the shared tolerant reader (sim/telemetry.py) — one
+        # implementation across every observability consumer
+        from testground_tpu.sim.telemetry import iter_jsonl
+
+        yield from iter_jsonl(path)
 
     def _iter_rows(self, plan: str, case: str | None, run_id: str | None):
-        for rid, ts_path, sim_path in self._run_dirs(plan):
+        for rid, ts_path, sim_path, lat_path in self._run_dirs(plan):
             # a task's runs are <task-id> (single run) or <task-id>-<run-id>
             # (multi-run [[runs]] compositions — supervisor run_id scheme),
             # so a task-scoped query matches both
@@ -163,6 +170,12 @@ class Viewer:
                     if case is not None and row.get("case") != case:
                         continue
                     yield from expand_sim_row(row)
+            if lat_path is not None:
+                # latency rows are written viewer-shaped — no expansion
+                for row in self._read_jsonl(lat_path):
+                    if case is not None and row.get("case") != case:
+                        continue
+                    yield row
 
     # ---------------------------------------------------------------- query
 

@@ -29,11 +29,12 @@ def render_telemetry_summary(stats: dict) -> str:
     optional — non-sim tasks render whatever they have)."""
     sim = stats.get("sim") or {}
     tele = stats.get("telemetry") or {}
+    trace = stats.get("trace") or {}
     events = stats.get("events") or {}
     ident = f"{stats.get('plan', '?')}:{stats.get('case', '?')}"
     if stats.get("task_id"):
         ident += f"  ({stats['task_id']})"
-    if not (sim or tele or events):
+    if not (sim or tele or trace or events):
         # e.g. a build task, or a run that recorded nothing
         return f"task  {ident}\nno telemetry recorded for this task"
     rows: list[tuple[str, str]] = [("task", ident)]
@@ -104,11 +105,40 @@ def render_telemetry_summary(stats: dict) -> str:
                     ),
                 )
             )
+        # per-receiver-group delivery-latency percentiles (telemetry
+        # plane histograms, docs/OBSERVABILITY.md) — one line per group
+        for gid, pct in sorted((sim.get("latency") or {}).items()):
+            if not pct.get("count"):
+                rows.append((f"latency {gid}", "no deliveries"))
+                continue
+            rows.append(
+                (
+                    f"latency {gid}",
+                    "p50={p50}ms p95={p95}ms p99={p99}ms (n={n})".format(
+                        p50=pct.get("p50_ms", "?"),
+                        p95=pct.get("p95_ms", "?"),
+                        p99=pct.get("p99_ms", "?"),
+                        n=pct["count"],
+                    ),
+                )
+            )
     if tele:
         shown = f"{tele.get('rows', 0)} per-tick rows"
         if tele.get("file"):  # absent when no outputs dir held the series
             shown += f" ({tele['file']})"
         rows.append(("telemetry", shown))
+    if trace:
+        shown = (
+            f"{trace.get('events', 0)} events from "
+            f"{trace.get('instances', 0)} instance(s)"
+        )
+        files = [trace.get("file"), trace.get("events_file")]
+        files = [f for f in files if f]
+        if files:
+            shown += f" ({', '.join(files)})"
+        if trace.get("truncated"):
+            shown += f" — {trace['truncated']} past the export cap"
+        rows.append(("trace", shown))
     for gid, counts in sorted(events.items()):
         if isinstance(counts, dict):
             shown = ", ".join(

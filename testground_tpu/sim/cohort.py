@@ -319,9 +319,12 @@ def _child_main() -> int:
             groups=[RunGroup.from_dict(g) for g in ri["groups"]],
             runner_config=cfg,
             disable_metrics=ri.get("disable_metrics", False),
-            # run-global fault schedule survives the child hop (the
-            # per-group schedules ride in groups[].faults via from_dict)
+            # run-global fault schedule and flight-recorder table
+            # survive the child hop (the per-group declarations ride in
+            # groups[].faults / groups[].trace via from_dict) — tracing
+            # is then re-gated off by the cohort rule in the executor
             faults=[dict(f) for f in ri.get("faults", [])],
+            trace=dict(ri.get("trace", {})),
             env=EnvConfig.load(job_d.get("home") or None),
         )
         try:

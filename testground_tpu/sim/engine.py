@@ -57,6 +57,7 @@ from .net import (
     apply_net_updates,
     deliver,
     enqueue,
+    latency_histogram,
     make_link_state,
     purge_dst,
 )
@@ -68,7 +69,8 @@ from .sync_kernel import (
     sync_occupancy,
     update_sync,
 )
-from .telemetry import TELEMETRY_FIXED_COLUMNS
+from .telemetry import LATENCY_BINS, TELEMETRY_FIXED_COLUMNS
+from .trace import EV_DELIVER, EV_SEND, EV_SIGNAL, EV_STATUS
 
 __all__ = [
     "MAX_FILTER_CELLS",
@@ -212,6 +214,14 @@ class SimCarry:
     faults_crashed: jax.Array
     faults_restarted: jax.Array
     fault_dropped: jax.Array
+    # --- delivery-latency histogram ([G, LATENCY_BINS] int32; None when
+    # the telemetry plane is compiled out): per-receiver-group log2 bin
+    # counts of (delivery tick - enqueue tick), accumulated per tick and
+    # FLUSHED (read + zeroed) once per chunk by _chunk_step — the host
+    # accumulates chunk deltas in python ints, so the device counter can
+    # never wrap however long the run (same overflow discipline as the
+    # limb-pair totals, without the limb arithmetic per bin).
+    lat_hist: jax.Array | None = None
 
 
 def build_groups(run_groups, parameters_of=None) -> tuple[GroupSpec, ...]:
@@ -246,6 +256,7 @@ class SimProgram:
         validate: bool = False,
         telemetry: bool = False,
         faults=None,
+        trace=None,
     ):
         self.tc = testcase
         self.groups = groups
@@ -286,6 +297,33 @@ class SimProgram:
                 f"the program has {self.n} — the schedule must be built "
                 "from the same group layout"
             )
+        # Flight recorder (sim/trace.py): a lowered TracePlan or None.
+        # A static program-shaping option like telemetry/faults — the
+        # traced lanes bake into the tick as gather indices, and None
+        # compiles the identical no-trace program (zero-overhead
+        # contract, pinned by jaxpr equality).
+        self.trace = trace
+        if trace is not None and trace.n != self.n:
+            raise ValueError(
+                f"trace plan lowered for {trace.n} instance(s) but the "
+                f"program has {self.n} — the plan must be built from "
+                "the same group layout"
+            )
+        if trace is not None:
+            self._trace_lanes = jnp.asarray(trace.lanes)
+            # post-host-merge outbox row count (the engine pads the
+            # outbox planes up to the host echo slot count)
+            o_rows = (
+                max(cls.OUT_MSGS, cls.IN_MSGS) if hosts else cls.OUT_MSGS
+            )
+            self._trace_o_rows = o_rows
+            self._trace_nrows = trace.count * (
+                1 + len(cls.STATES) + o_rows + cls.IN_MSGS
+            )
+        else:
+            self._trace_lanes = None
+            self._trace_o_rows = 0
+            self._trace_nrows = 0
         # Static horizon check: the plan's DEFAULT_LINK must be
         # deliverable within the calendar — shaped reconfigurations are
         # runtime data and get the clamp counter instead (NetFeedback).
@@ -401,6 +439,18 @@ class SimProgram:
                 [g.count for g in groups],
             )
         )
+        # receiver lane → group map for the latency histogram: host echo
+        # lanes map out of range so their control-route deliveries never
+        # enter the plan-traffic latency stats
+        self._lat_group_of = np.concatenate(
+            [
+                np.repeat(
+                    np.arange(len(groups), dtype=np.int32),
+                    [g.count for g in groups],
+                ),
+                np.full((len(self.hosts),), len(groups), np.int32),
+            ]
+        )
         self._chunk_fn: Callable | None = None
 
     # ------------------------------------------------------------ sharding
@@ -433,6 +483,9 @@ class SimProgram:
                 else None,
                 valid=wsc(carry.cal.valid, self._ishard(1))
                 if carry.cal.valid is not None
+                else None,
+                etick=wsc(carry.cal.etick, self._ishard(1))
+                if carry.cal.etick is not None
                 else None,
             ),
             link=LinkState(
@@ -504,6 +557,9 @@ class SimProgram:
                 # (see Calendar docstring); sharded: 2-D rows whose
                 # N·SLOTS axis carries the instance-axis sharding
                 flat=self.mesh is None,
+                # the enqueue-tick plane feeds the delivery-latency
+                # histograms — telemetry-gated like the counter block
+                track_etick=self.telemetry,
             ),
             link=make_link_state(
                 self.n_lanes,
@@ -540,6 +596,11 @@ class SimProgram:
             faults_crashed=jnp.int32(0),
             faults_restarted=jnp.int32(0),
             fault_dropped=_acc_zero(),
+            lat_hist=(
+                jnp.zeros((len(self.groups), LATENCY_BINS), jnp.int32)
+                if self.telemetry
+                else None
+            ),
         )
         if self.mesh is not None:
             carry = jax.jit(self._constrain)(carry)
@@ -554,6 +615,10 @@ class SimProgram:
         for the column schema)."""
         cls = type(self.tc)
         t = carry.t
+        # status snapshot BEFORE the fault plane touches it — the flight
+        # recorder's status-transition events must capture scheduled
+        # crashes/restarts as well as plan-driven terminals
+        status_prev = carry.status
 
         # --- fault plane, point events (docs/FAULTS.md): scheduled
         # restarts then crashes apply at tick START — before delivery, so
@@ -648,6 +713,24 @@ class SimProgram:
         live_g = live_per_group(carry.status, self.groups)
 
         cal, inbox_all = deliver(carry.cal, t)
+        # delivery-latency histogram (telemetry plane): bin this tick's
+        # deliveries by (t - enqueue tick) per receiver group. The etick
+        # row survives deliver's occupancy clear (only the occupancy
+        # plane is zeroed), so the pre-deliver calendar is read against
+        # the popped inbox's validity; host echo lanes are excluded by
+        # the out-of-range group map.
+        lat_hist_t = (
+            latency_histogram(
+                carry.cal,
+                inbox_all,
+                t,
+                self._lat_group_of,
+                len(self.groups),
+                LATENCY_BINS,
+            )
+            if self.telemetry
+            else None
+        )
         # messages popped into inboxes this tick (incl. host echo lanes)
         delivered_t = jnp.sum(inbox_all.valid.astype(jnp.int32))
         sub_payload, sub_valid = make_sub_window(carry.sync, cls.SUB_K)
@@ -809,6 +892,9 @@ class SimProgram:
             validate=self.validate,
             faults=faults,
             dead=dead,
+            # flight recorder: per-message transport fate for traced
+            # send events (compiled out when no trace plan is declared)
+            want_fate=self.trace is not None,
         )
         sync = update_sync(
             carry.sync, signals, pub_payload, pub_valid, sub_consume
@@ -952,10 +1038,20 @@ class SimProgram:
                 fault_dropped=_acc_add(
                     carry.fault_dropped, fault_dropped_t
                 ),
+                lat_hist=(
+                    carry.lat_hist + lat_hist_t
+                    if self.telemetry
+                    else None
+                ),
             )
         )
+        # flight-recorder event rows for this tick ([R, 5] int32; R = 0
+        # when no trace plan is compiled in)
+        trows = self._trace_tick_rows(
+            t, status_prev, status, signals, dst, valid, fb.fate, inbox_all
+        )
         if not self.telemetry:
-            return new_carry, jnp.zeros((0,), jnp.int32)
+            return new_carry, jnp.zeros((0,), jnp.int32), trows
         # per-tick counter block row (TELEMETRY_FIXED_COLUMNS order, then
         # one live-instance count per group) — all scalar reductions over
         # arrays the tick already materialized, so the block costs no
@@ -990,7 +1086,81 @@ class SimProgram:
                 *live,
             ]
         ).astype(jnp.int32)
-        return new_carry, tele
+        return new_carry, tele, trows
+
+    def _trace_tick_rows(
+        self, t, status_prev, status_new, signals, dst, valid, fate, inbox
+    ) -> jax.Array:
+        """One tick's flight-recorder rows: ``[R, 5]`` int32 with columns
+        ``(tick, lane, kind, a, b)``; unused slots carry kind = -1 (the
+        host decoder drops them). R is static — per traced lane, one
+        status slot, one per sync state, one per (host-padded) outbox
+        row, one per inbox slot — so the rows ride the chunk scan's
+        stacked ys like the counter block, with zero extra host syncs.
+        Returns ``[0, 5]`` when no trace plan is compiled in."""
+        if self.trace is None:
+            return jnp.zeros((0, 5), jnp.int32)
+        lanes = self._trace_lanes  # [L] int32, static
+
+        def repl(x):
+            """Pin a traced-lane gather to fully-replicated layout. The
+            source arrays shard by instance; without the constraint the
+            SPMD partitioner emits a partial-gather whose shard-wise
+            combine corrupts the masked -1 slots (observed: row values
+            summed across shards). Per-lane values are L-sized, so the
+            forced all-gather is noise."""
+            if self.mesh is None:
+                return x
+            return jax.lax.with_sharding_constraint(
+                x,
+                jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec()
+                ),
+            )
+
+        def rows(hit, kind, a, b):
+            """(…, L)-shaped event family → flattened [-1, 5] rows."""
+            k = jnp.where(hit, jnp.int32(kind), jnp.int32(-1))
+            shape = k.shape
+            return jnp.stack(
+                [
+                    jnp.broadcast_to(t, shape),
+                    jnp.broadcast_to(lanes, shape),
+                    k,
+                    jnp.broadcast_to(jnp.asarray(a, jnp.int32), shape),
+                    jnp.broadcast_to(jnp.asarray(b, jnp.int32), shape),
+                ],
+                axis=-1,
+            ).reshape(-1, 5)
+
+        parts = []
+        # status transitions — plan terminals AND scheduled crash/restart
+        sp = repl(status_prev[lanes])
+        sn = repl(status_new[lanes])
+        parts.append(rows(sp != sn, EV_STATUS, sn, sp))
+        # sync signals (barrier entry): one slot per declared state
+        if signals.shape[0] > 0:
+            sig = repl(signals[:, lanes])  # [S, L]
+            sid = jnp.broadcast_to(
+                jnp.arange(sig.shape[0], dtype=jnp.int32)[:, None],
+                sig.shape,
+            )
+            parts.append(rows(sig > 0, EV_SIGNAL, sid, 0))
+        # sends, with the transport fate in original outbox order
+        f = repl(fate.reshape(dst.shape)[:, lanes])  # [O, L]
+        parts.append(
+            rows(repl(valid[:, lanes]), EV_SEND, repl(dst[:, lanes]), f)
+        )
+        # deliveries, with provenance (src reads 0 under TRACK_SRC=False)
+        parts.append(
+            rows(
+                repl(inbox.valid[:, lanes]),
+                EV_DELIVER,
+                repl(inbox.src[:, lanes]),
+                0,
+            )
+        )
+        return jnp.concatenate(parts, axis=0)
 
     # ------------------------------------------------------------- sizing
 
@@ -1013,13 +1183,18 @@ class SimProgram:
     def _chunk_step(self, carry: SimCarry):
         """Run up to `chunk` ticks; ticks after global completion no-op.
 
-        Returns ``(carry, done)`` — or ``(carry, done, tele_block)`` with
-        a ``[chunk, K]`` per-tick counter block when the program was built
-        with ``telemetry=True`` (post-completion padding rows carry tick
-        = -1; the host decoder drops them). The block rides the scan's
-        stacked ys, so it reaches the host in the same dispatch result as
-        the done flag — no extra device round-trip."""
+        Returns ``(carry, done)``, extended positionally by the compiled-
+        in observability planes: with ``telemetry=True``, a ``[chunk, K]``
+        per-tick counter block and the chunk's ``[G, LATENCY_BINS]``
+        latency-histogram delta (read out of the carry and zeroed, so the
+        device counter never wraps); with a trace plan, a
+        ``[chunk, R, 5]`` flight-recorder block. Post-completion padding
+        rows carry tick/kind = -1 and are dropped by the host decoders.
+        Every block rides the scan's stacked ys (or the carry itself), so
+        it reaches the host in the same dispatch result as the done flag
+        — no extra device round-trip."""
         k = self._tele_k
+        r = self._trace_nrows
 
         def all_done(c):
             # host lanes never terminate — only plan instances gate done.
@@ -1032,19 +1207,35 @@ class SimProgram:
             return done
 
         def body(c, _):
-            c, tele = jax.lax.cond(
+            c, tele, trows = jax.lax.cond(
                 all_done(c),
-                lambda x: (x, jnp.full((k,), -1, jnp.int32)),
+                lambda x: (
+                    x,
+                    jnp.full((k,), -1, jnp.int32),
+                    jnp.full((r, 5), -1, jnp.int32),
+                ),
                 self._tick,
                 c,
             )
-            return c, tele
+            return c, (tele, trows)
 
-        carry, tele = jax.lax.scan(body, carry, None, length=self.chunk)
+        carry, (tele, trows) = jax.lax.scan(
+            body, carry, None, length=self.chunk
+        )
         done = all_done(carry)
-        if not self.telemetry:
-            return carry, done
-        return carry, done, tele
+        out = [carry, done]
+        if self.telemetry:
+            # flush-and-zero the histogram delta: the host accumulates
+            # chunk deltas in python ints (no int32 wrap, ever)
+            out.append(tele)
+            out.append(carry.lat_hist)
+            carry = dataclasses.replace(
+                carry, lat_hist=jnp.zeros_like(carry.lat_hist)
+            )
+            out[0] = carry
+        if self.trace is not None:
+            out.append(trows)
+        return tuple(out)
 
     def compiled_chunk(self):
         if self._chunk_fn is None:
@@ -1110,6 +1301,7 @@ class SimProgram:
         on_chunk: Callable[[int], None] | None = None,
         observer: Callable[[int, "SimCarry"], None] | None = None,
         telemetry_cb: Callable[[np.ndarray], None] | None = None,
+        trace_cb: Callable[[np.ndarray], None] | None = None,
         chunk_timeout: float = 0.0,
         on_stall: Callable[[int, int], None] | None = None,
         nan_guard: bool = False,
@@ -1129,6 +1321,10 @@ class SimProgram:
         ``telemetry=True`` only). The read piggybacks on the done-flag
         poll: by the time the done scalar is host-visible the block is
         materialized, so this is a copy, not an extra blocking sync.
+        The same applies to ``trace_cb(block)`` — each chunk's
+        ``[chunk, R, 5]`` flight-recorder block (trace-plan programs
+        only) — and to the per-chunk latency-histogram deltas, which the
+        loop accumulates into ``results()['lat_hist']``.
 
         ``chunk_timeout`` > 0 arms the per-chunk wall-clock watchdog
         (see :meth:`_dispatch_watched`); ``on_stall(last_tick, chunk)``
@@ -1146,6 +1342,13 @@ class SimProgram:
         fn = self.compiled_chunk()
         ticks = 0
         compile_secs = 0.0
+        # host-side accumulator for the per-chunk histogram deltas —
+        # python/int64 arithmetic, so the totals never wrap
+        lat_hist_acc = (
+            np.zeros((len(self.groups), LATENCY_BINS), np.int64)
+            if self.telemetry
+            else None
+        )
         while ticks < max_ticks:
             # the first dispatch includes trace + XLA compile (and under
             # a mesh the second recompiles at the sharding fixed point —
@@ -1183,8 +1386,14 @@ class SimProgram:
                 # on — verified). That cost lands in run wall; the
                 # sim:plan precompile warms BOTH variants.
                 compile_secs = _time.perf_counter() - t0
-            if self.telemetry and telemetry_cb is not None:
-                telemetry_cb(np.asarray(out[2]))
+            block_idx = 2
+            if self.telemetry:
+                if telemetry_cb is not None:
+                    telemetry_cb(np.asarray(out[2]))
+                lat_hist_acc += np.asarray(out[3], dtype=np.int64)
+                block_idx = 4
+            if self.trace is not None and trace_cb is not None:
+                trace_cb(np.asarray(out[block_idx]))
             if on_chunk is not None:
                 on_chunk(ticks)
             if observer is not None:
@@ -1195,6 +1404,11 @@ class SimProgram:
                 break
         res = self.results(carry, ticks)
         res["compile_secs"] = compile_secs
+        if lat_hist_acc is not None:
+            # per-receiver-group delivery-latency bin counts (see
+            # telemetry.LATENCY_BINS) — Σ over bins == delivered plan
+            # messages, exactly (host lanes excluded)
+            res["lat_hist"] = lat_hist_acc.tolist()
         return res
 
     def results(self, carry: SimCarry, ticks: int) -> dict[str, Any]:

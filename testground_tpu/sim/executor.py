@@ -172,6 +172,7 @@ def make_sim_program(
     validate,
     telemetry,
     faults,
+    trace,
 ):
     """The ONE construction site for a run's SimProgram. Every
     program-shaping option is a REQUIRED keyword: adding one here forces
@@ -192,6 +193,7 @@ def make_sim_program(
         validate=validate,
         telemetry=telemetry,
         faults=faults,
+        trace=trace,
     )
 
 
@@ -207,6 +209,20 @@ def fault_specs_of(run_groups, global_faults=None) -> dict:
         for g in run_groups
     }
     specs[""] = [dict(f) for f in (global_faults or [])]
+    return {k: v for k, v in specs.items() if v}
+
+
+def trace_specs_of(run_groups, global_trace=None) -> dict:
+    """Collect the declared flight-recorder tables for plan lowering:
+    {group_id: raw trace table}, with the run-global declaration
+    (``[global.run.trace]``) under the ``""`` key so its default target
+    is the whole run — the exact shape of :func:`fault_specs_of`. Plain
+    JSON-serializable data: broadcast to cohort followers and hashed
+    into the precompile BuildKey."""
+    specs = {
+        g.id: dict(getattr(g, "trace", None) or {}) for g in run_groups
+    }
+    specs[""] = dict(global_trace or {})
     return {k: v for k, v in specs.items() if v}
 
 
@@ -397,6 +413,34 @@ def _execute_sim_run(
             fault_schedule.summary(),
         )
 
+    # flight recorder (docs/OBSERVABILITY.md): lower the composition's
+    # [run.trace] sampling tables into a static TracePlan — a
+    # program-shaping input exactly like faults (the traced lanes bake
+    # into the tick), so it resolves before construction, joins the
+    # precompile BuildKey, and follows the telemetry plane's gating:
+    # disable_metrics wins, and cohorts run trace-free (the per-chunk
+    # leader-local block read is not symmetric across processes).
+    from .trace import build_trace_plan
+
+    trace_specs = trace_specs_of(job.groups, getattr(job, "trace", None))
+    trace_plan = build_trace_plan(groups, trace_specs)
+    if trace_plan is not None and job.disable_metrics:
+        trace_plan = None
+    if trace_plan is not None and getattr(cfg, "coordinator_address", ""):
+        ow.warn(
+            "sim:jax %s: flight recorder disabled for the cohort config "
+            "(per-chunk leader-local device reads are not symmetric "
+            "across processes)",
+            job.run_id,
+        )
+        trace_plan = None
+    if trace_plan is not None:
+        ow.infof(
+            "sim:jax %s: flight recorder armed — %s",
+            job.run_id,
+            trace_plan.summary(),
+        )
+
     # telemetry plane: the per-tick counter block is a PROGRAM-shaping
     # option (it changes the traced chunk), so it must be decided before
     # construction and broadcast to cohort followers. The composition's
@@ -471,6 +515,11 @@ def _execute_sim_run(
                 "validate": bool(getattr(cfg, "validate", False)),
                 "telemetry": telemetry_on,
                 "faults": fault_specs,
+                # cohorts run trace-free (gated above), so the broadcast
+                # carries the post-gate value — always empty here, kept
+                # explicit so a future symmetric-trace design cannot
+                # silently desync the followers
+                "trace": {},
             }
         )
         # readiness vote: a worker whose plans dir cannot satisfy the job
@@ -511,6 +560,7 @@ def _execute_sim_run(
         validate=bool(getattr(cfg, "validate", False)),
         telemetry=telemetry_on,
         faults=fault_schedule,
+        trace=trace_plan,
     )
     _precheck_device_memory(prog, cfg, mesh, ow)
     # the device-resident carry footprint is ALWAYS part of the run
@@ -525,14 +575,19 @@ def _execute_sim_run(
     )
     spans.end("build", carry_bytes=carry_bytes, instances=n)
 
-    t0 = time.time()
+    # duration math runs on the monotonic clock (a wall-clock step —
+    # NTP slew, operator date change — must not produce negative chunk
+    # timings or a wrong run wall); the wall-clock anchor survives only
+    # where a real timestamp is needed (the Influx base_ns)
+    t0_wall = time.time()
+    t0 = time.monotonic()
     last_report = [t0]
 
     def on_chunk(ticks: int) -> None:
         spans.point(
-            "chunk", ticks=ticks, wall_secs=round(time.time() - t0, 6)
+            "chunk", ticks=ticks, wall_secs=round(time.monotonic() - t0, 6)
         )
-        now = time.time()
+        now = time.monotonic()
         if now - last_report[0] >= 5.0:
             last_report[0] = now
             ow.infof(
@@ -574,6 +629,14 @@ def _execute_sim_run(
             else None,
         )
         if telemetry_on
+        else None
+    )
+    # Flight-recorder sink: per-chunk [chunk, R, 5] event blocks stream
+    # to sim_trace.jsonl as they arrive; a bounded buffer (the plan's
+    # ``events`` cap) feeds the Chrome trace export written at close.
+    trace_writer = (
+        _SimTraceWriter(groups, row_ident, run_dir, cfg.tick_ms, trace_plan)
+        if trace_plan is not None
         else None
     )
     # Profile capture — the pprof analog (``pkg/api/composition.go:153-162``
@@ -627,6 +690,7 @@ def _execute_sim_run(
             on_chunk=on_chunk,
             observer=recorder.observe if recorder.enabled else None,
             telemetry_cb=tele_writer.on_block if tele_writer else None,
+            trace_cb=trace_writer.on_block if trace_writer else None,
             chunk_timeout=float(getattr(cfg, "chunk_timeout_secs", 0.0)),
             on_stall=on_stall,
             # same rule as telemetry: a leader-local full-carry read is
@@ -644,7 +708,7 @@ def _execute_sim_run(
             res = _run()
     else:
         res = _run()
-    wall = time.time() - t0
+    wall = time.monotonic() - t0
     spans.point("compile", wall_secs=round(res.get("compile_secs", 0.0), 6))
     spans.end("execute", ticks=res["ticks"])
     status = res["status"]
@@ -770,6 +834,50 @@ def _execute_sim_run(
             },
         }
 
+    # ------------------------------------------- delivery-latency summary
+    # per-receiver-group p50/p95/p99 estimated from the device-side log2
+    # histograms (telemetry plane) — journaled under sim.latency, written
+    # as viewer-shaped sim_latency.jsonl rows for the dashboard, and
+    # mirrored to Influx as the ``sim.latency.*`` measurement family
+    lat_rows: list[dict] = []
+    latency: dict = {}
+    if res.get("lat_hist") is not None:
+        from .telemetry import LATENCY_FILE, latency_percentiles
+
+        latency = {
+            g.id: latency_percentiles(res["lat_hist"][gi], cfg.tick_ms)
+            for gi, g in enumerate(groups)
+        }
+        for gid, pct in latency.items():
+            for q in ("p50", "p95", "p99"):
+                if f"{q}_ms" not in pct:
+                    continue
+                v = pct[f"{q}_ms"]
+                lat_rows.append(
+                    {
+                        **row_ident,
+                        "tick": res["ticks"],
+                        "group_id": gid,
+                        "name": f"sim.latency.{q}",
+                        "count": pct["count"],
+                        "mean": v,
+                        "min": v,
+                        "max": v,
+                    }
+                )
+        if run_dir is not None and lat_rows:
+            try:
+                with open(os.path.join(run_dir, LATENCY_FILE), "w") as f:
+                    for row in lat_rows:
+                        f.write(json.dumps(row) + "\n")
+            except OSError:  # observability never fails the run
+                pass
+
+    # --------------------------------------------- flight-recorder close
+    if trace_writer is not None:
+        trace_writer.close()
+        result.journal["trace"] = trace_writer.journal()
+
     # ------------------------------------------------ metric time series
     # final sample at the last tick, then persist the run's series — written
     # even above write_outputs_max (per-group reductions stay small)
@@ -796,7 +904,7 @@ def _execute_sim_run(
     )
     # base_ns = run start, NOT push time: stable per run, so re-pushes
     # are idempotent and batches never collide
-    base_ns = int(t0 * 1e9)
+    base_ns = int(t0_wall * 1e9)
     if influx_endpoint and full_rows:
         from testground_tpu.metrics.influx import push_rows
 
@@ -815,6 +923,14 @@ def _execute_sim_run(
         # batch above
         result.journal["influx_telemetry"] = _push_sim_series(
             influx_endpoint, tele_writer.iter_rows(), base_ns
+        )
+    if influx_endpoint and lat_rows:
+        # per-group latency percentiles (sim.latency.* family) — already
+        # viewer-shaped, a handful of rows, one small batch
+        from testground_tpu.metrics.influx import push_rows
+
+        result.journal["influx_latency"] = push_rows(
+            influx_endpoint, lat_rows, base_ns=base_ns
         )
 
     for gi, g in enumerate(groups):
@@ -870,6 +986,9 @@ def _execute_sim_run(
         "faults_restarted": res.get("faults_restarted", 0),
         "msgs_fault_dropped": res.get("fault_dropped", 0),
         "carry_bytes": res.get("carry_bytes", carry_bytes),
+        # per-receiver-group delivery-latency percentiles (telemetry
+        # plane; docs/OBSERVABILITY.md) — absent when telemetry was off
+        **({"latency": latency} if latency else {}),
     }
     result.update_outcome()
     if cancel.is_set():
@@ -947,6 +1066,7 @@ def sim_worker_loop(
             log(f"sim-worker: cohort skipped run {spec['run_id']}")
             continue
         from .faults import build_fault_schedule as _build_faults
+        from .trace import build_trace_plan as _build_trace
 
         prog = make_sim_program(
             testcase,
@@ -966,6 +1086,7 @@ def sim_worker_loop(
             faults=_build_faults(
                 groups, spec.get("faults") or {}, spec["tick_ms"]
             ),
+            trace=_build_trace(groups, spec.get("trace") or {}),
         )
         res = prog.run(
             seed=spec["seed"],
@@ -1085,20 +1206,140 @@ class _SimTelemetryWriter:
         """Re-read the written series (for the Influx mirror) — the
         rows were streamed out, not retained. Unparseable lines are
         skipped (best-effort, like the push itself)."""
+        from .telemetry import iter_jsonl
+
         if self.path is None:
             return
-        try:
-            with open(self.path, "r", encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        yield json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-        except OSError:
+        yield from iter_jsonl(self.path)
+
+
+class _SimTraceWriter:
+    """Streams the chunk-flushed ``[chunk, R, 5]`` flight-recorder
+    blocks (``sim/trace.py``) into the run's ``sim_trace.jsonl`` as they
+    arrive — host memory stays bounded by one chunk for the jsonl path,
+    and a crashed run keeps everything flushed so far. Decoded events are
+    additionally buffered (bounded by the plan's ``events`` cap) for the
+    Chrome trace export written at :meth:`close`; past the cap the jsonl
+    keeps streaming and ``truncated`` counts what the export lost. With
+    no outputs dir the writer only counts events (same rule as the
+    telemetry writer)."""
+
+    def __init__(self, groups, ident: dict, run_dir, tick_ms: float, plan):
+        from .trace import TRACE_EVENTS_FILE, TRACE_FILE
+
+        self.plan = plan
+        self.ident = ident
+        self.tick_ms = float(tick_ms)
+        self.events_written = 0
+        self.truncated = 0
+        self._buffer: list[dict] = []
+        self._groups = groups
+        # lane → (group id, group-relative seq), for the TRACED lanes
+        # only (≤ MAX_TRACE_LANES): a fleet-wide map would cost O(N)
+        # memory for lookups that only ever hit the sample; the Chrome
+        # export's track names derive from the same resolution
+        self._lane_group = {}
+        for lane in plan.lanes:
+            lane = int(lane)
+            g = next(
+                (
+                    g
+                    for g in groups
+                    if g.offset <= lane < g.offset + g.count
+                ),
+                None,
+            )
+            self._lane_group[lane] = (
+                (g.id, lane - g.offset) if g is not None else ("", -1)
+            )
+        self._gid_of = {
+            lane: gid for lane, (gid, _) in self._lane_group.items()
+        }
+        self.path = (
+            os.path.join(run_dir, TRACE_FILE)
+            if run_dir is not None
+            else None
+        )
+        self.events_path = (
+            os.path.join(run_dir, TRACE_EVENTS_FILE)
+            if run_dir is not None
+            else None
+        )
+        self._f = None
+        if self.path is not None:
+            try:
+                self._f = open(self.path, "w")
+            except OSError:  # observe best-effort, never fail the run
+                self.path = None
+
+    def on_block(self, block) -> None:
+        from .trace import events_from_blocks
+
+        events = events_from_blocks(
+            [block], lambda i: self._gid_of.get(i, "")
+        )
+        self.events_written += len(events)
+        room = self.plan.events_cap - len(self._buffer)
+        if room > 0:
+            self._buffer.extend(events[:room])
+        self.truncated += max(0, len(events) - max(room, 0))
+        if self._f is not None:
+            try:
+                for ev in events:
+                    self._f.write(json.dumps({**self.ident, **ev}) + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+                self.path = None
+
+    def close(self) -> None:
+        from .trace import chrome_trace
+
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                self.path = None
+            finally:
+                self._f = None
+        if self.events_path is None:
             return
+        lane_names = {
+            lane: f"{gid}[{seq}] i{lane}"
+            for lane, (gid, seq) in self._lane_group.items()
+        }
+        try:
+            with open(self.events_path, "w") as f:
+                json.dump(
+                    chrome_trace(
+                        self._buffer,
+                        self.plan.lanes,
+                        lane_names,
+                        self.tick_ms,
+                    ),
+                    f,
+                )
+        except (OSError, ValueError):
+            self.events_path = None
+
+    def journal(self) -> dict:
+        from .trace import TRACE_EVENTS_FILE, TRACE_FILE
+
+        out: dict = {
+            "events": self.events_written,
+            "instances": self.plan.count,
+        }
+        if self.path is not None:
+            out["file"] = TRACE_FILE
+        if self.events_path is not None:
+            out["events_file"] = TRACE_EVENTS_FILE
+        if self.truncated:
+            out["truncated"] = self.truncated
+        return out
 
 
 class _TimeSeriesRecorder:

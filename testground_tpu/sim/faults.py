@@ -92,11 +92,11 @@ def _parse_range(spec: str, what: str) -> tuple[int, int]:
         lo, hi = int(lo_s), int(hi_s)
     except ValueError:
         raise ValueError(
-            f"fault {what} range {spec!r} is not 'lo:hi' (half-open ints)"
+            f"{what} range {spec!r} is not 'lo:hi' (half-open ints)"
         ) from None
     if lo < 0 or hi <= lo:
         raise ValueError(
-            f"fault {what} range {spec!r} is empty or negative"
+            f"{what} range {spec!r} is empty or negative"
         )
     return lo, hi
 
@@ -115,7 +115,7 @@ def _resolve_mask(sel: _Selector, groups, n: int, what: str) -> np.ndarray:
         g = next((g for g in groups if g.id == sel.group), None)
         if g is None:
             raise ValueError(
-                f"fault {what} targets unknown group {sel.group!r}; run "
+                f"{what} targets unknown group {sel.group!r}; run "
                 f"groups are {[g.id for g in groups]}"
             )
         lo, hi = g.offset, g.offset + g.count
@@ -125,7 +125,7 @@ def _resolve_mask(sel: _Selector, groups, n: int, what: str) -> np.ndarray:
         rlo, rhi = _parse_range(sel.instances, what)
         if rhi > hi - lo:
             raise ValueError(
-                f"fault {what} range {sel.instances!r} exceeds the "
+                f"{what} range {sel.instances!r} exceeds the "
                 f"{hi - lo} instance(s) of its target"
             )
         lo, hi = lo + rlo, lo + rhi
@@ -135,7 +135,7 @@ def _resolve_mask(sel: _Selector, groups, n: int, what: str) -> np.ndarray:
         k = int(np.floor(sel.fraction * idx.size + 0.5))
         if k <= 0:
             raise ValueError(
-                f"fault {what}: fraction {sel.fraction} of {idx.size} "
+                f"{what}: fraction {sel.fraction} of {idx.size} "
                 "instance(s) selects nobody — raise the fraction or "
                 "widen the target"
             )
@@ -144,7 +144,7 @@ def _resolve_mask(sel: _Selector, groups, n: int, what: str) -> np.ndarray:
         mask = np.zeros((n,), bool)
         mask[keep] = True
     if not mask.any():
-        raise ValueError(f"fault {what} selects no instances")
+        raise ValueError(f"{what} selects no instances")
     return mask
 
 
@@ -374,7 +374,7 @@ def build_fault_schedule(
     loss_t0, loss_t1, loss_masks, loss_pct = [], [], [], []
     last = 0
     for f in parsed:
-        mask = _resolve_mask(f.sel, groups, n, f.kind)
+        mask = _resolve_mask(f.sel, groups, n, f"fault {f.kind}")
         t0 = _ticks(f.start_ms, tick_ms)
         t1 = t0 + max(_ticks(f.duration_ms, tick_ms), 1)
         if f.kind == "crash":
@@ -386,7 +386,7 @@ def build_fault_schedule(
             restart_masks.append(mask)
             last = max(last, t0)
         elif f.kind == "partition":
-            other = _resolve_mask(f.to_sel, groups, n, "partition:to")
+            other = _resolve_mask(f.to_sel, groups, n, "fault partition:to")
             if (mask & other).any():
                 raise ValueError(
                     "fault partition: the two sides overlap — an instance "

@@ -61,6 +61,7 @@ __all__ = [
     "NetFeedback",
     "deliver",
     "enqueue",
+    "latency_histogram",
     "make_link_state",
     "purge_dst",
 ]
@@ -181,6 +182,13 @@ class NetFeedback:
                fault-injection plane (partition/link-flap windows, fault
                loss bursts, traffic to/from crashed instances); always 0
                when no fault schedule is compiled in
+    fate:      [O·N] int32 | None — per-message transport fate in the
+               ORIGINAL outbox order (m = o·N + src), for the flight
+               recorder's traced send events: 0 enqueued, 1 rejected,
+               2 fault_dropped, 3 dropped, -1 invalid outbox slot.
+               None unless ``want_fate`` was requested (trace plane
+               compiled in); duplicate-shaping copies report through
+               their original's fate (enqueued if either copy made it)
     """
 
     rejected: jax.Array
@@ -192,6 +200,7 @@ class NetFeedback:
     sent: jax.Array
     enqueued: jax.Array
     fault_dropped: jax.Array
+    fate: jax.Array | None = None
 
 
 @jax.tree_util.register_dataclass
@@ -206,6 +215,14 @@ class Calendar:
              with provenance on, validity is ``src != 0``, which saves a
              whole plane scatter per tick (~18% of the sustained full
              path at 100k instances)
+    etick:   [L, N·SLOTS] int32 — the tick each in-flight message was
+             enqueued at (None unless the telemetry plane is compiled
+             in): at delivery, ``t - etick`` is the message's end-to-end
+             delivery latency in ticks, binned into the per-group
+             latency histogram (:func:`latency_histogram`). Stale values
+             survive ``deliver``'s row clear exactly like payload words
+             (masked by the occupancy plane), so the plane costs one
+             extra scatter per tick and nothing at delivery.
 
     Bucket fill counts (how many slots of (bucket, dst) are taken, so
     messages enqueued on LATER ticks stack into the next free slots
@@ -236,6 +253,7 @@ class Calendar:
     payload: tuple
     src: jax.Array | None
     valid: jax.Array | None
+    etick: jax.Array | None = None
     slots: int = dataclasses.field(metadata=dict(static=True), default=4)
     flat: bool = dataclasses.field(metadata=dict(static=True), default=False)
     # bucket count — static; required to address flat planes (the 2-D
@@ -250,6 +268,7 @@ class Calendar:
         width: int,
         track_src: bool = True,
         flat: bool = False,
+        track_etick: bool = False,
     ) -> "Calendar":
         ns = n * slots
         shape = (horizon * ns,) if flat else (horizon, ns)
@@ -257,6 +276,7 @@ class Calendar:
             payload=tuple(jnp.zeros(shape, jnp.int32) for _ in range(width)),
             src=jnp.zeros(shape, jnp.int32) if track_src else None,
             valid=None if track_src else jnp.zeros(shape, bool),
+            etick=jnp.zeros(shape, jnp.int32) if track_etick else None,
             slots=slots,
             flat=flat,
             horizon=horizon,
@@ -388,6 +408,58 @@ def purge_dst(cal: Calendar, dst_mask: jax.Array) -> tuple[Calendar, jax.Array]:
     return cal, purged
 
 
+def latency_histogram(
+    cal: Calendar,
+    inbox: Inbox,
+    t: jax.Array,
+    group_of,  # [N_lanes] int32 — receiver lane → group (>=n_groups drops)
+    n_groups: int,
+    n_bins: int,
+) -> jax.Array:
+    """Per-receiver-group delivery-latency histogram of the bucket
+    delivered at tick ``t`` → ``[n_groups, n_bins]`` int32.
+
+    Latency = ``t - etick`` (the enqueue tick stored per message when the
+    telemetry plane is on), binned log2: bin b counts delays in
+    [2^b, 2^(b+1)) ticks, last bin open-ended (the clamp-to-last-bin
+    contract). Call with the PRE-deliver calendar (or post — ``deliver``
+    clears only the occupancy plane, the etick row survives) and the
+    inbox it popped; invalid inbox slots and lanes whose ``group_of``
+    entry is out of range (additional hosts) are dropped by the scatter,
+    so ``sum(hist) == delivered plan messages`` holds exactly per tick.
+    The cost is ~n_bins compares per inbox slot plus one scatter-add into
+    a [G·B] vector — noise beside the delivery gather itself."""
+    assert cal.etick is not None, (
+        "latency_histogram needs a Calendar built with track_etick=True"
+    )
+    slots = cal.slots
+    plane = cal.etick
+    b = jnp.mod(t, cal.horizon if cal.flat else plane.shape[0])
+    if cal.flat:
+        ns = plane.shape[0] // cal.horizon
+        row = jax.lax.dynamic_slice(plane, (b * ns,), (ns,))
+    else:
+        ns = plane.shape[1]
+        row = jax.lax.dynamic_index_in_dim(plane, b, axis=0, keepdims=False)
+    n = ns // slots
+    delay = t - row.reshape(slots, n)  # [SLOTS, N]; >= 1 when valid
+    # integer edge compares, not float log2 — exact at every power of two
+    edges = jnp.asarray([1 << e for e in range(1, n_bins)], jnp.int32)
+    binidx = jnp.sum(
+        (delay[..., None] >= edges).astype(jnp.int32), axis=-1
+    )
+    g = jnp.asarray(group_of, jnp.int32)
+    idx = g[None, :] * n_bins + binidx  # host lanes index out of range
+    oob = jnp.int32(n_groups * n_bins)
+    idx = jnp.where(inbox.valid, idx, oob)
+    hist = (
+        jnp.zeros((n_groups * n_bins,), jnp.int32)
+        .at[idx.reshape(-1)]
+        .add(1, mode="drop")
+    )
+    return hist.reshape(n_groups, n_bins)
+
+
 def enqueue(
     cal: Calendar,
     link: LinkState,
@@ -405,6 +477,7 @@ def enqueue(
     validate: bool = False,
     faults=None,
     dead: jax.Array | None = None,
+    want_fate: bool = False,
 ) -> tuple[Calendar, NetFeedback]:
     """Shape + schedule this tick's sends (inputs in plane layout, message
     m = o·N + src). Returns (cal', NetFeedback).
@@ -449,6 +522,15 @@ def enqueue(
     ``NetFeedback.fault_dropped`` (its in-flight backlog was purged at
     crash time by :func:`purge_dst`). Control-route traffic is exempt
     from every fault, like it is from shaping.
+
+    ``want_fate`` — flight-recorder support (``sim/trace.py``): also
+    return ``NetFeedback.fate``, the per-message transport fate in
+    original outbox order. Compiled out (fate = None, identical program)
+    when False.
+
+    A calendar built with ``track_etick=True`` additionally records each
+    enqueued message's send tick, the latency plane's ground truth
+    (:func:`latency_histogram`).
     """
     slots = cal.slots
     width = cal.width
@@ -480,6 +562,12 @@ def enqueue(
     pay_w = [payload[:, w, :].reshape(-1) for w in range(width)]  # W× [M]
     val_f = valid.reshape(-1)
     m = val_f.shape[0]
+    # flight-recorder fate tracking (want_fate): the original validity
+    # plus the per-stage kill masks, all in ORIGINAL message order —
+    # assembled into a per-message fate code at the end
+    val0 = val_f
+    rej_m = None
+    fault_m = None
     # telemetry: messages entering the transport (before any shaping or
     # bounds masking — out-of-range dsts count as sent-then-dropped);
     # duplicate-shaping copies are added below so conservation closes
@@ -605,6 +693,7 @@ def enqueue(
             accept = accept | is_ctrl
             rejected_msg = rejected_msg & ~is_ctrl
         val_f = val_f & accept
+        rej_m = rejected_msg
         rejected = jnp.sum(
             rejected_msg.reshape(o, n).astype(jnp.int32), axis=0
         )
@@ -655,6 +744,7 @@ def enqueue(
         if is_ctrl is not None:
             kill = kill & ~is_ctrl
         killed = val_f & kill
+        fault_m = killed
         fault_dropped = jnp.sum(killed.astype(jnp.int32))
         val_f = val_f & ~killed
 
@@ -791,6 +881,23 @@ def enqueue(
     clamped = jnp.sum((val_f & (delay > horizon - 1)).astype(jnp.int32))
     delay = jnp.clip(delay, 1, horizon - 1)
 
+    def fate_of(survived):
+        """Per-message fate in original order (see NetFeedback.fate):
+        the catch-all is 'dropped' (bounds, loss, bandwidth, slot
+        overflow), overridden by the specific kill masks, overridden by
+        survival — the precedence matches the flow-conservation
+        accounting, so a traced send's fate names the counter its
+        message landed in."""
+        if not want_fate:
+            return None
+        f = jnp.full((m,), 3, jnp.int32)  # dropped
+        if fault_m is not None:
+            f = jnp.where(fault_m, 2, f)  # fault_dropped
+        if rej_m is not None:
+            f = jnp.where(rej_m, 1, f)  # rejected
+        f = jnp.where(survived, 0, f)  # enqueued
+        return jnp.where(val0, f, -1)
+
     if slot_mode == "direct":
         # slot = the sender's outbox index: one scatter index per message
         # with no sort and no duplicate pass. Unique under the mode's
@@ -845,9 +952,18 @@ def enqueue(
         else:
             new_src = None
             new_valid = scat(cal.valid, buck_i, pos_i, True)
+        new_etick = (
+            scat(cal.etick, buck_i, pos_i, jnp.broadcast_to(t, pos_i.shape))
+            if cal.etick is not None
+            else None
+        )
         return (
             dataclasses.replace(
-                cal, payload=new_payload, src=new_src, valid=new_valid
+                cal,
+                payload=new_payload,
+                src=new_src,
+                valid=new_valid,
+                etick=new_etick,
             ),
             NetFeedback(
                 rejected=rejected,
@@ -859,6 +975,7 @@ def enqueue(
                 sent=sent,
                 enqueued=jnp.sum(val_f.astype(jnp.int32)),
                 fault_dropped=fault_dropped,
+                fate=fate_of(val_f),
             ),
         )
 
@@ -882,6 +999,9 @@ def enqueue(
             [delay, jnp.clip(delay + 1, 1, horizon - 1)]
         )
         m2 = 2 * m
+        # fate rides the sort as the original message index; a duplicate
+        # copy shares its original's index (their fates merge by max)
+        orig2 = jnp.concatenate([midx, midx]) if want_fate else None
     else:
         dst2, pay2, src2, val2, delay2, m2 = (
             dst_safe,
@@ -891,6 +1011,7 @@ def enqueue(
             delay,
             m,
         )
+        orig2 = midx if want_fate else None
 
     bucket = jnp.mod(t + delay2, horizon)
 
@@ -904,11 +1025,13 @@ def enqueue(
     # valid are re-derived from the sorted key instead of sorted.
     big = jnp.int32(horizon * n)
     sort_key = jnp.where(val2, bucket * n + dst2, big)
-    sorted_ops = jax.lax.sort(
-        [sort_key, src2] + list(pay2), num_keys=1, is_stable=True
-    )
+    sort_vals = [sort_key, src2] + list(pay2)
+    if orig2 is not None:
+        sort_vals.append(orig2)
+    sorted_ops = jax.lax.sort(sort_vals, num_keys=1, is_stable=True)
     sk, src_s = sorted_ops[:2]
-    pay_s = sorted_ops[2:]
+    pay_s = sorted_ops[2 : 2 + width]
+    orig_s = sorted_ops[-1] if orig2 is not None else None
     val_sorted = sk < big
     buck_s = jnp.where(val_sorted, sk // n, horizon)
     dst_s = jnp.mod(sk, n)
@@ -962,10 +1085,31 @@ def enqueue(
     else:
         new_src = None
         new_valid = scat(cal.valid, buck_i, pos_i, True)
+    new_etick = (
+        scat(cal.etick, buck_i, pos_i, jnp.broadcast_to(t, pos_i.shape))
+        if cal.etick is not None
+        else None
+    )
+
+    if orig_s is not None:
+        # map slot survival back to original order (duplicate copies
+        # share an index, so scatter-max: enqueued if either copy was)
+        surv = (
+            jnp.zeros((m,), jnp.int32)
+            .at[orig_s]
+            .max(val_s.astype(jnp.int32))
+        )
+        fate = fate_of(surv > 0)
+    else:
+        fate = None
 
     return (
         dataclasses.replace(
-            cal, payload=new_payload, src=new_src, valid=new_valid
+            cal,
+            payload=new_payload,
+            src=new_src,
+            valid=new_valid,
+            etick=new_etick,
         ),
         NetFeedback(
             rejected=rejected,
@@ -977,6 +1121,7 @@ def enqueue(
             sent=sent,
             enqueued=jnp.sum(val_s.astype(jnp.int32)),
             fault_dropped=fault_dropped,
+            fate=fate,
         ),
     )
 

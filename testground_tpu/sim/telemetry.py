@@ -27,10 +27,15 @@ import time
 from typing import Any, Iterable
 
 __all__ = [
+    "LATENCY_BINS",
+    "LATENCY_FILE",
     "SIM_SERIES_FILE",
     "SPAN_FILE",
     "TELEMETRY_FIXED_COLUMNS",
     "SpanTracer",
+    "iter_jsonl",
+    "latency_bin_edges",
+    "latency_percentiles",
     "rows_from_blocks",
     "telemetry_totals",
 ]
@@ -38,6 +43,60 @@ __all__ = [
 # Per-run output file names (under <outputs>/<plan>/<run_id>/).
 SIM_SERIES_FILE = "sim_timeseries.jsonl"
 SPAN_FILE = "run_spans.jsonl"
+# Per-group delivery-latency summary rows (viewer-shaped: run/plan/case/
+# tick/group_id/name + count/mean/min/max) — the ``sim.latency.*``
+# measurement family the dashboard and the Influx mirror consume.
+LATENCY_FILE = "sim_latency.jsonl"
+
+# Delivery-latency histogram schema, shared by the device accumulator
+# (``sim/net.py::latency_histogram``) and every host-side consumer. Bins
+# are log2-spaced in TICKS: bin b counts deliveries whose (delivery tick
+# - enqueue tick) lies in [2^b, 2^(b+1)); the LAST bin is open-ended
+# (delays past 2^(LATENCY_BINS-1) ticks clamp into it). Fixed and
+# log-spaced so the device-side cost is a handful of compares per
+# delivered message and the host can estimate stable p50/p95/p99 without
+# per-message state — the shape every serving/training stack converges
+# on for cheap always-on latency observability.
+LATENCY_BINS = 12
+
+
+def latency_bin_edges() -> tuple[int, ...]:
+    """Lower edge (inclusive, in ticks) of each histogram bin."""
+    return tuple(1 << b for b in range(LATENCY_BINS))
+
+
+def latency_percentiles(
+    hist, tick_ms: float, quantiles=(0.50, 0.95, 0.99)
+) -> dict:
+    """Estimate latency quantiles in milliseconds from one group's bin
+    counts (``[LATENCY_BINS]`` ints). Linear interpolation inside the
+    hit bin (the standard histogram-quantile estimator); the open last
+    bin is valued at its lower edge, so a tail that escaped the bin
+    range under-reports rather than inventing precision. Returns
+    ``{count, p50_ms, p95_ms, p99_ms}`` (``count`` only when empty)."""
+    counts = [int(c) for c in hist]
+    total = sum(counts)
+    out: dict = {"count": total}
+    if total == 0:
+        return out
+    edges = latency_bin_edges()
+    cum = 0
+    targets = [(q, q * total) for q in quantiles]
+    ti = 0
+    for b, c in enumerate(counts):
+        prev = cum
+        cum += c
+        while ti < len(targets) and cum >= targets[ti][1]:
+            q, rank = targets[ti]
+            lo = float(edges[b])
+            hi = float(edges[b] * 2) if b < LATENCY_BINS - 1 else lo
+            frac = (rank - prev) / c if c else 0.0
+            ticks = lo + frac * (hi - lo)
+            out[f"p{int(q * 100)}_ms"] = round(ticks * tick_ms, 6)
+            ti += 1
+        if ti >= len(targets):
+            break
+    return out
 
 # Fixed leading columns of the device-side counter vector, in order.
 # Columns after these are one live-instance count per group (schema key
@@ -83,6 +142,26 @@ TELEMETRY_FIXED_COLUMNS = (
     "faults_restarted",
     "fault_dropped",
 )
+
+
+def iter_jsonl(path: str) -> Iterable[dict]:
+    """Tolerant jsonl reader shared by every observability consumer
+    (viewer, trace reader, influx re-read): blank lines and unparseable
+    lines — e.g. the partially-written tail of a still-streaming file —
+    are skipped, IO errors end the stream. One implementation, so a
+    future hardening cannot drift across surfaces."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return
 
 
 def rows_from_blocks(blocks: Iterable, group_ids: tuple) -> list[dict]:
@@ -166,14 +245,18 @@ class SpanTracer:
             pass
 
     def start(self, span: str, **attrs) -> None:
-        self._open[span] = time.perf_counter()
+        # durations come from the monotonic clock — a wall-clock step
+        # (NTP slew, operator date change) mid-span must not produce a
+        # negative or wildly wrong wall_secs; the emitted line keeps the
+        # wall-clock ts for cross-host correlation
+        self._open[span] = time.monotonic()
         self._emit({"type": "span_start", "span": span, **attrs})
 
     def end(self, span: str, **attrs) -> None:
         t0 = self._open.pop(span, None)
         if t0 is not None:
             attrs.setdefault(
-                "wall_secs", round(time.perf_counter() - t0, 6)
+                "wall_secs", round(time.monotonic() - t0, 6)
             )
         self._emit({"type": "span_end", "span": span, **attrs})
 

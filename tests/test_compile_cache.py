@@ -74,6 +74,17 @@ os.environ.setdefault(
 )
 import jax
 jax.config.update("jax_platforms", "cpu")
+
+# count persistent-cache hits via jax's own monitoring events — a
+# deterministic signal, unlike wall-clock compile_secs on a loaded CI
+# container (where trace time and process noise can drown the
+# sub-second XLA compile of this tiny program)
+_cache_hits = [0]
+def _on_event(event, **kw):
+    if event == "/jax/compilation_cache/cache_hits":
+        _cache_hits[0] += 1
+jax.monitoring.register_event_listener(_on_event)
+
 from testground_tpu.api import RunGroup, RunInput
 from testground_tpu.config import EnvConfig
 from testground_tpu.rpc import discard_writer
@@ -102,6 +113,7 @@ print(
         {
             "outcome": out.result.outcome.value,
             "compile_secs": out.result.journal["sim"]["compile_secs"],
+            "cache_hits": _cache_hits[0],
         }
     )
 )
@@ -111,9 +123,12 @@ print(
 class TestPersistentCacheAcrossProcesses:
     def test_fresh_process_rerun_skips_xla_compile(self, tg_home):
         """Two FRESH processes run the identical composition; the second
-        must add zero cache entries (every compile was a disk hit) and
-        report a journal compile_secs that is a small fraction of the
-        first's."""
+        must add zero cache entries AND observe persistent-cache hits
+        (jax's /jax/compilation_cache/cache_hits monitoring event) where
+        the cold run observed none — the cross-process claim pinned by
+        the cache's own accounting rather than wall-clock ratios, which
+        are noise-dominated for this sub-second program on a loaded CI
+        container."""
         cache = os.path.join(str(tg_home), "data", "compile-cache")
         artifact = os.path.join(PLANS, "network")
 
@@ -136,6 +151,10 @@ class TestPersistentCacheAcrossProcesses:
         assert r1["outcome"] == "success"
         entries_after_cold = cache_entries(cache)
         assert entries_after_cold, "cold run wrote no cache entries"
+        assert r1["cache_hits"] == 0, (
+            f"cold run against an empty cache reported "
+            f"{r1['cache_hits']} cache hit(s)"
+        )
 
         r2 = run("warm")
         assert r2["outcome"] == "success"
@@ -144,12 +163,13 @@ class TestPersistentCacheAcrossProcesses:
             "warm process compiled new programs: "
             f"{sorted(entries_after_warm - entries_after_cold)}"
         )
-        # warm = trace/lower + deserialize; cold = trace/lower + XLA
-        # compile. The margin is generous — the signal on this program is
-        # far larger (see the persistent-cache probe in utils docstring).
-        assert r2["compile_secs"] <= 0.75 * r1["compile_secs"], (
-            f"warm compile_secs {r2['compile_secs']} not a fraction of "
-            f"cold {r1['compile_secs']}"
+        # the warm process's compiles were DISK READS: jax's own cache
+        # accounting must report at least one hit per cached program
+        # family it executed (init + chunk variants)
+        assert r2["cache_hits"] >= 2, (
+            f"warm run reported only {r2['cache_hits']} persistent-cache "
+            "hit(s) — the fresh process recompiled instead of reading "
+            "the cache"
         )
 
 
@@ -235,20 +255,45 @@ class TestBuildPrecompiles:
         after_build = cache_entries(cache)
         assert after_build, "precompile wrote no cache entries"
 
-        # the run compiles nothing — every program is a cache read
-        t2 = _wait(
-            engine,
-            engine.queue_run(_composition(), manifest, sources_dir=sources),
-        )
+        # the run compiles nothing — every program is a cache read,
+        # witnessed by jax's own cache-hit accounting (wall-clock ratios
+        # are noise-dominated for this sub-second program on a loaded CI
+        # container)
+        import jax.monitoring
+
+        hits = [0]
+
+        def _on_event(event, **kw):
+            if event == "/jax/compilation_cache/cache_hits":
+                hits[0] += 1
+
+        jax.monitoring.register_event_listener(_on_event)
+        try:
+            t2 = _wait(
+                engine,
+                engine.queue_run(
+                    _composition(), manifest, sources_dir=sources
+                ),
+            )
+        finally:
+            # best-effort unregister (private — jax.monitoring exposes no
+            # public remove); a leaked listener is harmless: it only
+            # increments a dead local counter on later events
+            try:
+                from jax._src import monitoring as _mon
+
+                _mon._unregister_event_listener_by_callback(_on_event)
+            except (ImportError, AttributeError):
+                pass
         assert t2.outcome() == Outcome.SUCCESS, t2.error
         after_run = cache_entries(cache)
         assert after_run == after_build, (
             "run compiled programs the build should have precompiled: "
             f"{sorted(after_run - after_build)}"
         )
-        assert (
-            t2.result["journal"]["sim"]["compile_secs"]
-            <= 0.75 * marker["compile_secs"]
+        assert hits[0] >= 1, (
+            "the run reported no persistent-cache hits — it recompiled "
+            "instead of reading the build's precompiled programs"
         )
 
         # rebuild of the identical composition: BuildKey marker hit

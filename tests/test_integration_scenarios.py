@@ -40,6 +40,8 @@ def engine(tg_home):
     e.stop()
 
 
+@pytest.mark.slow  # ~50s each (the silent plan runs to its timeout by
+# design): past the tier-1 870s budget's ~20s per-test ceiling
 class TestSilentFailure:
     def test_silent_instance_fails_the_run(self, engine):
         """An instance that exits without a terminal event — not even a

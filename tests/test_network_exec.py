@@ -6,6 +6,8 @@ import io
 import re
 import tarfile
 
+import pytest
+
 from testground_tpu.engine import Outcome
 from testground_tpu.rpc import discard_writer
 
@@ -38,6 +40,8 @@ class TestRealSocketPingPong:
         t = run_plan(engine, "network", "ping-pong", instances=3)
         assert t.outcome() == Outcome.SUCCESS
 
+    @pytest.mark.slow  # 60-160s (200 real processes; load-sensitive):
+    # past the tier-1 870s budget's ~20s per-test ceiling
     def test_local_envelope_200_instances(self, engine):  # noqa: F811
         """The reference's local-runner envelope is 2-300 REAL instances
         per host (``README.md:136-139``); run 200 real SDK processes —

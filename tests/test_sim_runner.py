@@ -67,6 +67,8 @@ def run_sim(
     raise TimeoutError(f"task {tid} did not finish")
 
 
+@pytest.mark.slow  # ~30s (jax.profiler trace capture): past the tier-1
+# 870s budget's ~20s per-test ceiling
 class TestProfiles:
     def test_profile_capture_writes_trace(self, tg_home):
         """A group requesting profiles makes the run record a jax.profiler

@@ -1,0 +1,609 @@
+"""Message-lifecycle flight recorder + delivery-latency histograms
+(docs/OBSERVABILITY.md): trace-plan selector lowering, per-tick event
+rows (status / signal / send-with-fate / deliver), determinism under a
+chaos schedule, the zero-overhead jaxpr contract, histogram correctness
+(bin edges, clamp-to-last-bin, Σbins == delivered), the percentile
+estimator, and the end-to-end artifact surface (``sim_trace.jsonl``,
+Chrome-trace ``trace_events.json``, journal sections)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from testground_tpu.api import RunGroup
+from testground_tpu.config import EnvConfig
+from testground_tpu.sim.api import (
+    FILTER_DROP,
+    FILTER_REJECT,
+    RUNNING,
+    SUCCESS,
+    Outbox,
+    SimTestcase,
+)
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import load_sim_testcases
+from testground_tpu.sim.telemetry import (
+    LATENCY_BINS,
+    latency_bin_edges,
+    latency_percentiles,
+)
+from testground_tpu.sim.trace import (
+    build_trace_plan,
+    chrome_trace,
+    events_from_blocks,
+    parse_trace,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def make_groups(*counts, params=None):
+    return build_groups(
+        [
+            RunGroup(id=f"g{i}", instances=c, parameters=dict(params or {}))
+            for i, c in enumerate(counts)
+        ]
+    )
+
+
+def plan_case(plan, case):
+    return load_sim_testcases(os.path.join(PLANS, plan))[case]()
+
+
+def run_traced(prog, **run_kw):
+    blocks = []
+    res = prog.run(trace_cb=blocks.append, **run_kw)
+    gids = {}
+    for g in prog.groups:
+        for i in range(g.offset, g.offset + g.count):
+            gids[i] = g.id
+    return res, events_from_blocks(blocks, lambda i: gids.get(i, ""))
+
+
+# ------------------------------------------------------------- selectors
+
+
+class TestTracePlan:
+    def test_unknown_key_refused(self):
+        with pytest.raises(ValueError, match="unknown key"):
+            parse_trace({"instnaces": "0:2"})
+
+    def test_bad_fraction_refused(self):
+        with pytest.raises(ValueError, match="fraction"):
+            parse_trace({"fraction": 1.5})
+
+    def test_nothing_declared_lowers_to_none(self):
+        assert build_trace_plan(make_groups(4), {}) is None
+        assert build_trace_plan(make_groups(4), {"g0": {}}) is None
+
+    def test_range_and_group_scoping(self):
+        groups = make_groups(4, 4)
+        # group-level table scopes to its own group (group-relative range)
+        plan = build_trace_plan(groups, {"g1": {"instances": "1:3"}})
+        assert plan.lanes.tolist() == [5, 6]
+        # run-global table covers the whole axis
+        plan = build_trace_plan(groups, {"": {"instances": "6:8"}})
+        assert plan.lanes.tolist() == [6, 7]
+
+    def test_tables_union(self):
+        groups = make_groups(4, 4)
+        plan = build_trace_plan(
+            groups, {"g0": {"instances": "0:1"}, "g1": {"instances": "0:1"}}
+        )
+        assert plan.lanes.tolist() == [0, 4]
+
+    def test_seeded_fraction_is_deterministic(self):
+        groups = make_groups(16)
+        a = build_trace_plan(groups, {"": {"fraction": 0.25, "seed": 7}})
+        b = build_trace_plan(groups, {"": {"fraction": 0.25, "seed": 7}})
+        assert a.lanes.tolist() == b.lanes.tolist()
+        assert a.count == 4
+
+    def test_oversized_selection_refused(self, monkeypatch):
+        import testground_tpu.sim.trace as trace_mod
+
+        monkeypatch.setattr(trace_mod, "MAX_TRACE_LANES", 2)
+        with pytest.raises(ValueError, match="MAX_TRACE_LANES"):
+            build_trace_plan(make_groups(4), {"": {"instances": "0:3"}})
+
+    def test_group_layout_mismatch_refused(self):
+        plan = build_trace_plan(make_groups(8), {"": {"instances": "0:2"}})
+        with pytest.raises(ValueError, match="group layout"):
+            SimProgram(
+                plan_case("placebo", "ok"), make_groups(4), trace=plan
+            )
+
+
+# ------------------------------------------------------ latency histogram
+
+
+class _TwoLatency(SimTestcase):
+    """Two groups ping a same-group partner once: group 0 at 2 ms egress
+    latency, group 1 at 9 ms — the bins and the receiver-group
+    attribution are then exact."""
+
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 32
+    SHAPING = ("latency",)
+
+    def step(self, env, state, inbox, sync, t):
+        lat = 2.0 if env.group.index == 0 else 9.0
+        partner = env.group.offset + jnp.mod(
+            env.group_seq + 1, env.group.count
+        )
+        ob = Outbox.single(partner, jnp.asarray([1]), t == 1, 1, 1)
+        return self.out(
+            state,
+            status=jnp.where(t >= 16, SUCCESS, RUNNING),
+            outbox=ob,
+            net_shape=self.link_shape(latency_ms=lat),
+            net_shape_valid=t == 0,
+        )
+
+
+class _BigDelay(SimTestcase):
+    """One exchange at a latency past the last bin's lower edge
+    (2^(LATENCY_BINS-1) ticks) — must clamp into the last bin."""
+
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = (1 << (LATENCY_BINS - 1)) + 8
+    SHAPING = ("latency",)
+    DEFAULT_LINK = (float(1 << (LATENCY_BINS - 1)) + 2.0,) + (0.0,) * 6
+
+    def step(self, env, state, inbox, sync, t):
+        n = env.test_instance_count
+        dst = jnp.mod(env.global_seq + 1, n)
+        ob = Outbox.single(dst, jnp.asarray([1]), t == 1, 1, 1)
+        got = state.get("got", jnp.asarray(False)) | (inbox.count > 0)
+        return self.out(
+            {"got": got},
+            status=jnp.where(got, SUCCESS, RUNNING),
+            outbox=ob,
+        )
+
+    def init(self, env):
+        return {"got": jnp.asarray(False)}
+
+
+class TestLatencyHistogram:
+    def test_bin_edges_schema(self):
+        edges = latency_bin_edges()
+        assert len(edges) == LATENCY_BINS
+        assert edges[0] == 1
+        assert all(b == 2 * a for a, b in zip(edges, edges[1:]))
+
+    def test_bins_and_receiver_group_attribution(self):
+        prog = SimProgram(
+            _TwoLatency(), make_groups(2, 2), chunk=8, telemetry=True
+        )
+        res = prog.run(max_ticks=64)
+        hist = np.asarray(res["lat_hist"])
+        assert hist.shape == (2, LATENCY_BINS)
+        # group 0: delay 2 ticks → bin 1 ([2, 4)); group 1: 9 → bin 3
+        want0 = np.zeros(LATENCY_BINS, int)
+        want0[1] = 2
+        want1 = np.zeros(LATENCY_BINS, int)
+        want1[3] = 2
+        assert hist[0].tolist() == want0.tolist()
+        assert hist[1].tolist() == want1.tolist()
+        # conservation: Σ bins == delivered, exactly
+        assert hist.sum() == res["msgs_delivered"] == 4
+
+    def test_clamp_to_last_bin(self):
+        prog = SimProgram(
+            _BigDelay(), make_groups(2), chunk=256, telemetry=True
+        )
+        res = prog.run(max_ticks=8192)
+        assert (res["status"] == SUCCESS).all()
+        hist = np.asarray(res["lat_hist"])
+        assert hist.sum() == res["msgs_delivered"] == 2
+        assert hist[0, LATENCY_BINS - 1] == 2  # everything in the last bin
+
+    def test_conservation_on_real_plan(self):
+        prog = SimProgram(
+            plan_case("network", "ping-pong"),
+            make_groups(4),
+            chunk=16,
+            telemetry=True,
+        )
+        res = prog.run(max_ticks=512)
+        assert np.asarray(res["lat_hist"]).sum() == res["msgs_delivered"]
+
+    def test_no_histogram_without_telemetry(self):
+        prog = SimProgram(plan_case("placebo", "ok"), make_groups(2), chunk=8)
+        res = prog.run(max_ticks=32)
+        assert "lat_hist" not in res
+
+    def test_percentile_estimator(self):
+        # empty: count only
+        assert latency_percentiles([0] * LATENCY_BINS, 1.0) == {"count": 0}
+        # single hit bin [8, 16): every quantile lands inside it
+        hist = [0] * LATENCY_BINS
+        hist[3] = 100
+        pct = latency_percentiles(hist, 2.0)  # tick_ms = 2
+        assert pct["count"] == 100
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            assert 8 * 2.0 <= pct[q] <= 16 * 2.0
+        assert pct["p50_ms"] < pct["p95_ms"] < pct["p99_ms"]
+        # open last bin values at its lower edge
+        hist = [0] * LATENCY_BINS
+        hist[-1] = 10
+        pct = latency_percentiles(hist, 1.0)
+        assert pct["p50_ms"] == float(1 << (LATENCY_BINS - 1))
+
+
+# ------------------------------------------------------- flight recorder
+
+
+class _OneShot(SimTestcase):
+    """Instance 0 sends one message to 1 at tick 1; everyone succeeds at
+    tick 5 — every event of the tiny timeline is then exactly known."""
+
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 8
+    SHAPING = ("latency",)
+
+    def step(self, env, state, inbox, sync, t):
+        ob = Outbox.single(
+            1, jnp.asarray([42]), (t == 1) & (env.global_seq == 0), 1, 1
+        )
+        return self.out(
+            state, status=jnp.where(t >= 5, SUCCESS, RUNNING), outbox=ob
+        )
+
+
+class _Filtered(SimTestcase):
+    """Instance 0 sends to {1, 2, 3} at tick 1 under rules REJECT [1,2)
+    / DROP [2,3) — one send per fate."""
+
+    SHAPING = ("latency", "filter_rules")
+    FILTER_RULES = 2
+    MSG_WIDTH = 1
+    OUT_MSGS = 3
+    IN_MSGS = 4
+    MAX_LINK_TICKS = 8
+
+    def step(self, env, state, inbox, sync, t):
+        is_sender = env.global_seq == 0
+        ob = Outbox(
+            dst=jnp.asarray([1, 2, 3], jnp.int32),
+            payload=jnp.ones((3, 1), jnp.int32),
+            valid=jnp.full((3,), (t == 1) & is_sender, bool),
+        )
+        return self.out(
+            state,
+            status=jnp.where(t >= 4, SUCCESS, RUNNING),
+            outbox=ob,
+            net_rules=self.filter_rules(
+                (1, 2, FILTER_REJECT), (2, 3, FILTER_DROP)
+            ),
+            net_rules_valid=(t == 0) & is_sender,
+        )
+
+
+class TestFlightRecorder:
+    def test_event_timeline_exact(self):
+        groups = make_groups(2)
+        prog = SimProgram(
+            _OneShot(),
+            groups,
+            chunk=8,
+            trace=build_trace_plan(groups, {"": {"instances": "0:2"}}),
+        )
+        res, events = run_traced(prog, max_ticks=64)
+        assert (res["status"] == SUCCESS).all()
+        sends = [e for e in events if e["event"] == "send"]
+        assert sends == [
+            {
+                "tick": 1,
+                "instance": 0,
+                "group": "g0",
+                "event": "send",
+                "dst": 1,
+                "fate": "enqueued",
+            }
+        ]
+        delivers = [e for e in events if e["event"] == "deliver"]
+        assert delivers == [
+            {
+                "tick": 2,
+                "instance": 1,
+                "group": "g0",
+                "event": "deliver",
+                "src": 0,
+            }
+        ]
+        status = [e for e in events if e["event"] == "status"]
+        assert {(e["tick"], e["instance"]) for e in status} == {
+            (5, 0),
+            (5, 1),
+        }
+        assert all(
+            e["prev"] == "running" and e["status"] == "success"
+            for e in status
+        )
+
+    def test_send_fates(self):
+        groups = make_groups(4)
+        prog = SimProgram(
+            _Filtered(),
+            groups,
+            chunk=8,
+            trace=build_trace_plan(groups, {"": {"instances": "0:1"}}),
+        )
+        res, events = run_traced(prog, max_ticks=32)
+        sends = {
+            e["dst"]: e["fate"] for e in events if e["event"] == "send"
+        }
+        assert sends == {1: "rejected", 2: "dropped", 3: "enqueued"}
+
+    def test_untraced_lanes_emit_nothing(self):
+        groups = make_groups(4)
+        prog = SimProgram(
+            _Filtered(),
+            groups,
+            chunk=8,
+            trace=build_trace_plan(groups, {"": {"instances": "2:3"}}),
+        )
+        _, events = run_traced(prog, max_ticks=32)
+        assert {e["instance"] for e in events} <= {2}
+
+    def test_deterministic_under_chaos(self):
+        """Same seed + schedule → bit-identical event streams, with a
+        crash/restart/loss-burst schedule live (the replayability
+        contract the fault plane established, extended to the trace)."""
+        from testground_tpu.sim.faults import build_fault_schedule
+
+        groups = make_groups(4)
+        faults = build_fault_schedule(
+            groups,
+            {
+                "": [
+                    {"kind": "crash", "start_ms": 4, "instances": "0:1"},
+                    {"kind": "restart", "start_ms": 9, "instances": "0:1"},
+                    {
+                        "kind": "loss_burst",
+                        "start_ms": 2,
+                        "duration_ms": 12,
+                        "loss": 60.0,
+                    },
+                ]
+            },
+            1.0,
+        )
+
+        def once():
+            prog = SimProgram(
+                plan_case("chaos", "chaos-barrier"),
+                make_groups(4),
+                chunk=8,
+                faults=faults,
+                trace=build_trace_plan(
+                    groups, {"": {"instances": "0:2"}}
+                ),
+            )
+            _, events = run_traced(prog, max_ticks=512, seed=3)
+            return events
+
+        a, b = once(), once()
+        assert a == b
+        assert any(e["event"] == "status" for e in a)  # the crash shows
+
+    def test_sharded_matches_unsharded(self):
+        """Trace rows gather from instance-sharded arrays; without the
+        replication constraint the SPMD partitioner emitted corrupted
+        partial-combined rows — pin bit-equality across layouts (the
+        telemetry plane's cross-validation pattern)."""
+        devs = jax.devices()
+        assert len(devs) == 8
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+        groups = make_groups(16)
+        plan = build_trace_plan(groups, {"": {"instances": "0:3"}})
+
+        def run(mesh_):
+            prog = SimProgram(
+                plan_case("network", "ping-pong"),
+                make_groups(16),
+                chunk=16,
+                mesh=mesh_,
+                telemetry=True,
+                trace=plan,
+            )
+            res, events = run_traced(prog, max_ticks=512)
+            return res["lat_hist"], events
+
+        (hist_u, ev_u), (hist_s, ev_s) = run(None), run(mesh)
+        assert ev_u == ev_s
+        assert hist_u == hist_s
+
+    def test_chrome_trace_shape(self):
+        groups = make_groups(2)
+        prog = SimProgram(
+            _OneShot(),
+            groups,
+            chunk=8,
+            trace=build_trace_plan(groups, {"": {"instances": "0:2"}}),
+        )
+        _, events = run_traced(prog, max_ticks=64)
+        doc = chrome_trace(events, [0, 1], {0: "g0[0] i0", 1: "g0[1] i1"}, 1.0)
+        # valid Chrome trace-event JSON: serializable, traceEvents list,
+        # every event carries the required keys
+        parsed = json.loads(json.dumps(doc))
+        assert isinstance(parsed["traceEvents"], list)
+        names = {e["name"] for e in parsed["traceEvents"]}
+        assert "thread_name" in names and "send→1 (enqueued)" in names
+        for ev in parsed["traceEvents"]:
+            for key in ("name", "ph", "pid", "tid"):
+                assert key in ev
+            if ev["ph"] == "i":
+                assert "ts" in ev and ev["s"] == "t"
+
+
+class TestZeroOverhead:
+    def test_no_trace_traces_identically_to_baseline(self):
+        """trace=None must produce the byte-identical traced chunk as a
+        program built without the option (the acceptance contract), and
+        an armed plan must change it — with and without telemetry."""
+        groups = make_groups(4)
+        tc = plan_case("network", "ping-pong")
+        armed = build_trace_plan(groups, {"": {"instances": "0:1"}})
+        for telemetry in (False, True):
+            base = SimProgram(tc, groups, chunk=4, telemetry=telemetry)
+            none = SimProgram(
+                tc, groups, chunk=4, telemetry=telemetry, trace=None
+            )
+            on = SimProgram(
+                tc, groups, chunk=4, telemetry=telemetry, trace=armed
+            )
+            carry = base.init_carry(0)
+            j_base = str(jax.make_jaxpr(base._chunk_step)(carry))
+            assert str(jax.make_jaxpr(none._chunk_step)(carry)) == j_base
+            assert str(jax.make_jaxpr(on._chunk_step)(carry)) != j_base
+
+
+# ------------------------------------------------------------ end-to-end
+
+
+@pytest.fixture()
+def sim_engine(tg_home):
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.engine import Engine, EngineConfig
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    env = EnvConfig.load()
+    e = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    e.start_workers()
+    yield e
+    e.stop()
+
+
+def run_traced_composition(engine, timeout=180):
+    import time
+
+    from testground_tpu.api import (
+        Composition,
+        Global,
+        Group,
+        Instances,
+        TestPlanManifest,
+        generate_default_run,
+    )
+    from testground_tpu.api.composition import RunParams
+    from testground_tpu.engine import State
+
+    comp = generate_default_run(
+        Composition(
+            global_=Global(
+                plan="network",
+                case="ping-pong",
+                builder="sim:plan",
+                runner="sim:jax",
+                run_config={"telemetry": True, "chunk": 16},
+            ),
+            groups=[Group(id="all", instances=Instances(count=4))],
+        )
+    )
+    comp.global_.run = RunParams(trace={"instances": "0:2"})
+    manifest = TestPlanManifest.load_file(
+        os.path.join(PLANS, "network", "manifest.toml")
+    )
+    tid = engine.queue_run(
+        comp, manifest, sources_dir=os.path.join(PLANS, "network")
+    )
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = engine.get_task(tid)
+        if t is not None and t.state().state in (
+            State.COMPLETE,
+            State.CANCELED,
+        ):
+            return t
+        time.sleep(0.05)
+    raise TimeoutError(tid)
+
+
+class TestTraceE2E:
+    def test_run_writes_trace_artifacts_and_journal(self, sim_engine):
+        from testground_tpu.engine import Outcome
+        from testground_tpu.sim.telemetry import LATENCY_FILE
+        from testground_tpu.sim.trace import (
+            TRACE_EVENTS_FILE,
+            TRACE_FILE,
+            read_trace_events,
+        )
+
+        t = run_traced_composition(sim_engine)
+        assert t.outcome() == Outcome.SUCCESS
+        journal = t.result["journal"]
+        assert journal["trace"]["instances"] == 2
+        assert journal["trace"]["events"] > 0
+        assert journal["trace"]["file"] == TRACE_FILE
+        assert journal["trace"]["events_file"] == TRACE_EVENTS_FILE
+        # latency percentiles rode the telemetry plane into the journal
+        lat = journal["sim"]["latency"]["all"]
+        assert lat["count"] > 0 and lat["p50_ms"] > 0
+        run_dir = os.path.join(
+            sim_engine.env.dirs.outputs(), "network", t.id
+        )
+        # jsonl events match the journal count and the reader helper
+        rows = [
+            json.loads(line)
+            for line in open(os.path.join(run_dir, TRACE_FILE))
+        ]
+        assert len(rows) == journal["trace"]["events"]
+        assert {r["instance"] for r in rows} <= {0, 1}
+        assert (
+            read_trace_events(
+                sim_engine.env.dirs.outputs(), "network", t.id
+            )
+            == rows
+        )
+        # Chrome export loads as valid trace-event JSON
+        doc = json.load(open(os.path.join(run_dir, TRACE_EVENTS_FILE)))
+        assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+        # latency rows are viewer-shaped and visible to the Viewer
+        lat_rows = [
+            json.loads(line)
+            for line in open(os.path.join(run_dir, LATENCY_FILE))
+        ]
+        assert {r["name"] for r in lat_rows} == {
+            "sim.latency.p50",
+            "sim.latency.p95",
+            "sim.latency.p99",
+        }
+        from testground_tpu.metrics import Viewer
+
+        data = Viewer(sim_engine.env).get_data(
+            "network", "ping-pong", "sim.latency.p50", run_id=t.id
+        )
+        assert len(data) == 1 and data[0].fields["mean"] == lat["p50_ms"]
+        # stats payload carries both new sections
+        stats = t.stats_payload()
+        assert stats["trace"]["events"] == journal["trace"]["events"]
+        assert stats["sim"]["latency"]["all"]["count"] == lat["count"]
+
+    def test_no_trace_without_declaration(self, sim_engine):
+        from tests.test_sim_runner import run_sim
+        from testground_tpu.sim.trace import TRACE_FILE
+
+        t = run_sim(sim_engine, "placebo", "ok", instances=2)
+        run_dir = os.path.join(
+            sim_engine.env.dirs.outputs(), "placebo", t.id
+        )
+        assert not os.path.exists(os.path.join(run_dir, TRACE_FILE))
+        assert "trace" not in t.result["journal"]

@@ -108,6 +108,9 @@ def _stress(server, label, tmp_path):
     return total_secs
 
 
+@pytest.mark.slow  # ~430s + ~90s: far past the tier-1 870s budget's
+# per-test ceiling (~20s, Makefile `test` durations note); runs in the
+# full `make test` ladder
 class TestSyncEnvelope:
     def test_python_server_holds_300_clients(self, tmp_path):
         server = SyncServiceServer().start()

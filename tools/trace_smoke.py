@@ -1,0 +1,227 @@
+"""``make trace-smoke``: run the ``plans/chaos`` smoke composition
+(which declares ``[global.run.trace]`` + telemetry) on the CPU backend
+and assert the flight-recorder + latency-histogram contract end-to-end:
+
+- the run completes and writes ``sim_trace.jsonl`` with schema-valid
+  events scoped to the declared lanes, counting what the journal claims;
+- ``trace_events.json`` is valid Chrome trace-event JSON (loads in
+  Perfetto): a ``traceEvents`` list whose entries carry name/ph/pid/tid,
+  with one named track per traced instance;
+- the scheduled chaos is visible IN the trace (crash + restart status
+  transitions on the crashed lanes, and fault_dropped send fates);
+- the journal carries per-group delivery-latency percentiles whose
+  histogram totals conserve (Σ bins == delivered), and ``tg stats``
+  renders them;
+- determinism: a second run of the same composition produces the
+  identical event stream (modulo the run id).
+
+Exits non-zero with a readable message on any violation. Self-contained:
+temporary $TESTGROUND_HOME, CPU backend — safe in CI (mirrors
+``tools/chaos_smoke.py``).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def fail(msg: str) -> "None":
+    print(f"trace-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _run_once(engine, comp, manifest, sources):
+    import time
+
+    from testground_tpu.engine import State
+
+    tid = engine.queue_run(comp, manifest, sources_dir=sources)
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        t = engine.get_task(tid)
+        if t is not None and t.state().state in (
+            State.COMPLETE,
+            State.CANCELED,
+        ):
+            return t
+        time.sleep(0.05)
+    fail(f"task {tid} did not finish within 300s")
+
+
+def _read_events(env, task):
+    from testground_tpu.sim.trace import TRACE_FILE
+
+    path = os.path.join(env.dirs.outputs(), "chaos", task.id, TRACE_FILE)
+    if not os.path.isfile(path):
+        fail(f"{TRACE_FILE} was not written ({path})")
+    events = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                fail(f"line {i + 1} is not JSON: {e}")
+    if not events:
+        fail(f"{TRACE_FILE} is empty")
+    return events
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-trace-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from testground_tpu.api import TestPlanManifest, load_composition
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome
+    from testground_tpu.runners.pretty import render_telemetry_summary
+    from testground_tpu.sim.runner import SimJaxRunner
+    from testground_tpu.sim.trace import TRACE_EVENTS_FILE
+
+    plan_dir = os.path.join(REPO_ROOT, "plans", "chaos")
+    comp_path = os.path.join(plan_dir, "_compositions", "smoke.toml")
+    manifest = TestPlanManifest.load_file(
+        os.path.join(plan_dir, "manifest.toml")
+    )
+
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.start_workers()
+    try:
+        tasks = [
+            _run_once(engine, load_composition(comp_path), manifest, plan_dir)
+            for _ in range(2)  # second run pins determinism
+        ]
+    finally:
+        engine.stop()
+
+    task = tasks[0]
+    if task.outcome() != Outcome.SUCCESS:
+        fail(f"run outcome {task.outcome().value}: {task.error}")
+    journal = task.result["journal"]
+
+    # --- journal trace section vs the event stream on disk
+    trace_j = journal.get("trace") or {}
+    if trace_j.get("instances") != 3:
+        fail(f"journal trace.instances = {trace_j.get('instances')} != 3")
+    events = _read_events(env, task)
+    if len(events) != trace_j.get("events"):
+        fail(
+            f"{len(events)} jsonl events != journal count "
+            f"{trace_j.get('events')}"
+        )
+    lanes = {e["instance"] for e in events}
+    if not lanes <= {0, 1, 2}:
+        fail(f"events leaked outside the declared lanes 0:3: {lanes}")
+    for key in ("tick", "instance", "group", "event"):
+        if any(key not in e for e in events):
+            fail(f"an event is missing the {key!r} field")
+
+    # --- the scheduled chaos is visible in the trace: the crashed pair
+    # must show crash AND restart status transitions, and the windows
+    # must kill at least one traced send
+    crashes = {
+        e["instance"]
+        for e in events
+        if e["event"] == "status" and e.get("status") == "crash"
+    }
+    revivals = {
+        e["instance"]
+        for e in events
+        if e["event"] == "status"
+        and e.get("prev") == "crash"
+        and e.get("status") == "running"
+    }
+    if crashes != {0, 1} or revivals != {0, 1}:
+        fail(
+            f"crash/restart transitions not recorded for lanes 0:2 "
+            f"(crashes={crashes}, revivals={revivals})"
+        )
+    fates = {e.get("fate") for e in events if e["event"] == "send"}
+    if "fault_dropped" not in fates:
+        fail(f"no traced send with fate=fault_dropped (saw {fates})")
+
+    # --- Chrome trace export loads as valid trace-event JSON
+    ct_path = os.path.join(
+        env.dirs.outputs(), "chaos", task.id, TRACE_EVENTS_FILE
+    )
+    try:
+        with open(ct_path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"{TRACE_EVENTS_FILE} is not valid JSON: {e}")
+    te = doc.get("traceEvents")
+    if not isinstance(te, list) or not te:
+        fail("traceEvents is missing or empty")
+    for ev in te:
+        for key in ("name", "ph", "pid", "tid"):
+            if key not in ev:
+                fail(f"trace event missing {key!r}: {ev}")
+    tracks = {
+        ev["tid"] for ev in te if ev.get("name") == "thread_name"
+    }
+    if tracks != {0, 1, 2}:
+        fail(f"expected one named track per traced instance, got {tracks}")
+
+    # --- latency percentiles: journaled, conserving, and rendered
+    latency = (journal.get("sim") or {}).get("latency") or {}
+    if "all" not in latency or not latency["all"].get("count"):
+        fail(f"journal sim.latency missing or empty: {latency}")
+    for q in ("p50_ms", "p95_ms", "p99_ms"):
+        if q not in latency["all"]:
+            fail(f"latency percentile {q} missing: {latency['all']}")
+    if latency["all"]["count"] != journal["sim"]["msgs_delivered"]:
+        fail(
+            "Σ latency bins {c} != delivered {d} — histogram "
+            "conservation violated".format(
+                c=latency["all"]["count"],
+                d=journal["sim"]["msgs_delivered"],
+            )
+        )
+    rendered = render_telemetry_summary(task.stats_payload())
+    if "p50=" not in rendered or "latency all" not in rendered:
+        fail(f"tg stats output lacks the latency section:\n{rendered}")
+
+    # --- determinism: same seed + schedule → identical event stream
+    strip = lambda evs: [  # noqa: E731
+        {k: v for k, v in e.items() if k != "run"} for e in evs
+    ]
+    if strip(events) != strip(_read_events(env, tasks[1])):
+        fail("two runs of the same composition produced different event "
+             "streams — the flight recorder broke determinism")
+
+    print(
+        "trace-smoke: OK — {e} events from 3 instances (crash/restart "
+        "transitions + fault_dropped fates recorded), Perfetto export "
+        "valid ({t} trace events), latency p50/p95/p99 = "
+        "{p50}/{p95}/{p99} ms over {n} deliveries, deterministic".format(
+            e=len(events),
+            t=len(te),
+            p50=latency["all"]["p50_ms"],
+            p95=latency["all"]["p95_ms"],
+            p99=latency["all"]["p99_ms"],
+            n=latency["all"]["count"],
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
