@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
-	telemetry-smoke chaos-smoke trace-smoke
+	telemetry-smoke chaos-smoke trace-smoke perf-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -60,6 +60,14 @@ chaos-smoke:
 # percentiles, and stay deterministic across two runs
 trace-smoke:
 	$(PY) tools/trace_smoke.py
+
+# performance-ledger contract check (docs/OBSERVABILITY.md): a tiny run
+# must journal sim.perf (AOT lower/compile split + cost analysis +
+# throughput gauges), write a schema-valid sim_perf.jsonl whose
+# per-chunk walls sum to the ledger's execute wall, and conserve the
+# chunk/tick accounting
+perf-smoke:
+	$(PY) tools/perf_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -76,40 +76,62 @@ def _build(plan, case, n, params, chunk):
     )
 
 
-def _timed_ticks(prog, ticks):
+def _timed_ticks(prog, ticks, ledger=None):
     """Warm one chunk (compile excluded from the throughput number but
     REPORTED — the north star says wall-clock, so the one-off cost must
     be visible), run ~`ticks` more, and return (carry, actual_ticks,
     wall, compile_secs). Actual ticks come from the carry's tick counter,
     which stops advancing once every instance is terminal — a workload
-    finishing mid-chunk is not credited for no-op ticks."""
+    finishing mid-chunk is not credited for no-op ticks.
+
+    ``ledger`` is an optional sim.perf.PerfLedger: each dispatch's wall
+    lands in it so the bench emits the SAME per-chunk ledger schema as
+    a framework run's journal (chunk 0 carries compile, exactly like
+    the executor's first dispatch)."""
     import jax
     import numpy as np
 
     tc0 = time.perf_counter()
     carry = jax.jit(lambda: prog.init_carry(0))()
     fn = prog.compiled_chunk()
+    t_chunk = time.perf_counter()
     carry, _ = fn(carry)
     # D2H forces completion on remotely-tunneled backends where
     # block_until_ready may not block
     warm_t = int(np.asarray(carry.t))
+    now = time.perf_counter()
     # compile_secs = init trace/compile + first chunk trace/compile/run;
     # the warm chunk's execution (~chunk ticks of steady-state work) is
     # inside it, so this slightly OVERstates pure compilation — the
     # honest direction for a "wall-clock includes compile" claim
-    compile_secs = time.perf_counter() - tc0
+    compile_secs = now - tc0
+    if ledger is not None:
+        ledger.on_chunk(0, prog.chunk, prog.chunk, now - t_chunk)
     t0 = time.perf_counter()
     dispatched = 0
+    index = 1
     while dispatched < ticks:
+        t_chunk = time.perf_counter()
         carry, done = fn(carry)
+        done_host = bool(done)
         dispatched += prog.chunk
-        if bool(done):
+        if ledger is not None:
+            ledger.on_chunk(
+                index,
+                prog.chunk + dispatched,
+                prog.chunk,
+                time.perf_counter() - t_chunk,
+            )
+        index += 1
+        if done_host:
             break
     run_ticks = int(np.asarray(carry.t)) - warm_t
     return carry, run_ticks, time.perf_counter() - t0, compile_secs
 
 
 def bench_sustained(n, ticks):
+    from testground_tpu.sim.perf import PerfLedger
+
     prog = _build(
         "network",
         "pingpong-sustained",
@@ -122,7 +144,16 @@ def bench_sustained(n, ticks):
         },
         chunk=250,
     )
-    carry, run_ticks, wall, compile_secs = _timed_ticks(prog, ticks)
+    import jax
+
+    # the ledger makes bench emit the exact journal sim.perf schema, so
+    # BENCH_r*.json and `tg perf --compare` read both interchangeably;
+    # on a mesh the second dispatch carries the sharding fixed-point
+    # retrace (engine.run), so it too sits outside the steady window
+    ledger = PerfLedger(
+        n, prog.chunk, aot=False, warmup=2 if jax.device_count() > 1 else 1
+    )
+    carry, run_ticks, wall, compile_secs = _timed_ticks(prog, ticks, ledger)
     import numpy as np
 
     rounds = int(np.asarray(carry.states[0]["rounds"]).sum())
@@ -143,15 +174,22 @@ def bench_sustained(n, ticks):
     def _chunk_step(c):
         return prog._chunk_step(c)
 
-    tw = time.perf_counter()
-    jax.jit(_chunk_step, donate_argnums=0).lower(carry).compile()
-    warm_compile_secs = time.perf_counter() - tw
+    from testground_tpu.sim.perf import timed_lower_compile
+
+    # ledger compile block: the warm split (trace/lower vs persistent-
+    # cache read) plus XLA's cost/memory analysis of one chunk program
+    lower_secs, xla_secs, compiled = timed_lower_compile(
+        jax.jit(_chunk_step, donate_argnums=0), carry
+    )
+    warm_compile_secs = lower_secs + xla_secs
+    ledger.on_compile(lower_secs, xla_secs, compiled)
+    del compiled
     print(
         f"# warm recompile (persistent cache): {warm_compile_secs:.1f}s "
         f"vs {compile_secs:.1f}s cold",
         file=sys.stderr,
     )
-    return n * run_ticks / wall, compile_secs, warm_compile_secs
+    return n * run_ticks / wall, compile_secs, warm_compile_secs, ledger.summary()
 
 
 def bench_flood(n, ticks):
@@ -240,7 +278,7 @@ def main() -> int:
         file=sys.stderr,
     )
 
-    full, full_compile, warm_compile = bench_sustained(n, ticks)
+    full, full_compile, warm_compile, perf_block = bench_sustained(n, ticks)
     result = {
         "metric": "sim_peer_ticks_per_sec",
         "value": round(full, 1),
@@ -263,6 +301,12 @@ def main() -> int:
         # a fresh jit of the same program against the populated cache —
         # what any warm rerun of this composition pays instead of compile
         "warm_compile_secs": round(warm_compile, 2),
+        # the run performance ledger (journal sim.perf schema —
+        # docs/OBSERVABILITY.md): per-chunk-derived throughput, the
+        # warm lower-vs-compile split, and XLA cost/memory analysis of
+        # one chunk program; `tg perf --compare` diffs a task's ledger
+        # against this line
+        "perf": perf_block,
     }
 
     if not args.skip_secondary:

@@ -281,12 +281,29 @@ class SimPlanBuilder(Builder, Precompiler):
             # compiles variant 1 + runs one chunk (telemetry programs
             # return (carry, done, block) — take the carry positionally)
             carry = fn(carry)[0]
-            fn.lower(carry).compile()  # fixed-point variant, no execution
-            del carry
+            # fixed-point variant, no execution — timed in its
+            # lower-vs-compile halves and harvested for cost/memory
+            # analysis, so the BuildKey marker records the performance
+            # ledger's compile block (docs/OBSERVABILITY.md)
+            from testground_tpu.sim.perf import (
+                compile_analysis,
+                timed_lower_compile,
+            )
+
+            lower_secs, xla_secs, compiled = timed_lower_compile(fn, carry)
+            perf = {
+                "lower_secs": round(lower_secs, 6),
+                "compile_secs": round(xla_secs, 6),
+                **compile_analysis(compiled),
+            }
+            del carry, compiled
             secs = time.perf_counter() - t0
             os.makedirs(os.path.dirname(marker), exist_ok=True)
             with open(marker, "w") as f:
-                json.dump({**spec, "compile_secs": round(secs, 3)}, f)
+                json.dump(
+                    {**spec, "compile_secs": round(secs, 3), "perf": perf},
+                    f,
+                )
             ow.infof(
                 "sim:plan precompiled run %s into %s in %.1fs (key %s)",
                 run.id,

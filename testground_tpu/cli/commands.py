@@ -818,6 +818,89 @@ def stats_cmd(args) -> int:
         engine.stop()
 
 
+def register_perf(sub) -> None:
+    p = sub.add_parser(
+        "perf",
+        help="show a task's performance ledger (compile/execute split, "
+        "peer·ticks/s, HBM high-water mark, XLA cost estimates — "
+        "docs/OBSERVABILITY.md)",
+    )
+    p.add_argument("task", help="task id")
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the raw perf payload as JSON (machine-readable; the "
+        "same shape as GET /perf)",
+    )
+    p.add_argument(
+        "--compare",
+        default="",
+        metavar="FILE",
+        help="print throughput deltas against a baseline JSON file — a "
+        "BENCH_rNN.json line, a prior `tg perf --json` dump, or a "
+        "journal sim block (written to stderr under --json so stdout "
+        "stays parseable)",
+    )
+    p.set_defaults(func=perf_cmd)
+
+
+def perf_cmd(args) -> int:
+    import json
+
+    from testground_tpu.client import RemoteEngine
+    from testground_tpu.runners.pretty import render_perf_summary
+    from testground_tpu.sim.perf import perf_compare
+
+    engine = _engine(args)
+    try:
+        if isinstance(engine, RemoteEngine):
+            data = engine.task_perf(args.task)
+        else:
+            t = engine.get_task(args.task)
+            if t is None:
+                raise KeyError(f"unknown task {args.task}")
+            data = t.perf_payload()
+        if getattr(args, "json", False):
+            print(json.dumps(data, indent=2, sort_keys=True))
+        else:
+            print(render_perf_summary(data))
+        if getattr(args, "compare", ""):
+            with open(args.compare) as f:
+                # BENCH_rNN.json files are one JSON object per line
+                # (possibly with comment noise) — take the LAST line
+                # that parses (the newest round, matching the bench
+                # tail unwrapping in sim/perf.py); a whole-file JSON
+                # document also parses
+                text = f.read()
+            try:
+                baseline = json.loads(text)
+            except ValueError:
+                baseline = None
+                for line in reversed(text.splitlines()):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        baseline = json.loads(line)
+                        break
+                    except ValueError:
+                        continue
+                if baseline is None:
+                    raise ValueError(
+                        f"{args.compare} holds no parseable JSON"
+                    ) from None
+            # with --json, stdout is the machine-readable payload — the
+            # human-facing delta lines go to stderr so `| jq` keeps working
+            out = sys.stderr if getattr(args, "json", False) else sys.stdout
+            label = os.path.basename(args.compare)
+            print(f"-- vs {label} --", file=out)
+            for line in perf_compare(data, baseline, label=label):
+                print(line, file=out)
+        return 0
+    finally:
+        engine.stop()
+
+
 def register_trace(sub) -> None:
     p = sub.add_parser(
         "trace",

@@ -176,6 +176,29 @@ class Client:
         backend): identity + the journal's sim/telemetry/events sections."""
         return self._get_json("/stats", {"task_id": task_id})
 
+    def perf(self, task_id: str) -> dict:
+        """GET /perf — a task's performance-ledger payload (the ``tg
+        perf`` backend): identity + the journal's sim block + the
+        sim.perf ledger + task-level queue/runner timings."""
+        return self._get_json("/perf", {"task_id": task_id})
+
+    def metrics(self) -> str:
+        """GET /metrics — the daemon's Prometheus text exposition
+        (task gauges, flow counters, perf gauges)."""
+        conn = self._conn()
+        conn.request("GET", "/metrics", headers=self._headers())
+        resp = conn.getresponse()
+        try:
+            data = resp.read()
+            if resp.status >= 400:
+                raise DaemonError(
+                    data.decode(errors="replace")[:500]
+                    or f"HTTP {resp.status}"
+                )
+            return data.decode(errors="replace")
+        finally:
+            conn.close()
+
     def trace(self, task_id: str, limit: int = 0) -> dict:
         """GET /trace — a task's flight-recorder events (the ``tg trace``
         backend): the journal's trace summary plus the recorded
@@ -307,6 +330,12 @@ class RemoteEngine:
         of ``tg stats``; in-process engines assemble the same payload
         via Task.stats_payload)."""
         return self.client.stats(task_id)
+
+    def task_perf(self, task_id: str) -> dict:
+        """One round trip to the daemon's /perf route (the remote half
+        of ``tg perf``; in-process engines assemble the same payload
+        via Task.perf_payload)."""
+        return self.client.perf(task_id)
 
     def task_trace(self, task_id: str, limit: int = 0) -> dict:
         """One round trip to the daemon's /trace route (the remote half

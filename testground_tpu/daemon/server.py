@@ -6,8 +6,8 @@ surface (``daemon.go:83-101``) and bearer-token auth (``daemon.go:49-70``):
 
     POST /run /build /tasks /status /logs /outputs /terminate
          /healthcheck /kill /delete /build/purge /plan/import
-    GET  / /tasks /logs /outputs /journal /stats /trace /artifact /data
-         /dashboard /describe /kill /delete
+    GET  / /tasks /logs /outputs /journal /stats /perf /metrics /trace
+         /artifact /data /dashboard /describe /kill /delete
 
 The GET tier is the reference's web-dashboard surface (``daemon.go:83-91``,
 ``dashboard.go:44-75``): ``/journal`` returns a task's result journal,
@@ -132,6 +132,8 @@ class _Handler(BaseHTTPRequestHandler):
             "/tasks": lambda: self._tasks(q),
             "/journal": lambda: self._journal(q),
             "/stats": lambda: self._stats(q),
+            "/perf": lambda: self._perf(q),
+            "/metrics": lambda: self._metrics(q),
             "/trace": lambda: self._trace(q),
             "/artifact": lambda: self._artifact(q),
             "/data": lambda: self._data(q),
@@ -464,6 +466,41 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_json(f"unknown task {task_id}", 404)
         self._send_json(t.stats_payload())
 
+    def _perf(self, q: dict) -> None:
+        """GET /perf?task_id= — the task's performance-ledger payload
+        (the ``tg perf`` backend; docs/OBSERVABILITY.md): identity, the
+        journal's sim block, the sim.perf ledger, and the supervisor's
+        task-level timings. Payload shape is Task.perf_payload — shared
+        with the in-process CLI."""
+        task_id = q.get("task_id", "")
+        t = self.engine.get_task(task_id)
+        if t is None:
+            return self._send_error_json(f"unknown task {task_id}", 404)
+        self._send_json(t.perf_payload())
+
+    # Task-label cardinality bound for one /metrics scrape (most recent
+    # first — a scraper watches the daemon's working set, not history).
+    _METRICS_TASKS_MAX = 200
+
+    def _metrics(self, q: dict) -> None:
+        """GET /metrics — Prometheus text exposition (format 0.0.4):
+        task gauges, cumulative flow counters, and performance-ledger
+        gauges for the most recent tasks, so any standard scraper can
+        watch a daemon (docs/OBSERVABILITY.md)."""
+        from testground_tpu.metrics.prometheus import (
+            CONTENT_TYPE,
+            render_prometheus,
+        )
+
+        body = render_prometheus(
+            self.engine.tasks(), per_task_limit=self._METRICS_TASKS_MAX
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     # Event cap for one /trace JSON response (sim_trace.jsonl itself is
     # unbounded; the full file streams via /artifact).
     _TRACE_EVENTS_MAX = 50_000
@@ -521,24 +558,53 @@ class _Handler(BaseHTTPRequestHandler):
         "timeseries.jsonl",
         "sim_timeseries.jsonl",
         "sim_latency.jsonl",
+        "sim_perf.jsonl",
         "run_spans.jsonl",
         "sim_trace.jsonl",
         "trace_events.json",
     )
+    # Instance-side artifacts live NESTED under <group>/<instance>/ —
+    # still a closed basename whitelist, with every path component
+    # validated, so the route cannot read outside the task's outputs.
+    _ARTIFACT_NESTED = ("profile-cpu.pstats",)
+
+    @classmethod
+    def _artifact_relpath(cls, name: str) -> str | None:
+        """Validate an artifact name → safe run-dir-relative path, or
+        None. Accepts the flat whitelist, or a nested path (e.g.
+        ``single/0/profile-cpu.pstats`` — the SDK's cProfile dump) whose
+        basename is whitelisted and whose every component is a plain
+        path segment."""
+        if name in cls._ARTIFACT_FILES:
+            return name
+        parts = name.split("/")
+        if (
+            len(parts) in (2, 3, 4)
+            and parts[-1] in cls._ARTIFACT_NESTED
+            and all(
+                p and p not in (".", "..") and p == os.path.basename(p)
+                and "\\" not in p
+                for p in parts
+            )
+        ):
+            return os.path.join(*parts)
+        return None
 
     def _artifact(self, q: dict) -> None:
         """GET /artifact?task_id=&name=[&run=] — serve one whitelisted
         observability artifact from a task's run outputs dir (the
-        dashboard's trace/telemetry links)."""
+        dashboard's trace/telemetry/profile links)."""
         task_id = q.get("task_id", "")
         t = self.engine.get_task(task_id)
         if t is None:
             return self._send_error_json(f"unknown task {task_id}", 404)
         name = q.get("name", "")
-        if name not in self._ARTIFACT_FILES:
+        rel = self._artifact_relpath(name)
+        if rel is None:
             return self._send_error_json(
                 f"unknown artifact {name!r}; serving only "
-                f"{list(self._ARTIFACT_FILES)}",
+                f"{list(self._ARTIFACT_FILES)} and per-instance "
+                f"{list(self._ARTIFACT_NESTED)}",
                 400,
             )
         rid = q.get("run", task_id)
@@ -547,7 +613,7 @@ class _Handler(BaseHTTPRequestHandler):
         ):
             return self._send_error_json(f"invalid run id {rid!r}", 400)
         path = os.path.join(
-            self.engine.env.dirs.outputs(), t.plan, rid, name
+            self.engine.env.dirs.outputs(), t.plan, rid, rel
         )
         if not os.path.isfile(path):
             return self._send_error_json(
@@ -566,6 +632,8 @@ class _Handler(BaseHTTPRequestHandler):
             "Content-Type",
             "application/json"
             if name.endswith(".json")
+            else "application/octet-stream"
+            if name.endswith(".pstats")
             else "application/x-ndjson",
         )
         self.send_header("Content-Length", str(size))
@@ -698,6 +766,20 @@ class _Handler(BaseHTTPRequestHandler):
                     for name in self._ARTIFACT_FILES
                     if os.path.isfile(os.path.join(run_dir, name))
                 ]
+                # instance-side profiles (sdk/invoke.py cProfile dumps)
+                # live under <group>/<instance>/ — link them like the
+                # run-level artifacts, capped so a huge fleet of
+                # profiled instances cannot flood the page
+                import glob as _glob
+
+                for base in self._ARTIFACT_NESTED:
+                    hits = sorted(
+                        _glob.glob(os.path.join(run_dir, "*", "*", base))
+                    )[:16]
+                    present.extend(
+                        os.path.relpath(p, run_dir).replace(os.sep, "/")
+                        for p in hits
+                    )
                 if not present:
                     continue
                 tag = (
@@ -724,6 +806,7 @@ class _Handler(BaseHTTPRequestHandler):
             f"outcome {esc(t.outcome().value)} — "
             f'<a href="/journal?task_id={esc(task_id)}">journal</a> · '
             f'<a href="/stats?task_id={esc(task_id)}">stats</a> · '
+            f'<a href="/perf?task_id={esc(task_id)}">perf</a> · '
             f'<a href="/trace?task_id={esc(task_id)}">trace</a> · '
             f'<a href="/logs?task_id={esc(task_id)}">logs</a>'
             + output_links

@@ -254,6 +254,17 @@ def do_run(
     outcome = Outcome.SUCCESS
     artifacts_by_group = {g.id: g.run.artifact for g in comp.groups}
 
+    # task-level performance ledger (docs/OBSERVABILITY.md): the queue
+    # wait and per-run runner wall are only visible HERE — the executor
+    # measures inside a run, the engine's /metrics surface needs what
+    # happened around it (scheduled → processing is appended by
+    # queue.pop, so the state timestamps carry the wait)
+    task_perf: dict = {"runner_wall_secs": {}}
+    if len(tsk.states) >= 2:
+        task_perf["queued_secs"] = round(
+            max(0.0, tsk.states[-1].created - tsk.states[0].created), 3
+        )
+
     for run in comp.runs:
         if cancel.is_set():
             raise RuntimeError("task canceled")
@@ -308,6 +319,7 @@ def do_run(
             run.total_instances,
             runner_id,
         )
+        t_run = time.monotonic()
         try:
             out = runner.run(rinput, ow, cancel)
         except Exception as e:  # noqa: BLE001 — per-run isolation
@@ -327,6 +339,10 @@ def do_run(
             }
             outcome = Outcome.FAILURE
             continue
+        finally:
+            task_perf["runner_wall_secs"][run.id] = round(
+                time.monotonic() - t_run, 3
+            )
         result = out.result if out is not None else None
         result_dict = (
             result.to_dict() if hasattr(result, "to_dict") else (result or {})
@@ -340,4 +356,9 @@ def do_run(
         if len(comp.runs) == 1
         else {"runs": run_results}
     )
-    return {**base, "outcome": outcome.value, "composition": comp.to_dict()}
+    return {
+        **base,
+        "outcome": outcome.value,
+        "composition": comp.to_dict(),
+        "perf": task_perf,
+    }

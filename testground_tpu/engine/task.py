@@ -206,6 +206,33 @@ class Task:
             "events": journal.get("events", {}),
         }
 
+    def perf_payload(self) -> dict:
+        """The performance-ledger payload (``tg perf`` / GET /perf):
+        identity, the journal's sim block, its nested perf ledger
+        (surfaced at top level for consumers), and the supervisor's
+        task-level timings (queue wait, per-run runner wall). ONE
+        builder for the daemon route and the in-process CLI — same rule
+        as :meth:`stats_payload`."""
+        result = self.result if isinstance(self.result, dict) else {}
+        journal = result.get("journal", {})
+        if not isinstance(journal, dict):
+            journal = {}
+        sim = journal.get("sim", {})
+        if not isinstance(sim, dict):
+            sim = {}
+        return {
+            "task_id": self.id,
+            "plan": self.plan,
+            "case": self.case,
+            "state": self.state().state.value,
+            "outcome": self.outcome().value,
+            "sim": {k: v for k, v in sim.items() if k != "perf"},
+            "perf": sim.get("perf", {}),
+            "task": result.get("perf", {})
+            if isinstance(result.get("perf"), dict)
+            else {},
+        }
+
     def to_dict(self) -> dict:
         return {
             "version": self.version,

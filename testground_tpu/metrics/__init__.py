@@ -2,6 +2,7 @@
 (InfluxDB viewer for the daemon dashboard) over the per-run
 ``timeseries.jsonl`` files the ``sim:jax`` runner writes."""
 
+from .prometheus import render_prometheus
 from .viewer import Row, Viewer, clean, measurement_name
 
-__all__ = ["Row", "Viewer", "clean", "measurement_name"]
+__all__ = ["Row", "Viewer", "clean", "measurement_name", "render_prometheus"]

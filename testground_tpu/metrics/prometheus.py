@@ -1,0 +1,217 @@
+"""Prometheus text-exposition rendering for the daemon's ``GET /metrics``.
+
+Format 0.0.4 (https://prometheus.io/docs/instrumenting/exposition_formats/):
+``metric_name{label="value"} number`` lines with one ``# HELP`` /
+``# TYPE`` header per family. Stdlib-only and dependency-free on purpose
+— the daemon is a long-lived process any standard scraper should be able
+to watch without this repo growing a client library.
+
+Three tiers of gauges/counters, all derived from the engine's task store
+(no live engine internals — a scrape never blocks a running task):
+
+- **task gauges** — tasks by lifecycle state and type, plus per-task
+  queue/exec timings from the supervisor's ledger (``result["perf"]``).
+- **cumulative flow counters** — a finished sim run's message-flow
+  totals (``journal["sim"]``), labeled by flow leg so conservation is
+  checkable in PromQL.
+- **perf gauges** — the run performance ledger
+  (``journal["sim"]["perf"]``): throughput, compile split, HBM
+  high-water mark.
+
+Per-task label cardinality is bounded by ``per_task_limit`` (the daemon
+exports series for its most recent tasks only); the aggregate
+``tg_tasks`` counts always cover the full task store.
+"""
+
+from __future__ import annotations
+
+# the shared finite-number coercion every ledger consumer uses —
+# NaN/Inf and non-numerics never reach the exposition (a scraper would
+# reject the whole scrape)
+from testground_tpu.sim.perf import num as _num
+
+__all__ = ["CONTENT_TYPE", "render_prometheus"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# flow legs of the conservation identity (docs/OBSERVABILITY.md):
+# sent = delivered + in_flight + dropped + rejected + fault_dropped
+_FLOWS = (
+    ("sent", "msgs_sent"),
+    ("delivered", "msgs_delivered"),
+    ("enqueued", "msgs_enqueued"),
+    ("dropped", "msgs_dropped"),
+    ("rejected", "msgs_rejected"),
+    ("in_flight", "msgs_in_flight"),
+    ("fault_dropped", "msgs_fault_dropped"),
+)
+
+
+def _escape(value) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+class _Exposition:
+    def __init__(self):
+        self._families: dict[str, tuple[str, str, list[str]]] = {}
+
+    def add(self, name: str, mtype: str, help_: str, labels: dict, value):
+        v = _num(value)
+        if v is None:
+            return
+        if name not in self._families:
+            self._families[name] = (mtype, help_, [])
+        lbl = ",".join(
+            f'{k}="{_escape(val)}"' for k, val in labels.items()
+        )
+        self._families[name][2].append(
+            f"{name}{{{lbl}}} {v}" if lbl else f"{name} {v}"
+        )
+
+    def render(self) -> str:
+        out = []
+        for name, (mtype, help_, lines) in self._families.items():
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {mtype}")
+            out.extend(lines)
+        return "\n".join(out) + "\n" if out else "\n"
+
+
+def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
+    """Render the daemon's metric surface from a task list (most recent
+    first). The fixed-cardinality ``tg_tasks`` aggregate counts EVERY
+    task given; ``per_task_limit`` bounds only the task-labeled series
+    (label cardinality), so counts stay honest on daemons whose history
+    outgrows the per-task window."""
+    exp = _Exposition()
+
+    by_state: dict[tuple[str, str], int] = {}
+    for t in tasks:
+        key = (t.state().state.value, t.type.value)
+        by_state[key] = by_state.get(key, 0) + 1
+    for (state, ttype), count in sorted(by_state.items()):
+        exp.add(
+            "tg_tasks",
+            "gauge",
+            "Tasks known to this daemon, by lifecycle state and type.",
+            {"state": state, "type": ttype},
+            count,
+        )
+
+    if per_task_limit is not None:
+        tasks = tasks[:per_task_limit]
+    for t in tasks:
+        ident = {"task": t.id, "plan": t.plan, "case": t.case}
+        result = t.result if isinstance(t.result, dict) else {}
+        # supervisor ledger: queue wait + per-run runner wall
+        tperf = result.get("perf") if isinstance(result.get("perf"), dict) else {}
+        exp.add(
+            "tg_task_queued_seconds",
+            "gauge",
+            "Seconds a task waited in the queue before processing.",
+            ident,
+            tperf.get("queued_secs"),
+        )
+        for rid, wall in sorted(
+            (tperf.get("runner_wall_secs") or {}).items()
+        ):
+            exp.add(
+                "tg_task_runner_wall_seconds",
+                "gauge",
+                "Wall seconds the runner spent executing one run of a task.",
+                {**ident, "run": rid},
+                wall,
+            )
+        journal = (
+            result.get("journal") if isinstance(result.get("journal"), dict)
+            else {}
+        )
+        sim = journal.get("sim") if isinstance(journal.get("sim"), dict) else {}
+        if not sim:
+            continue
+        for flow, key in _FLOWS:
+            exp.add(
+                "tg_run_msgs_total",
+                "counter",
+                "Cumulative message-flow totals of a finished sim run, "
+                "by conservation leg.",
+                {**ident, "flow": flow},
+                sim.get(key),
+            )
+        for name, key, help_ in (
+            ("tg_run_ticks", "ticks", "Simulated ticks the run executed."),
+            (
+                "tg_run_wall_seconds",
+                "wall_secs",
+                "Wall seconds of the run's execute phase.",
+            ),
+            (
+                "tg_run_compile_seconds",
+                "compile_secs",
+                "Init + first-dispatch seconds (trace/lower + XLA compile "
+                "or persistent-cache read).",
+            ),
+            ("tg_run_devices", "devices", "Devices the run's mesh spanned."),
+            (
+                "tg_run_carry_bytes",
+                "carry_bytes",
+                "Device-resident carry footprint in bytes (eval_shape-exact).",
+            ),
+        ):
+            exp.add(name, "gauge", help_, ident, sim.get(key))
+        perf = sim.get("perf") if isinstance(sim.get("perf"), dict) else {}
+        ex = perf.get("execute") if isinstance(perf.get("execute"), dict) else {}
+        co = perf.get("compile") if isinstance(perf.get("compile"), dict) else {}
+        hbm = perf.get("hbm") if isinstance(perf.get("hbm"), dict) else {}
+        exp.add(
+            "tg_run_peer_ticks_per_second",
+            "gauge",
+            "Steady-state instance*ticks per wall second (performance "
+            "ledger; first dispatch excluded when more than one ran).",
+            ident,
+            ex.get("steady_peer_ticks_per_sec", ex.get("peer_ticks_per_sec")),
+        )
+        exp.add(
+            "tg_run_lower_seconds",
+            "gauge",
+            "Trace+lower seconds of the chunk program (AOT accounting pass).",
+            ident,
+            co.get("lower_secs"),
+        )
+        exp.add(
+            "tg_run_xla_compile_seconds",
+            "gauge",
+            "XLA compile (or persistent-cache read) seconds of the chunk "
+            "program (AOT accounting pass).",
+            ident,
+            co.get("compile_secs"),
+        )
+        exp.add(
+            "tg_run_est_flops_per_chunk",
+            "gauge",
+            "XLA cost-analysis FLOP estimate for one tick-chunk program.",
+            ident,
+            co.get("flops"),
+        )
+        exp.add(
+            "tg_run_est_bytes_accessed_per_chunk",
+            "gauge",
+            "XLA cost-analysis bytes-accessed estimate for one tick-chunk "
+            "program.",
+            ident,
+            co.get("bytes_accessed"),
+        )
+        exp.add(
+            "tg_run_hbm_peak_bytes",
+            "gauge",
+            "Device memory high-water mark sampled across the run "
+            "(absent when the backend exposes no memory stats).",
+            ident,
+            hbm.get("peak_bytes"),
+        )
+    return exp.render()

@@ -27,7 +27,14 @@ import os
 
 from testground_tpu.config import EnvConfig
 
-__all__ = ["Row", "Viewer", "clean", "expand_sim_row", "measurement_name"]
+__all__ = [
+    "Row",
+    "Viewer",
+    "clean",
+    "expand_perf_row",
+    "expand_sim_row",
+    "measurement_name",
+]
 
 # Tag keys that identify rather than dimension a series — excluded from the
 # dashboard's tag pickers like the reference's tagsIgnoreList
@@ -40,22 +47,29 @@ TAGS_IGNORE = {"plan", "case", "group_id", "run"}
 # the ``sim.latency.p50/p95/p99`` measurement family, per group.
 from testground_tpu.sim.telemetry import (  # noqa: E402
     LATENCY_FILE,
+    PERF_FILE,
     SIM_SERIES_FILE,
 )
 
 # Keys of a sim telemetry row that identify rather than measure.
 _SIM_IDENTITY = {"run", "plan", "case", "tick"}
+# Perf rows additionally carry the chunk index as identity (the tick
+# already orders the series; a sim.perf.chunk measurement would be noise).
+_PERF_IDENTITY = _SIM_IDENTITY | {"chunk"}
 
 
-def expand_sim_row(row: dict):
-    """One sim_timeseries.jsonl row → viewer-shaped rows, one per
-    counter: measurement ``sim.<counter>`` with the per-tick value in
-    every field slot, and ``sim.live`` per group from the nested live
-    map. Non-numeric values are skipped (the jsonl is an open format)."""
+def expand_sim_row(row: dict, prefix: str = "sim", identity=None):
+    """One open-format jsonl counter row → viewer-shaped rows, one per
+    counter: measurement ``<prefix>.<counter>`` with the per-tick value
+    in every field slot, and ``<prefix>.live`` per group from a nested
+    live map. Non-numeric values are skipped (the jsonl is an open
+    format)."""
+    if identity is None:
+        identity = _SIM_IDENTITY
     base = {k: row.get(k, "") for k in ("run", "plan", "case")}
     tick = row.get("tick", 0)
     for key, val in row.items():
-        if key in _SIM_IDENTITY:
+        if key in identity:
             continue
         if key == "live" and isinstance(val, dict):
             for gid, v in val.items():
@@ -64,7 +78,7 @@ def expand_sim_row(row: dict):
                         **base,
                         "tick": tick,
                         "group_id": str(gid),
-                        "name": "sim.live",
+                        "name": f"{prefix}.live",
                         "count": v,
                         "mean": v,
                         "min": v,
@@ -77,12 +91,19 @@ def expand_sim_row(row: dict):
             **base,
             "tick": tick,
             "group_id": "_run",
-            "name": f"sim.{key}",
+            "name": f"{prefix}.{key}",
             "count": val,
             "mean": val,
             "min": val,
             "max": val,
         }
+
+
+def expand_perf_row(row: dict):
+    """One sim_perf.jsonl row (performance ledger, sim/perf.py) → the
+    ``sim.perf.<gauge>`` measurement family (group_id ``_run``, like the
+    counter family)."""
+    yield from expand_sim_row(row, prefix="sim.perf", identity=_PERF_IDENTITY)
 
 
 def clean(name: str) -> str:
@@ -121,25 +142,25 @@ class Viewer:
 
     def _run_dirs(self, plan: str):
         """Yield (run_id, plan-metric series path | None, sim telemetry
-        series path | None, latency summary path | None) for every run
-        dir carrying any of the three families."""
+        series path | None, latency summary path | None, perf ledger
+        path | None) for every run dir carrying any of the four
+        families."""
         root = os.path.join(self.env.dirs.outputs(), plan)
         if not os.path.isdir(root):
             return
         for run_id in sorted(os.listdir(root)):
-            ts = os.path.join(root, run_id, "timeseries.jsonl")
-            sim = os.path.join(root, run_id, SIM_SERIES_FILE)
-            lat = os.path.join(root, run_id, LATENCY_FILE)
-            ts_ok = os.path.isfile(ts)
-            sim_ok = os.path.isfile(sim)
-            lat_ok = os.path.isfile(lat)
-            if ts_ok or sim_ok or lat_ok:
-                yield (
-                    run_id,
-                    ts if ts_ok else None,
-                    sim if sim_ok else None,
-                    lat if lat_ok else None,
+            paths = [
+                os.path.join(root, run_id, name)
+                for name in (
+                    "timeseries.jsonl",
+                    SIM_SERIES_FILE,
+                    LATENCY_FILE,
+                    PERF_FILE,
                 )
+            ]
+            present = [p if os.path.isfile(p) else None for p in paths]
+            if any(present):
+                yield (run_id, *present)
 
     @staticmethod
     def _read_jsonl(path: str):
@@ -150,7 +171,9 @@ class Viewer:
         yield from iter_jsonl(path)
 
     def _iter_rows(self, plan: str, case: str | None, run_id: str | None):
-        for rid, ts_path, sim_path, lat_path in self._run_dirs(plan):
+        for rid, ts_path, sim_path, lat_path, perf_path in self._run_dirs(
+            plan
+        ):
             # a task's runs are <task-id> (single run) or <task-id>-<run-id>
             # (multi-run [[runs]] compositions — supervisor run_id scheme),
             # so a task-scoped query matches both
@@ -176,6 +199,11 @@ class Viewer:
                     if case is not None and row.get("case") != case:
                         continue
                     yield row
+            if perf_path is not None:
+                for row in self._read_jsonl(perf_path):
+                    if case is not None and row.get("case") != case:
+                        continue
+                    yield from expand_perf_row(row)
 
     # ---------------------------------------------------------------- query
 

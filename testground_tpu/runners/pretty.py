@@ -16,7 +16,29 @@ from typing import IO
 from testground_tpu.rpc import OutputWriter
 from testground_tpu.sdk.events import parse_event_line
 
-__all__ = ["PrettyPrinter", "render_telemetry_summary"]
+__all__ = ["PrettyPrinter", "render_perf_summary", "render_telemetry_summary"]
+
+
+# the shared ledger-consumer helpers (stdlib-only module, safe here):
+# null/NaN/string fields from foreign writers degrade to readable
+# placeholders, not TypeErrors or misleading blanks
+from testground_tpu.sim.perf import fmt_rate as _fmt_rate
+from testground_tpu.sim.perf import num as _num
+
+
+def _fmt(v, spec: str = "{:.2f}", missing: str = "?") -> str:
+    n = _num(v)
+    return missing if n is None else spec.format(n)
+
+
+def _fmt_count(v, missing: str = "?") -> str:
+    """An integral count rendered verbatim — ``'{:g}'`` would truncate
+    counts >= 1e6 into scientific notation (format(1234567, 'g') ==
+    '1.23457e+06'), and tick totals get there routinely."""
+    n = _num(v)
+    if n is None:
+        return missing
+    return str(int(n)) if float(n).is_integer() else str(n)
 
 
 def render_telemetry_summary(stats: dict) -> str:
@@ -41,30 +63,37 @@ def render_telemetry_summary(stats: dict) -> str:
     if stats.get("outcome"):
         rows.append(("outcome", str(stats["outcome"])))
     if sim:
-        ticks = sim.get("ticks", 0)
-        tick_ms = sim.get("tick_ms", 0.0)
+        ticks = _num(sim.get("ticks"), 0)
+        tick_ms = _num(sim.get("tick_ms"), 0.0)
         rows.append(
             (
                 "ticks",
-                f"{ticks} ({ticks * tick_ms / 1000.0:.2f} sim-s at "
-                f"{tick_ms:g} ms/tick)",
+                f"{_fmt_count(ticks)} ({ticks * tick_ms / 1000.0:.2f} "
+                f"sim-s at {tick_ms:g} ms/tick)",
             )
         )
         rows.append(
             (
                 "wall",
-                f"{sim.get('wall_secs', 0.0):.2f}s (compile "
-                f"{sim.get('compile_secs', 0.0):.2f}s) on "
-                f"{sim.get('devices', 1)} device(s) / "
-                f"{sim.get('processes', 1)} process(es)",
+                f"{_fmt(sim.get('wall_secs'))}s (compile "
+                f"{_fmt(sim.get('compile_secs'))}s) on "
+                f"{_fmt(sim.get('devices'), '{:g}', '1')} device(s) / "
+                f"{_fmt(sim.get('processes'), '{:g}', '1')} process(es)",
             )
         )
-        if "carry_bytes" in sim:
+        carry = _num(sim.get("carry_bytes"))
+        if carry is not None:
             rows.append(
-                (
-                    "carry",
-                    f"{sim['carry_bytes'] / 2**20:.2f} MiB device-resident",
-                )
+                ("carry", f"{carry / 2**20:.2f} MiB device-resident")
+            )
+        # one-line performance-ledger teaser (full view: `tg perf`)
+        perf_ex = (sim.get("perf") or {}).get("execute") or {}
+        rate = _num(perf_ex.get("steady_peer_ticks_per_sec")) or _num(
+            perf_ex.get("peer_ticks_per_sec")
+        )
+        if rate:
+            rows.append(
+                ("perf", f"{rate:,.0f} peer·ticks/s (details: tg perf)")
             )
         rows.append(
             (
@@ -108,7 +137,7 @@ def render_telemetry_summary(stats: dict) -> str:
         # per-receiver-group delivery-latency percentiles (telemetry
         # plane histograms, docs/OBSERVABILITY.md) — one line per group
         for gid, pct in sorted((sim.get("latency") or {}).items()):
-            if not pct.get("count"):
+            if not _num(pct.get("count"), 0):
                 rows.append((f"latency {gid}", "no deliveries"))
                 continue
             rows.append(
@@ -147,6 +176,147 @@ def render_telemetry_summary(stats: dict) -> str:
             rows.append((f"group {gid}", shown or "-"))
     width = max(len(k) for k, _ in rows)
     return "\n".join(f"{k:<{width}}  {v}" for k, v in rows)
+
+
+def _fmt_bytes(v) -> str:
+    n = _num(v)
+    if n is None:
+        return "?"
+    for div, suffix in ((2**30, "GiB"), (2**20, "MiB"), (2**10, "KiB")):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {suffix}"
+    return f"{n:.0f} B"
+
+
+def render_perf_summary(payload: dict) -> str:
+    """Render a task's performance ledger as an aligned table — the
+    console surface of the perf plane (``tg perf <task>``;
+    docs/OBSERVABILITY.md "Performance ledger").
+
+    ``payload`` is the /perf payload shape (Task.perf_payload): identity
+    + ``sim`` + ``perf`` + ``task``, every field optional — absent, zero
+    or NaN fields render as ``?`` lines or are dropped, never as
+    misleading blanks."""
+    sim = payload.get("sim") or {}
+    perf = payload.get("perf") or {}
+    task = payload.get("task") or {}
+    ident = f"{payload.get('plan', '?')}:{payload.get('case', '?')}"
+    if payload.get("task_id"):
+        ident += f"  ({payload['task_id']})"
+    rows: list[tuple[str, str]] = [("task", ident)]
+    if payload.get("outcome"):
+        rows.append(("outcome", str(payload["outcome"])))
+    if not perf and not sim:
+        # multi-run compositions journal per-run results (no top-level
+        # sim block yet), and disable_metrics / cohorts / perf=false run
+        # ledger-free — say so, but still render the scheduler timings
+        # the supervisor recorded for exactly this surface
+        rows.append(
+            (
+                "ledger",
+                "no performance ledger recorded (a multi-run composition, "
+                "disable_metrics, a cohort run, or runner config "
+                "perf=false)",
+            )
+        )
+    co = perf.get("compile") or {}
+    ex = perf.get("execute") or {}
+    if perf or sim:
+        # the compile split: the journal's compile_secs (init + first
+        # dispatch) beside the AOT pass's true lower-vs-XLA breakdown
+        split = (
+            f" (AOT lower {_fmt(co.get('lower_secs'))}s + "
+            f"xla {_fmt(co.get('compile_secs'))}s)"
+            if co
+            else ""
+        )
+        rows.append(
+            (
+                "compile",
+                f"{_fmt(sim.get('compile_secs'))}s first dispatch{split}",
+            )
+        )
+    n_inst = _num(perf.get("instances"), 0)
+    if ex:
+        rows.append(
+            (
+                "execute",
+                f"{_fmt_count(ex.get('ticks'))} ticks in "
+                f"{_fmt(ex.get('wall_secs'))}s — "
+                f"{_fmt_rate(ex.get('ticks_per_sec'))} ticks/s, "
+                f"{_fmt_rate(ex.get('peer_ticks_per_sec'))} peer·ticks/s "
+                f"({_fmt_count(n_inst)} instance(s), "
+                f"{_fmt_count(ex.get('chunks'))} chunk(s))",
+            )
+        )
+        if _num(ex.get("steady_peer_ticks_per_sec")):
+            rows.append(
+                (
+                    "steady",
+                    f"{_fmt_rate(ex.get('steady_ticks_per_sec'))} ticks/s, "
+                    f"{_fmt_rate(ex.get('steady_peer_ticks_per_sec'))} "
+                    f"peer·ticks/s over "
+                    f"{_fmt_count(ex.get('steady_chunks'))} steady "
+                    "chunk(s)",
+                )
+            )
+    flops = _num(co.get("flops"))
+    if flops:
+        achieved = (
+            f" (achieved {_fmt_rate(ex.get('est_flops_per_sec'))} flop/s)"
+            if _num(ex.get("est_flops_per_sec"))
+            else ""
+        )
+        rows.append(
+            (
+                "cost",
+                f"~{_fmt_rate(flops)} flops, "
+                f"{_fmt_bytes(co.get('bytes_accessed'))} accessed "
+                f"per chunk{achieved}",
+            )
+        )
+    if _num(co.get("peak_bytes")) is not None:
+        rows.append(
+            (
+                "program",
+                f"args {_fmt_bytes(co.get('argument_bytes'))} + "
+                f"temp {_fmt_bytes(co.get('temp_bytes'))} + "
+                f"codegen {_fmt_bytes(co.get('generated_code_bytes'))} "
+                f"= peak {_fmt_bytes(co.get('peak_bytes'))}",
+            )
+        )
+    carry = _num(sim.get("carry_bytes"))
+    if carry is not None:
+        rows.append(("carry", f"{_fmt_bytes(carry)} device-resident"))
+    hbm = perf.get("hbm") or {}
+    if _num(hbm.get("peak_bytes")):
+        limit = (
+            f" of {_fmt_bytes(hbm['bytes_limit'])}"
+            if _num(hbm.get("bytes_limit"))
+            else ""
+        )
+        rows.append(
+            ("hbm", f"high-water {_fmt_bytes(hbm['peak_bytes'])}{limit}")
+        )
+    elif perf:
+        rows.append(("hbm", "no memory stats on this backend"))
+    if task:
+        bits = []
+        if _num(task.get("queued_secs")) is not None:
+            bits.append(f"queued {_fmt(task.get('queued_secs'))}s")
+        for rid, wall in sorted((task.get("runner_wall_secs") or {}).items()):
+            bits.append(f"run {rid} {_fmt(wall)}s")
+        if bits:
+            rows.append(("sched", ", ".join(bits)))
+    series = perf.get("series") or {}
+    if _num(series.get("rows")):
+        shown = f"{_fmt_count(series['rows'])} per-chunk rows"
+        if series.get("file"):
+            shown += f" ({series['file']})"
+        rows.append(("series", shown))
+    width = max(len(k) for k, _ in rows)
+    return "\n".join(f"{k:<{width}}  {v}" for k, v in rows)
+
 
 _CLASS = {
     "error": "ERROR",

@@ -1305,6 +1305,7 @@ class SimProgram:
         chunk_timeout: float = 0.0,
         on_stall: Callable[[int, int], None] | None = None,
         nan_guard: bool = False,
+        perf=None,
     ) -> dict[str, Any]:
         """Step to completion. Returns host-side results:
 
@@ -1332,6 +1333,16 @@ class SimProgram:
         the carry after each chunk and fails fast naming the offending
         leaf and tick range — a debug flag (each scan is a device→host
         read of the whole carry).
+
+        ``perf`` is a performance-ledger hook object (``sim/perf.py``):
+        ``on_compile(lower_secs, compile_secs, compiled)`` fires once
+        from an AOT lower/compile pass before the first dispatch (only
+        when ``perf.wants_aot`` — with the persistent compile cache
+        warm, the loop's own first dispatch then reads the cache entry
+        this pass wrote), and ``on_chunk(index, ticks, delta, wall)``
+        fires per dispatch with its host-clock wall. The ledger shapes
+        NO part of the program and adds NO device syncs — both pinned
+        by tests.
         """
         import time as _time
 
@@ -1340,6 +1351,20 @@ class SimProgram:
         t0 = _time.perf_counter()
         carry = jax.jit(lambda: self.init_carry(seed))()
         fn = self.compiled_chunk()
+        if perf is not None and getattr(perf, "wants_aot", False):
+            # AOT accounting pass: lower + compile the chunk program
+            # out-of-line so the ledger records the true trace/lower vs
+            # XLA-compile split and can harvest cost/memory analysis.
+            # The compile lands in the persistent cache, so the loop's
+            # first dispatch below re-traces but reads the cache entry
+            # instead of compiling again. Best-effort: the ledger must
+            # never fail the run it measures.
+            try:
+                from .perf import timed_lower_compile
+
+                perf.on_compile(*timed_lower_compile(fn, carry))
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
         ticks = 0
         compile_secs = 0.0
         # host-side accumulator for the per-chunk histogram deltas —
@@ -1359,6 +1384,7 @@ class SimProgram:
             watch = chunk_timeout and chunk_timeout > 0 and (
                 ticks >= 2 * self.chunk
             )
+            t_chunk = _time.perf_counter()
             if watch:
                 out, done_host = self._dispatch_watched(
                     fn, carry, ticks, chunk_timeout, cancel, on_stall
@@ -1373,6 +1399,15 @@ class SimProgram:
                 # count _poll_done calls to pin the telemetry plane's
                 # zero-extra-syncs contract).
                 done_host = _poll_done(done)
+            if perf is not None:
+                # host-clock wall of this dispatch + done poll — no
+                # device reads beyond the poll the loop already paid
+                perf.on_chunk(
+                    ticks // self.chunk - 1,
+                    ticks,
+                    self.chunk,
+                    _time.perf_counter() - t_chunk,
+                )
             if nan_guard:
                 _check_carry_finite(carry, ticks - self.chunk, ticks)
             if compile_secs == 0.0:

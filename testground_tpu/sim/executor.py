@@ -74,6 +74,15 @@ class SimJaxConfig:
     # flag poll (zero extra host syncs); off by default because a
     # 100k-tick run writes 100k jsonl rows
     telemetry: bool = False
+    # performance ledger (docs/OBSERVABILITY.md "Performance ledger"):
+    # per-chunk dispatch wall / ticks/s / peer·ticks/s rows into
+    # sim_perf.jsonl, the AOT lower-vs-compile split, XLA cost/memory
+    # analysis of the chunk program, and the device HBM high-water mark
+    # — all host-side bookkeeping on state the loop already has (zero
+    # extra device syncs, program untouched). On by default; follows
+    # the telemetry plane's gating (disable_metrics wins, cohorts run
+    # ledger-free)
+    perf: bool = True
     # opt-in jax.profiler trace for the whole run — the global switch
     # beside the per-group composition flag (Group.profiles); writes the
     # XLA op + host timeline under <run outputs>/profiles
@@ -265,10 +274,9 @@ def _precheck_device_memory(prog, cfg, mesh, ow) -> None:
     if limit < 0:
         return
     if limit == 0:
-        import jax
+        from .perf import device_memory_stats
 
-        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
-        limit = stats.get("bytes_limit") or 0
+        limit = device_memory_stats().get("bytes_limit") or 0
         if not limit:
             return  # backend exposes no memory stats — nothing to check
     n_dev = int(mesh.devices.size) if mesh is not None else 1
@@ -356,8 +364,16 @@ def _execute_sim_run(
 
     # the compiled XLA program is this framework's build artifact: route
     # compilation through the persistent cache so a precompiled build
-    # (sim:plan) or any prior run of the same program skips XLA compile
-    enable_compile_cache(job.env.dirs.home if job.env is not None else None)
+    # (sim:plan) or any prior run of the same program skips XLA compile.
+    # The perf ledger's AOT accounting pass needs to know whether the
+    # cache is live: without it, lowering+compiling out-of-line would
+    # force a full second XLA compile instead of a cache read.
+    compile_cache_on = (
+        enable_compile_cache(
+            job.env.dirs.home if job.env is not None else None
+        )
+        is not None
+    )
 
     # multi-host cohort join MUST precede any jax call that initializes
     # the backend (jax.distributed.initialize's contract)
@@ -639,6 +655,42 @@ def _execute_sim_run(
         if trace_plan is not None
         else None
     )
+    # Performance ledger (docs/OBSERVABILITY.md "Performance ledger"):
+    # host-side only — the program is untouched — so the gate is NOT
+    # program-shaping; it still follows the telemetry plane's rules
+    # (disable_metrics wins, cohorts run ledger-free: the per-chunk
+    # walls and AOT pass are leader-local and would skew under a
+    # cohort's lockstep dispatches).
+    perf_on = (
+        bool(getattr(cfg, "perf", True))
+        and not job.disable_metrics
+        and not getattr(cfg, "coordinator_address", "")
+    )
+    perf_ledger = None
+    if perf_on:
+        from .perf import PERF_FILE, PerfLedger
+
+        perf_ledger = PerfLedger(
+            n,
+            cfg.chunk,
+            ident=row_ident,
+            path=(
+                os.path.join(run_dir, PERF_FILE)
+                if run_dir is not None
+                else None
+            ),
+            # without the persistent cache the AOT pass would pay a full
+            # second XLA compile — skip it and keep only the gauges
+            aot=compile_cache_on,
+            # on a mesh the second dispatch retraces at the GSPMD
+            # sharding fixed point (engine.run) — keep it out of the
+            # steady_* throughput window
+            warmup=(
+                2
+                if mesh is not None and int(mesh.devices.size) > 1
+                else 1
+            ),
+        )
     # Profile capture — the pprof analog (``pkg/api/composition.go:153-162``
     # → TestCaptureProfiles): any group requesting profiles — or the
     # runner-config ``profile`` flag — makes the run record a
@@ -698,6 +750,7 @@ def _execute_sim_run(
             # cross-process-sharded leaf raises outright), so the guard
             # is single-process only
             nan_guard=bool(getattr(cfg, "nan_guard", False)) and not multi,
+            perf=perf_ledger,
         )
 
     spans.start("execute")
@@ -878,6 +931,40 @@ def _execute_sim_run(
         trace_writer.close()
         result.journal["trace"] = trace_writer.journal()
 
+    # ---------------------------------------------- performance ledger
+    # journaled under sim.perf (below) — the block every perf PR and the
+    # bench trajectory report against; one task-log line so the
+    # throughput is visible without digging into the journal
+    perf_summary = None
+    if perf_ledger is not None:
+        perf_ledger.close()
+        perf_summary = perf_ledger.summary()
+        ex = perf_summary.get("execute", {})
+        co = perf_summary.get("compile", {})
+        if ex:
+            ow.infof(
+                "sim:jax %s: perf — %.0f peer·ticks/s over %d chunk(s)"
+                "%s%s",
+                job.run_id,
+                ex.get(
+                    "steady_peer_ticks_per_sec",
+                    ex.get("peer_ticks_per_sec", 0.0),
+                ),
+                ex.get("chunks", 0),
+                (
+                    " (lower %.2fs + xla %.2fs)"
+                    % (co["lower_secs"], co["compile_secs"])
+                    if co
+                    else ""
+                ),
+                (
+                    ", hbm peak %.2f MiB"
+                    % (perf_summary["hbm"]["peak_bytes"] / 2**20)
+                    if perf_summary.get("hbm")
+                    else ""
+                ),
+            )
+
     # ------------------------------------------------ metric time series
     # final sample at the last tick, then persist the run's series — written
     # even above write_outputs_max (per-group reductions stay small)
@@ -931,6 +1018,28 @@ def _execute_sim_run(
 
         result.journal["influx_latency"] = push_rows(
             influx_endpoint, lat_rows, base_ns=base_ns
+        )
+    if (
+        influx_endpoint
+        and perf_ledger is not None
+        and perf_ledger.path is not None
+        and perf_ledger.rows_written > 0
+    ):
+        # performance-ledger rows (sim.perf.* family) — one row per
+        # chunk dispatch, so one small batch like the latency family
+        from testground_tpu.metrics.influx import push_rows
+        from testground_tpu.metrics.viewer import expand_perf_row
+
+        from .telemetry import iter_jsonl
+
+        result.journal["influx_perf"] = push_rows(
+            influx_endpoint,
+            [
+                r
+                for row in iter_jsonl(perf_ledger.path)
+                for r in expand_perf_row(row)
+            ],
+            base_ns=base_ns,
         )
 
     for gi, g in enumerate(groups):
@@ -989,6 +1098,10 @@ def _execute_sim_run(
         # per-receiver-group delivery-latency percentiles (telemetry
         # plane; docs/OBSERVABILITY.md) — absent when telemetry was off
         **({"latency": latency} if latency else {}),
+        # performance ledger (compile split + cost/memory analysis +
+        # throughput gauges; docs/OBSERVABILITY.md) — absent only under
+        # disable_metrics, cohorts, or an explicit perf=false
+        **({"perf": perf_summary} if perf_summary else {}),
     }
     result.update_outcome()
     if cancel.is_set():

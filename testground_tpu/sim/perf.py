@@ -1,0 +1,464 @@
+"""Run performance ledger: compile/memory/FLOP accounting and
+throughput gauges (docs/OBSERVABILITY.md "Performance ledger").
+
+The host-side half of the perf plane. The engine (``sim/engine.py``)
+calls two hooks on a :class:`PerfLedger`:
+
+- ``on_compile(lower_secs, compile_secs, compiled)`` — once, from the
+  AOT lower/compile pass the run loop performs before its first
+  dispatch when a ledger is attached. The split is the true
+  trace/lower vs XLA-compile breakdown (the journal's ``compile_secs``
+  lumps init + first dispatch), and the ``compiled`` object is
+  harvested for ``cost_analysis()`` / ``memory_analysis()`` — the
+  estimated FLOPs, bytes accessed, and peak/temp/argument bytes of one
+  tick-chunk program.
+- ``on_chunk(index, ticks, ticks_delta, wall_secs)`` — once per chunk
+  dispatch, with the host-clock wall of that dispatch. Each call
+  becomes one ``sim_perf.jsonl`` row (ticks/s, peer·ticks/s, achieved
+  FLOP/s and bytes/s against the cost-analysis estimates, device
+  bytes-in-use where the backend exposes memory stats).
+
+Everything here is host-side bookkeeping riding state the run loop
+already has: the ledger shapes NO part of the compiled program (pinned
+by jaxpr equality in tests) and adds NO device→host syncs beyond the
+per-chunk done poll (``engine._poll_done``; tests count calls). Like
+every observability writer, the ledger never fails the run it observes.
+
+``bench.py`` emits the same ledger schema (``compile``/``execute``
+blocks) so ad-hoc bench runs and framework runs are directly
+comparable, and ``perf_compare`` diffs a task's ledger against a
+``BENCH_rNN.json`` line or a prior ``tg perf --json`` dump.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any
+
+# the writer-owned file-name constant lives beside its siblings
+# (SIM_SERIES_FILE / SPAN_FILE / LATENCY_FILE) in sim/telemetry.py
+from .telemetry import PERF_FILE
+
+__all__ = [
+    "PERF_FILE",
+    "PerfLedger",
+    "compile_analysis",
+    "cost_analysis_dict",
+    "device_memory_stats",
+    "fmt_rate",
+    "memory_analysis_dict",
+    "num",
+    "perf_compare",
+    "timed_lower_compile",
+]
+
+
+def num(v, default=None):
+    """A finite number, or ``default`` — perf/stats payloads are decoded
+    JSON from possibly foreign writers, so a null/NaN/string field must
+    degrade gracefully, never TypeError. Shared by every ledger consumer
+    (``runners/pretty.py`` tables, the Prometheus exposition)."""
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return default
+    if not math.isfinite(v):
+        return default
+    return v
+
+
+def fmt_rate(v, missing: str = "?") -> str:
+    """A rate with a G/M/k suffix (``?`` for absent/non-finite) — the one
+    formatter behind both the ``tg perf`` table and ``--compare`` lines."""
+    n = num(v)
+    if n is None:
+        return missing
+    for div, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(n) >= div:
+            return f"{n / div:.2f}{suffix}"
+    return f"{n:.1f}"
+
+
+def device_memory_stats(device=None) -> dict:
+    """The ONE device-memory probe (used by the runner healthcheck, the
+    executor's capacity precheck, and the perf ledger's HBM sampling).
+
+    Returns the backend's ``memory_stats()`` dict normalized to the keys
+    consumers read — ``bytes_in_use`` / ``peak_bytes_in_use`` /
+    ``bytes_limit`` — keeping only those actually present (some
+    platforms expose none, some a subset). Never raises: no backend, no
+    device, or no stats all return ``{}``.
+    """
+    try:
+        if device is None:
+            import jax
+
+            devs = jax.devices()
+            if not devs:
+                return {}
+            device = devs[0]
+        stats = getattr(device, "memory_stats", lambda: None)() or {}
+        out = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            v = stats.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[key] = int(v)
+        return out
+    except Exception:  # noqa: BLE001 — observability never raises
+        return {}
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """Normalize ``compiled.cost_analysis()`` across jax versions and
+    backends into ``{flops, bytes_accessed, transcendentals}`` (only the
+    fields the backend actually estimates; XLA's keys carry spaces).
+    Never raises; ``{}`` when the backend offers no estimate."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per module
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return {}
+        out = {}
+        for key, name in (
+            ("flops", "flops"),
+            ("bytes accessed", "bytes_accessed"),
+            ("transcendentals", "transcendentals"),
+        ):
+            v = ca.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v) and v > 0:
+                out[name] = float(v)
+        return out
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def memory_analysis_dict(compiled) -> dict:
+    """Normalize ``compiled.memory_analysis()`` (a CompiledMemoryStats
+    object, or None on some backends) into plain byte counts. Never
+    raises; ``{}`` when unavailable."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return {}
+        out = {}
+        for attr, name in (
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("alias_size_in_bytes", "alias_bytes"),
+            ("generated_code_size_in_bytes", "generated_code_bytes"),
+        ):
+            v = getattr(ma, attr, None)
+            if isinstance(v, (int, float)) and v >= 0:
+                out[name] = int(v)
+        if out:
+            # the program's device-memory high-water estimate: arguments
+            # + outputs + codegen + temporaries (what XLA reserves for
+            # one execution, the per-program analog of the carry bytes)
+            out["peak_bytes"] = (
+                out.get("argument_bytes", 0)
+                + out.get("output_bytes", 0)
+                + out.get("temp_bytes", 0)
+                + out.get("generated_code_bytes", 0)
+            )
+        return out
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def compile_analysis(compiled) -> dict:
+    """cost + memory analysis of one compiled chunk program, merged —
+    the shared harvest used by the run ledger, the sim:plan precompile
+    marker, and bench.py."""
+    return {**cost_analysis_dict(compiled), **memory_analysis_dict(compiled)}
+
+
+def timed_lower_compile(fn, *args) -> tuple:
+    """Time ``fn.lower(*args)`` and ``.compile()`` separately; returns
+    ``(lower_secs, compile_secs, compiled)`` — the argument order
+    :meth:`PerfLedger.on_compile` takes. The ONE timed AOT accounting
+    pass, shared by the run loop (``engine.run``), the ``sim:plan``
+    precompile marker, and ``bench.py``'s warm-recompile split."""
+    import time
+
+    t0 = time.perf_counter()
+    lowered = fn.lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    return t1 - t0, time.perf_counter() - t1, compiled
+
+
+class PerfLedger:
+    """Per-run performance ledger (see module docstring).
+
+    Streams one jsonl row per chunk dispatch to ``path`` (``None`` only
+    counts — the telemetry-writer rule), aggregates host-side, and
+    renders the ``journal["sim"]["perf"]`` block via :meth:`summary`.
+    ``aot=False`` skips the lower/compile pass entirely — the executor
+    passes it when the persistent compile cache is disabled, where the
+    AOT pass would force a full second XLA compile instead of a cache
+    read.
+    """
+
+    def __init__(
+        self,
+        instances: int,
+        chunk: int,
+        ident: dict | None = None,
+        path: str | None = None,
+        aot: bool = True,
+        warmup: int = 1,
+    ):
+        self.instances = int(instances)
+        self.chunk = int(chunk)
+        # dispatches excluded from the steady_* window: the first carries
+        # trace + compile everywhere; under a multi-device mesh the
+        # SECOND retraces at the GSPMD sharding fixed point (see
+        # engine.run's compile_secs comment), so the executor passes 2
+        # there — otherwise that recompile lands in steady throughput
+        # and `--compare` reports phantom regressions
+        self.warmup = max(0, int(warmup))
+        self.ident = dict(ident or {})
+        self.path = path
+        self.wants_aot = bool(aot)
+        self.rows_written = 0
+        self._compile: dict = {}
+        self._chunk_walls: list[float] = []
+        self._ticks = 0
+        self._hbm_peak = 0
+        self._hbm_limit = 0
+        self._f = None
+        if path is not None:
+            try:
+                self._f = open(path, "w")
+            except OSError:  # observe best-effort, never fail the run
+                self.path = None
+
+    # ------------------------------------------------------------- hooks
+
+    def on_compile(self, lower_secs: float, compile_secs: float, compiled) -> None:
+        self._compile = {
+            "lower_secs": round(float(lower_secs), 6),
+            "compile_secs": round(float(compile_secs), 6),
+            **compile_analysis(compiled),
+        }
+
+    def on_chunk(
+        self, index: int, ticks: int, ticks_delta: int, wall_secs: float
+    ) -> None:
+        wall = max(float(wall_secs), 1e-9)
+        self._chunk_walls.append(wall)
+        self._ticks = int(ticks)
+        row: dict[str, Any] = {
+            "tick": int(ticks),
+            "chunk": int(index),
+            "wall_secs": round(wall, 6),
+            "ticks_per_sec": round(ticks_delta / wall, 3),
+            "peer_ticks_per_sec": round(
+                self.instances * ticks_delta / wall, 3
+            ),
+        }
+        flops = self._compile.get("flops")
+        if flops:
+            # achieved rate of the ESTIMATED per-chunk work — how fast
+            # the hardware retired what XLA predicted the chunk costs
+            row["flops_per_sec"] = round(flops / wall, 3)
+        bytes_acc = self._compile.get("bytes_accessed")
+        if bytes_acc:
+            row["bytes_per_sec"] = round(bytes_acc / wall, 3)
+        mem = device_memory_stats()
+        if "bytes_in_use" in mem:
+            row["bytes_in_use"] = mem["bytes_in_use"]
+        self._hbm_peak = max(
+            self._hbm_peak,
+            mem.get("peak_bytes_in_use", 0),
+            mem.get("bytes_in_use", 0),
+        )
+        self._hbm_limit = mem.get("bytes_limit", self._hbm_limit)
+        self.rows_written += 1
+        if self._f is not None:
+            try:
+                self._f.write(json.dumps({**self.ident, **row}) + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+                self.path = None
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                self.path = None
+            finally:
+                self._f = None
+
+    # ----------------------------------------------------------- summary
+
+    def summary(self) -> dict:
+        """The ``sim.perf`` journal block. ``execute.wall_secs`` is the
+        sum of per-chunk dispatch walls (what the jsonl rows must sum
+        to); ``steady_*`` excludes the ``warmup`` leading dispatches,
+        which carry trace + compile (or the persistent-cache read) and,
+        on a mesh, the sharding fixed-point retrace."""
+        out: dict[str, Any] = {
+            "instances": self.instances,
+            "chunk": self.chunk,
+        }
+        if self._compile:
+            out["compile"] = dict(self._compile)
+        if self._chunk_walls:
+            wall = sum(self._chunk_walls)
+            ex: dict[str, Any] = {
+                "chunks": len(self._chunk_walls),
+                "ticks": self._ticks,
+                "wall_secs": round(wall, 6),
+                "ticks_per_sec": round(self._ticks / wall, 3),
+                "peer_ticks_per_sec": round(
+                    self.instances * self._ticks / wall, 3
+                ),
+            }
+            steady = self._chunk_walls[self.warmup :]
+            if steady:
+                s_wall = sum(steady)
+                s_ticks = len(steady) * self.chunk
+                ex["steady_chunks"] = len(steady)
+                ex["steady_wall_secs"] = round(s_wall, 6)
+                ex["steady_ticks_per_sec"] = round(s_ticks / s_wall, 3)
+                ex["steady_peer_ticks_per_sec"] = round(
+                    self.instances * s_ticks / s_wall, 3
+                )
+                flops = self._compile.get("flops")
+                if flops:
+                    ex["est_flops_per_sec"] = round(
+                        flops * len(steady) / s_wall, 3
+                    )
+                bytes_acc = self._compile.get("bytes_accessed")
+                if bytes_acc:
+                    ex["est_bytes_per_sec"] = round(
+                        bytes_acc * len(steady) / s_wall, 3
+                    )
+            out["execute"] = ex
+        if self._hbm_peak:
+            hbm = {"peak_bytes": self._hbm_peak}
+            if self._hbm_limit:
+                hbm["bytes_limit"] = self._hbm_limit
+            out["hbm"] = hbm
+        series: dict[str, Any] = {"rows": self.rows_written}
+        if self.path is not None:
+            series["file"] = PERF_FILE
+        out["series"] = series
+        return out
+
+
+# --------------------------------------------------------------- compare
+
+
+def _extract_metrics(obj: dict) -> dict:
+    """Pull the comparable numbers out of any ledger-bearing shape:
+
+    - a ``tg perf --json`` payload (``{"perf": {...}, "sim": {...}}``)
+    - a journal ``sim`` block (``{"perf": {...}, "wall_secs": ...}``)
+    - a bare ledger block (``{"compile": ..., "execute": ...}``)
+    - a ``bench.py`` / BENCH_rNN.json line
+      (``{"metric": "sim_peer_ticks_per_sec", "value": ..., "perf": ...}``)
+    - the bench-trajectory wrapper the driver records (``{"tail":
+      "<log>\\n{bench json line}"}``) — the embedded line is unwrapped
+
+    Returns ``{peer_ticks_per_sec?, compile_secs?, lower_secs?,
+    xla_compile_secs?, wall_secs?, ticks?}`` — only what the shape holds.
+    """
+    out: dict[str, float] = {}
+    if not isinstance(obj, dict):
+        return out
+    if (
+        isinstance(obj.get("tail"), str)
+        and "metric" not in obj
+        and "perf" not in obj
+        and "sim" not in obj
+    ):
+        for line in reversed(obj["tail"].splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                return _extract_metrics(json.loads(line))
+            except ValueError:
+                continue
+        return out
+    perf = obj
+    if isinstance(obj.get("perf"), dict):
+        perf = obj["perf"]
+    elif isinstance(obj.get("sim"), dict):
+        perf = obj["sim"].get("perf", {})
+    sim = obj.get("sim") if isinstance(obj.get("sim"), dict) else obj
+    # the module-level finite coercion — json.loads admits NaN/Infinity
+    # literals, and a hand-edited baseline must not print 'xnan' ratios
+    ex = perf.get("execute") if isinstance(perf.get("execute"), dict) else {}
+    co = perf.get("compile") if isinstance(perf.get("compile"), dict) else {}
+    for key, src in (
+        ("peer_ticks_per_sec", ex.get("steady_peer_ticks_per_sec")),
+        ("peer_ticks_per_sec", ex.get("peer_ticks_per_sec")),
+        ("wall_secs", ex.get("wall_secs")),
+        ("ticks", ex.get("ticks")),
+        ("lower_secs", co.get("lower_secs")),
+        ("xla_compile_secs", co.get("compile_secs")),
+    ):
+        v = num(src)
+        if v is not None and key not in out:
+            out[key] = v
+    # bench.py headline line (BENCH_rNN.json)
+    if obj.get("metric") == "sim_peer_ticks_per_sec":
+        v = num(obj.get("value"))
+        if v is not None:
+            out.setdefault("peer_ticks_per_sec", v)
+        v = num(obj.get("compile_secs"))
+        if v is not None:
+            out.setdefault("compile_secs", v)
+    # journal sim block fields
+    if isinstance(sim, dict):
+        for key, name in (("wall_secs", "wall_secs"), ("ticks", "ticks")):
+            v = num(sim.get(key))
+            if v is not None:
+                out.setdefault(name, v)
+        v = num(sim.get("compile_secs"))
+        if v is not None:
+            out.setdefault("compile_secs", v)
+    return out
+
+
+def perf_compare(current: dict, baseline: dict, label: str = "baseline") -> list[str]:
+    """Human-readable throughput deltas between two ledger-bearing
+    dicts — the ``tg perf --compare`` body. Returns one line per
+    comparable metric; a single explanatory line when nothing overlaps
+    (never raises on shape mismatches — review-time tooling must not
+    crash on a hand-edited baseline)."""
+    cur, base = _extract_metrics(current), _extract_metrics(baseline)
+    lines: list[str] = []
+    c, b = cur.get("peer_ticks_per_sec"), base.get("peer_ticks_per_sec")
+    if c and b:
+        lines.append(
+            f"peer·ticks/s  {fmt_rate(c)} vs {fmt_rate(b)} {label} "
+            f"(x{c / b:.3f})"
+        )
+    c, b = cur.get("compile_secs"), base.get("compile_secs")
+    if c is None:
+        c = (cur.get("lower_secs") or 0) + (cur.get("xla_compile_secs") or 0) or None
+    if b is None:
+        b = (base.get("lower_secs") or 0) + (base.get("xla_compile_secs") or 0) or None
+    if c and b:
+        lines.append(f"compile       {c:.2f}s vs {b:.2f}s {label} (x{c / b:.3f})")
+    c, b = cur.get("wall_secs"), base.get("wall_secs")
+    if c and b:
+        lines.append(f"wall          {c:.2f}s vs {b:.2f}s {label} (x{c / b:.3f})")
+    if not lines:
+        lines.append(
+            f"no comparable throughput fields between this task and {label} "
+            "(expected a perf ledger, a journal sim block, or a bench.py "
+            "JSON line)"
+        )
+    return lines

@@ -100,10 +100,14 @@ class SimJaxRunner(Runner, HealthcheckedRunner, Terminatable):
         def device_memory():
             import jax
 
+            from .perf import device_memory_stats
+
             devs = jax.devices()
             if not devs:
                 return False, "no devices"
-            stats = getattr(devs[0], "memory_stats", lambda: None)() or {}
+            # the shared never-raising probe (sim/perf.py) — one place
+            # normalizes backend-dependent memory_stats key presence
+            stats = device_memory_stats(devs[0])
             limit = stats.get("bytes_limit")
             in_use = stats.get("bytes_in_use")
             if not limit or in_use is None:

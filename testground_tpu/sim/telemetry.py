@@ -29,6 +29,7 @@ from typing import Any, Iterable
 __all__ = [
     "LATENCY_BINS",
     "LATENCY_FILE",
+    "PERF_FILE",
     "SIM_SERIES_FILE",
     "SPAN_FILE",
     "TELEMETRY_FIXED_COLUMNS",
@@ -47,6 +48,10 @@ SPAN_FILE = "run_spans.jsonl"
 # tick/group_id/name + count/mean/min/max) — the ``sim.latency.*``
 # measurement family the dashboard and the Influx mirror consume.
 LATENCY_FILE = "sim_latency.jsonl"
+# Per-chunk performance-ledger rows (sim/perf.py: dispatch wall, ticks/s,
+# peer·ticks/s, achieved FLOP/s and bytes/s, device bytes-in-use) — the
+# ``sim.perf.*`` measurement family.
+PERF_FILE = "sim_perf.jsonl"
 
 # Delivery-latency histogram schema, shared by the device accumulator
 # (``sim/net.py::latency_histogram``) and every host-side consumer. Bins

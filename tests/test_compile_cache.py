@@ -125,10 +125,10 @@ class TestPersistentCacheAcrossProcesses:
         """Two FRESH processes run the identical composition; the second
         must add zero cache entries AND observe persistent-cache hits
         (jax's /jax/compilation_cache/cache_hits monitoring event) where
-        the cold run observed none — the cross-process claim pinned by
-        the cache's own accounting rather than wall-clock ratios, which
-        are noise-dominated for this sub-second program on a loaded CI
-        container."""
+        the cold run observed at most its own AOT-pass self-hit — the
+        cross-process claim pinned by the cache's own accounting rather
+        than wall-clock ratios, which are noise-dominated for this
+        sub-second program on a loaded CI container."""
         cache = os.path.join(str(tg_home), "data", "compile-cache")
         artifact = os.path.join(PLANS, "network")
 
@@ -151,9 +151,17 @@ class TestPersistentCacheAcrossProcesses:
         assert r1["outcome"] == "success"
         entries_after_cold = cache_entries(cache)
         assert entries_after_cold, "cold run wrote no cache entries"
-        assert r1["cache_hits"] == 0, (
+        # the perf ledger's AOT accounting pass compiles the chunk
+        # program out-of-line BEFORE the first dispatch, by design
+        # landing it in the persistent cache so the dispatch reads the
+        # entry this same process just wrote (sim/perf.py). Whether
+        # that read surfaces as a cache_hits event depends on jax's
+        # in-memory executable dedup — so a cold run observes 0 or 1
+        # self-hits, never a hit it didn't itself write.
+        assert r1["cache_hits"] <= 1, (
             f"cold run against an empty cache reported "
-            f"{r1['cache_hits']} cache hit(s)"
+            f"{r1['cache_hits']} cache hit(s) — more than the AOT "
+            "accounting pass's single self-written entry can explain"
         )
 
         r2 = run("warm")

@@ -1,0 +1,274 @@
+"""``runners/pretty.py`` table rendering under hostile payloads
+(satellite of the perf-ledger PR): the stats and perf tables are fed
+decoded JSON from the daemon or hand-rolled clients, so missing, zero,
+None and NaN fields must degrade to readable placeholders — never a
+TypeError, never a misleading blank."""
+
+import math
+
+from testground_tpu.runners.pretty import (
+    render_perf_summary,
+    render_telemetry_summary,
+)
+
+NAN = float("nan")
+
+
+class TestTelemetrySummaryRobustness:
+    def test_empty_payload(self):
+        out = render_telemetry_summary({})
+        assert "no telemetry recorded" in out
+
+    def test_missing_sim_fields_render_placeholders(self):
+        out = render_telemetry_summary(
+            {"plan": "p", "case": "c", "sim": {"msgs_delivered": 1}}
+        )
+        # absent wall/compile render as '?', not as fake zeros or a crash
+        assert "?s (compile ?s)" in out
+        assert "delivered=1" in out
+
+    def test_none_and_nan_fields(self):
+        out = render_telemetry_summary(
+            {
+                "plan": "p",
+                "case": "c",
+                "sim": {
+                    "ticks": None,
+                    "tick_ms": NAN,
+                    "wall_secs": None,
+                    "compile_secs": NAN,
+                    "devices": None,
+                    "carry_bytes": NAN,
+                    "msgs_delivered": 2,
+                },
+            }
+        )
+        assert "nan" not in out.lower()
+        assert "?" in out
+        # a NaN carry must drop the line, not print a bogus size
+        assert "device-resident" not in out
+
+    def test_zero_values_still_render(self):
+        out = render_telemetry_summary(
+            {
+                "plan": "p",
+                "case": "c",
+                "sim": {
+                    "ticks": 0,
+                    "tick_ms": 1.0,
+                    "wall_secs": 0.0,
+                    "compile_secs": 0.0,
+                    "msgs_delivered": 0,
+                },
+            }
+        )
+        assert "0 (0.00 sim-s at 1 ms/tick)" in out
+        assert "delivered=0" in out
+
+    def test_latency_with_nan_count(self):
+        out = render_telemetry_summary(
+            {
+                "plan": "p",
+                "case": "c",
+                "sim": {
+                    "ticks": 1,
+                    "tick_ms": 1.0,
+                    "latency": {"g0": {"count": NAN}},
+                },
+            }
+        )
+        assert "latency g0" in out and "no deliveries" in out
+
+    def test_perf_teaser_line(self):
+        out = render_telemetry_summary(
+            {
+                "plan": "p",
+                "case": "c",
+                "sim": {
+                    "ticks": 8,
+                    "tick_ms": 1.0,
+                    "perf": {
+                        "execute": {"steady_peer_ticks_per_sec": 1234.0}
+                    },
+                },
+            }
+        )
+        assert "1,234 peer·ticks/s" in out
+
+    def test_perf_teaser_skipped_when_nan(self):
+        out = render_telemetry_summary(
+            {
+                "plan": "p",
+                "case": "c",
+                "sim": {
+                    "ticks": 8,
+                    "tick_ms": 1.0,
+                    "perf": {"execute": {"peer_ticks_per_sec": NAN}},
+                },
+            }
+        )
+        assert "peer·ticks/s" not in out
+
+
+class TestPerfSummaryRobustness:
+    FULL = {
+        "task_id": "t1",
+        "plan": "network",
+        "case": "ping-pong",
+        "outcome": "success",
+        "sim": {"compile_secs": 1.2, "carry_bytes": 4096},
+        "perf": {
+            "instances": 2,
+            "chunk": 16,
+            "compile": {
+                "lower_secs": 0.4,
+                "compile_secs": 0.7,
+                "flops": 4872.0,
+                "bytes_accessed": 69231.0,
+                "argument_bytes": 12568,
+                "temp_bytes": 15584,
+                "generated_code_bytes": 0,
+                "peak_bytes": 28152,
+            },
+            "execute": {
+                "chunks": 14,
+                "ticks": 224,
+                "wall_secs": 0.14,
+                "ticks_per_sec": 1580.0,
+                "peer_ticks_per_sec": 3161.0,
+                "steady_chunks": 13,
+                "steady_ticks_per_sec": 13450.0,
+                "steady_peer_ticks_per_sec": 26901.0,
+                "est_flops_per_sec": 4.1e6,
+            },
+            "hbm": {"peak_bytes": 3 << 30, "bytes_limit": 16 << 30},
+            "series": {"rows": 14, "file": "sim_perf.jsonl"},
+        },
+        "task": {"queued_secs": 0.2, "runner_wall_secs": {"r1": 1.4}},
+    }
+
+    def test_full_payload_prints_every_section(self):
+        out = render_perf_summary(self.FULL)
+        for fragment in (
+            "AOT lower 0.40s + xla 0.70s",  # compile split
+            "peer·ticks/s",  # throughput
+            "26.90k",  # steady rate
+            "flops",  # cost analysis
+            "high-water 3.00 GiB of 16.00 GiB",  # HBM mark
+            "queued 0.20s",  # supervisor timings
+            "sim_perf.jsonl",  # series pointer
+        ):
+            assert fragment in out, fragment
+
+    def test_empty_payload(self):
+        out = render_perf_summary({"plan": "p", "case": "c"})
+        assert "no performance ledger recorded" in out
+
+    def test_ledgerless_payload_still_renders_scheduler_timings(self):
+        # a multi-run composition journals per-run results (no top-level
+        # sim/perf), but the supervisor's queue/runner walls are present
+        # and must not be swallowed by the no-ledger message
+        out = render_perf_summary(
+            {
+                "plan": "p",
+                "case": "c",
+                "task": {
+                    "queued_secs": 0.25,
+                    "runner_wall_secs": {"r1": 3.5, "r2": 4.5},
+                },
+            }
+        )
+        assert "no performance ledger recorded" in out
+        assert "multi-run composition" in out
+        assert "queued 0.25s" in out
+        assert "run r1 3.50s" in out and "run r2 4.50s" in out
+
+    def test_large_counts_render_verbatim_not_scientific(self):
+        # '{:g}' would print 12345678 as '1.23457e+07' — tick totals
+        # reach 1e6+ routinely, so counts must render losslessly
+        out = render_perf_summary(
+            {
+                "plan": "p",
+                "case": "c",
+                "sim": {"compile_secs": 1.0},
+                "perf": {
+                    "instances": 100000,
+                    "execute": {
+                        "ticks": 12345678,
+                        "wall_secs": 10.0,
+                        "chunks": 1234567,
+                    },
+                },
+            }
+        )
+        assert "12345678 ticks" in out
+        assert "1234567 chunk(s)" in out
+        assert "100000 instance(s)" in out
+        assert "e+" not in out
+        tele = render_telemetry_summary(
+            {"plan": "p", "case": "c", "sim": {"ticks": 12345678, "tick_ms": 1.0}}
+        )
+        assert "12345678" in tele and "e+" not in tele
+
+    def test_missing_hbm_says_so(self):
+        payload = {
+            "plan": "p",
+            "case": "c",
+            "sim": {"compile_secs": 1.0},
+            "perf": {"execute": {"ticks": 8, "wall_secs": 1.0}},
+        }
+        out = render_perf_summary(payload)
+        assert "no memory stats on this backend" in out
+
+    def test_none_nan_and_zero_fields(self):
+        payload = {
+            "task_id": "x",
+            "plan": "p",
+            "case": "c",
+            "sim": {"compile_secs": None, "carry_bytes": NAN},
+            "perf": {
+                "instances": None,
+                "compile": {"lower_secs": NAN, "compile_secs": None},
+                "execute": {
+                    "ticks": NAN,
+                    "wall_secs": 0,
+                    "chunks": None,
+                    "ticks_per_sec": math.inf,
+                    "peer_ticks_per_sec": None,
+                },
+                "hbm": {"peak_bytes": NAN},
+                "series": {"rows": 0},
+            },
+            "task": {"queued_secs": NAN, "runner_wall_secs": {"r1": None}},
+        }
+        out = render_perf_summary(payload)
+        assert "nan" not in out.lower()
+        assert "inf" not in out.lower()
+        assert "?" in out
+        # NaN HBM degrades to the unavailable line, zero rows drop series
+        assert "no memory stats on this backend" in out
+        assert "sim_perf.jsonl" not in out
+
+    def test_absent_cost_analysis_drops_cost_line(self):
+        payload = {
+            "plan": "p",
+            "case": "c",
+            "sim": {"compile_secs": 1.0},
+            "perf": {
+                "instances": 2,
+                "compile": {"lower_secs": 0.1, "compile_secs": 0.2},
+                "execute": {
+                    "chunks": 2,
+                    "ticks": 16,
+                    "wall_secs": 0.1,
+                    "ticks_per_sec": 160.0,
+                    "peer_ticks_per_sec": 320.0,
+                },
+            },
+        }
+        out = render_perf_summary(payload)
+        assert "cost" not in out.splitlines()[0]
+        assert not any(
+            line.startswith("cost") for line in out.splitlines()
+        )
+        assert "AOT lower 0.10s + xla 0.20s" in out

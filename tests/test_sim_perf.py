@@ -1,0 +1,797 @@
+"""Run performance ledger (docs/OBSERVABILITY.md "Performance ledger"):
+compile/memory/FLOP accounting, throughput gauges, the ``tg perf``
+surface, and the Prometheus ``GET /metrics`` exposition.
+
+Pins the acceptance contract: with the ledger active the compiled tick
+program is bit-identical (jaxpr equality — the ledger is host-side
+bookkeeping, not a program-shaping option) and no host syncs are added
+beyond the per-chunk done poll; ``GET /metrics`` serves valid Prometheus
+text exposition for a finished task; ``tg perf`` prints the
+compile/execute split, peer·ticks/s, HBM high-water mark, and
+cost-analysis estimates.
+"""
+
+import json
+import math
+import os
+import time
+import urllib.request
+
+import pytest
+
+from testground_tpu.api import RunGroup
+from testground_tpu.config import EnvConfig
+from testground_tpu.sim import engine as engine_mod
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import load_sim_testcases
+from testground_tpu.sim.perf import (
+    PERF_FILE,
+    PerfLedger,
+    compile_analysis,
+    device_memory_stats,
+    perf_compare,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def make_groups(*counts, params=None):
+    return build_groups(
+        [
+            RunGroup(id=f"g{i}", instances=c, parameters=dict(params or {}))
+            for i, c in enumerate(counts)
+        ]
+    )
+
+
+def plan_case(plan, case):
+    return load_sim_testcases(os.path.join(PLANS, plan))[case]()
+
+
+def pingpong_prog(chunk=16, n=4):
+    return SimProgram(
+        plan_case("network", "ping-pong"), make_groups(n), chunk=chunk
+    )
+
+
+# ------------------------------------------------------ zero overhead
+
+
+class TestZeroOverheadContract:
+    def test_ledger_is_not_program_shaping(self):
+        """The acceptance pin: the ledger attaches at run time, never at
+        program construction — two identically-configured programs trace
+        the identical chunk jaxpr whether or not a ledger will observe
+        them (there is no perf knob on SimProgram to diverge on)."""
+        import jax
+
+        a, b = pingpong_prog(), pingpong_prog()
+        carry = jax.eval_shape(lambda: a.init_carry(0))
+        assert str(jax.make_jaxpr(a._chunk_step)(carry)) == str(
+            jax.make_jaxpr(b._chunk_step)(carry)
+        )
+
+    def test_ledger_adds_no_host_syncs_and_identical_results(
+        self, monkeypatch, tmp_path
+    ):
+        """One done poll per chunk, ledger or not — the per-chunk gauges
+        ride the host clock and the AOT pass never executes. The run's
+        results are bit-identical either way."""
+        calls = {"n": 0}
+        real = engine_mod._poll_done
+
+        def counting(done):
+            calls["n"] += 1
+            return real(done)
+
+        monkeypatch.setattr(engine_mod, "_poll_done", counting)
+
+        def run(ledger):
+            calls["n"] = 0
+            res = pingpong_prog().run(max_ticks=256, perf=ledger)
+            return calls["n"], res
+
+        ledger = PerfLedger(
+            4, 16, path=str(tmp_path / PERF_FILE), aot=True
+        )
+        syncs_off, res_off = run(None)
+        syncs_on, res_on = run(ledger)
+        ledger.close()
+        assert syncs_on == syncs_off
+        assert res_on["ticks"] == res_off["ticks"]
+        assert (res_on["status"] == res_off["status"]).all()
+        assert res_on["msgs_delivered"] == res_off["msgs_delivered"]
+        # ...while the ledger saw every chunk and the AOT split
+        assert ledger.rows_written == res_on["ticks"] // 16
+        assert ledger.summary()["compile"]["lower_secs"] >= 0
+
+
+# ---------------------------------------------------------- the ledger
+
+
+class TestPerfLedger:
+    def test_rows_and_summary_conserve(self, tmp_path):
+        path = str(tmp_path / PERF_FILE)
+        ledger = PerfLedger(10, 8, ident={"run": "r"}, path=path, aot=False)
+        assert not ledger.wants_aot
+        for i in range(4):
+            ledger.on_chunk(i, (i + 1) * 8, 8, 0.25)
+        ledger.close()
+        rows = [json.loads(line) for line in open(path)]
+        assert len(rows) == 4 == ledger.rows_written
+        for i, row in enumerate(rows):
+            assert row["run"] == "r"
+            assert row["chunk"] == i
+            assert row["tick"] == (i + 1) * 8
+            assert row["ticks_per_sec"] == pytest.approx(32.0)
+            assert row["peer_ticks_per_sec"] == pytest.approx(320.0)
+        s = ledger.summary()
+        ex = s["execute"]
+        assert ex["chunks"] == 4 and ex["ticks"] == 32
+        assert ex["wall_secs"] == pytest.approx(
+            sum(r["wall_secs"] for r in rows)
+        )
+        assert ex["peer_ticks_per_sec"] == pytest.approx(320.0)
+        # steady excludes the (compile-bearing) first chunk
+        assert ex["steady_chunks"] == 3
+        assert ex["steady_peer_ticks_per_sec"] == pytest.approx(320.0)
+        assert s["series"] == {"rows": 4, "file": PERF_FILE}
+
+    def test_no_path_only_counts(self):
+        ledger = PerfLedger(2, 4, path=None, aot=False)
+        ledger.on_chunk(0, 4, 4, 0.1)
+        ledger.close()
+        assert ledger.rows_written == 1
+        assert "file" not in ledger.summary()["series"]
+
+    def test_warmup_2_excludes_the_mesh_retrace_dispatch(self):
+        # on a multi-device mesh the SECOND dispatch retraces at the
+        # GSPMD sharding fixed point (engine.run) — with warmup=2 its
+        # wall must not pollute steady throughput
+        ledger = PerfLedger(10, 8, aot=False, warmup=2)
+        ledger.on_chunk(0, 8, 8, 5.0)  # trace + compile
+        ledger.on_chunk(1, 16, 8, 3.0)  # sharding fixed-point retrace
+        for i in range(2, 6):
+            ledger.on_chunk(i, (i + 1) * 8, 8, 0.25)
+        ex = ledger.summary()["execute"]
+        assert ex["chunks"] == 6 and ex["steady_chunks"] == 4
+        assert ex["steady_peer_ticks_per_sec"] == pytest.approx(320.0)
+
+    def test_aot_harvest_on_cpu(self):
+        """The AOT pass's harvest: on the CPU backend XLA provides a
+        cost analysis (flops, bytes accessed) and a memory analysis
+        (argument/temp/output bytes) for the chunk program."""
+        import jax
+
+        prog = pingpong_prog(chunk=8, n=2)
+        carry = jax.jit(lambda: prog.init_carry(0))()
+        compiled = prog.compiled_chunk().lower(carry).compile()
+        got = compile_analysis(compiled)
+        assert got.get("flops", 0) > 0
+        assert got.get("bytes_accessed", 0) > 0
+        assert got.get("argument_bytes", 0) > 0
+        assert got["peak_bytes"] >= got.get("temp_bytes", 0)
+
+    def test_rows_carry_flop_rates_after_on_compile(self, tmp_path):
+        class FakeCompiled:
+            def cost_analysis(self):
+                return {"flops": 1000.0, "bytes accessed": 4000.0}
+
+            def memory_analysis(self):
+                return None
+
+        ledger = PerfLedger(2, 4, path=None, aot=True)
+        ledger.on_compile(0.5, 1.5, FakeCompiled())
+        ledger.on_chunk(0, 4, 4, 0.5)
+        s = ledger.summary()
+        assert s["compile"] == {
+            "lower_secs": 0.5,
+            "compile_secs": 1.5,
+            "flops": 1000.0,
+            "bytes_accessed": 4000.0,
+        }
+
+
+class TestDeviceMemoryStats:
+    """The ONE memory_stats probe (satellite: deduped from the runner
+    healthcheck and the executor precheck) — normalizes key presence
+    and never raises."""
+
+    def test_normalizes_and_filters_keys(self):
+        class Dev:
+            def memory_stats(self):
+                return {
+                    "bytes_in_use": 10,
+                    "peak_bytes_in_use": 20,
+                    "bytes_limit": 100,
+                    "largest_free_block_bytes": 5,  # dropped
+                    "pool_bytes": "n/a",  # non-numeric dropped
+                }
+
+        assert device_memory_stats(Dev()) == {
+            "bytes_in_use": 10,
+            "peak_bytes_in_use": 20,
+            "bytes_limit": 100,
+        }
+
+    def test_missing_keys_and_absent_stats(self):
+        class Partial:
+            def memory_stats(self):
+                return {"bytes_in_use": 7}
+
+        class NoneStats:
+            def memory_stats(self):
+                return None
+
+        class Raises:
+            def memory_stats(self):
+                raise RuntimeError("backend says no")
+
+        class NoMethod:
+            pass
+
+        assert device_memory_stats(Partial()) == {"bytes_in_use": 7}
+        assert device_memory_stats(NoneStats()) == {}
+        assert device_memory_stats(Raises()) == {}
+        assert device_memory_stats(NoMethod()) == {}
+
+    def test_healthcheck_and_precheck_share_the_probe(self):
+        """No clone survives: the runner healthcheck and executor
+        precheck modules reference the shared helper, not their own
+        memory_stats probing."""
+        import inspect
+
+        from testground_tpu.sim import executor, runner
+
+        assert "device_memory_stats" in inspect.getsource(
+            executor._precheck_device_memory
+        )
+        assert "device_memory_stats" in inspect.getsource(
+            runner.SimJaxRunner.healthcheck
+        )
+
+
+# ------------------------------------------------------------- compare
+
+
+class TestPerfCompare:
+    PAYLOAD = {
+        "sim": {"compile_secs": 2.0, "wall_secs": 10.0},
+        "perf": {
+            "execute": {
+                "steady_peer_ticks_per_sec": 1000.0,
+                "wall_secs": 8.0,
+            }
+        },
+    }
+
+    def test_against_bench_line(self):
+        bench = {
+            "metric": "sim_peer_ticks_per_sec",
+            "value": 2000.0,
+            "compile_secs": 4.0,
+        }
+        lines = perf_compare(self.PAYLOAD, bench, label="B")
+        assert any("x0.500" in ln for ln in lines)  # both ratios halve
+        assert sum("x0.500" in ln for ln in lines) == 2
+
+    def test_against_bench_trajectory_wrapper(self):
+        wrapper = {
+            "n": 5,
+            "tail": 'noise\n# log\n{"metric": "sim_peer_ticks_per_sec", '
+            '"value": 500.0}',
+        }
+        lines = perf_compare(self.PAYLOAD, wrapper)
+        assert any("x2.000" in ln for ln in lines)
+
+    def test_against_prior_perf_payload(self):
+        lines = perf_compare(self.PAYLOAD, self.PAYLOAD)
+        assert any("x1.000" in ln for ln in lines)
+
+    def test_nothing_comparable_degrades_readably(self):
+        lines = perf_compare({"sim": {}}, {"whatever": 1})
+        assert len(lines) == 1 and "no comparable" in lines[0]
+
+    def test_nan_baseline_fields_are_ignored(self):
+        # json.loads admits NaN/Infinity literals — a corrupted baseline
+        # must drop those fields, not print 'xnan' ratios
+        baseline = json.loads(
+            '{"metric": "sim_peer_ticks_per_sec", "value": NaN, '
+            '"compile_secs": Infinity}'
+        )
+        lines = perf_compare(self.PAYLOAD, baseline, label="B")
+        assert len(lines) == 1 and "no comparable" in lines[0]
+
+
+# ---------------------------------------------------------- prometheus
+
+
+class TestPrometheusRender:
+    def _task(self, **kw):
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+
+        t = Task(
+            id=kw.get("id", "t1"),
+            type=TaskType.RUN,
+            plan=kw.get("plan", "network"),
+            case=kw.get("case", "ping-pong"),
+            states=[
+                DatedState(state=State.SCHEDULED, created=1.0),
+                DatedState(state=State.COMPLETE, created=2.0),
+            ],
+            result=kw.get("result"),
+        )
+        return t
+
+    def test_valid_exposition_for_a_finished_task(self):
+        import re
+
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        result = {
+            "outcome": "success",
+            "perf": {"queued_secs": 0.25, "runner_wall_secs": {"r1": 3.5}},
+            "journal": {
+                "sim": {
+                    "ticks": 224,
+                    "wall_secs": 1.5,
+                    "compile_secs": 1.2,
+                    "devices": 1,
+                    "carry_bytes": 4096,
+                    "msgs_sent": 10,
+                    "msgs_delivered": 8,
+                    "msgs_dropped": 1,
+                    "msgs_rejected": 1,
+                    "msgs_in_flight": 0,
+                    "msgs_fault_dropped": 0,
+                    "perf": {
+                        "compile": {
+                            "lower_secs": 0.4,
+                            "compile_secs": 0.7,
+                            "flops": 4872.0,
+                            "bytes_accessed": 69231.0,
+                        },
+                        "execute": {"steady_peer_ticks_per_sec": 26901.0},
+                        "hbm": {"peak_bytes": 1 << 30},
+                    },
+                }
+            },
+        }
+        text = render_prometheus([self._task(result=result)])
+        # every non-comment line must match the exposition grammar
+        line_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+            r"-?[0-9.e+-]+(\.[0-9]+)?$"
+        )
+        families = set()
+        for line in text.strip().splitlines():
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                continue
+            assert line_re.match(line), line
+            families.add(line.split("{")[0])
+        for family in (
+            "tg_tasks",
+            "tg_task_queued_seconds",
+            "tg_task_runner_wall_seconds",
+            "tg_run_msgs_total",
+            "tg_run_ticks",
+            "tg_run_compile_seconds",
+            "tg_run_peer_ticks_per_second",
+            "tg_run_lower_seconds",
+            "tg_run_xla_compile_seconds",
+            "tg_run_est_flops_per_chunk",
+            "tg_run_hbm_peak_bytes",
+        ):
+            assert family in families, family
+        # the flow label carries the conservation legs
+        assert 'flow="delivered"' in text and 'flow="sent"' in text
+        # each family declares HELP + TYPE exactly once
+        assert text.count("# TYPE tg_run_msgs_total") == 1
+
+    def test_escapes_labels_and_skips_nan(self):
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        result = {
+            "journal": {
+                "sim": {"ticks": float("nan"), "wall_secs": 1.0}
+            }
+        }
+        t = self._task(id='we"ird\\id', plan="a\nb", result=result)
+        text = render_prometheus([t])
+        assert 'task="we\\"ird\\\\id"' in text
+        assert 'plan="a\\nb"' in text
+        assert "nan" not in text.lower().replace("instance", "")
+        assert "tg_run_ticks" not in text  # NaN metric dropped entirely
+        assert "tg_run_wall_seconds" in text
+
+    def test_empty_task_list(self):
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        assert render_prometheus([]).strip() == ""
+
+    def test_per_task_limit_bounds_series_not_counts(self):
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        result = {"perf": {"queued_secs": 0.5}}
+        tasks = [
+            self._task(id=f"t{i}", result=result) for i in range(5)
+        ]
+        text = render_prometheus(tasks, per_task_limit=2)
+        # the aggregate counts the FULL store (honest on busy daemons)...
+        assert 'tg_tasks{state="complete",type="run"} 5' in text
+        # ...while task-labeled series stop at the cardinality window
+        assert text.count("tg_task_queued_seconds{") == 2
+        assert 'task="t0"' in text and 'task="t4"' not in text
+
+
+# ------------------------------------------------- payload + artifacts
+
+
+class TestPerfPayload:
+    def test_task_perf_payload_shape(self):
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+
+        t = Task(
+            id="t1",
+            type=TaskType.RUN,
+            plan="p",
+            case="c",
+            states=[DatedState(state=State.COMPLETE, created=1.0)],
+            result={
+                "outcome": "success",
+                "perf": {"queued_secs": 0.1},
+                "journal": {
+                    "sim": {"ticks": 3, "perf": {"instances": 2}}
+                },
+            },
+        )
+        p = t.perf_payload()
+        assert p["task_id"] == "t1"
+        assert p["perf"] == {"instances": 2}
+        assert p["sim"] == {"ticks": 3}  # nested ledger lifted out
+        assert p["task"] == {"queued_secs": 0.1}
+
+    def test_perf_payload_tolerates_missing_everything(self):
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+
+        t = Task(
+            id="t2",
+            type=TaskType.BUILD,
+            states=[DatedState(state=State.COMPLETE, created=1.0)],
+        )
+        p = t.perf_payload()
+        assert p["perf"] == {} and p["sim"] == {} and p["task"] == {}
+
+
+class TestArtifactWhitelist:
+    def test_flat_and_nested_names(self):
+        from testground_tpu.daemon.server import _Handler
+
+        rel = _Handler._artifact_relpath
+        assert rel("sim_perf.jsonl") == "sim_perf.jsonl"
+        assert rel("sim_trace.jsonl") == "sim_trace.jsonl"
+        # nested SDK profile dumps: <group>/<instance>/profile-cpu.pstats
+        assert rel("single/0/profile-cpu.pstats") == os.path.join(
+            "single", "0", "profile-cpu.pstats"
+        )
+        # traversal and junk are refused
+        for bad in (
+            "../../etc/passwd",
+            "single/../../../profile-cpu.pstats",
+            "single/0/../profile-cpu.pstats",
+            "/etc/profile-cpu.pstats",
+            "single/0/other.pstats",
+            "a/b/c/d/e/profile-cpu.pstats",
+            "profile-cpu.pstats.evil",
+            "",
+        ):
+            assert rel(bad) is None, bad
+
+
+# -------------------------------------------------------------- viewer
+
+
+class TestViewerPerfFamily:
+    def test_expand_perf_row(self):
+        from testground_tpu.metrics.viewer import expand_perf_row
+
+        row = {
+            "run": "r",
+            "plan": "p",
+            "case": "c",
+            "tick": 16,
+            "chunk": 0,
+            "wall_secs": 0.1,
+            "peer_ticks_per_sec": 320.0,
+        }
+        out = {r["name"]: r for r in expand_perf_row(row)}
+        assert set(out) == {
+            "sim.perf.wall_secs",
+            "sim.perf.peer_ticks_per_sec",
+        }
+        assert out["sim.perf.peer_ticks_per_sec"]["mean"] == 320.0
+        assert out["sim.perf.wall_secs"]["group_id"] == "_run"
+        assert out["sim.perf.wall_secs"]["tick"] == 16
+
+    def test_viewer_surfaces_perf_measurements(self, tg_home):
+        from testground_tpu.metrics import Viewer, measurement_name
+
+        env = EnvConfig.load()
+        run_dir = os.path.join(env.dirs.outputs(), "p", "r1")
+        os.makedirs(run_dir)
+        with open(os.path.join(run_dir, PERF_FILE), "w") as f:
+            for i in range(3):
+                f.write(
+                    json.dumps(
+                        {
+                            "run": "r1",
+                            "plan": "p",
+                            "case": "c",
+                            "tick": (i + 1) * 8,
+                            "chunk": i,
+                            "wall_secs": 0.5,
+                            "ticks_per_sec": 16.0,
+                        }
+                    )
+                    + "\n"
+                )
+        v = Viewer(env)
+        names = v.get_measurements("p", "c")
+        assert measurement_name("p", "c", "sim.perf.ticks_per_sec") in names
+        rows = v.get_data("p", "c", "sim.perf.wall_secs", run_id="r1")
+        assert len(rows) == 3
+        assert rows[0].fields["mean"] == 0.5
+        # the chunk index is identity, not a measurement
+        assert measurement_name("p", "c", "sim.perf.chunk") not in names
+
+
+# --------------------------------------------------------- end-to-end
+
+
+@pytest.fixture(scope="class")
+def perf_daemon(tmp_path_factory):
+    # class-scoped (one sim run feeds every surface test below), so no
+    # function-scoped monkeypatch — save/restore the env var by hand
+    prev = os.environ.get("TESTGROUND_HOME")
+    os.environ["TESTGROUND_HOME"] = str(
+        tmp_path_factory.mktemp("tghome-perf")
+    )
+    from testground_tpu.daemon import Daemon
+
+    d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+    d.start()
+    yield d
+    d.stop()
+    if prev is None:
+        os.environ.pop("TESTGROUND_HOME", None)
+    else:
+        os.environ["TESTGROUND_HOME"] = prev
+
+
+@pytest.fixture(scope="class")
+def perf_task(perf_daemon):
+    from testground_tpu.client import Client
+
+    client = Client(perf_daemon.address)
+    client.import_plan(os.path.join(PLANS, "network"))
+    task_id = client.run(
+        {
+            "global": {
+                "plan": "network",
+                "case": "ping-pong",
+                "builder": "sim:plan",
+                "runner": "sim:jax",
+                "total_instances": 2,
+                "run_config": {"chunk": 16},
+            },
+            "groups": [{"id": "all", "instances": {"count": 2}}],
+        }
+    )
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        t = client.status(task_id)
+        if t["states"][-1]["state"] in ("complete", "canceled"):
+            assert t["outcome"] == "success"
+            return task_id
+        time.sleep(0.2)
+    raise TimeoutError(task_id)
+
+
+class TestPerfSurfaceE2E:
+    def test_perf_route_and_client(self, perf_daemon, perf_task):
+        from testground_tpu.client import Client
+
+        data = Client(perf_daemon.address).perf(perf_task)
+        assert data["task_id"] == perf_task
+        assert data["outcome"] == "success"
+        perf = data["perf"]
+        assert perf["execute"]["peer_ticks_per_sec"] > 0
+        assert perf["compile"]["lower_secs"] >= 0
+        assert perf["series"]["file"] == PERF_FILE
+        assert data["task"]["queued_secs"] >= 0
+        assert data["task"]["runner_wall_secs"]
+
+    def test_perf_route_404s_unknown_task(self, perf_daemon):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                perf_daemon.address + "/perf?task_id=ghost", timeout=30
+            )
+        assert ei.value.code == 404
+
+    def test_metrics_route_serves_prometheus(self, perf_daemon, perf_task):
+        resp = urllib.request.urlopen(
+            perf_daemon.address + "/metrics", timeout=30
+        )
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "version=0.0.4" in resp.headers["Content-Type"]
+        text = resp.read().decode()
+        assert f'task="{perf_task}"' in text
+        assert "tg_tasks{" in text
+        assert 'tg_run_msgs_total{' in text and 'flow="delivered"' in text
+        assert "tg_run_peer_ticks_per_second{" in text
+        assert "# TYPE tg_run_msgs_total counter" in text
+
+    def test_metrics_via_client(self, perf_daemon, perf_task):
+        from testground_tpu.client import Client
+
+        text = Client(perf_daemon.address).metrics()
+        assert "tg_tasks" in text
+
+    def test_cli_perf_renders_summary(self, perf_daemon, perf_task, capsys):
+        """``tg perf <task>`` against the daemon prints the
+        compile/execute split, peer·ticks/s, the HBM line, and the
+        cost-analysis estimates (the acceptance criterion's CLI half)."""
+        from testground_tpu.cli.main import main
+
+        rc = main(["--endpoint", perf_daemon.address, "perf", perf_task])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "compile" in out and "AOT lower" in out
+        assert "peer·ticks/s" in out
+        assert "hbm" in out  # present even when the backend has no stats
+        assert "flops" in out  # CPU cost analysis
+        assert "network:ping-pong" in out
+
+    def test_cli_perf_compare(
+        self, perf_daemon, perf_task, tmp_path, capsys
+    ):
+        from testground_tpu.cli.main import main
+
+        baseline = tmp_path / "BENCH_r99.json"
+        baseline.write_text(
+            json.dumps(
+                {
+                    "metric": "sim_peer_ticks_per_sec",
+                    "value": 1000.0,
+                    "compile_secs": 10.0,
+                }
+            )
+        )
+        rc = main(
+            [
+                "--endpoint",
+                perf_daemon.address,
+                "perf",
+                perf_task,
+                "--compare",
+                str(baseline),
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "vs BENCH_r99.json" in out
+        assert "peer·ticks/s" in out and " vs " in out
+
+    def test_cli_perf_json_round_trips(
+        self, perf_daemon, perf_task, capsys
+    ):
+        from testground_tpu.cli.main import main
+
+        rc = main(
+            ["--endpoint", perf_daemon.address, "perf", perf_task, "--json"]
+        )
+        assert rc == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["perf"]["execute"]["chunks"] > 0
+
+    def test_perf_artifact_served(self, perf_daemon, perf_task):
+        resp = urllib.request.urlopen(
+            perf_daemon.address
+            + f"/artifact?task_id={perf_task}&name=sim_perf.jsonl",
+            timeout=30,
+        )
+        rows = [
+            json.loads(line)
+            for line in resp.read().decode().splitlines()
+            if line.strip()
+        ]
+        assert rows and all("peer_ticks_per_sec" in r for r in rows)
+        wall = sum(r["wall_secs"] for r in rows)
+        assert wall > 0 and math.isfinite(wall)
+
+
+class TestPerfGating:
+    def test_disable_metrics_suppresses_ledger(self, tg_home):
+        import threading
+
+        from testground_tpu.api import RunInput
+        from testground_tpu.engine import Outcome
+        from testground_tpu.rpc import discard_writer
+        from testground_tpu.sim.executor import (
+            SimJaxConfig,
+            execute_sim_run,
+        )
+
+        env = EnvConfig.load()
+        job = RunInput(
+            run_id="noperf",
+            test_plan="placebo",
+            test_case="ok",
+            total_instances=2,
+            groups=[
+                RunGroup(
+                    id="all",
+                    instances=2,
+                    artifact_path=os.path.join(PLANS, "placebo"),
+                    parameters={},
+                )
+            ],
+            env=env,
+            disable_metrics=True,
+        )
+        job.runner_config = SimJaxConfig(chunk=8)
+        out = execute_sim_run(job, discard_writer(), threading.Event())
+        assert out.result.outcome == Outcome.SUCCESS
+        run_dir = os.path.join(env.dirs.outputs(), "placebo", "noperf")
+        assert not os.path.exists(os.path.join(run_dir, PERF_FILE))
+        assert "perf" not in out.result.journal["sim"]
+
+    def test_perf_false_suppresses_ledger(self, tg_home):
+        import threading
+
+        from testground_tpu.api import RunInput
+        from testground_tpu.engine import Outcome
+        from testground_tpu.rpc import discard_writer
+        from testground_tpu.sim.executor import (
+            SimJaxConfig,
+            execute_sim_run,
+        )
+
+        env = EnvConfig.load()
+        job = RunInput(
+            run_id="perfoff",
+            test_plan="placebo",
+            test_case="ok",
+            total_instances=2,
+            groups=[
+                RunGroup(
+                    id="all",
+                    instances=2,
+                    artifact_path=os.path.join(PLANS, "placebo"),
+                    parameters={},
+                )
+            ],
+            env=env,
+        )
+        job.runner_config = SimJaxConfig(chunk=8, perf=False)
+        out = execute_sim_run(job, discard_writer(), threading.Event())
+        assert out.result.outcome == Outcome.SUCCESS
+        run_dir = os.path.join(env.dirs.outputs(), "placebo", "perfoff")
+        assert not os.path.exists(os.path.join(run_dir, PERF_FILE))
+        assert "perf" not in out.result.journal["sim"]

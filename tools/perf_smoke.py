@@ -1,0 +1,151 @@
+"""``make perf-smoke``: run a tiny composition and assert the
+performance-ledger contract end-to-end (docs/OBSERVABILITY.md
+"Performance ledger") —
+
+- the journal carries a ``sim.perf`` block (compile split, execute
+  gauges, per-chunk series reference);
+- ``sim_perf.jsonl`` exists, every row is schema-valid, and the rows'
+  per-chunk walls sum exactly to the ledger's ``execute.wall_secs``
+  (which in turn must fit inside the run's wall);
+- chunk accounting conserves: row count == ``execute.chunks`` and the
+  last row's tick == ``execute.ticks`` == the dispatched tick count;
+- on CPU the AOT pass harvests XLA cost analysis, so the estimated
+  FLOPs / bytes-accessed fields are present and non-zero (tolerated
+  absent on backends that expose no estimate — reported, not failed).
+
+Exits non-zero with a readable message on any violation; prints a
+one-line summary on success. Self-contained: runs against a temporary
+$TESTGROUND_HOME on the CPU backend, so it is safe in CI.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def fail(msg: str) -> "None":
+    print(f"perf-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-smoke-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tests.test_sim_runner import run_sim
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome
+    from testground_tpu.sim.runner import SimJaxRunner
+    from testground_tpu.sim.telemetry import PERF_FILE
+
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.start_workers()
+    try:
+        task = run_sim(
+            engine,
+            "network",
+            "ping-pong",
+            instances=2,
+            run_params={"chunk": 16},
+        )
+    finally:
+        engine.stop()
+    if task.outcome() != Outcome.SUCCESS:
+        fail(f"run outcome {task.outcome().value}: {task.error}")
+
+    sim = task.result["journal"]["sim"]
+    perf = sim.get("perf")
+    if not perf:
+        fail("journal sim.perf block is absent")
+    ex = perf.get("execute") or {}
+    for key in ("chunks", "ticks", "wall_secs", "peer_ticks_per_sec"):
+        if not ex.get(key):
+            fail(f"sim.perf.execute.{key} missing or zero")
+    if ex["ticks"] != sim["ticks"]:
+        fail(f"execute.ticks {ex['ticks']} != journal ticks {sim['ticks']}")
+    co = perf.get("compile") or {}
+    if not co:
+        fail("sim.perf.compile block absent (AOT accounting did not run)")
+    for key in ("lower_secs", "compile_secs"):
+        if key not in co:
+            fail(f"sim.perf.compile.{key} missing")
+    cost_note = ""
+    if "flops" in co or "bytes_accessed" in co:
+        # where the backend estimates at all, the fields must be real
+        for key in ("flops", "bytes_accessed"):
+            if key in co and not co[key] > 0:
+                fail(f"sim.perf.compile.{key} present but not > 0")
+    else:
+        cost_note = " (no cost analysis on this backend)"
+
+    path = os.path.join(env.dirs.outputs(), "network", task.id, PERF_FILE)
+    if not os.path.isfile(path):
+        fail(f"{PERF_FILE} was not written ({path})")
+    rows = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                fail(f"line {i + 1} is not JSON: {e}")
+            for col in (
+                "run",
+                "plan",
+                "case",
+                "tick",
+                "chunk",
+                "wall_secs",
+                "ticks_per_sec",
+                "peer_ticks_per_sec",
+            ):
+                if col not in row:
+                    fail(f"line {i + 1} missing column {col!r}")
+            rows.append(row)
+    if not rows:
+        fail(f"{PERF_FILE} is empty")
+    if len(rows) != ex["chunks"]:
+        fail(f"{len(rows)} rows != execute.chunks {ex['chunks']}")
+    if rows[-1]["tick"] != ex["ticks"]:
+        fail(f"last row tick {rows[-1]['tick']} != execute.ticks")
+    wall_sum = sum(r["wall_secs"] for r in rows)
+    if abs(wall_sum - ex["wall_secs"]) > 1e-3 + 0.01 * ex["wall_secs"]:
+        fail(
+            f"Σ per-chunk wall {wall_sum:.6f}s !≈ execute.wall_secs "
+            f"{ex['wall_secs']:.6f}s"
+        )
+    if wall_sum > sim["wall_secs"]:
+        fail(
+            f"Σ per-chunk wall {wall_sum:.3f}s exceeds the run wall "
+            f"{sim['wall_secs']:.3f}s"
+        )
+
+    print(
+        f"perf-smoke: OK — {len(rows)} chunk rows, "
+        f"{ex['peer_ticks_per_sec']:.0f} peer·ticks/s, lower "
+        f"{co['lower_secs']:.2f}s + xla {co['compile_secs']:.2f}s"
+        f"{cost_note}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
