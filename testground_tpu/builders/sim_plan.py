@@ -114,6 +114,7 @@ class SimPlanBuilder(Builder, Precompiler):
             fault_specs_of,
             load_and_specialize,
             make_sim_program,
+            resolve_transport,
             trace_specs_of,
         )
         from testground_tpu.sim.faults import build_fault_schedule
@@ -144,6 +145,15 @@ class SimPlanBuilder(Builder, Precompiler):
             and not comp.global_.disable_metrics
             and not getattr(cfg, "coordinator_address", "")
         )
+        # transport gate mirrors the executor (resolve_transport is the
+        # shared gate): a mesh forces xla, so the build must precompile
+        # the variant the run will actually trace. A cohort resolves
+        # against the GLOBAL mesh at run time (always multi-device), so
+        # coordinator_address forces xla here too — like the telemetry
+        # gate above, or the build warms a program the run never traces
+        transport = resolve_transport(cfg, _make_mesh(cfg.shard))
+        if getattr(cfg, "coordinator_address", ""):
+            transport = "xla"
         digests = {
             path: _source_digest(path) for path in set(artifacts.values())
         }
@@ -204,6 +214,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 "shard": cfg.shard,
                 "validate": bool(getattr(cfg, "validate", False)),
                 "telemetry": telemetry,
+                "transport": transport,
                 "faults": run_fault_specs,
                 "trace": run_trace_specs,
                 "hosts": list(hosts),
@@ -264,6 +275,7 @@ class SimPlanBuilder(Builder, Precompiler):
                     groups, run_fault_specs, cfg.tick_ms
                 ),
                 trace=build_trace_plan(groups, run_trace_specs),
+                transport=transport,
             )
             # same capacity precheck as the run: an oversized composition
             # must refuse readably at BUILD time too, not die as an XLA

@@ -34,6 +34,7 @@ import json
 import numpy as np
 
 __all__ = [
+    "SPEC_BYTES",
     "broadcast_json",
     "global_mesh",
     "init_distributed",
@@ -42,8 +43,11 @@ __all__ = [
 ]
 
 # Fixed wire size for the job-spec broadcast: multi-controller broadcasts
-# need identical static shapes on every process.
-_SPEC_BYTES = 65536
+# need identical static shapes on every process. Public name: the
+# executor prechecks a composition's spec against this bound BEFORE any
+# cohort process spawns (executor._precheck_cohort_spec_size).
+SPEC_BYTES = 65536
+_SPEC_BYTES = SPEC_BYTES
 
 _initialized = False
 

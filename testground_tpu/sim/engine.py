@@ -257,6 +257,7 @@ class SimProgram:
         telemetry: bool = False,
         faults=None,
         trace=None,
+        transport: str = "xla",
     ):
         self.tc = testcase
         self.groups = groups
@@ -276,6 +277,27 @@ class SimProgram:
         self.hosts = tuple(hosts)
         self.n_lanes = self.n + len(self.hosts)
         self.validate = bool(validate)
+        # Transport backend for the calendar hot path (ISSUE 5 / SURVEY
+        # §2.4.1): "xla" compiles the scatter/gather path unchanged (the
+        # zero-overhead default, pinned by jaxpr equality); "pallas"
+        # swaps in the hand-tiled commit + delivery kernels
+        # (sim/pallas_transport.py). A static program-shaping option
+        # like telemetry/faults/trace: it must ride the cohort broadcast
+        # and the precompile BuildKey.
+        if transport not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown transport {transport!r}: expected 'xla' or "
+                "'pallas'"
+            )
+        if transport == "pallas" and mesh is not None:
+            raise ValueError(
+                "transport=pallas supports single-device programs only: "
+                "the cross-shard calendar scatter IS the inter-chip "
+                "traffic on a mesh, and the single-device kernel cannot "
+                "express it — drop the mesh (shard=false) or use "
+                "transport=xla"
+            )
+        self.transport = transport
         # Per-tick counter block (telemetry plane): when enabled, every
         # tick emits one K-vector through the scan's ys output and the
         # chunk returns a [chunk, K] block beside the done flag. A static
@@ -555,8 +577,11 @@ class SimProgram:
                 track_src=cls.TRACK_SRC,
                 # unsharded: flat planes in the scatters' linear layout
                 # (see Calendar docstring); sharded: 2-D rows whose
-                # N·SLOTS axis carries the instance-axis sharding
-                flat=self.mesh is None,
+                # N·SLOTS axis carries the instance-axis sharding. The
+                # pallas backend keeps the 2-D form too — its kernels
+                # block bucket rows directly, so the flat layout XLA's
+                # scatter lowering wants buys nothing there
+                flat=self.mesh is None and self.transport != "pallas",
                 # the enqueue-tick plane feeds the delivery-latency
                 # histograms — telemetry-gated like the counter block
                 track_etick=self.telemetry,
@@ -712,7 +737,7 @@ class SimProgram:
         # (see sync_kernel.live_per_group — the degraded-barrier target)
         live_g = live_per_group(carry.status, self.groups)
 
-        cal, inbox_all = deliver(carry.cal, t)
+        cal, inbox_all = deliver(carry.cal, t, transport=self.transport)
         # delivery-latency histogram (telemetry plane): bin this tick's
         # deliveries by (t - enqueue tick) per receiver group. The etick
         # row survives deliver's occupancy clear (only the occupancy
@@ -895,6 +920,7 @@ class SimProgram:
             # flight recorder: per-message transport fate for traced
             # send events (compiled out when no trace plan is declared)
             want_fate=self.trace is not None,
+            transport=self.transport,
         )
         sync = update_sync(
             carry.sync, signals, pub_payload, pub_valid, sub_consume

@@ -87,6 +87,15 @@ class SimJaxConfig:
     # beside the per-group composition flag (Group.profiles); writes the
     # XLA op + host timeline under <run outputs>/profiles
     profile: bool = False
+    # transport backend for the calendar hot path (PERF.md "Pallas
+    # transport kernels"): "xla" (default — the scatter path, program
+    # unchanged) or "pallas" (hand-tiled commit + delivery kernels,
+    # sim/pallas_transport.py; interpret mode off-TPU). Single-device
+    # only: under a mesh the run falls back to xla with a warning (the
+    # cross-shard scatter is the inter-chip traffic). A program-shaping
+    # option like telemetry: broadcast to cohort followers and keyed
+    # into the precompile BuildKey. CLI: --run-cfg transport=pallas
+    transport: str = "xla"
     # whitelisted control-route service hosts (echo lanes past the instance
     # axis) — the ADDITIONAL_HOSTS analog (``local_docker.go:78``); plans
     # address them via ``env.host_index(name)``
@@ -182,6 +191,7 @@ def make_sim_program(
     telemetry,
     faults,
     trace,
+    transport,
 ):
     """The ONE construction site for a run's SimProgram. Every
     program-shaping option is a REQUIRED keyword: adding one here forces
@@ -203,7 +213,33 @@ def make_sim_program(
         telemetry=telemetry,
         faults=faults,
         trace=trace,
+        transport=transport,
     )
+
+
+def resolve_transport(cfg, mesh, warn=None) -> str:
+    """The ONE transport-gate: validate the runner-config knob and apply
+    the single-device bound. Shared by the executor, the sim-worker
+    followers, and the sim:plan precompile so all three resolve the
+    same program variant (the telemetry-gate discipline). ``warn`` is a
+    ``(fmt, *args)`` callable for the loud fallback."""
+    transport = str(getattr(cfg, "transport", "xla") or "xla").lower()
+    if transport not in ("xla", "pallas"):
+        raise ValueError(
+            f"unknown transport {transport!r} in runner config: expected "
+            "'xla' or 'pallas' (--run-cfg transport=pallas)"
+        )
+    if transport == "pallas" and mesh is not None:
+        if warn is not None:
+            warn(
+                "transport=pallas supports a single device only (the "
+                "cross-shard calendar scatter is the inter-chip traffic) "
+                "— falling back to the XLA transport on this %d-device "
+                "mesh",
+                int(mesh.devices.size),
+            )
+        return "xla"
+    return transport
 
 
 def fault_specs_of(run_groups, global_faults=None) -> dict:
@@ -302,10 +338,95 @@ def _precheck_device_memory(prog, cfg, mesh, ow) -> None:
     )
 
 
+def _cohort_job_spec(
+    job: RunInput, cfg, *, hosts, telemetry, transport, faults
+) -> dict:
+    """The cohort job spec — the ONE dict shape both the leader's
+    ``broadcast_json`` and the pre-spawn size check build. Every
+    program-shaping option must reach the followers (a mismatch would
+    trace different programs and desync the cohort inside a collective),
+    so gated values (telemetry/transport post their cohort gates) are
+    passed in by the caller. Cohorts run trace-free, so ``trace`` is
+    always the post-gate empty dict — kept explicit so a future
+    symmetric-trace design cannot silently desync the followers."""
+    return {
+        "plan": job.test_plan,
+        "case": job.test_case,
+        "run_id": job.run_id,
+        "groups": [
+            {
+                "id": g.id,
+                "instances": g.instances,
+                "parameters": dict(g.parameters),
+            }
+            for g in job.groups
+        ],
+        "tick_ms": cfg.tick_ms,
+        "chunk": cfg.chunk,
+        "seed": cfg.seed,
+        "max_ticks": cfg.max_ticks,
+        "hosts": list(hosts),
+        "validate": bool(getattr(cfg, "validate", False)),
+        "telemetry": bool(telemetry),
+        "transport": str(transport),
+        "faults": faults,
+        "trace": {},
+    }
+
+
+def _precheck_cohort_spec_size(job: RunInput, cfg) -> None:
+    """Refuse an over-the-wire-bound cohort job spec BEFORE any process
+    is spawned or collective entered (VERDICT r5 weak #5 — the
+    MAX_FILTER_CELLS precheck philosophy). The broadcast buffer is a
+    fixed ``distributed.SPEC_BYTES``; without this check an oversized
+    composition (many groups / large parameter blobs) dies as a
+    ValueError inside the cohort child, after the leader child and every
+    worker have already joined collectives."""
+    import json as _json
+
+    from .distributed import SPEC_BYTES
+
+    # same builder as the leader's broadcast, with the POST-gate scalar
+    # values a cohort always broadcasts (telemetry off, transport xla —
+    # the cohort gates), so the estimate is byte-exact, never under
+    spec = _cohort_job_spec(
+        job,
+        cfg,
+        hosts=_parse_hosts(getattr(cfg, "additional_hosts", None)),
+        telemetry=False,
+        transport="xla",
+        faults=fault_specs_of(job.groups, getattr(job, "faults", None)),
+    )
+    raw = len(_json.dumps(spec).encode()) + 8  # the length prefix
+    if raw > SPEC_BYTES:
+        biggest = max(
+            job.groups,
+            key=lambda g: len(_json.dumps(dict(g.parameters))),
+            default=None,
+        )
+        hint = (
+            f" (largest parameter blob: group {biggest.id!r}, "
+            f"{len(_json.dumps(dict(biggest.parameters)))} bytes)"
+            if biggest is not None
+            else ""
+        )
+        raise ValueError(
+            f"cohort job spec is {raw:,} bytes, over the {SPEC_BYTES:,}-"
+            "byte broadcast bound — shrink the composition's group "
+            f"parameters or fault tables{hint}; refused before spawning "
+            "the cohort (the broadcast inside the collective would fail "
+            "anyway, stranding every joined worker)"
+        )
+
+
 def execute_sim_run(
     job: RunInput, ow: OutputWriter, cancel: threading.Event
 ) -> RunOutput:
     cfg = job.runner_config or SimJaxConfig()
+    # oversized cohort specs are refused HERE — before the leader child
+    # is spawned and before jax.distributed is initialized anywhere
+    if getattr(cfg, "coordinator_address", ""):
+        _precheck_cohort_spec_size(job, cfg)
     # Multi-host: the engine NEVER joins the cohort in-process — a member
     # death LOG(FATAL)s every joined process once the coordination
     # service notices (no Python hook exists), which would kill the
@@ -505,38 +626,19 @@ def _execute_sim_run(
             mesh.devices.size,
             jax.process_index(),
         )
+        # transport gate precedes the broadcast: followers must compile
+        # the POST-gate variant (a cohort mesh always forces xla)
+        transport = resolve_transport(cfg, mesh, ow.warn)
         # followers compile the identical program from this spec
         broadcast_json(
-            {
-                "plan": job.test_plan,
-                "case": job.test_case,
-                "run_id": job.run_id,
-                "groups": [
-                    {
-                        "id": g.id,
-                        "instances": g.instances,
-                        "parameters": dict(g.parameters),
-                    }
-                    for g in job.groups
-                ],
-                "tick_ms": cfg.tick_ms,
-                "chunk": cfg.chunk,
-                "seed": cfg.seed,
-                "max_ticks": cfg.max_ticks,
-                "hosts": list(hosts),
-                # every program-shaping option must reach the followers —
-                # a validate/telemetry/faults mismatch would trace
-                # different programs and desync the cohort inside a
-                # collective
-                "validate": bool(getattr(cfg, "validate", False)),
-                "telemetry": telemetry_on,
-                "faults": fault_specs,
-                # cohorts run trace-free (gated above), so the broadcast
-                # carries the post-gate value — always empty here, kept
-                # explicit so a future symmetric-trace design cannot
-                # silently desync the followers
-                "trace": {},
-            }
+            _cohort_job_spec(
+                job,
+                cfg,
+                hosts=hosts,
+                telemetry=telemetry_on,
+                transport=transport,
+                faults=fault_specs,
+            )
         )
         # readiness vote: a worker whose plans dir cannot satisfy the job
         # votes False and everyone skips in lockstep (a worker dying
@@ -549,6 +651,9 @@ def _execute_sim_run(
             )
     else:
         mesh = _make_mesh(cfg.shard)
+        transport = resolve_transport(cfg, mesh, ow.warn)
+    if transport != "xla":
+        ow.infof("sim:jax %s: transport backend = %s", job.run_id, transport)
     ow.infof(
         "sim:jax run %s: plan=%s case=%s instances=%d groups=%d "
         "tick=%.3fms devices=%s",
@@ -577,6 +682,7 @@ def _execute_sim_run(
         telemetry=telemetry_on,
         faults=fault_schedule,
         trace=trace_plan,
+        transport=transport,
     )
     _precheck_device_memory(prog, cfg, mesh, ow)
     # the device-resident carry footprint is ALWAYS part of the run
@@ -690,6 +796,10 @@ def _execute_sim_run(
                 if mesh is not None and int(mesh.devices.size) > 1
                 else 1
             ),
+            # per-backend ledger tag: every sim_perf.jsonl row and the
+            # journal sim.perf block name the transport, so A/B runs
+            # (`tg perf --compare`, bench) are never cross-attributed
+            transport=transport,
         )
     # Profile capture — the pprof analog (``pkg/api/composition.go:153-162``
     # → TestCaptureProfiles): any group requesting profiles — or the
@@ -1193,6 +1303,10 @@ def sim_worker_loop(
             hosts=tuple(spec.get("hosts", ())),
             validate=bool(spec.get("validate", False)),
             telemetry=bool(spec.get("telemetry", False)),
+            # post-gate value from the leader (cohort meshes always
+            # resolve to xla today; threaded so a future single-device
+            # symmetric design cannot silently desync the followers)
+            transport=spec.get("transport", "xla"),
             # deterministic lowering: the same spec dict produces the
             # same event tensors on every process, so the cohort traces
             # one program

@@ -316,14 +316,41 @@ def make_link_state(
     )
 
 
-def deliver(cal: Calendar, t: jax.Array) -> tuple[Calendar, Inbox]:
+def deliver(
+    cal: Calendar, t: jax.Array, transport: str = "xla"
+) -> tuple[Calendar, Inbox]:
     """Pop the bucket arriving at tick ``t`` → inboxes in plane layout
     (payload [W, SLOTS, N], src/valid [SLOTS, N]); the bucket's occupancy
     plane row is zeroed for reuse at t+L (stale payloads stay, masked) —
     which also resets the bucket's derived fill counts. With provenance
     on, the src plane doubles as occupancy (src+1, 0 = empty); invalid
-    inbox slots then read src = -1."""
+    inbox slots then read src = -1.
+
+    ``transport="pallas"`` routes the pop through the hand-tiled
+    delivery kernel (``sim/pallas_transport.py``): one grid step reads
+    the arriving bucket's rows and writes the cleared occupancy row back
+    in the same pass, instead of XLA's separate dynamic-slice read and
+    clear-row update. Bit-identical output; requires the 2-D plane
+    layout the pallas backend keeps (``Calendar.flat=False``)."""
     slots = cal.slots
+    if transport == "pallas":
+        from .pallas_transport import pop_bucket
+
+        horizon, ns = cal.occupancy_plane.shape
+        n = ns // slots
+        cal, occ_row, pay_rows = pop_bucket(cal, t)
+        if cal.src is not None:
+            row_v = occ_row != 0
+            row_s = occ_row - 1
+        else:
+            row_v = occ_row
+            row_s = jnp.zeros((ns,), jnp.int32)
+        inbox = Inbox(
+            payload=jnp.stack([r.reshape(slots, n) for r in pay_rows]),
+            src=row_s.reshape(slots, n),
+            valid=row_v.reshape(slots, n),
+        )
+        return cal, inbox
     if cal.flat:
         horizon = cal.horizon
         ns = cal.occupancy_plane.shape[0] // horizon
@@ -478,6 +505,7 @@ def enqueue(
     faults=None,
     dead: jax.Array | None = None,
     want_fate: bool = False,
+    transport: str = "xla",
 ) -> tuple[Calendar, NetFeedback]:
     """Shape + schedule this tick's sends (inputs in plane layout, message
     m = o·N + src). Returns (cal', NetFeedback).
@@ -531,6 +559,18 @@ def enqueue(
     A calendar built with ``track_etick=True`` additionally records each
     enqueued message's send tick, the latency plane's ground truth
     (:func:`latency_histogram`).
+
+    ``transport`` — "xla" (default: the scatter path below, program
+    unchanged) or "pallas": commit the sorted message stream through the
+    hand-tiled calendar-commit kernel (``sim/pallas_transport.py``),
+    which fuses the payload + occupancy (+ etick) plane writes into one
+    bucket-partitioned traversal and computes slot ranks and cross-tick
+    stacking bases in-kernel from the in-VMEM occupancy row — replacing
+    the two plane scatters, the derived fill table, and its per-message
+    base gather (the three ops PERF.md measures at 84% of the sustained
+    tick). Sorted slot mode only; direct mode keeps its XLA scatter
+    (one index per message, no sort — no bucket ordering to exploit).
+    Bit-identical results either way, pinned by the equality suites.
     """
     slots = cal.slots
     width = cal.width
@@ -1032,6 +1072,46 @@ def enqueue(
     sk, src_s = sorted_ops[:2]
     pay_s = sorted_ops[2 : 2 + width]
     orig_s = sorted_ops[-1] if orig2 is not None else None
+
+    if transport == "pallas":
+        # hand-tiled calendar commit (sim/pallas_transport.py): slot
+        # ranks, stacking bases, and every plane write happen inside one
+        # bucket-partitioned kernel pass over the sorted stream — the
+        # fill-table derivation, base gather, rank cummax, and the
+        # scatters below are all compiled out of the XLA program.
+        from .pallas_transport import commit_calendar
+
+        occ_vals = (
+            src_s + 1 if cal.src is not None else jnp.ones_like(src_s)
+        )
+        cal, survived = commit_calendar(
+            cal, sk, occ_vals, list(pay_s), t, stacking=stacking
+        )
+        if orig_s is not None:
+            # map sorted survival back to original order (duplicate
+            # copies share an index; enqueued if either copy was)
+            surv_orig = (
+                jnp.zeros((m,), jnp.int32).at[orig_s].max(survived)
+            )
+            fate = fate_of(surv_orig > 0)
+        else:
+            fate = None
+        return (
+            cal,
+            NetFeedback(
+                rejected=rejected,
+                clamped=clamped,
+                bw_dropped=bw_dropped,
+                backlog=new_backlog,
+                collisions=jnp.int32(0),
+                collision_where=jnp.zeros((2,), jnp.int32),
+                sent=sent,
+                enqueued=jnp.sum(survived),
+                fault_dropped=fault_dropped,
+                fate=fate,
+            ),
+        )
+
     val_sorted = sk < big
     buck_s = jnp.where(val_sorted, sk // n, horizon)
     dst_s = jnp.mod(sk, n)

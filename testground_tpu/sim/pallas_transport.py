@@ -1,0 +1,356 @@
+"""Hand-tiled Pallas transport kernels — the ``transport=pallas`` backend.
+
+PERF.md's single-chip floor claim rests on XLA's lowering choices: 84% of
+the sustained full-path tick is three gather/scatter ops (the stacking
+base gather over the derived [L·N] fill table, and the payload +
+src/occupancy plane scatters) that XLA:TPU lowers to ~6 ns/lane
+scalar-core loops, each op re-walking its own 200k-entry index stream.
+This module is the SURVEY §2.4.1 escalation ("implement the hot delivery
+kernel in … Pallas where jnp ops are insufficient"): the same work
+expressed as two hand-tiled kernels that walk the index stream ONCE.
+
+**Calendar-commit kernel** (:func:`commit_calendar`) — replaces, for the
+sorted slot path, everything downstream of the multi-operand sort:
+
+- grid = one step per calendar bucket. The sort already orders messages
+  by (bucket, dst), so bucket b's messages are one contiguous segment
+  of the sorted stream; the segment bounds are a single ``searchsorted``
+  of the L+1 bucket boundaries over the sorted keys, handed to the
+  kernel as scalar prefetch (the index computation is known before the
+  grid runs, so Pallas pipelines the row DMAs against it).
+- each grid step holds bucket b's occupancy/payload/etick rows in VMEM
+  (Pallas DMAs the [1, N·SLOTS] blocks HBM→VMEM and back around the
+  step), walks the segment once, and for each message stores EVERY
+  plane's word — occupancy mark, W payload words, enqueue tick — at the
+  message's slot position in the same pass. One index decode per
+  message, versus one scalar-core loop per plane per tick under XLA.
+- slot assignment happens IN the kernel: a message's slot is its rank
+  within its (bucket, dst) run — runs are contiguous in the sorted
+  segment, so a sequential counter reproduces the XLA rank exactly —
+  plus the bucket's pre-tick fill, read as SLOTS scalar loads from the
+  in-VMEM occupancy row at each run start. That replaces the derived
+  [L·N] fill table, its 200k-lane base gather (30% of the XLA tick),
+  and the rank prefix-max entirely. Within-segment stores never affect
+  the base reads: a (bucket, dst) run is visited once, and its fill is
+  read from the PRE-update input block, exactly like the XLA path
+  derives the fill table before the scatter.
+- per-message survival (slot < SLOTS) is written to a [1, m] output so
+  the flow counters and the flight recorder's fate plane stay exact.
+
+**Delivery kernel** (:func:`pop_bucket`) — the tiled row pop over the
+arriving bucket: one grid step DMAs bucket (t mod L)'s rows into VMEM,
+emits the popped occupancy/payload rows for the inbox unpack, and
+writes the zeroed occupancy row back in the same pass — fusing
+``deliver``'s dynamic-slice read and clear-row write into one traversal.
+
+Layout: the pallas backend keeps the 2-D ``[L, N·SLOTS]`` plane form
+(``Calendar.flat=False``) even unsharded — the kernels block rows
+directly, so the flat linear layout XLA's scatter lowering wants buys
+nothing here. The N·SLOTS axis stays minor (the net.py layout rule).
+
+Scope: the sorted enqueue path and ``deliver``. Direct slot mode keeps
+its XLA scatter (one index per message, no sort — there is no bucket
+ordering for the kernel to exploit), and mesh-sharded programs keep the
+XLA path entirely (the cross-shard scatter IS the inter-chip traffic;
+a single-device kernel cannot express it) — ``SimProgram`` enforces the
+single-device bound. VMEM envelope: the whole sorted message stream
+((3+W) × m2 int32) plus ~2(2+W) row blocks must fit in ~16 MB VMEM —
+the flagship full path (m2 = 2N, W = 1, SLOTS = 2) fits to ~500k
+instances; storm-shaped workloads (OUT_MSGS·IN_MSGS large) exceed it
+well below 100k, which is part of what the A/B harness measures.
+
+On non-TPU backends every kernel runs in interpret mode, so the CPU
+test tier executes the real kernel logic bit-for-bit against the XLA
+path (``tests/test_transport_pallas.py``, the fuzz suites); the real
+chip is measured by ``tools/bench_pallas_transport.py`` and
+``bench.py --transport pallas``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["commit_calendar", "pop_bucket", "pallas_interpret"]
+
+
+def pallas_interpret() -> bool:
+    """Interpret-mode gate: anywhere but a real TPU backend, the kernels
+    run under the Pallas interpreter — same semantics, executable on the
+    CPU test tier (and on the 8-device virtual mesh's host platform)."""
+    return jax.default_backend() != "tpu"
+
+
+@functools.lru_cache(maxsize=64)
+def _commit_call(
+    horizon: int,
+    n: int,
+    slots: int,
+    width: int,
+    m2: int,
+    track_src: bool,
+    has_etick: bool,
+    stacking: bool,
+    occ_bool: bool,
+    interpret: bool,
+):
+    """Build the pallas_call for one static commit configuration.
+
+    Cached per program shape: the engine traces one enqueue per program,
+    but eager callers (the fuzz suites) hit this per tick."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ns = n * slots
+    occ_dtype = jnp.bool_ if occ_bool else jnp.int32
+    n_et = 1 if has_etick else 0
+
+    def kernel(*refs):
+        # operand order (after the 2 scalar-prefetch refs): sorted
+        # message stream, then the input rows, then outputs
+        starts_ref, t_ref = refs[0], refs[1]
+        sk_ref, occv_ref = refs[2], refs[3]
+        pay_refs = refs[4 : 4 + width]
+        occ_in = refs[4 + width]
+        pay_in = refs[5 + width : 5 + 2 * width]
+        et_in = refs[5 + 2 * width] if has_etick else None
+        base = 5 + 2 * width + n_et
+        surv_ref = refs[base]
+        occ_out = refs[base + 1]
+        pay_out = refs[base + 2 : base + 2 + width]
+        et_out = refs[base + 2 + width] if has_etick else None
+
+        b = pl.program_id(0)
+
+        # the survival plane is revisited by every grid step (each step
+        # writes its own segment); zero it once before the first
+        @pl.when(b == 0)
+        def _():
+            surv_ref[:] = jnp.zeros_like(surv_ref)
+
+        # pass the rows through: untouched cells must survive the write-
+        # back (the out block is a fresh VMEM buffer, not the input)
+        occ_out[:] = occ_in[:]
+        for w in range(width):
+            pay_out[w][:] = pay_in[w][:]
+        if has_etick:
+            et_out[:] = et_in[:]
+
+        lo = starts_ref[b]
+        hi = starts_ref[b + 1]
+        tick = t_ref[0]
+
+        def body(j, carry):
+            prev_key, next_slot = carry
+            key = sk_ref[0, j]
+            dstj = key - b * n
+
+            def fresh(_):
+                # new (bucket, dst) run: rank restarts at the bucket's
+                # pre-tick fill for this dst — read straight from the
+                # PRE-update occupancy row (the in block), replacing the
+                # XLA path's derived fill table + 200k-lane base gather
+                if not stacking:
+                    return jnp.int32(0)
+                acc = jnp.int32(0)
+                for s in range(slots):
+                    acc += (occ_in[0, s * n + dstj] != 0).astype(jnp.int32)
+                return acc
+
+            slot = jax.lax.cond(
+                key != prev_key, fresh, lambda _: next_slot, None
+            )
+
+            @pl.when(slot < slots)
+            def _():
+                # one traversal writes EVERY plane at this position —
+                # the fusion the XLA path pays three scalar-core loops
+                # for (positions are slot-major: pos = slot·N + dst)
+                pos = slot * n + dstj
+                if occ_bool:
+                    occ_out[0, pos] = occv_ref[0, j] != 0
+                else:
+                    occ_out[0, pos] = occv_ref[0, j]
+                for w in range(width):
+                    pay_out[w][0, pos] = pay_refs[w][0, j]
+                if has_etick:
+                    et_out[0, pos] = tick
+                surv_ref[0, j] = 1
+
+            return key, slot + 1
+
+        jax.lax.fori_loop(lo, hi, body, (jnp.int32(-1), jnp.int32(0)))
+
+    stream_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    def row_spec():
+        return pl.BlockSpec((1, ns), lambda b, *_: (b, 0))
+
+    n_rows = 1 + width + n_et
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(horizon,),
+        in_specs=[stream_spec] * (2 + width)
+        + [row_spec() for _ in range(n_rows)],
+        out_specs=[stream_spec] + [row_spec() for _ in range(n_rows)],
+    )
+    out_shape = [jax.ShapeDtypeStruct((1, m2), jnp.int32)]
+    out_shape.append(jax.ShapeDtypeStruct((horizon, ns), occ_dtype))
+    out_shape += [
+        jax.ShapeDtypeStruct((horizon, ns), jnp.int32) for _ in range(width)
+    ]
+    if has_etick:
+        out_shape.append(jax.ShapeDtypeStruct((horizon, ns), jnp.int32))
+    # operand index of the first plane input: 2 prefetch + (2 + W) stream
+    first_plane = 4 + width
+    aliases = {first_plane + i: 1 + i for i in range(n_rows)}
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )
+
+
+def commit_calendar(
+    cal,
+    sk: jax.Array,  # [m2] int32, sorted keys (bucket·n + dst; big = invalid)
+    occ_vals: jax.Array,  # [m2] int32 occupancy marks (src+1, or 1)
+    pay_sorted,  # W × [m2] int32, sorted alongside sk
+    t: jax.Array,
+    *,
+    stacking: bool = True,
+):
+    """Commit one tick's sorted message stream into the calendar planes.
+
+    Returns ``(cal', survived)`` with ``survived`` a [m2] int32 0/1 mask
+    in SORTED order — 1 exactly where the XLA path's ``val_s`` (valid ∧
+    rank < SLOTS) holds, so flow counters and fate mapping stay exact.
+    Requires the 2-D plane layout (``cal.flat`` False)."""
+    assert not cal.flat, "pallas transport requires 2-D calendar planes"
+    slots = cal.slots
+    width = cal.width
+    occ = cal.occupancy_plane
+    horizon, ns = occ.shape
+    n = ns // slots
+    m2 = int(sk.shape[0])
+    track_src = cal.src is not None
+    has_etick = cal.etick is not None
+
+    # bucket b's sorted segment is [starts[b], starts[b+1]); invalid
+    # messages carry key = horizon·n and fall past starts[horizon]
+    starts = jnp.searchsorted(
+        sk, jnp.arange(horizon + 1, dtype=jnp.int32) * jnp.int32(n)
+    ).astype(jnp.int32)
+    tvec = jnp.reshape(jnp.asarray(t, jnp.int32), (1,))
+
+    call = _commit_call(
+        horizon,
+        n,
+        slots,
+        width,
+        m2,
+        track_src,
+        has_etick,
+        bool(stacking),
+        occ.dtype == jnp.bool_,
+        pallas_interpret(),
+    )
+    # message-stream operands ride as [1, m2] rows (TPU-friendly 2-D)
+    args = [starts, tvec, sk[None, :], occ_vals[None, :]]
+    args += [p[None, :] for p in pay_sorted]
+    args.append(occ)
+    args += list(cal.payload)
+    if has_etick:
+        args.append(cal.etick)
+    out = call(*args)
+    survived = out[0][0]
+    new_occ = out[1]
+    new_payload = tuple(out[2 : 2 + width])
+    new_etick = out[2 + width] if has_etick else None
+    cal = dataclasses.replace(
+        cal,
+        payload=new_payload,
+        src=new_occ if track_src else None,
+        valid=None if track_src else new_occ,
+        etick=new_etick,
+    )
+    return cal, survived
+
+
+@functools.lru_cache(maxsize=64)
+def _pop_call(
+    horizon: int, ns: int, width: int, occ_bool: bool, interpret: bool
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    occ_dtype = jnp.bool_ if occ_bool else jnp.int32
+
+    def kernel(*refs):
+        # refs: b_ref, occ_in, pay_in×W, occ_out, row_occ, row_pay×W
+        occ_in = refs[1]
+        pay_in = refs[2 : 2 + width]
+        occ_out = refs[2 + width]
+        row_occ = refs[3 + width]
+        row_pay = refs[4 + width : 4 + 2 * width]
+        row = occ_in[:]
+        row_occ[:] = row  # pop ...
+        occ_out[:] = jnp.zeros_like(row)  # ... and clear, one traversal
+        for w in range(width):
+            row_pay[w][:] = pay_in[w][:]
+
+    def row_spec():
+        return pl.BlockSpec((1, ns), lambda i, b: (b[0], 0))
+
+    full_row = pl.BlockSpec(memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[row_spec() for _ in range(1 + width)],
+        out_specs=[row_spec()] + [full_row] * (1 + width),
+    )
+    out_shape = [jax.ShapeDtypeStruct((horizon, ns), occ_dtype)]
+    out_shape.append(jax.ShapeDtypeStruct((1, ns), occ_dtype))
+    out_shape += [
+        jax.ShapeDtypeStruct((1, ns), jnp.int32) for _ in range(width)
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases={1: 0},  # occupancy plane updated in place
+        interpret=interpret,
+    )
+
+
+def pop_bucket(cal, t: jax.Array):
+    """Pop the bucket arriving at tick ``t``: returns ``(cal', occ_row,
+    pay_rows)`` with the rows as [N·SLOTS] vectors and the occupancy row
+    cleared in the returned calendar (payload stays stale-but-masked,
+    exactly like the XLA ``deliver``)."""
+    assert not cal.flat, "pallas transport requires 2-D calendar planes"
+    width = cal.width
+    occ = cal.occupancy_plane
+    horizon, ns = occ.shape
+    bvec = jnp.reshape(
+        jnp.mod(jnp.asarray(t, jnp.int32), horizon), (1,)
+    )
+    call = _pop_call(
+        horizon, ns, width, occ.dtype == jnp.bool_, pallas_interpret()
+    )
+    out = call(bvec, occ, *cal.payload)
+    new_occ = out[0]
+    occ_row = out[1][0]
+    pay_rows = [r[0] for r in out[2 : 2 + width]]
+    track_src = cal.src is not None
+    cal = dataclasses.replace(
+        cal,
+        src=new_occ if track_src else None,
+        valid=None if track_src else new_occ,
+    )
+    return cal, occ_row, pay_rows

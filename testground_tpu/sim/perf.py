@@ -208,9 +208,15 @@ class PerfLedger:
         path: str | None = None,
         aot: bool = True,
         warmup: int = 1,
+        transport: str = "xla",
     ):
         self.instances = int(instances)
         self.chunk = int(chunk)
+        # per-backend tag (ISSUE 5): every jsonl row and the summary
+        # name the transport backend the measured program compiled with,
+        # so xla-vs-pallas A/B ledgers are never cross-attributed by
+        # `tg perf --compare` or the bench trajectory
+        self.transport = str(transport or "xla")
         # dispatches excluded from the steady_* window: the first carries
         # trace + compile everywhere; under a multi-device mesh the
         # SECOND retraces at the GSPMD sharding fixed point (see
@@ -252,6 +258,7 @@ class PerfLedger:
         row: dict[str, Any] = {
             "tick": int(ticks),
             "chunk": int(index),
+            "transport": self.transport,
             "wall_secs": round(wall, 6),
             "ticks_per_sec": round(ticks_delta / wall, 3),
             "peer_ticks_per_sec": round(
@@ -308,6 +315,7 @@ class PerfLedger:
         out: dict[str, Any] = {
             "instances": self.instances,
             "chunk": self.chunk,
+            "transport": self.transport,
         }
         if self._compile:
             out["compile"] = dict(self._compile)

@@ -199,3 +199,58 @@ def test_conservation_and_termination_under_random_chaos(events, seed):
         "faults_restarted",
     ):
         assert res2[key] == res[key], key
+
+
+@settings(max_examples=4, deadline=None)
+@given(fault_schedules(), st.integers(0, 2**31 - 1))
+def test_pallas_transport_bit_equal_under_random_chaos(events, seed):
+    """ISSUE 5 equality pin, chaos edition: the SAME random schedule +
+    seed through `transport="pallas"` (hand-tiled commit/pop kernels,
+    interpret mode on CPU) and `transport="xla"` must produce identical
+    telemetry streams and flow totals — fault kills land inside enqueue,
+    exactly where the pallas kernel replaces the plane scatters. Few
+    examples: each compiles BOTH backends' programs."""
+    groups = build_groups(
+        [RunGroup(id="all", instances=N, parameters={})]
+    )
+    faults = build_fault_schedule(groups, {"all": events}, 1.0)
+
+    def run(transport):
+        prog = SimProgram(
+            _BarrierTraffic(),
+            groups,
+            chunk=16,
+            telemetry=True,
+            faults=faults,
+            transport=transport,
+        )
+        blocks = []
+        res = prog.run(
+            seed=seed,
+            max_ticks=MAX_TICKS,
+            telemetry_cb=lambda b: blocks.append(np.asarray(b).copy()),
+        )
+        return res, np.concatenate(blocks)
+
+    res_x, stream_x = run("xla")
+    res_p, stream_p = run("pallas")
+    assert np.array_equal(stream_x, stream_p)
+    for key in (
+        "ticks",
+        "msgs_sent",
+        "msgs_delivered",
+        "msgs_enqueued",
+        "msgs_dropped",
+        "msgs_rejected",
+        "cal_depth",
+        "fault_dropped",
+        "faults_crashed",
+        "faults_restarted",
+    ):
+        assert res_p[key] == res_x[key], key
+    assert np.array_equal(
+        np.asarray(res_x["status"]), np.asarray(res_p["status"])
+    )
+    assert np.array_equal(
+        np.asarray(res_x["finished_at"]), np.asarray(res_p["finished_at"])
+    )

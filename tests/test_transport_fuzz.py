@@ -92,7 +92,7 @@ def schedules(draw):
     )
 
 
-def _run(sched: Schedule, flat: bool):
+def _run(sched: Schedule, flat: bool, transport: str = "xla"):
     """Run the schedule; returns per-tick inbox snapshots (numpy)."""
     n, o = sched.n, sched.o
     width = 2
@@ -109,7 +109,7 @@ def _run(sched: Schedule, flat: bool):
     uid = 0
     total_ticks = sched.ticks + sched.horizon + 2
     for t in range(total_ticks):
-        cal, inbox = deliver(cal, jnp.int32(t))
+        cal, inbox = deliver(cal, jnp.int32(t), transport=transport)
         out.append(
             (
                 np.asarray(inbox.payload),
@@ -133,6 +133,7 @@ def _run(sched: Schedule, flat: bool):
                 jnp.int32(t),
                 1.0,
                 jax.random.key(sched.seed + t),
+                transport=transport,
             )
     return out
 
@@ -155,6 +156,22 @@ def _sent_index(sched: Schedule):
 def test_flat_and_rows_layouts_deliver_identically(sched):
     a = _run(sched, flat=False)
     b = _run(sched, flat=True)
+    for (pa, sa, va), (pb, sb, vb) in zip(a, b):
+        assert (va == vb).all()
+        assert (np.where(va, sa, -1) == np.where(vb, sb, -1)).all()
+        assert (np.where(va[None], pa, -1) == np.where(vb[None], pb, -1)).all()
+
+
+@settings(max_examples=10, deadline=None)
+@given(schedules())
+def test_pallas_transport_delivers_identically(sched):
+    """The hand-tiled commit + pop kernels (sim/pallas_transport.py,
+    interpret mode on CPU) against the XLA scatter path, on the SAME 2-D
+    plane layout, through random latency/jitter/loss/duplicate shaping —
+    the net-level face of the ISSUE 5 equality pin. Fewer examples than
+    the layout fuzz: every drawn shape compiles its own kernel pair."""
+    a = _run(sched, flat=False, transport="xla")
+    b = _run(sched, flat=False, transport="pallas")
     for (pa, sa, va), (pb, sb, vb) in zip(a, b):
         assert (va == vb).all()
         assert (np.where(va, sa, -1) == np.where(vb, sb, -1)).all()

@@ -1,0 +1,359 @@
+"""The `transport=pallas` backend vs the XLA scatter path (ISSUE 5).
+
+Three contracts pinned here:
+
+1. **Bit-equality across the dryrun feature matrix**: every workload of
+   `__graft_entry__.dryrun_multichip`'s gate — sorted transport,
+   filters+regions, direct slots, control lanes, far pairs, duplicate
+   shaping, bandwidth queue, filter rules, storm — runs bit-identically
+   (status + finished_at + every state leaf + every flow total) under
+   `transport="pallas"` and `transport="xla"`. On CPU the kernels run in
+   Pallas interpret mode, so tier-1 executes the REAL kernel logic.
+2. **Zero-overhead default**: `transport="xla"` (the default) compiles a
+   jaxpr-identical program to one built without the knob, with no pallas
+   ops and the flat plane layout intact — the pre-PR program, unchanged.
+3. **Gating**: the single-device bound (`resolve_transport` falls back
+   to xla on a mesh, loudly; `SimProgram` refuses a pallas+mesh build)
+   and unknown-value refusal.
+
+Plus chaos equality: a crash/partition/loss schedule with telemetry on
+produces the identical per-tick counter stream through both backends.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import __graft_entry__ as ge
+from testground_tpu.api import RunGroup
+from testground_tpu.sim.api import RUNNING, SUCCESS, Outbox, SimTestcase
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import resolve_transport
+from testground_tpu.sim.faults import build_fault_schedule
+
+# every results() key that is part of the run's observable outcome —
+# bit-compared between backends (carry_bytes differs only if the carry
+# layout diverged, which the flat/2-D calendar split makes legitimate)
+RESULT_KEYS = (
+    "status",
+    "finished_at",
+    "ticks",
+    "sync_counts",
+    "pub_dropped",
+    "latency_clamped",
+    "bw_queue_dropped",
+    "bw_rate_change_backlogged",
+    "collisions",
+    "msgs_delivered",
+    "msgs_sent",
+    "msgs_enqueued",
+    "msgs_dropped",
+    "msgs_rejected",
+    "cal_depth",
+    "faults_crashed",
+    "faults_restarted",
+    "fault_dropped",
+)
+
+
+def assert_runs_equal(label, res_x, res_p):
+    for key in RESULT_KEYS:
+        a, b = np.asarray(res_x[key]), np.asarray(res_p[key])
+        assert np.array_equal(a, b), (
+            f"[{label}] xla vs pallas {key} mismatch: {a} vs {b}"
+        )
+    leaves_x, tree_x = jax.tree.flatten(res_x["states"])
+    leaves_p, tree_p = jax.tree.flatten(res_p["states"])
+    assert tree_x == tree_p, f"[{label}] state STRUCTURE mismatch"
+    for i, (a, b) in enumerate(zip(leaves_x, leaves_p)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"[{label}] state leaf {i} mismatch"
+        )
+
+
+def _inline_prog(factory, n, transport, **kw):
+    return SimProgram(
+        factory(),
+        build_groups([RunGroup(id="all", instances=n, parameters={})]),
+        test_plan="pallas-ab",
+        test_case=factory.__name__,
+        tick_ms=1.0,
+        chunk=8,
+        transport=transport,
+        **kw,
+    )
+
+
+# the dryrun_multichip feature matrix, shrunk to single-device CPU test
+# scale: (label, make_prog(transport), n, max_ticks). Same plans, same
+# parameters, same inline testcases as the gate — only n is smaller.
+WORKLOADS = [
+    (
+        "ping-pong/sorted",
+        lambda tr: ge._pingpong_program(8, transport=tr),
+        8,
+        512,
+    ),
+    (
+        "splitbrain/filters+regions",
+        lambda tr: ge._plan_program(
+            "splitbrain", "reject", 15, {}, transport=tr
+        ),
+        15,
+        2048,
+    ),
+    (
+        "flood/direct",
+        lambda tr: ge._plan_program(
+            "benchmarks",
+            "pingpong-flood",
+            8,
+            {"duration_ticks": "64", "latency_ms": "4"},
+            transport=tr,
+        ),
+        8,
+        512,
+    ),
+    (
+        "additional-hosts/control-lanes",
+        lambda tr: ge._plan_program(
+            "additional_hosts",
+            "additional_hosts",
+            8,
+            {},
+            hosts=("http-echo",),
+            transport=tr,
+        ),
+        8,
+        1024,
+    ),
+    (
+        "far-pairs/pairwise",
+        lambda tr: _inline_prog(ge._far_pairs_testcase(), 8, tr),
+        8,
+        64,
+    ),
+    (
+        "ring/duplicate",
+        lambda tr: _inline_prog(ge._dup_ring_testcase(), 8, tr),
+        8,
+        64,
+    ),
+    (
+        "traffic-shaped/bandwidth-queue",
+        lambda tr: ge._plan_program(
+            "network",
+            "traffic-shaped",
+            8,
+            {"burst": "12", "rate": "1.5"},
+            transport=tr,
+        ),
+        8,
+        256,
+    ),
+    (
+        "ruled-ring/filter-rules",
+        lambda tr: _inline_prog(ge._ruled_ring_testcase(), 8, tr),
+        8,
+        64,
+    ),
+    (
+        "storm/random-graph",
+        lambda tr: ge._plan_program(
+            "benchmarks",
+            "storm",
+            16,
+            {
+                "conn_outgoing": "3",
+                "conn_delay_ticks": "8",
+                "data_size_kb": "16",
+            },
+            transport=tr,
+        ),
+        16,
+        512,
+    ),
+]
+
+
+class TestDryrunEquality:
+    @pytest.mark.parametrize(
+        "label,make_prog,n,max_ticks",
+        WORKLOADS,
+        ids=[w[0] for w in WORKLOADS],
+    )
+    def test_workload_bit_equal(self, label, make_prog, n, max_ticks):
+        res_x = make_prog("xla").run(max_ticks=max_ticks)
+        res_p = make_prog("pallas").run(max_ticks=max_ticks)
+        # the workload must actually run to SUCCESS — a bit-equal pair
+        # of broken runs proves nothing
+        ok = int((np.asarray(res_x["status"]) == SUCCESS).sum())
+        assert ok == n, (
+            f"[{label}] xla arm not all-SUCCESS: {ok}/{n}, "
+            f"status={np.asarray(res_x['status']).tolist()}"
+        )
+        assert res_x["msgs_delivered"] > 0, f"[{label}] no traffic"
+        assert_runs_equal(label, res_x, res_p)
+
+
+class _ChaosBarrierTraffic(SimTestcase):
+    """Signal → live-degraded barrier → rotating ring traffic → SUCCESS;
+    terminates under any crash subset (sync.live shrinks the barrier)."""
+
+    STATES = ["go"]
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 8
+    MAX_LINK_TICKS = 8
+    SHAPING = ("latency",)
+    DURATION = 24
+
+    def init(self, env):
+        return {"k": jnp.int32(0), "passed": jnp.asarray(False)}
+
+    def step(self, env, state, inbox, sync, t):
+        cls = type(self)
+        n = env.test_instance_count
+        already = sync.last_seq[self.state_id("go")] > 0
+        counts = sync.counts[self.state_id("go")]
+        passed = state["passed"] | (
+            (counts > 0) & (counts >= jnp.sum(sync.live))
+        )
+        k = jnp.where(passed, state["k"] + 1, state["k"])
+        return self.out(
+            {"k": k, "passed": passed},
+            status=jnp.where(k >= cls.DURATION, SUCCESS, RUNNING),
+            outbox=Outbox.single(
+                jnp.mod(env.global_seq + 1 + t, n),
+                jnp.zeros((1,), jnp.int32),
+                passed,
+                cls.OUT_MSGS,
+                cls.MSG_WIDTH,
+            ),
+            signals=self.signal("go") * ~already,
+        )
+
+
+class TestChaosEquality:
+    def test_chaos_schedule_streams_bit_equal(self):
+        """Crash + restart + partition + loss through BOTH backends: the
+        full results surface AND the per-tick telemetry counter stream
+        must match bit for bit (fault kills happen inside enqueue, where
+        the pallas commit kernel replaces the scatters)."""
+        n = 6
+        events = [
+            {"kind": "crash", "instances": "2:4", "start_ms": 4.0},
+            {"kind": "restart", "instances": "2:3", "start_ms": 9.0},
+            {
+                "kind": "partition",
+                "instances": "0:2",
+                "to_instances": "4:6",
+                "start_ms": 3.0,
+                "duration_ms": 6.0,
+                "bidirectional": True,
+            },
+            {
+                "kind": "loss_burst",
+                "instances": "0:6",
+                "start_ms": 6.0,
+                "duration_ms": 8.0,
+                "loss": 50.0,
+            },
+        ]
+        groups = build_groups(
+            [RunGroup(id="all", instances=n, parameters={})]
+        )
+        faults = build_fault_schedule(groups, {"all": events}, 1.0)
+
+        def run(transport):
+            prog = SimProgram(
+                _ChaosBarrierTraffic(),
+                groups,
+                test_plan="pallas-ab",
+                test_case="chaos",
+                tick_ms=1.0,
+                chunk=16,
+                telemetry=True,
+                faults=faults,
+                transport=transport,
+            )
+            blocks = []
+            res = prog.run(
+                seed=7,
+                max_ticks=2048,
+                telemetry_cb=lambda b: blocks.append(np.asarray(b).copy()),
+            )
+            return res, np.concatenate(blocks)
+
+        res_x, stream_x = run("xla")
+        res_p, stream_p = run("pallas")
+        assert res_x["faults_crashed"] > 0  # the schedule actually fired
+        assert res_x["msgs_delivered"] > 0
+        assert_runs_equal("chaos", res_x, res_p)
+        assert np.array_equal(stream_x, stream_p), (
+            "telemetry counter streams diverge between backends"
+        )
+
+
+class TestZeroOverheadDefault:
+    def test_default_xla_program_is_jaxpr_identical_and_pallas_free(self):
+        """The zero-overhead contract: a program built WITHOUT the knob
+        traces the identical chunk jaxpr as transport='xla', contains no
+        pallas call, and keeps the flat plane layout — the exact pre-PR
+        program. The pallas build of the same workload differs and DOES
+        carry the kernels."""
+        make = lambda **kw: ge._pingpong_program(8, **kw)
+        base = make()
+        explicit = make(transport="xla")
+        carry = jax.jit(lambda: base.init_carry(0))()
+        j_base = str(jax.make_jaxpr(base._chunk_step)(carry))
+        assert str(jax.make_jaxpr(explicit._chunk_step)(carry)) == j_base
+        assert "pallas" not in j_base
+        assert base.transport == "xla"
+        # unsharded xla keeps the flat [L·N·SLOTS] planes (PERF.md layout)
+        assert carry.cal.flat
+
+        pal = make(transport="pallas")
+        carry_p = jax.jit(lambda: pal.init_carry(0))()
+        j_pal = str(jax.make_jaxpr(pal._chunk_step)(carry_p))
+        assert "pallas" in j_pal
+        assert not carry_p.cal.flat
+
+
+class TestTransportGating:
+    def test_unknown_transport_refused(self):
+        with pytest.raises(ValueError, match="unknown transport"):
+            ge._pingpong_program(8, transport="cuda")
+
+    def test_pallas_on_mesh_refused_by_program(self):
+        devs = jax.devices()[:2]
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+        with pytest.raises(ValueError, match="single-device"):
+            ge._pingpong_program(8, mesh=mesh, transport="pallas")
+
+    def test_resolve_transport_gate(self):
+        cfg = dataclasses.make_dataclass("Cfg", [("transport", str)])
+
+        assert resolve_transport(cfg("xla"), None) == "xla"
+        assert resolve_transport(cfg("pallas"), None) == "pallas"
+        assert resolve_transport(cfg("PALLAS"), None) == "pallas"
+        assert resolve_transport(cfg(""), None) == "xla"
+        with pytest.raises(ValueError, match="unknown transport"):
+            resolve_transport(cfg("tpu"), None)
+
+        # a mesh forces xla, loudly — the single-device bound
+        devs = jax.devices()[:2]
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+        warned = []
+        assert (
+            resolve_transport(
+                cfg("pallas"), mesh, lambda fmt, *a: warned.append(fmt % a)
+            )
+            == "xla"
+        )
+        assert warned and "single device" in warned[0]
+        # xla on a mesh stays silent
+        assert resolve_transport(cfg("xla"), mesh) == "xla"

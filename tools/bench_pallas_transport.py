@@ -1,0 +1,175 @@
+"""A/B harness for the hand-tiled Pallas transport kernels (PERF.md
+"Pallas transport kernels"; ISSUE 5).
+
+Runs the SAME workload once per transport backend — ``xla`` (the scatter
+path PERF.md profiles at 84% of the sustained tick) and ``pallas``
+(``sim/pallas_transport.py``) — on a single device, and reports
+steady-state per-tick wall, peer·ticks/s, and the ratio, as one JSON
+line. Compile time is excluded from the per-tick number and reported
+alongside (both backends pay their own trace + compile/cache-read).
+
+On the real chip this is the measurement the PERF.md verdict (win or
+banked negative result) comes from:
+
+    python tools/bench_pallas_transport.py --instances 100000 --ticks 2048
+
+On CPU the kernels run under the Pallas interpreter, so the numbers are
+FUNCTIONAL only (the interpreter emulates the kernel op by op and is
+orders of magnitude off real kernel cost) — the tool still verifies the
+two backends agree on the workload's flow totals before timing, so a
+CPU run is a correctness gate, not a perf claim. The default sizes are
+CPU-safe; pass the 100k/2048 shape above on hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+WORKLOADS = {
+    # the primary PERF.md target: general sorted transport, provenance
+    # plane on, cross-tick stacking, 7/8 shaping features — the three
+    # hot ops the kernels replace all live here
+    "sustained": (
+        "network",
+        "pingpong-sustained",
+        lambda ticks: {
+            "duration_ticks": str(10 * ticks),
+            "latency_ms": "4",
+            "latency2_ms": "2",
+            "reshape_every": "1000",
+        },
+    ),
+    # direct slot mode: only the delivery kernel applies (the commit
+    # kernel needs the sort's bucket ordering) — isolates the pop fusion
+    "flood": (
+        "benchmarks",
+        "pingpong-flood",
+        lambda ticks: {"duration_ticks": str(10 * ticks), "latency_ms": "4"},
+    ),
+}
+
+
+def _build(plan, case, n, params, chunk, transport):
+    from testground_tpu.api import RunGroup
+    from testground_tpu.sim.engine import SimProgram, build_groups
+    from testground_tpu.sim.executor import (
+        instantiate_testcase,
+        load_sim_testcases,
+    )
+
+    factory = load_sim_testcases(os.path.join(REPO_ROOT, "plans", plan))[case]
+    groups = build_groups([RunGroup(id="all", instances=n, parameters=params)])
+    tc = instantiate_testcase(factory, groups, tick_ms=1.0)
+    return SimProgram(
+        tc,
+        groups,
+        test_plan=plan,
+        test_case=case,
+        tick_ms=1.0,
+        mesh=None,  # single-device A/B: identical topology both arms
+        chunk=chunk,
+        transport=transport,
+    )
+
+
+def _measure(prog, ticks: int) -> dict:
+    # bench.py's warm-then-time loop IS the measurement (one code path
+    # for the D2H-sync and done-break details); only the flow extraction
+    # and the per-tick normalization live here
+    from bench import _timed_ticks
+
+    carry, run_ticks, wall, compile_secs = _timed_ticks(prog, ticks)
+    run_ticks = max(run_ticks, 1)
+    return {
+        "compile_secs": round(compile_secs, 3),
+        "ticks": run_ticks,
+        "wall_secs": round(wall, 4),
+        "ms_per_tick": round(1e3 * wall / run_ticks, 4),
+        "peer_ticks_per_sec": round(prog.n * run_ticks / wall, 1),
+        "flow": {
+            "delivered": _acc(carry.msgs_delivered),
+            "sent": _acc(carry.msgs_sent),
+            "enqueued": _acc(carry.msgs_enqueued),
+            "dropped": _acc(carry.msgs_dropped),
+        },
+    }
+
+
+def _acc(limb) -> int:
+    from testground_tpu.sim.engine import _acc_total
+
+    import numpy as np
+
+    return _acc_total(np.asarray(limb))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--instances", type=int, default=2048)
+    p.add_argument("--ticks", type=int, default=256)
+    p.add_argument("--chunk", type=int, default=64)
+    p.add_argument(
+        "--workload", choices=sorted(WORKLOADS), default="sustained"
+    )
+    args = p.parse_args()
+
+    from testground_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    import jax
+
+    plan, case, params_of = WORKLOADS[args.workload]
+    backend = jax.default_backend()
+    interpreted = backend != "tpu"
+    print(
+        f"# pallas-transport A/B: {args.workload} @ {args.instances} "
+        f"instances × {args.ticks} ticks on {backend}"
+        + (" (pallas INTERPRETED — functional gate, not a perf claim)"
+           if interpreted else ""),
+        file=sys.stderr,
+    )
+    out = {
+        "workload": args.workload,
+        "instances": args.instances,
+        "ticks": args.ticks,
+        "backend": backend,
+        "pallas_interpreted": interpreted,
+    }
+    for transport in ("xla", "pallas"):
+        prog = _build(
+            plan,
+            case,
+            args.instances,
+            params_of(args.ticks),
+            args.chunk,
+            transport,
+        )
+        out[transport] = _measure(prog, args.ticks)
+        print(
+            f"# {transport}: {out[transport]['ms_per_tick']} ms/tick "
+            f"(+{out[transport]['compile_secs']}s compile)",
+            file=sys.stderr,
+        )
+    if out["xla"]["flow"] != out["pallas"]["flow"]:
+        print(
+            "bench_pallas_transport: FAIL — flow totals diverge between "
+            f"backends: xla={out['xla']['flow']} "
+            f"pallas={out['pallas']['flow']}",
+            file=sys.stderr,
+        )
+        return 1
+    out["pallas_vs_xla"] = round(
+        out["xla"]["ms_per_tick"] / out["pallas"]["ms_per_tick"], 3
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
