@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
-	telemetry-smoke chaos-smoke trace-smoke perf-smoke
+	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -68,6 +68,15 @@ trace-smoke:
 # chunk/tick accounting
 perf-smoke:
 	$(PY) tools/perf_smoke.py
+
+# run-health-plane contract check (docs/OBSERVABILITY.md "Run health
+# plane"): the chaos smoke composition's warn-severity SLO must breach
+# deterministically and be recorded (journal + sim_slo.jsonl + stats
+# table) without failing the run; the same rule at severity=fail must
+# cancel the run with a typed SloBreachError whose archived journal
+# keeps the telemetry record; SLOs without telemetry refuse loudly
+slo-smoke:
+	$(PY) tools/slo_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -192,15 +192,19 @@ class RunParams:
     fault-injection plane (docs/FAULTS.md), plus a flight-recorder
     sampling table (``[groups.run.trace]`` / ``[global.run.trace]``,
     docs/OBSERVABILITY.md) selecting which instances the sim engine
-    records per-tick lifecycle events for. Entries are kept as raw
-    tables here; validation happens at lowering, where the group layout
-    is known."""
+    records per-tick lifecycle events for, and run-health SLO
+    assertions (``[[groups.run.slo]]`` / ``[[global.run.slo]]``,
+    docs/OBSERVABILITY.md "Run health plane"): metric/comparator/
+    threshold rules the sim engine evaluates per chunk while the run is
+    in flight. Entries are kept as raw tables here; validation happens
+    at lowering, where the group layout is known."""
 
     artifact: str = ""
     test_params: dict[str, str] = field(default_factory=dict)
     profiles: dict[str, str] = field(default_factory=dict)
     faults: list = field(default_factory=list)
     trace: dict = field(default_factory=dict)
+    slo: list = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "RunParams":
@@ -210,6 +214,7 @@ class RunParams:
             profiles=dict(d.get("profiles", {})),
             faults=[dict(f) for f in d.get("faults", [])],
             trace=dict(d.get("trace", {})),
+            slo=[dict(s) for s in d.get("slo", [])],
         )
 
     def to_dict(self) -> dict:
@@ -219,11 +224,14 @@ class RunParams:
             "profiles": dict(self.profiles),
         }
         # omit when empty: keeps serialized compositions byte-stable for
-        # the (vast) majority that declare no chaos schedule or trace
+        # the (vast) majority that declare no chaos schedule, trace, or
+        # SLO rules
         if self.faults:
             out["faults"] = [dict(f) for f in self.faults]
         if self.trace:
             out["trace"] = dict(self.trace)
+        if self.slo:
+            out["slo"] = [dict(s) for s in self.slo]
         return out
 
 
@@ -342,6 +350,7 @@ class Group:
             profiles=dict(self.run.profiles),
             faults=[dict(f) for f in self.run.faults],
             trace=dict(self.run.trace),
+            slo=[dict(s) for s in self.run.slo],
         )
 
 
@@ -361,6 +370,8 @@ class CompositionRunGroup:
     faults: list = field(default_factory=list)
     # flight-recorder sampling table, same inheritance rule as faults
     trace: dict = field(default_factory=dict)
+    # SLO assertion tables, same inheritance rule as faults
+    slo: list = field(default_factory=list)
     calculated_instance_count: int = 0
 
     @classmethod
@@ -374,6 +385,7 @@ class CompositionRunGroup:
             profiles=dict(d.get("profiles", {})),
             faults=[dict(f) for f in d.get("faults", [])],
             trace=dict(d.get("trace", {})),
+            slo=[dict(s) for s in d.get("slo", [])],
         )
 
     def to_dict(self) -> dict:
@@ -389,6 +401,8 @@ class CompositionRunGroup:
             out["faults"] = [dict(f) for f in self.faults]
         if self.trace:
             out["trace"] = dict(self.trace)
+        if self.slo:
+            out["slo"] = [dict(s) for s in self.slo]
         return out
 
     def effective_group_id(self) -> str:
@@ -414,6 +428,10 @@ class CompositionRunGroup:
         # RunInput.trace, scoped to the whole run
         if not self.trace and g.run.trace:
             self.trace = dict(g.run.trace)
+        # slo follows the same rule: fill-if-empty from the backing
+        # group; [[global.run.slo]] reaches the runner as RunInput.slo
+        if not self.slo and g.run.slo:
+            self.slo = [dict(s) for s in g.run.slo]
 
     def merge_run(self, rp: RunParams) -> None:
         """Fill missing test params / profiles from ``rp``

@@ -42,6 +42,9 @@ class RunGroup:
     # flight-recorder sampling table for this group's slice
     # ([groups.run.trace] — raw table, lowered by the sim:jax runner)
     trace: dict = field(default_factory=dict)
+    # SLO assertion tables for this group's slice ([[groups.run.slo]] —
+    # raw tables, lowered by the sim:jax runner)
+    slo: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -54,6 +57,7 @@ class RunGroup:
             "resources": self.resources.to_dict(),
             "faults": [dict(f) for f in self.faults],
             "trace": dict(self.trace),
+            "slo": [dict(s) for s in self.slo],
         }
 
     @classmethod
@@ -68,6 +72,7 @@ class RunGroup:
             resources=Resources.from_dict(d.get("resources", {})),
             faults=[dict(f) for f in d.get("faults", [])],
             trace=dict(d.get("trace", {})),
+            slo=[dict(s) for s in d.get("slo", [])],
         )
 
 
@@ -89,6 +94,9 @@ class RunInput:
     # run-global flight-recorder table ([global.run.trace]): selectors
     # whose default target is the WHOLE run
     trace: dict = field(default_factory=dict)
+    # run-global SLO assertions ([[global.run.slo]]): rules evaluated
+    # against the whole run's metric stream
+    slo: list = field(default_factory=list)
     # EnvConfig equivalent is attached by the engine at dispatch time.
     env: Any = None
 
@@ -102,6 +110,7 @@ class RunInput:
             "disable_metrics": self.disable_metrics,
             "faults": [dict(f) for f in self.faults],
             "trace": dict(self.trace),
+            "slo": [dict(s) for s in self.slo],
         }
 
 

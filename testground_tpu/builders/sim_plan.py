@@ -115,6 +115,7 @@ class SimPlanBuilder(Builder, Precompiler):
             load_and_specialize,
             make_sim_program,
             resolve_transport,
+            slo_specs_of,
             trace_specs_of,
         )
         from testground_tpu.sim.faults import build_fault_schedule
@@ -190,6 +191,19 @@ class SimPlanBuilder(Builder, Precompiler):
                 and not getattr(cfg, "coordinator_address", "")
                 else {}
             )
+            # SLO rules never shape the program (host-side evaluation),
+            # but they are part of the run declaration the marker
+            # records — same gating as the telemetry plane they ride
+            run_slo_specs = (
+                slo_specs_of(
+                    run.groups,
+                    comp.global_.run.slo
+                    if comp.global_.run is not None
+                    else None,
+                )
+                if telemetry
+                else {}
+            )
             spec = {
                 "sources": digests[
                     artifacts[
@@ -217,6 +231,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 "transport": transport,
                 "faults": run_fault_specs,
                 "trace": run_trace_specs,
+                "slo": run_slo_specs,
                 "hosts": list(hosts),
                 "backend": jax.default_backend(),
                 "devices": jax.device_count(),

@@ -791,6 +791,14 @@ def register_stats(sub) -> None:
         help="dump the raw stats payload as JSON (machine-readable; the "
         "same shape as GET /stats)",
     )
+    p.add_argument(
+        "-f",
+        "--follow",
+        action="store_true",
+        help="follow the task live first (per-chunk telemetry + SLO "
+        "breaches via GET /stream, like `tg logs -f`), then print the "
+        "final summary table",
+    )
     p.set_defaults(func=stats_cmd)
 
 
@@ -802,6 +810,15 @@ def stats_cmd(args) -> int:
 
     engine = _engine(args)
     try:
+        if getattr(args, "follow", False):
+            # under --json the live view goes to stderr — stdout stays
+            # the machine-readable payload (the --json contract)
+            _follow_stream(
+                engine,
+                args.task,
+                families=("telemetry", "slo", "spans"),
+                out=sys.stderr if getattr(args, "json", False) else None,
+            )
         if isinstance(engine, RemoteEngine):
             data = engine.task_stats(args.task)
         else:
@@ -841,6 +858,14 @@ def register_perf(sub) -> None:
         "journal sim block (written to stderr under --json so stdout "
         "stays parseable)",
     )
+    p.add_argument(
+        "-f",
+        "--follow",
+        action="store_true",
+        help="follow the task live first (per-chunk throughput rows + "
+        "SLO breaches via GET /stream, like `tg logs -f`), then print "
+        "the final ledger table",
+    )
     p.set_defaults(func=perf_cmd)
 
 
@@ -853,6 +878,13 @@ def perf_cmd(args) -> int:
 
     engine = _engine(args)
     try:
+        if getattr(args, "follow", False):
+            _follow_stream(
+                engine,
+                args.task,
+                families=("perf", "slo", "spans"),
+                out=sys.stderr if getattr(args, "json", False) else None,
+            )
         if isinstance(engine, RemoteEngine):
             data = engine.task_perf(args.task)
         else:
@@ -1000,6 +1032,169 @@ def trace_cmd(args) -> int:
         )
         for ev in events:
             print(_render_trace_event(ev))
+        return 0
+    finally:
+        engine.stop()
+
+
+# ------------------------------------------------------------------ watch
+
+
+def _breach_line(row: dict, color: bool) -> str:
+    """One highlighted SLO-breach line (the run health plane's live
+    surface — docs/OBSERVABILITY.md "Run health plane")."""
+    sev = row.get("severity", "warn")
+    text = (
+        f"!! SLO breach ({sev}) {row.get('rule', '?')}: "
+        f"{row.get('metric', '?')} = {row.get('observed', '?')} "
+        f"violates {row.get('op', '?')} {row.get('threshold', '?')} "
+        f"at tick {row.get('tick', '?')}"
+    )
+    if color:
+        code = "\033[31;1m" if sev == "fail" else "\033[33m"
+        return f"{code}{text}\033[0m"
+    return text
+
+
+def _follow_stream(engine, task_id: str, families, out=None, follow=True) -> None:
+    """Follow a task's observability stream and render one line per
+    chunk (plus immediate SLO-breach lines) until the task finishes —
+    the shared live view behind ``tg watch``, ``tg stats -f`` and
+    ``tg perf -f``. ``families`` must include ``spans`` for the chunk
+    clock unless ``perf`` rows (one per chunk) are streamed; with
+    ``follow=False`` (``tg watch --no-follow``) one replay sweep of
+    what exists is rendered instead of waiting for the task."""
+    from testground_tpu.sim.perf import fmt_rate
+
+    out = out or sys.stdout
+    color = hasattr(out, "isatty") and out.isatty()
+    use_spans_clock = "spans" in families
+    header = (
+        f"{'tick':>8}  {'wall':>8}  {'ticks/s':>9}  {'peer·t/s':>9}"
+        f"  {'delivered':>9}  {'dropped':>8}  {'in-flight':>9}  breaches"
+    )
+    printed_header = False
+    # telemetry deltas accumulated since the last chunk line
+    acc = {"delivered": 0, "dropped": 0, "fault_dropped": 0}
+    last_tele: dict = {}
+    last_perf: dict = {}
+    breaches = 0
+
+    def chunk_line(tick, wall) -> str:
+        d = acc["delivered"]
+        x = acc["dropped"] + acc["fault_dropped"]
+        acc.update(delivered=0, dropped=0, fault_dropped=0)
+        return (
+            f"{tick:>8}  {wall:>8.2f}  "
+            f"{fmt_rate(last_perf.get('ticks_per_sec')):>9}  "
+            f"{fmt_rate(last_perf.get('peer_ticks_per_sec')):>9}  "
+            f"{d:>9}  {x:>8}  "
+            f"{last_tele.get('cal_depth', '?'):>9}  {breaches}"
+        )
+
+    for row in engine.stream_rows(
+        task_id, follow=follow, families=families
+    ):
+        if not row:
+            continue  # heartbeat / blank keepalive
+        fam = row.get("stream")
+        if fam == "telemetry":
+            for k in acc:
+                acc[k] += int(row.get(k, 0) or 0)
+            last_tele = row
+        elif fam == "perf":
+            last_perf = row
+            if not use_spans_clock:  # perf rows ARE the chunk clock
+                if not printed_header:
+                    printed_header = True
+                    print(header, file=out)
+                print(
+                    chunk_line(
+                        row.get("tick", "?"), row.get("wall_secs", 0.0)
+                    ),
+                    file=out,
+                )
+        elif fam == "slo":
+            breaches += 1
+            print(_breach_line(row, color), file=out)
+        elif fam == "spans":
+            ev = row.get("event") or {}
+            span, typ = ev.get("span"), ev.get("type")
+            if typ == "point" and span == "chunk" and use_spans_clock:
+                if not printed_header:
+                    printed_header = True
+                    print(header, file=out)
+                print(
+                    chunk_line(
+                        ev.get("ticks", "?"), ev.get("wall_secs", 0.0)
+                    ),
+                    file=out,
+                )
+            elif typ == "span_start" and span == "run":
+                run = row.get("run", "")
+                tag = f" [{run}]" if run and run != task_id else ""
+                print(f"-- run started{tag} --", file=out)
+            elif typ == "span_end" and span == "run":
+                print(
+                    "-- run finished: outcome "
+                    f"{ev.get('outcome', ev.get('error', '?'))} --",
+                    file=out,
+                )
+        try:
+            out.flush()
+        except OSError:
+            pass
+
+
+def register_watch(sub) -> None:
+    p = sub.add_parser(
+        "watch",
+        help="live one-row-per-chunk view of a task (telemetry deltas, "
+        "throughput, SLO-breach highlighting), across the queued→"
+        "running→done lifecycle — docs/OBSERVABILITY.md 'Run health "
+        "plane'",
+    )
+    p.add_argument("task", help="task id")
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the raw ndjson rows (the GET /stream payload) "
+        "instead of the rendered view",
+    )
+    p.add_argument(
+        "--no-follow",
+        action="store_true",
+        help="replay what exists and exit instead of waiting for the "
+        "task to finish",
+    )
+    p.set_defaults(func=watch_cmd)
+
+
+def watch_cmd(args) -> int:
+    import json
+
+    engine = _engine(args)
+    try:
+        follow = not getattr(args, "no_follow", False)
+        if getattr(args, "json", False):
+            for row in engine.stream_rows(args.task, follow=follow):
+                print(json.dumps(row))
+                sys.stdout.flush()
+        else:
+            if follow:
+                print(f"watching task {args.task} (ctrl-c to stop)")
+            _follow_stream(
+                engine,
+                args.task,
+                families=("telemetry", "perf", "slo", "spans"),
+                follow=follow,
+            )
+            if follow:
+                t = engine.get_task(args.task)
+                if t is not None:
+                    print(
+                        f"task {args.task}: outcome {t.outcome().value}"
+                    )
         return 0
     finally:
         engine.stop()

@@ -2,9 +2,9 @@
 
 Command surface mirrors the reference's ``pkg/cmd/root.go:10-24``: run,
 build, plan, describe, daemon, collect, terminate, healthcheck, tasks,
-status, stats, perf, trace, logs, version. The engine runs in-process unless ``--endpoint``
-points at a daemon (the reference's client↔daemon hop is transport, not
-semantics).
+status, stats, perf, watch, trace, logs, version. The engine runs
+in-process unless ``--endpoint`` points at a daemon (the reference's
+client↔daemon hop is transport, not semantics).
 """
 
 from __future__ import annotations
@@ -42,6 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
     commands.register_status(sub)
     commands.register_stats(sub)
     commands.register_perf(sub)
+    commands.register_watch(sub)
     commands.register_trace(sub)
     commands.register_logs(sub)
     commands.register_collect(sub)

@@ -83,6 +83,12 @@ class Client:
     def _post_stream(self, route: str, body: dict) -> Iterator[str]:
         """POST; yield response lines (chunked ndjson streams)."""
         conn, resp = self._post(route, body)
+        yield from self._read_stream(conn, resp)
+
+    @staticmethod
+    def _read_stream(conn, resp) -> Iterator[str]:
+        """Yield a chunked response's complete lines — the ONE reader
+        behind both streaming verbs (error decode + line split)."""
         try:
             if resp.status >= 400:
                 data = resp.read()
@@ -113,6 +119,17 @@ class Client:
             "GET", f"{route}?{urlencode(params)}", headers=self._headers()
         )
         return self._read_json_response(conn, conn.getresponse())
+
+    def _get_stream(self, route: str, params: dict) -> Iterator[str]:
+        """GET; yield response lines (chunked ndjson streams — the GET
+        twin of :meth:`_post_stream`)."""
+        from urllib.parse import urlencode
+
+        conn = self._conn()
+        conn.request(
+            "GET", f"{route}?{urlencode(params)}", headers=self._headers()
+        )
+        yield from self._read_stream(conn, conn.getresponse())
 
     # -------------------------------------------------------------- verbs
 
@@ -207,6 +224,27 @@ class Client:
         if limit:
             params["limit"] = str(limit)
         return self._get_json("/trace", params)
+
+    def stream(
+        self, task_id: str, follow: bool = True, families=None
+    ) -> Iterator[dict]:
+        """GET /stream — follow a task's live observability rows
+        (telemetry / perf / SLO breaches / run spans) as ndjson: the
+        ``tg watch`` backend (docs/OBSERVABILITY.md "Run health
+        plane"). Yields one dict per row; the stream closes when the
+        task finishes (an already-finished task replays its history,
+        then closes)."""
+        params: dict = {"task_id": task_id, "follow": "1" if follow else "0"}
+        if families:
+            params["families"] = ",".join(families)
+        for line in self._get_stream("/stream", params):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue  # tolerant-reader rule: skip foreign noise
 
     def logs(self, task_id: str, follow: bool = False) -> Iterator[str]:
         return self._post_stream(
@@ -342,6 +380,14 @@ class RemoteEngine:
         of ``tg trace``; in-process engines read the run outputs via
         sim.trace.read_trace_events)."""
         return self.client.trace(task_id, limit=limit)
+
+    def stream_rows(
+        self, task_id: str, follow: bool = True, cancel=None, families=None
+    ) -> Iterator[dict]:
+        """The daemon's /stream route, shaped like Engine.stream_rows so
+        ``tg watch`` / ``-f`` followers work identically in-process and
+        remote."""
+        return self.client.stream(task_id, follow=follow, families=families)
 
     def tasks(
         self, states=None, types=None, before=None, after=None, limit=0, **_

@@ -6,8 +6,8 @@ surface (``daemon.go:83-101``) and bearer-token auth (``daemon.go:49-70``):
 
     POST /run /build /tasks /status /logs /outputs /terminate
          /healthcheck /kill /delete /build/purge /plan/import
-    GET  / /tasks /logs /outputs /journal /stats /perf /metrics /trace
-         /artifact /data /dashboard /describe /kill /delete
+    GET  / /tasks /logs /outputs /journal /stats /perf /stream /metrics
+         /trace /artifact /data /dashboard /describe /kill /delete
 
 The GET tier is the reference's web-dashboard surface (``daemon.go:83-91``,
 ``dashboard.go:44-75``): ``/journal`` returns a task's result journal,
@@ -133,6 +133,7 @@ class _Handler(BaseHTTPRequestHandler):
             "/journal": lambda: self._journal(q),
             "/stats": lambda: self._stats(q),
             "/perf": lambda: self._perf(q),
+            "/stream": lambda: self._stream(q),
             "/metrics": lambda: self._metrics(q),
             "/trace": lambda: self._trace(q),
             "/artifact": lambda: self._artifact(q),
@@ -478,22 +479,78 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_json(f"unknown task {task_id}", 404)
         self._send_json(t.perf_payload())
 
+    def _stream(self, q: dict) -> None:
+        """GET /stream?task_id=[&follow=0][&families=perf,slo] — ndjson
+        stream of a task's live observability rows (telemetry / perf /
+        SLO breaches / run spans), tailed from the run outputs as they
+        are appended: the ``tg watch`` backend (docs/OBSERVABILITY.md
+        "Run health plane"). Follows by default — an already-finished
+        task replays its full history, then the stream closes; a
+        running task streams until it completes."""
+        task_id = q.get("task_id", "") or q.get("task", "")
+        if not task_id:
+            return self._send_error_json("task_id is required", 400)
+        # resolve BEFORE starting the chunked stream (the /logs rule)
+        if self.engine.get_task(task_id) is None:
+            return self._send_error_json(f"unknown task {task_id}", 404)
+        follow = q.get("follow", "1") not in ("0", "false", "no")
+        families = None
+        if q.get("families"):
+            from testground_tpu.engine.stream import STREAM_FAMILIES
+
+            families = tuple(
+                f.strip() for f in q["families"].split(",") if f.strip()
+            )
+            known = {name for name, _ in STREAM_FAMILIES}
+            unknown = sorted(set(families) - known)
+            if unknown or not families:
+                # a typo'd (or all-blank, e.g. "families=,") family list
+                # would otherwise follow silently, row-less, for the
+                # task's whole lifetime
+                return self._send_error_json(
+                    f"unknown stream families {unknown}; families: "
+                    f"{sorted(known)}",
+                    400,
+                )
+        self._start_stream()
+        try:
+            # heartbeat: a blank ndjson line at least every 15 s of
+            # idle, so a queued task / long compile / quiet soak cannot
+            # trip a follower's socket read timeout
+            for row in self.engine.stream_rows(
+                task_id, follow=follow, families=families, heartbeat_secs=15.0
+            ):
+                self._write_chunked(
+                    b"\n"
+                    if row is None
+                    else (json.dumps(row) + "\n").encode()
+                )
+        finally:
+            self._end_chunked()
+
     # Task-label cardinality bound for one /metrics scrape (most recent
     # first — a scraper watches the daemon's working set, not history).
+    # The default; .env.toml ``[daemon] metrics_task_limit`` overrides.
     _METRICS_TASKS_MAX = 200
 
     def _metrics(self, q: dict) -> None:
         """GET /metrics — Prometheus text exposition (format 0.0.4):
-        task gauges, cumulative flow counters, and performance-ledger
-        gauges for the most recent tasks, so any standard scraper can
-        watch a daemon (docs/OBSERVABILITY.md)."""
+        task gauges, cumulative flow counters, performance-ledger and
+        SLO gauges for the most recent tasks, so any standard scraper
+        can watch a daemon (docs/OBSERVABILITY.md). Truncation is never
+        silent: ``tg_scrape_tasks_total`` / ``tg_scrape_tasks_elided``
+        report how much of the task store one scrape covered."""
         from testground_tpu.metrics.prometheus import (
             CONTENT_TYPE,
             render_prometheus,
         )
 
+        limit = (
+            int(self.daemon_ref.env.daemon.metrics_task_limit or 0)
+            or self._METRICS_TASKS_MAX
+        )
         body = render_prometheus(
-            self.engine.tasks(), per_task_limit=self._METRICS_TASKS_MAX
+            self.engine.tasks(), per_task_limit=limit
         ).encode()
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE)
@@ -559,6 +616,7 @@ class _Handler(BaseHTTPRequestHandler):
         "sim_timeseries.jsonl",
         "sim_latency.jsonl",
         "sim_perf.jsonl",
+        "sim_slo.jsonl",
         "run_spans.jsonl",
         "sim_trace.jsonl",
         "trace_events.json",

@@ -295,6 +295,45 @@ class Engine:
                     return
                 time.sleep(0.1)
 
+    def stream_rows(
+        self,
+        task_id: str,
+        follow: bool = True,
+        cancel: threading.Event | None = None,
+        families=None,
+        heartbeat_secs: float = 0.0,
+    ) -> Iterator[dict]:
+        """Stream a task's live observability rows (telemetry / perf /
+        SLO breaches / run spans) from its run outputs dirs — the
+        backend of the daemon's ``GET /stream`` and ``tg watch``
+        (docs/OBSERVABILITY.md "Run health plane"). With ``follow``,
+        tails across the queued→running→done lifecycle and closes after
+        a final sweep once the task finishes; on an already-finished
+        task it replays the full history, then closes (the ``logs``
+        follow contract)."""
+        tsk = self.get_task(task_id)
+        if tsk is None:
+            raise FileNotFoundError(f"unknown task {task_id}")
+        from .stream import stream_task_rows
+
+        def is_done() -> bool:
+            t = self.get_task(task_id)
+            return t is None or t.state().state in (
+                State.COMPLETE,
+                State.CANCELED,
+            )
+
+        yield from stream_task_rows(
+            self.env.dirs.outputs(),
+            tsk.plan,
+            task_id,
+            is_done,
+            follow=follow,
+            cancel=cancel,
+            families=families,
+            heartbeat_secs=heartbeat_secs,
+        )
+
     # -------------------------------------------------------------- actions
 
     def do_collect_outputs(self, runner_id: str, run_id: str, w, ow) -> None:

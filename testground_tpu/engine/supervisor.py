@@ -28,6 +28,10 @@ from testground_tpu.config import CoalescedConfig
 from testground_tpu.logging_ import S
 from testground_tpu.rpc import OutputWriter
 
+# import-light on purpose (numpy + stdlib — sim/slo.py's contract): the
+# typed SLO failure must be catchable here without loading jax
+from testground_tpu.sim.slo import SloBreachError
+
 from .engine import Engine
 from .notify import notify_task_finished, notify_task_started
 from .queue import QueueEmptyError
@@ -283,6 +287,7 @@ def do_run(
                     resources=rg.resources,
                     faults=[dict(f) for f in getattr(rg, "faults", [])],
                     trace=dict(getattr(rg, "trace", {}) or {}),
+                    slo=[dict(s) for s in getattr(rg, "slo", [])],
                 )
             )
         rinput = RunInput(
@@ -309,6 +314,15 @@ def do_run(
                 if comp.global_.run is not None
                 else {}
             ),
+            # run-global SLO assertions ([[global.run.slo]])
+            slo=[
+                dict(s)
+                for s in (
+                    comp.global_.run.slo
+                    if comp.global_.run is not None
+                    else []
+                )
+            ],
             env=engine.env,
         )
         ow.infof(
@@ -322,6 +336,26 @@ def do_run(
         t_run = time.monotonic()
         try:
             out = runner.run(rinput, ow, cancel)
+        except SloBreachError as e:
+            # typed run-health failure (docs/OBSERVABILITY.md "Run health
+            # plane"): the run was canceled at a chunk boundary because a
+            # severity="fail" SLO breached. The exception carries the
+            # fully-assembled RunOutput — journal (telemetry, perf, slo
+            # breach records) included — so the archived task keeps the
+            # failed soak's complete record instead of a bare error
+            # string. The task-level cancel event was NOT set (the SLO
+            # plane cancels through its own wrapper), so later [[runs]]
+            # still execute, mirroring the continue-on-failure rule.
+            ow.write_error(f"run {run.id} failed: {e}")
+            bo = e.run_output
+            result_dict = (
+                bo.result.to_dict()
+                if bo is not None and hasattr(bo.result, "to_dict")
+                else {"outcome": Outcome.FAILURE.value}
+            )
+            run_results[run.id] = {**result_dict, "error": str(e)}
+            outcome = Outcome.FAILURE
+            continue
         except Exception as e:  # noqa: BLE001 — per-run isolation
             # single-run: the exception IS the task error (existing path).
             # multi-[[runs]]: record it on THIS run and keep going — the

@@ -203,6 +203,9 @@ class Task:
             # flight-recorder summary (docs/OBSERVABILITY.md) — the
             # events themselves are served by `tg trace` / GET /trace
             "trace": journal.get("trace", {}),
+            # run health plane (docs/OBSERVABILITY.md "Run health
+            # plane"): rule verdicts + bounded breach records
+            "slo": journal.get("slo", {}),
             "events": journal.get("events", {}),
         }
 

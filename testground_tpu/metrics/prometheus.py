@@ -17,10 +17,16 @@ Three tiers of gauges/counters, all derived from the engine's task store
 - **perf gauges** — the run performance ledger
   (``journal["sim"]["perf"]``): throughput, compile split, HBM
   high-water mark.
+- **SLO gauges** — the run health plane (``journal["slo"]``,
+  docs/OBSERVABILITY.md "Run health plane"): per-rule breach counts,
+  thresholds and last-observed values, plus a per-task failed flag.
 
 Per-task label cardinality is bounded by ``per_task_limit`` (the daemon
-exports series for its most recent tasks only); the aggregate
-``tg_tasks`` counts always cover the full task store.
+exports series for its most recent tasks only — configurable via
+``[daemon] metrics_task_limit``); the aggregate ``tg_tasks`` counts
+always cover the full task store, and truncation is never silent:
+``tg_scrape_tasks_total`` / ``tg_scrape_tasks_elided`` report how much
+of the store this scrape's per-task series covered.
 """
 
 from __future__ import annotations
@@ -103,8 +109,27 @@ def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
             count,
         )
 
+    # truncation is NEVER silent (the render_prometheus contract): a
+    # scraper can alert on elided > 0 instead of trusting an invisibly
+    # windowed task list
+    total = len(tasks)
     if per_task_limit is not None:
         tasks = tasks[:per_task_limit]
+    exp.add(
+        "tg_scrape_tasks_total",
+        "gauge",
+        "Tasks in the daemon's store at scrape time.",
+        {},
+        total,
+    )
+    exp.add(
+        "tg_scrape_tasks_elided",
+        "gauge",
+        "Tasks whose per-task series were elided from this scrape by the "
+        "per-task cardinality bound ([daemon] metrics_task_limit).",
+        {},
+        total - len(tasks),
+    )
     for t in tasks:
         ident = {"task": t.id, "plan": t.plan, "case": t.case}
         result = t.result if isinstance(t.result, dict) else {}
@@ -131,6 +156,57 @@ def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
             result.get("journal") if isinstance(result.get("journal"), dict)
             else {}
         )
+        # run health plane (journal["slo"]): per-rule verdicts — checked
+        # BEFORE the sim-block gate because a fail-fast SLO run archives
+        # its journal through the typed-error path too
+        slo = journal.get("slo") if isinstance(journal.get("slo"), dict) else {}
+        rules = slo.get("rules") if isinstance(slo.get("rules"), list) else []
+        if rules:
+            exp.add(
+                "tg_slo_rules",
+                "gauge",
+                "SLO rules the run declared (run health plane).",
+                ident,
+                len(rules),
+            )
+            exp.add(
+                "tg_slo_failed",
+                "gauge",
+                "1 when a severity=fail SLO breached and canceled the run.",
+                ident,
+                1 if slo.get("error") else 0,
+            )
+            for r in rules:
+                if not isinstance(r, dict):
+                    continue
+                rident = {
+                    **ident,
+                    "rule": r.get("name", "?"),
+                    "metric": r.get("metric", "?"),
+                    "severity": r.get("severity", "warn"),
+                }
+                exp.add(
+                    "tg_slo_breaches_total",
+                    "counter",
+                    "Breaching evaluations of one SLO rule across the run.",
+                    rident,
+                    r.get("breaches"),
+                )
+                exp.add(
+                    "tg_slo_threshold",
+                    "gauge",
+                    "Declared threshold of one SLO rule.",
+                    rident,
+                    r.get("threshold"),
+                )
+                exp.add(
+                    "tg_slo_observed",
+                    "gauge",
+                    "Last observed value of one SLO rule's metric (the "
+                    "final evaluation before the run ended).",
+                    rident,
+                    r.get("last_observed"),
+                )
         sim = journal.get("sim") if isinstance(journal.get("sim"), dict) else {}
         if not sim:
             continue

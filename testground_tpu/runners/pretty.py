@@ -52,11 +52,12 @@ def render_telemetry_summary(stats: dict) -> str:
     sim = stats.get("sim") or {}
     tele = stats.get("telemetry") or {}
     trace = stats.get("trace") or {}
+    slo = stats.get("slo") or {}
     events = stats.get("events") or {}
     ident = f"{stats.get('plan', '?')}:{stats.get('case', '?')}"
     if stats.get("task_id"):
         ident += f"  ({stats['task_id']})"
-    if not (sim or tele or trace or events):
+    if not (sim or tele or trace or slo or events):
         # e.g. a build task, or a run that recorded nothing
         return f"task  {ident}\nno telemetry recorded for this task"
     rows: list[tuple[str, str]] = [("task", ident)]
@@ -168,6 +169,31 @@ def render_telemetry_summary(stats: dict) -> str:
         if trace.get("truncated"):
             shown += f" — {trace['truncated']} past the export cap"
         rows.append(("trace", shown))
+    # run health plane (docs/OBSERVABILITY.md "Run health plane"): one
+    # verdict line per rule — "ok" or the breach count with the worst
+    # observed value, so a soak's health reads at a glance
+    for r in slo.get("rules") or []:
+        if not isinstance(r, dict):
+            continue
+        rule = (
+            f"{r.get('metric', '?')} {r.get('op', '?')} "
+            f"{_fmt(r.get('threshold'), '{:g}')}"
+        )
+        n = _num(r.get("breaches"), 0)
+        if n:
+            verdict = (
+                f"{rule} — {_fmt_count(n)} breach(es) "
+                f"[{r.get('severity', 'warn')}], worst "
+                f"{_fmt(r.get('worst'), '{:g}')} "
+                f"(ticks {r.get('first_tick', '?')}–{r.get('last_tick', '?')})"
+            )
+        else:
+            verdict = rule + " — ok"
+            if _num(r.get("last_observed")) is not None:
+                verdict += f" (last {_fmt(r.get('last_observed'), '{:g}')})"
+        rows.append((f"slo {r.get('name', '?')}", verdict))
+    if slo.get("error"):
+        rows.append(("slo FAILED", str(slo["error"])))
     for gid, counts in sorted(events.items()):
         if isinstance(counts, dict):
             shown = ", ".join(

@@ -1327,6 +1327,7 @@ class SimProgram:
         on_chunk: Callable[[int], None] | None = None,
         observer: Callable[[int, "SimCarry"], None] | None = None,
         telemetry_cb: Callable[[np.ndarray], None] | None = None,
+        lat_hist_cb: Callable[[np.ndarray], None] | None = None,
         trace_cb: Callable[[np.ndarray], None] | None = None,
         chunk_timeout: float = 0.0,
         on_stall: Callable[[int, int], None] | None = None,
@@ -1351,7 +1352,10 @@ class SimProgram:
         The same applies to ``trace_cb(block)`` — each chunk's
         ``[chunk, R, 5]`` flight-recorder block (trace-plan programs
         only) — and to the per-chunk latency-histogram deltas, which the
-        loop accumulates into ``results()['lat_hist']``.
+        loop accumulates into ``results()['lat_hist']`` and hands to
+        ``lat_hist_cb(delta)`` (the run health plane's per-chunk feed,
+        ``sim/slo.py``) as host numpy: the delta was already read for
+        the accumulator, so the callback adds no device traffic.
 
         ``chunk_timeout`` > 0 arms the per-chunk wall-clock watchdog
         (see :meth:`_dispatch_watched`); ``on_stall(last_tick, chunk)``
@@ -1451,7 +1455,10 @@ class SimProgram:
             if self.telemetry:
                 if telemetry_cb is not None:
                     telemetry_cb(np.asarray(out[2]))
-                lat_hist_acc += np.asarray(out[3], dtype=np.int64)
+                delta = np.asarray(out[3], dtype=np.int64)
+                lat_hist_acc += delta
+                if lat_hist_cb is not None:
+                    lat_hist_cb(delta)
                 block_idx = 4
             if self.trace is not None and trace_cb is not None:
                 trace_cb(np.asarray(out[block_idx]))

@@ -271,6 +271,45 @@ def trace_specs_of(run_groups, global_trace=None) -> dict:
     return {k: v for k, v in specs.items() if v}
 
 
+def slo_specs_of(run_groups, global_slo=None) -> dict:
+    """Collect the declared SLO tables for plan lowering:
+    {group_id: [raw slo dicts]}, with run-global declarations
+    (``[[global.run.slo]]``) under the ``""`` key — the exact shape of
+    :func:`fault_specs_of`. Plain JSON-serializable data: hashed into
+    the precompile BuildKey (the SLO plane never shapes the program, but
+    the build marker records the full run declaration) and kept out of
+    the cohort broadcast (cohorts run SLO-free — see the executor
+    gate)."""
+    specs = {
+        g.id: [dict(s) for s in (getattr(g, "slo", None) or [])]
+        for g in run_groups
+    }
+    specs[""] = [dict(s) for s in (global_slo or [])]
+    return {k: v for k, v in specs.items() if v}
+
+
+class _SloRunCancel:
+    """OR-composition of the task cancel event with a run-local signal —
+    the run health plane's fail path. The chunk loop stops when either
+    is set. ``set()`` keeps TASK-level semantics: the stall watchdog
+    (and anything else holding the loop's cancel object) calls it, and
+    declaring an SLO must not weaken a stall from a task cancel to a
+    run-local one. The SLO evaluator cancels through ``run_local``
+    instead — an SLO breach fails ONE run, the task was not canceled by
+    the operator, and a multi-``[[runs]]`` composition keeps executing
+    its later runs."""
+
+    def __init__(self, task_cancel: threading.Event):
+        self._task = task_cancel
+        self.run_local = threading.Event()
+
+    def set(self) -> None:
+        self._task.set()
+
+    def is_set(self) -> bool:
+        return self.run_local.is_set() or self._task.is_set()
+
+
 def _parse_hosts(raw) -> tuple[str, ...]:
     """Normalize the additional_hosts config: a TOML list, or a
     comma-separated string like the reference's ADDITIONAL_HOSTS env var
@@ -371,6 +410,10 @@ def _cohort_job_spec(
         "transport": str(transport),
         "faults": faults,
         "trace": {},
+        # cohorts run SLO-free (the telemetry plane the rules evaluate
+        # is off under a cohort) — kept explicit, like trace, so a
+        # future symmetric design cannot silently desync the followers
+        "slo": [],
     }
 
 
@@ -599,6 +642,44 @@ def _execute_sim_run(
             job.run_id,
         )
         telemetry_on = False
+    # run health plane (docs/OBSERVABILITY.md "Run health plane"): lower
+    # the composition's [[run.slo]] tables into a static SloPlan. NOT a
+    # program-shaping option — evaluation is host-side over the chunk
+    # blocks the loop already flushes (jaxpr-identical with and without
+    # SLOs, pinned by tests) — but every metric derives from the
+    # telemetry plane, so rules without telemetry are refused loudly
+    # rather than silently unenforced.
+    from .slo import build_slo_plan
+
+    slo_specs = slo_specs_of(job.groups, getattr(job, "slo", None))
+    slo_plan = build_slo_plan(groups, slo_specs)
+    if slo_plan is not None and getattr(cfg, "coordinator_address", ""):
+        ow.warn(
+            "sim:jax %s: SLO assertions disabled for the cohort config "
+            "(the telemetry plane they evaluate is leader-local and runs "
+            "off under a cohort)",
+            job.run_id,
+        )
+        slo_plan = None
+    if slo_plan is not None and not telemetry_on:
+        raise ValueError(
+            f"composition declares {slo_plan.count} SLO rule(s) but the "
+            "telemetry plane is off"
+            + (
+                " (disable_metrics = true wins over everything)"
+                if job.disable_metrics
+                else " — set telemetry = true in the runner config "
+                "(--run-cfg telemetry=true)"
+            )
+            + "; refusing to run with unenforceable SLOs"
+        )
+    if slo_plan is not None:
+        ow.infof(
+            "sim:jax %s: run health plane armed — %s",
+            job.run_id,
+            slo_plan.summary(),
+        )
+
     if bool(getattr(cfg, "nan_guard", False)) and getattr(
         cfg, "coordinator_address", ""
     ):
@@ -705,10 +786,38 @@ def _execute_sim_run(
     t0 = time.monotonic()
     last_report = [t0]
 
+    # bounded SLO warn lines: the first breach of each rule (and every
+    # fail) reaches the task log; the full record stream is the jsonl
+    slo_warned: set[str] = set()
+
     def on_chunk(ticks: int) -> None:
         spans.point(
             "chunk", ticks=ticks, wall_secs=round(time.monotonic() - t0, 6)
         )
+        if slo_eval is not None:
+            # evaluate AFTER the loop delivered this chunk's telemetry
+            # rows and latency delta (telemetry_cb/lat_hist_cb run
+            # before on_chunk in SimProgram.run)
+            for breach in slo_eval.evaluate():
+                first = breach["rule"] not in slo_warned
+                slo_warned.add(breach["rule"])
+                if first or breach["severity"] == "fail":
+                    spans.point("slo_breach", **breach)
+                    ow.warn(
+                        "sim:jax %s: SLO breach (%s): %s — %s = %g "
+                        "violates %s %g at tick %d%s",
+                        job.run_id,
+                        breach["severity"],
+                        breach["rule"],
+                        breach["metric"],
+                        breach["observed"],
+                        breach["op"],
+                        breach["threshold"],
+                        breach["tick"],
+                        " — canceling the run"
+                        if breach["severity"] == "fail"
+                        else "",
+                    )
         now = time.monotonic()
         if now - last_report[0] >= 5.0:
             last_report[0] = now
@@ -761,6 +870,30 @@ def _execute_sim_run(
         if trace_plan is not None
         else None
     )
+    # Run health plane evaluator: fed per chunk from the decoded
+    # telemetry rows and the latency-histogram deltas the loop already
+    # reads; breach records stream to sim_slo.jsonl as they fire. A
+    # fail-severity breach sets the run-LOCAL cancel (the task event
+    # stays untouched — see _SloRunCancel).
+    slo_eval = None
+    slo_cancel = None
+    if slo_plan is not None:
+        from .slo import SLO_FILE, SloEvaluator
+
+        slo_cancel = _SloRunCancel(cancel)
+        slo_eval = SloEvaluator(
+            slo_plan,
+            groups,
+            cfg.tick_ms,
+            cfg.chunk,
+            ident=row_ident,
+            path=(
+                os.path.join(run_dir, SLO_FILE)
+                if run_dir is not None
+                else None
+            ),
+            cancel=slo_cancel.run_local,
+        )
     # Performance ledger (docs/OBSERVABILITY.md "Performance ledger"):
     # host-side only — the program is untouched — so the gate is NOT
     # program-shaping; it still follows the telemetry plane's rules
@@ -822,6 +955,10 @@ def _execute_sim_run(
         from .distributed import CohortCancel
 
         run_cancel = CohortCancel(cancel)
+    elif slo_cancel is not None:
+        # the SLO fail path cancels the RUN (chunk loop) without setting
+        # the task-level event — see _SloRunCancel
+        run_cancel = slo_cancel
     else:
         run_cancel = cancel
 
@@ -844,6 +981,17 @@ def _execute_sim_run(
             last_tick,
         )
 
+    # the telemetry writer decodes each chunk's rows anyway; when the
+    # run health plane is armed the same decoded rows feed the evaluator
+    # (one decode, two consumers — no second pass over the block)
+    if slo_eval is not None:
+
+        def _tele_cb(block):
+            slo_eval.on_rows(tele_writer.on_block(block))
+
+    else:
+        _tele_cb = tele_writer.on_block if tele_writer else None
+
     def _run():
         return prog.run(
             seed=cfg.seed,
@@ -851,7 +999,8 @@ def _execute_sim_run(
             cancel=run_cancel,
             on_chunk=on_chunk,
             observer=recorder.observe if recorder.enabled else None,
-            telemetry_cb=tele_writer.on_block if tele_writer else None,
+            telemetry_cb=_tele_cb,
+            lat_hist_cb=slo_eval.on_lat_delta if slo_eval else None,
             trace_cb=trace_writer.on_block if trace_writer else None,
             chunk_timeout=float(getattr(cfg, "chunk_timeout_secs", 0.0)),
             on_stall=on_stall,
@@ -1041,6 +1190,14 @@ def _execute_sim_run(
         trace_writer.close()
         result.journal["trace"] = trace_writer.journal()
 
+    # ----------------------------------------------- run health plane
+    # journaled under slo (rule verdicts + bounded breach records; the
+    # full record stream is sim_slo.jsonl) — present whenever rules were
+    # armed, breaches or not, so "no breaches" is a recorded verdict
+    if slo_eval is not None:
+        slo_eval.close()
+        result.journal["slo"] = slo_eval.journal()
+
     # ---------------------------------------------- performance ledger
     # journaled under sim.perf (below) — the block every perf PR and the
     # bench trajectory report against; one task-log line so the
@@ -1217,6 +1374,24 @@ def _execute_sim_run(
     if cancel.is_set():
         result.outcome = Outcome.CANCELED
     spans.end("collect")
+    # fail-severity SLO breach: the chunk loop was canceled (run-local,
+    # the task event untouched); the fully-assembled result — journal
+    # included — rides the typed error so the supervisor archives the
+    # failed soak's complete telemetry record (docs/OBSERVABILITY.md
+    # "Run health plane"). An operator kill wins: a task-canceled run
+    # stays CANCELED, not an SLO failure.
+    if (
+        slo_eval is not None
+        and slo_eval.fatal is not None
+        and not cancel.is_set()
+    ):
+        from .slo import SloBreachError
+
+        result.outcome = Outcome.FAILURE
+        err = SloBreachError(slo_eval.fatal)
+        result.journal["slo"]["error"] = str(err)
+        err.run_output = RunOutput(run_id=job.run_id, result=result)
+        raise err
     spans.end("run", outcome=result.outcome.value, ticks=res["ticks"])
     return RunOutput(run_id=job.run_id, result=result)
 
@@ -1399,7 +1574,10 @@ class _SimTelemetryWriter:
             except OSError:
                 self.path = None  # observe best-effort, never fail the run
 
-    def on_block(self, block) -> None:
+    def on_block(self, block) -> list:
+        """Decode + stream one chunk's block; returns the decoded rows
+        so the run health plane can evaluate them without a second
+        decode."""
         from .telemetry import rows_from_blocks
 
         rows = rows_from_blocks([block], self.group_ids)
@@ -1419,6 +1597,7 @@ class _SimTelemetryWriter:
                     pass
                 self._f = None
                 self.path = None
+        return rows
 
     def close(self) -> None:
         if self._f is not None:

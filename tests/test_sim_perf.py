@@ -413,7 +413,20 @@ class TestPrometheusRender:
     def test_empty_task_list(self):
         from testground_tpu.metrics.prometheus import render_prometheus
 
-        assert render_prometheus([]).strip() == ""
+        # no task-derived series — but the scrape-coverage gauges are
+        # always present (truncation is never silent, even at zero)
+        text = render_prometheus([])
+        assert "tg_scrape_tasks_total 0" in text
+        assert "tg_scrape_tasks_elided 0" in text
+        lines = [
+            ln
+            for ln in text.splitlines()
+            if ln and not ln.startswith("#")
+        ]
+        assert lines == [
+            "tg_scrape_tasks_total 0",
+            "tg_scrape_tasks_elided 0",
+        ]
 
     def test_per_task_limit_bounds_series_not_counts(self):
         from testground_tpu.metrics.prometheus import render_prometheus

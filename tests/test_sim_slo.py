@@ -1,0 +1,698 @@
+"""Run health plane (docs/OBSERVABILITY.md "Run health plane"): SLO
+table validation, plan lowering, the per-chunk evaluator's metric math
+and windowing, warn-vs-fail behavior, the zero-overhead contract
+(program untouched, host-sync count unchanged), and the end-to-end
+journal / jsonl / stats / Prometheus surfaces."""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from testground_tpu.config import EnvConfig
+from testground_tpu.sim.slo import (
+    SLO_FILE,
+    SloEvaluator,
+    SloBreachError,
+    build_slo_plan,
+    parse_slo,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def gspec(gid, count):
+    """The id/count view the slo plane needs of a GroupSpec."""
+    return types.SimpleNamespace(id=gid, count=count)
+
+
+# ------------------------------------------------------------- validation
+
+
+class TestParse:
+    def test_minimal_rule(self):
+        r = parse_slo(
+            {"metric": "drop_rate", "op": "<=", "threshold": 0.01}
+        )
+        assert r.metric == "drop_rate"
+        assert r.severity == "warn"  # default
+        assert r.window_ticks == 0  # whole run
+        assert r.name  # auto-named
+
+    def test_unknown_key_refused(self):
+        with pytest.raises(ValueError, match="unknown key"):
+            parse_slo(
+                {"metric": "drop_rate", "op": "<", "threshold": 1, "oops": 2}
+            )
+
+    def test_unknown_metric_refused(self):
+        with pytest.raises(ValueError, match="unknown slo metric"):
+            parse_slo({"metric": "p99", "op": "<", "threshold": 1})
+
+    def test_unknown_op_refused(self):
+        with pytest.raises(ValueError, match="unknown slo op"):
+            parse_slo({"metric": "drop_rate", "op": "!=", "threshold": 1})
+
+    def test_threshold_required_and_numeric(self):
+        with pytest.raises(ValueError, match="threshold"):
+            parse_slo({"metric": "drop_rate", "op": "<"})
+        with pytest.raises(ValueError, match="threshold"):
+            parse_slo(
+                {"metric": "drop_rate", "op": "<", "threshold": "lots"}
+            )
+
+    def test_bad_severity_and_window_refused(self):
+        with pytest.raises(ValueError, match="severity"):
+            parse_slo(
+                {
+                    "metric": "drop_rate",
+                    "op": "<",
+                    "threshold": 1,
+                    "severity": "panic",
+                }
+            )
+        with pytest.raises(ValueError, match="window_ticks"):
+            parse_slo(
+                {
+                    "metric": "drop_rate",
+                    "op": "<",
+                    "threshold": 1,
+                    "window_ticks": -5,
+                }
+            )
+
+    def test_group_on_run_global_metric_refused(self):
+        """A silently-ignored scope would assert something other than
+        what was written — refuse it."""
+        with pytest.raises(ValueError, match="group"):
+            parse_slo(
+                {
+                    "metric": "drop_rate",
+                    "op": "<",
+                    "threshold": 1,
+                    "group": "clients",
+                }
+            )
+
+    def test_group_scoping_latency_only(self):
+        """[[groups.run.slo]] declarations default latency metrics to
+        their own group (the faults scoping rule); run-global metrics
+        refuse a group-level placement — a silently run-global rule
+        would assert something other than what the operator wrote."""
+        lat = parse_slo(
+            {"metric": "latency_p99_ticks", "op": "<", "threshold": 8},
+            default_group="clients",
+        )
+        assert lat.group == "clients"
+        with pytest.raises(ValueError, match="global.run.slo"):
+            parse_slo(
+                {"metric": "drop_rate", "op": "<", "threshold": 0.5},
+                default_group="clients",
+            )
+
+    def test_window_ticks_must_be_whole_number(self):
+        for bad in (512.7, True, "soon"):
+            with pytest.raises(ValueError, match="window_ticks"):
+                parse_slo(
+                    {
+                        "metric": "drop_rate",
+                        "op": "<",
+                        "threshold": 1,
+                        "window_ticks": bad,
+                    }
+                )
+
+
+class TestBuildPlan:
+    def test_nothing_declared_lowers_to_none(self):
+        assert build_slo_plan([gspec("a", 4)], {}) is None
+        assert build_slo_plan([gspec("a", 4)], {"a": []}) is None
+
+    def test_unknown_group_refused(self):
+        with pytest.raises(ValueError, match="unknown group"):
+            build_slo_plan(
+                [gspec("a", 4)],
+                {
+                    "": [
+                        {
+                            "metric": "latency_p99_ticks",
+                            "op": "<",
+                            "threshold": 8,
+                            "group": "ghost",
+                        }
+                    ]
+                },
+            )
+
+    def test_duplicate_names_refused(self):
+        tbl = {"name": "x", "metric": "drop_rate", "op": "<", "threshold": 1}
+        with pytest.raises(ValueError, match="duplicate"):
+            build_slo_plan([gspec("a", 4)], {"": [dict(tbl), dict(tbl)]})
+
+    def test_plan_shape(self):
+        plan = build_slo_plan(
+            [gspec("a", 4)],
+            {
+                "": [
+                    {
+                        "metric": "drop_rate",
+                        "op": "<",
+                        "threshold": 0.1,
+                        "window_ticks": 100,
+                    }
+                ],
+                "a": [
+                    {
+                        "metric": "latency_p95_ticks",
+                        "op": "<",
+                        "threshold": 8,
+                        "severity": "fail",
+                    }
+                ],
+            },
+        )
+        assert plan.count == 2
+        assert plan.has_fail()
+        assert plan.max_window_ticks() == 100
+        assert "drop_rate" in plan.summary()
+
+
+# -------------------------------------------------------------- evaluator
+
+
+def make_eval(rules, groups=None, chunk=16, path=None, cancel=None):
+    groups = groups or [gspec("g0", 8)]
+    plan = build_slo_plan(groups, {"": [dict(r) for r in rules]})
+    return SloEvaluator(
+        plan, groups, tick_ms=1.0, chunk=chunk, path=path, cancel=cancel
+    )
+
+
+def rows_for(n, start=0, **counters):
+    """n telemetry rows with the given per-tick counter values."""
+    return [
+        {
+            "tick": start + i,
+            "delivered": counters.get("delivered", 0),
+            "sent": counters.get("sent", 0),
+            "dropped": counters.get("dropped", 0),
+            "fault_dropped": counters.get("fault_dropped", 0),
+            "faults_crashed": counters.get("faults_crashed", 0),
+            "faults_restarted": counters.get("faults_restarted", 0),
+        }
+        for i in range(n)
+    ]
+
+
+class TestEvaluator:
+    def test_delivered_per_tick_breach_and_recovery(self):
+        ev = make_eval(
+            [
+                {
+                    "name": "rate",
+                    "metric": "delivered_per_tick",
+                    "op": ">=",
+                    "threshold": 2.0,
+                    "window_ticks": 16,
+                }
+            ]
+        )
+        ev.on_rows(rows_for(16, delivered=1))  # 1/tick < 2 → breach
+        b = ev.evaluate()
+        assert len(b) == 1 and b[0]["rule"] == "rate"
+        assert b[0]["observed"] == pytest.approx(1.0)
+        ev.on_rows(rows_for(16, start=16, delivered=4))  # windowed: 4/tick
+        assert ev.evaluate() == []
+        j = ev.journal()
+        assert j["breaches"] == 1
+        assert j["rules"][0]["last_observed"] == pytest.approx(4.0)
+
+    def test_windowed_rule_waits_for_a_full_window(self):
+        """The Prometheus for-clause rule: a windowed assertion is not
+        judged until the run has produced a FULL window of history —
+        warmup noise in chunk 1 must not fail a healthy soak."""
+        ev = make_eval(
+            [
+                {
+                    "name": "rate",
+                    "metric": "delivered_per_tick",
+                    "op": ">=",
+                    "threshold": 2.0,
+                    "window_ticks": 48,
+                    "severity": "fail",
+                }
+            ]
+        )
+        ev.on_rows(rows_for(16, delivered=1))  # 16 < 48 ticks of history
+        assert ev.evaluate() == []
+        ev.on_rows(rows_for(16, start=16, delivered=1))
+        assert ev.evaluate() == []  # still partial (32 < 48)
+        assert ev.fatal is None
+        ev.on_rows(rows_for(16, start=32, delivered=1))
+        b = ev.evaluate()
+        assert len(b) == 1  # full window → judged
+        # inclusive clamped tick bounds of the evidence window
+        assert b[0]["window"] == [0, 47]
+
+    def test_whole_run_window_is_cumulative(self):
+        ev = make_eval(
+            [
+                {
+                    "metric": "delivered_per_tick",
+                    "op": ">=",
+                    "threshold": 2.0,
+                }
+            ]
+        )
+        ev.on_rows(rows_for(16, delivered=1))
+        assert len(ev.evaluate()) == 1
+        ev.on_rows(rows_for(16, start=16, delivered=4))
+        # cumulative mean = (16 + 64)/32 = 2.5 → holds
+        assert ev.evaluate() == []
+
+    def test_drop_rate_skips_empty_window(self):
+        ev = make_eval(
+            [{"metric": "drop_rate", "op": "<", "threshold": 0.1}]
+        )
+        ev.on_rows(rows_for(8))  # zero sends → no evidence, no breach
+        assert ev.evaluate() == []
+        ev.on_rows(rows_for(8, start=8, sent=10, dropped=2))
+        b = ev.evaluate()
+        assert len(b) == 1
+        assert b[0]["observed"] == pytest.approx(0.2)
+
+    def test_crashed_fraction_is_state_not_window(self):
+        ev = make_eval(
+            [
+                {
+                    "metric": "crashed_fraction",
+                    "op": "<",
+                    "threshold": 0.2,
+                    "window_ticks": 16,
+                }
+            ]
+        )
+        rows = rows_for(16)
+        rows[5]["faults_crashed"] = 2  # 2/8 = 0.25 crashed
+        ev.on_rows(rows)
+        assert len(ev.evaluate()) == 1
+        # the window moved on but nobody restarted: still crashed
+        ev.on_rows(rows_for(16, start=16))
+        assert len(ev.evaluate()) == 1
+        rows = rows_for(16, start=32)
+        rows[0]["faults_restarted"] = 2  # recovery
+        ev.on_rows(rows)
+        assert ev.evaluate() == []
+
+    def test_latency_percentile_per_group_and_aggregate(self):
+        from testground_tpu.sim.telemetry import LATENCY_BINS
+
+        groups = [gspec("a", 4), gspec("b", 4)]
+        plan = build_slo_plan(
+            groups,
+            {
+                "a": [
+                    {
+                        "name": "a-p99",
+                        "metric": "latency_p99_ticks",
+                        "op": "<",
+                        "threshold": 4.0,
+                    }
+                ],
+                "": [
+                    {
+                        "name": "all-p50",
+                        "metric": "latency_p50_ticks",
+                        "op": "<",
+                        "threshold": 100.0,
+                    }
+                ],
+            },
+        )
+        ev = SloEvaluator(plan, groups, tick_ms=1.0, chunk=16)
+        # group a: everything in bin 3 ([8, 16) ticks) → p99 ≥ 8 breaches
+        # the < 4 assertion; group b: bin 0 → aggregate p50 stays low
+        hist = np.zeros((2, LATENCY_BINS), np.int64)
+        hist[0, 3] = 50
+        hist[1, 0] = 50
+        ev.on_rows(rows_for(16, delivered=6))
+        ev.on_lat_delta(hist)
+        breaches = ev.evaluate()
+        assert [b["rule"] for b in breaches] == ["a-p99"]
+        assert breaches[0]["observed"] >= 8.0
+        assert breaches[0]["group"] == "a"
+
+    def test_latency_skips_zero_delivery_window(self):
+        ev = make_eval(
+            [{"metric": "latency_p99_ticks", "op": "<", "threshold": 1.0}]
+        )
+        ev.on_rows(rows_for(16))
+        assert ev.evaluate() == []  # no deliveries → no evidence
+
+    def test_fail_severity_sets_cancel_and_fatal(self):
+        import threading
+
+        cancel = threading.Event()
+        ev = make_eval(
+            [
+                {
+                    "name": "warny",
+                    "metric": "delivered_per_tick",
+                    "op": ">=",
+                    "threshold": 100.0,
+                },
+                {
+                    "name": "fatal",
+                    "metric": "drop_rate",
+                    "op": "<",
+                    "threshold": 0.1,
+                    "severity": "fail",
+                },
+            ],
+            cancel=cancel,
+        )
+        ev.on_rows(rows_for(16, delivered=1, sent=10, dropped=5))
+        breaches = ev.evaluate()
+        assert {b["rule"] for b in breaches} == {"warny", "fatal"}
+        assert ev.fatal is not None and ev.fatal["rule"] == "fatal"
+        assert cancel.is_set()
+        err = SloBreachError(ev.fatal)
+        assert "fatal" in str(err) and "drop_rate" in str(err)
+
+    def test_warn_severity_never_cancels(self):
+        import threading
+
+        cancel = threading.Event()
+        ev = make_eval(
+            [
+                {
+                    "metric": "delivered_per_tick",
+                    "op": ">=",
+                    "threshold": 100.0,
+                }
+            ],
+            cancel=cancel,
+        )
+        ev.on_rows(rows_for(16, delivered=1))
+        assert len(ev.evaluate()) == 1
+        assert ev.fatal is None and not cancel.is_set()
+
+    def test_jsonl_records_conserve_journal_total(self, tmp_path):
+        path = str(tmp_path / SLO_FILE)
+        ev = make_eval(
+            [
+                {
+                    "metric": "delivered_per_tick",
+                    "op": ">=",
+                    "threshold": 100.0,
+                }
+            ],
+            path=path,
+        )
+        for i in range(5):
+            ev.on_rows(rows_for(16, start=16 * i, delivered=1))
+            ev.evaluate()
+        ev.close()
+        records = [json.loads(l) for l in open(path)]
+        j = ev.journal()
+        assert len(records) == ev.records_written == j["breaches"] == 5
+        assert j["rules"][0]["breaches"] == 5
+        assert j["file"] == SLO_FILE
+        for r in records:
+            assert r["metric"] == "delivered_per_tick"
+            assert r["observed"] == pytest.approx(1.0)
+
+
+# ------------------------------------------------------------- run cancel
+
+
+class TestSloRunCancel:
+    """The SLO fail path cancels the RUN; everything else holding the
+    loop's cancel object (the stall watchdog above all) keeps TASK-level
+    semantics — declaring an SLO must not weaken a stall."""
+
+    def test_set_keeps_task_level_semantics(self):
+        import threading
+
+        from testground_tpu.sim.executor import _SloRunCancel
+
+        task = threading.Event()
+        rc = _SloRunCancel(task)
+        rc.set()  # the stall watchdog's call on the loop's cancel
+        assert task.is_set() and rc.is_set()
+
+    def test_slo_fail_path_is_run_local(self):
+        import threading
+
+        from testground_tpu.sim.executor import _SloRunCancel
+
+        task = threading.Event()
+        rc = _SloRunCancel(task)
+        rc.run_local.set()  # the evaluator's cancel target
+        assert rc.is_set()
+        assert not task.is_set()  # later [[runs]] still execute
+
+
+# ----------------------------------------------------------- zero overhead
+
+
+class TestZeroOverhead:
+    def test_slo_never_reaches_the_program(self):
+        """The SLO plane is host-side by contract: the ONE SimProgram
+        construction site takes no slo parameter — adding one would be
+        a program-shaping change and must re-pin this contract (cohort
+        broadcast + BuildKey + jaxpr tests, like telemetry/faults)."""
+        import inspect
+
+        from testground_tpu.sim.executor import make_sim_program
+
+        assert "slo" not in inspect.signature(make_sim_program).parameters
+
+    def test_same_program_and_sync_count_with_evaluator_attached(
+        self, monkeypatch
+    ):
+        """Jaxpr-identical and zero extra host syncs: attaching the SLO
+        evaluator's callbacks (telemetry rows + latency deltas) to a
+        telemetry run changes neither the traced chunk program nor the
+        per-chunk done-poll count."""
+        import jax
+
+        from testground_tpu.api import RunGroup
+        from testground_tpu.sim import engine as engine_mod
+        from testground_tpu.sim.engine import SimProgram, build_groups
+        from testground_tpu.sim.executor import load_sim_testcases
+        from testground_tpu.sim.telemetry import rows_from_blocks
+
+        calls = {"n": 0}
+        real = engine_mod._poll_done
+
+        def counting(done):
+            calls["n"] += 1
+            return real(done)
+
+        monkeypatch.setattr(engine_mod, "_poll_done", counting)
+
+        def build():
+            tc = load_sim_testcases(os.path.join(PLANS, "network"))[
+                "ping-pong"
+            ]()
+            return SimProgram(
+                tc,
+                build_groups(
+                    [RunGroup(id="g0", instances=4, parameters={})]
+                ),
+                chunk=16,
+                telemetry=True,
+            )
+
+        def run(with_slo):
+            calls["n"] = 0
+            prog = build()
+            jaxpr = str(jax.make_jaxpr(prog._chunk_step)(prog.init_carry()))
+            ev = None
+            if with_slo:
+                ev = make_eval(
+                    [
+                        {
+                            "metric": "delivered_per_tick",
+                            "op": ">=",
+                            "threshold": 1e9,  # breaches every chunk
+                        }
+                    ]
+                )
+                res = prog.run(
+                    max_ticks=512,
+                    telemetry_cb=lambda b: ev.on_rows(
+                        rows_from_blocks([b], ("g0",))
+                    ),
+                    lat_hist_cb=ev.on_lat_delta,
+                    on_chunk=lambda ticks: ev.evaluate(),
+                )
+            else:
+                res = prog.run(max_ticks=512)
+            return jaxpr, calls["n"], res["ticks"], ev
+
+        jaxpr_off, syncs_off, ticks_off, _ = run(False)
+        jaxpr_on, syncs_on, ticks_on, ev = run(True)
+        assert jaxpr_on == jaxpr_off  # program untouched
+        assert ticks_on == ticks_off
+        assert syncs_on == syncs_off  # ZERO extra host syncs
+        assert ev.journal()["breaches"] > 0  # yet every chunk evaluated
+
+
+# ------------------------------------------------------------- end to end
+
+
+@pytest.fixture()
+def sim_engine(tg_home):
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.engine import Engine, EngineConfig
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    env = EnvConfig.load()
+    e = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    e.start_workers()
+    yield e
+    e.stop()
+
+
+def run_sim_slo(engine, slo, telemetry=True, plan="network", case="ping-pong"):
+    import time
+
+    from testground_tpu.api import (
+        Composition,
+        Global,
+        Group,
+        Instances,
+        RunParams,
+        TestPlanManifest,
+        generate_default_run,
+    )
+    from testground_tpu.engine import State
+
+    comp = Composition(
+        global_=Global(
+            plan=plan,
+            case=case,
+            builder="sim:plan",
+            runner="sim:jax",
+            run_config={"telemetry": telemetry, "chunk": 16},
+            run=RunParams(slo=[dict(s) for s in slo]),
+        ),
+        groups=[Group(id="all", instances=Instances(count=4))],
+    )
+    comp = generate_default_run(comp)
+    manifest = TestPlanManifest.load_file(
+        os.path.join(PLANS, plan, "manifest.toml")
+    )
+    tid = engine.queue_run(
+        comp, manifest, sources_dir=os.path.join(PLANS, plan)
+    )
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        t = engine.get_task(tid)
+        if t is not None and t.state().state in (
+            State.COMPLETE,
+            State.CANCELED,
+        ):
+            return t
+        time.sleep(0.05)
+    raise TimeoutError(f"task {tid} did not finish")
+
+
+WARN_RULE = {
+    "name": "impossible-rate",
+    "metric": "delivered_per_tick",
+    "op": ">=",
+    "threshold": 1e9,  # breaches every evaluated chunk, deterministically
+    "severity": "warn",
+}
+
+
+class TestEndToEnd:
+    def test_warn_breach_journal_jsonl_stats_prometheus(self, sim_engine):
+        from testground_tpu.engine import Outcome
+        from testground_tpu.metrics.prometheus import render_prometheus
+        from testground_tpu.runners.pretty import render_telemetry_summary
+
+        t = run_sim_slo(sim_engine, [WARN_RULE])
+        assert t.outcome() == Outcome.SUCCESS  # warn records, never kills
+        slo = t.result["journal"]["slo"]
+        rule = slo["rules"][0]
+        assert rule["name"] == "impossible-rate"
+        assert rule["breaches"] > 0
+        assert "error" not in slo
+        # jsonl records conserve the journal total
+        path = os.path.join(
+            sim_engine.env.dirs.outputs(), "network", t.id, SLO_FILE
+        )
+        records = [json.loads(l) for l in open(path)]
+        assert len(records) == slo["breaches"]
+        assert all(r["run"] == t.id for r in records)
+        # stats payload + table carry the verdict
+        payload = t.stats_payload()
+        assert payload["slo"]["breaches"] == slo["breaches"]
+        table = render_telemetry_summary(payload)
+        assert "slo impossible-rate" in table
+        assert "breach(es)" in table
+        # Prometheus: per-rule series + the scrape gauges
+        text = render_prometheus([t], per_task_limit=10)
+        assert 'tg_slo_breaches_total{' in text
+        assert 'rule="impossible-rate"' in text
+        assert "tg_slo_failed{" in text
+        assert "tg_scrape_tasks_total 1" in text
+        assert "tg_scrape_tasks_elided 0" in text
+
+    def test_fail_breach_cancels_with_typed_error_and_keeps_journal(
+        self, sim_engine
+    ):
+        from testground_tpu.engine import Outcome
+
+        t = run_sim_slo(
+            sim_engine, [{**WARN_RULE, "severity": "fail"}]
+        )
+        assert t.outcome() == Outcome.FAILURE
+        err = t.result.get("error", "")
+        assert "SLO breach" in err and "impossible-rate" in err
+        journal = t.result["journal"]
+        assert "SLO breach" in journal["slo"]["error"]
+        # the fail-fast run kept its full telemetry record
+        assert journal["telemetry"]["rows"] > 0
+        assert journal["sim"]["ticks"] > 0
+        # canceled at the first breaching chunk boundary: one chunk of
+        # 16 ticks, not the full ~3-chunk ping-pong run
+        assert journal["sim"]["ticks"] == 16
+        # the task-level record is FAILURE (not CANCELED): the SLO
+        # cancel is run-local
+        assert t.state().state.value == "complete"
+
+    def test_slo_without_telemetry_refuses_loudly(self, sim_engine):
+        from testground_tpu.engine import Outcome
+
+        t = run_sim_slo(sim_engine, [WARN_RULE], telemetry=False)
+        assert t.outcome() == Outcome.FAILURE
+        assert "telemetry" in t.error
+        assert "SLO" in t.error
+
+    def test_no_rules_no_journal_block(self, sim_engine):
+        from tests.test_sim_runner import run_sim
+
+        t = run_sim(
+            sim_engine,
+            "network",
+            "ping-pong",
+            instances=2,
+            run_params={"telemetry": True, "chunk": 16},
+        )
+        assert "slo" not in t.result["journal"]
+        run_dir = os.path.join(
+            sim_engine.env.dirs.outputs(), "network", t.id
+        )
+        assert not os.path.exists(os.path.join(run_dir, SLO_FILE))

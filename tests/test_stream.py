@@ -1,0 +1,423 @@
+"""Live observability streaming (docs/OBSERVABILITY.md "Run health
+plane"): the ``engine/stream.py`` tail generator, the daemon's
+``GET /stream`` route, and ``Client.stream`` — replay-then-close on
+finished tasks, live rows while a writer appends, partial-line safety,
+family filtering, and bearer-token auth."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from testground_tpu.client import Client, DaemonError
+from testground_tpu.config import EnvConfig
+from testground_tpu.daemon import Daemon
+from testground_tpu.engine.stream import stream_task_rows
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+# ---------------------------------------------------------- tail generator
+
+
+class TestTailGenerator:
+    def run_dir(self, tmp_path, task_id="task1"):
+        d = tmp_path / "plan" / task_id
+        d.mkdir(parents=True)
+        return d
+
+    def test_finished_task_replays_full_history_then_closes(self, tmp_path):
+        d = self.run_dir(tmp_path)
+        with open(d / "sim_timeseries.jsonl", "w") as f:
+            for i in range(5):
+                f.write(json.dumps({"tick": i, "delivered": 1}) + "\n")
+        with open(d / "sim_slo.jsonl", "w") as f:
+            f.write(json.dumps({"rule": "r", "tick": 3}) + "\n")
+        rows = list(
+            stream_task_rows(
+                str(tmp_path), "plan", "task1", is_done=lambda: True,
+                follow=True,
+            )
+        )
+        # full history, tagged, then the generator CLOSED (list() returned)
+        tele = [r for r in rows if r["stream"] == "telemetry"]
+        slo = [r for r in rows if r["stream"] == "slo"]
+        assert [r["tick"] for r in tele] == list(range(5))
+        assert len(slo) == 1 and slo[0]["rule"] == "r"
+        assert all(r["run"] == "task1" for r in rows)
+
+    def test_no_follow_is_one_sweep(self, tmp_path):
+        d = self.run_dir(tmp_path)
+        with open(d / "sim_perf.jsonl", "w") as f:
+            f.write(json.dumps({"chunk": 0}) + "\n")
+        rows = list(
+            stream_task_rows(
+                str(tmp_path), "plan", "task1",
+                is_done=lambda: False,  # still running...
+                follow=False,  # ...but a non-follow sweep closes anyway
+            )
+        )
+        assert [r["stream"] for r in rows] == ["perf"]
+
+    def test_concurrent_reader_sees_rows_as_writer_appends(self, tmp_path):
+        """The live contract: a reader following a running task receives
+        rows the writer appended AFTER the stream started, then the
+        stream closes once the task finishes."""
+        d = self.run_dir(tmp_path)
+        path = d / "sim_timeseries.jsonl"
+        path.write_text(json.dumps({"tick": 0}) + "\n")
+        done = threading.Event()
+        got: list = []
+
+        def reader():
+            for row in stream_task_rows(
+                str(tmp_path), "plan", "task1",
+                is_done=done.is_set, follow=True, poll_secs=0.01,
+            ):
+                got.append(row)
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got, "reader saw nothing from the pre-existing file"
+        # writer appends while the reader is live
+        with open(path, "a") as f:
+            f.write(json.dumps({"tick": 1}) + "\n")
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert [r["tick"] for r in got] == [0, 1]
+        done.set()
+        th.join(timeout=5)
+        assert not th.is_alive(), "stream did not close after the task"
+
+    def test_partial_trailing_line_is_never_consumed(self, tmp_path):
+        """A writer mid-``write`` must not produce a torn row: bytes
+        after the last newline stay unread until their newline lands."""
+        d = self.run_dir(tmp_path)
+        path = d / "sim_timeseries.jsonl"
+        path.write_text(json.dumps({"tick": 0}) + "\n" + '{"tick": 1, "de')
+        done = threading.Event()
+        got: list = []
+
+        def reader():
+            for row in stream_task_rows(
+                str(tmp_path), "plan", "task1",
+                is_done=done.is_set, follow=True, poll_secs=0.01,
+            ):
+                got.append(row)
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert [r["tick"] for r in got] == [0]
+        with open(path, "a") as f:  # complete the torn line
+            f.write('livered": 2}\n')
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert got[1] == {
+            "stream": "telemetry", "run": "task1", "tick": 1,
+            "delivered": 2,
+        }
+        done.set()
+        th.join(timeout=5)
+
+    def test_multi_run_dirs_are_tagged(self, tmp_path):
+        for rid in ("task1-a", "task1-b"):
+            d = self.run_dir(tmp_path, rid)
+            (d / "sim_perf.jsonl").write_text(
+                json.dumps({"chunk": 0, "run": rid}) + "\n"
+            )
+        # an unrelated task's dir must NOT leak in
+        other = self.run_dir(tmp_path, "task2")
+        (other / "sim_perf.jsonl").write_text(json.dumps({"chunk": 9}) + "\n")
+        rows = list(
+            stream_task_rows(
+                str(tmp_path), "plan", "task1", is_done=lambda: True,
+            )
+        )
+        assert sorted(r["run"] for r in rows) == ["task1-a", "task1-b"]
+
+    def test_family_filter(self, tmp_path):
+        d = self.run_dir(tmp_path)
+        (d / "sim_perf.jsonl").write_text(json.dumps({"chunk": 0}) + "\n")
+        (d / "sim_timeseries.jsonl").write_text(
+            json.dumps({"tick": 0}) + "\n"
+        )
+        rows = list(
+            stream_task_rows(
+                str(tmp_path), "plan", "task1", is_done=lambda: True,
+                families=("perf",),
+            )
+        )
+        assert [r["stream"] for r in rows] == ["perf"]
+
+    def test_large_backlog_drains_in_bounded_chunks(
+        self, tmp_path, monkeypatch
+    ):
+        """A finished soak's replay must stream its backlog chunk by
+        chunk, not land it in one allocation — and a partial trailing
+        line still survives chunked reads."""
+        from testground_tpu.engine import stream as stream_mod
+
+        monkeypatch.setattr(stream_mod, "_READ_CHUNK", 64)
+        d = self.run_dir(tmp_path)
+        with open(d / "sim_timeseries.jsonl", "w") as f:
+            for i in range(100):  # ~2 KB >> the 64-byte chunk
+                f.write(json.dumps({"tick": i}) + "\n")
+            f.write('{"tick": 100')  # partial: no newline yet
+        rows = list(
+            stream_task_rows(
+                str(tmp_path), "plan", "task1", is_done=lambda: True,
+            )
+        )
+        assert [r["tick"] for r in rows] == list(range(100))
+
+    def test_heartbeat_yields_none_while_idle(self, tmp_path):
+        """heartbeat_secs > 0: an idle follow yields None keepalives (the
+        daemon turns them into blank ndjson lines) so a quiet soak can't
+        trip a client's socket read timeout."""
+        done = threading.Event()
+        got: list = []
+
+        def reader():
+            for row in stream_task_rows(
+                str(tmp_path), "plan", "task1",
+                is_done=done.is_set, follow=True, poll_secs=0.01,
+                heartbeat_secs=0.05,
+            ):
+                got.append(row)
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got, "no heartbeat within the deadline"
+        assert all(r is None for r in got)
+        done.set()
+        th.join(timeout=5)
+
+    def test_no_heartbeat_by_default(self, tmp_path):
+        d = self.run_dir(tmp_path)
+        (d / "sim_timeseries.jsonl").write_text(
+            json.dumps({"tick": 0}) + "\n"
+        )
+        rows = list(
+            stream_task_rows(
+                str(tmp_path), "plan", "task1", is_done=lambda: True,
+            )
+        )
+        assert None not in rows and len(rows) == 1
+
+    def test_queued_task_waits_for_the_run_dir(self, tmp_path):
+        """Before the runner creates the outputs dir the stream yields
+        nothing but stays open; rows appear once the run starts."""
+        done = threading.Event()
+        got: list = []
+
+        def reader():
+            for row in stream_task_rows(
+                str(tmp_path), "plan", "task1",
+                is_done=done.is_set, follow=True, poll_secs=0.01,
+            ):
+                got.append(row)
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        time.sleep(0.05)
+        assert not got and th.is_alive()
+        d = self.run_dir(tmp_path)  # the run "starts"
+        (d / "sim_timeseries.jsonl").write_text(
+            json.dumps({"tick": 0}) + "\n"
+        )
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got and got[0]["tick"] == 0
+        done.set()
+        th.join(timeout=5)
+
+
+# ------------------------------------------------------------- daemon e2e
+
+
+def _sim_composition(telemetry=True):
+    return {
+        "metadata": {"name": "stream-smoke"},
+        "global": {
+            "plan": "network",
+            "case": "ping-pong",
+            "builder": "sim:plan",
+            "runner": "sim:jax",
+            "run_config": {"telemetry": telemetry, "chunk": 16},
+        },
+        "groups": [{"id": "all", "instances": {"count": 2}}],
+    }
+
+
+def _wait(client, task_id, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = client.status(task_id)
+        if t["states"][-1]["state"] in ("complete", "canceled"):
+            return t
+        time.sleep(0.2)
+    raise TimeoutError(task_id)
+
+
+class TestDaemonStream:
+    @pytest.fixture()
+    def daemon(self, tg_home):
+        d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+        d.start()
+        yield d
+        d.stop()
+
+    @pytest.fixture()
+    def client(self, daemon):
+        return Client(daemon.address)
+
+    def test_stream_replays_finished_task_then_closes(self, client):
+        client.import_plan(os.path.join(PLANS, "network"))
+        tid = client.run(_sim_composition())
+        _wait(client, tid)
+        rows = list(client.stream(tid))  # follow=True on a DONE task
+        fams = {r["stream"] for r in rows}
+        assert "telemetry" in fams  # per-tick counter rows
+        assert "perf" in fams  # per-chunk ledger rows
+        assert "spans" in fams  # chunk clock
+        tele = [r for r in rows if r["stream"] == "telemetry"]
+        assert [r["tick"] for r in tele] == list(range(len(tele)))
+        assert all(r["run"] == tid for r in rows)
+        # family filter narrows server-side
+        only_perf = list(client.stream(tid, families=("perf",)))
+        assert only_perf and {r["stream"] for r in only_perf} == {"perf"}
+
+    def test_concurrent_reader_sees_live_rows(self, client):
+        """Follow a RUNNING task: the reader must receive rows while the
+        run is still in flight (state processing), not only a replay."""
+        client.import_plan(os.path.join(PLANS, "network"))
+        tid = client.run(
+            {
+                **_sim_composition(),
+                "global": {
+                    **_sim_composition()["global"],
+                    # long enough to still be running when we attach:
+                    # max_ticks bounds it; ping-pong finishes on its own
+                    "run_config": {"telemetry": True, "chunk": 16},
+                },
+            }
+        )
+        live_states: list = []
+        rows: list = []
+        for row in client.stream(tid):
+            rows.append(row)
+            if len(live_states) < 3:
+                live_states.append(
+                    client.status(tid)["states"][-1]["state"]
+                )
+        assert rows, "stream produced nothing"
+        # the stream closed only after completion
+        assert client.status(tid)["states"][-1]["state"] == "complete"
+
+    def test_unknown_task_404(self, client):
+        with pytest.raises(DaemonError, match="unknown task"):
+            list(client.stream("nope"))
+
+    def test_unknown_family_refused(self, client):
+        """A typo'd families= must 400 loudly, not follow row-less for
+        the task's whole lifetime."""
+        client.import_plan(os.path.join(PLANS, "placebo"))
+        tid = client.run(
+            {
+                "metadata": {"name": "p"},
+                "global": {
+                    "plan": "placebo",
+                    "case": "ok",
+                    "builder": "exec:py",
+                    "runner": "local:exec",
+                    "total_instances": 1,
+                },
+                "groups": [{"id": "all", "instances": {"count": 1}}],
+            }
+        )
+        _wait(client, tid)
+        with pytest.raises(DaemonError, match="unknown stream families"):
+            list(client.stream(tid, families=("telemety",)))
+        # all-blank ("families=,") must 400 too, not follow row-less
+        with pytest.raises(DaemonError, match="unknown stream families"):
+            list(client.stream(tid, families=(" ",)))
+
+    def test_unauthenticated_stream_refused(self, tg_home):
+        home = os.environ["TESTGROUND_HOME"]
+        with open(os.path.join(home, ".env.toml"), "w") as f:
+            f.write('[daemon]\ntokens = ["sekrit"]\n')
+        d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+        d.start()
+        try:
+            with pytest.raises(DaemonError, match="unauthorized"):
+                list(Client(d.address).stream("whatever"))
+            # the right token gets through to task resolution
+            with pytest.raises(DaemonError, match="unknown task"):
+                list(Client(d.address, token="sekrit").stream("whatever"))
+        finally:
+            d.stop()
+
+    def test_watch_cli_renders_stream(self, client, daemon, capsys):
+        """`tg watch` against --endpoint: chunk lines + final outcome."""
+        from testground_tpu.cli.main import main as tg_main
+
+        client.import_plan(os.path.join(PLANS, "network"))
+        tid = client.run(_sim_composition())
+        _wait(client, tid)
+        rc = tg_main(["--endpoint", daemon.address, "watch", tid])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "tick" in out and "peer·t/s" in out  # the header
+        assert "run finished" in out
+        assert f"task {tid}: outcome success" in out
+
+    def test_negative_metrics_task_limit_clamped(self, tg_home):
+        """A negative limit would slice tasks[:-n] (export the OLDEST
+        tasks) — the parser clamps it back to 'use the default'."""
+        home = os.environ["TESTGROUND_HOME"]
+        with open(os.path.join(home, ".env.toml"), "w") as f:
+            f.write("[daemon]\nmetrics_task_limit = -1\n")
+        assert EnvConfig.load().daemon.metrics_task_limit == 0
+
+    def test_metrics_task_limit_configurable_and_loud(self, tg_home):
+        home = os.environ["TESTGROUND_HOME"]
+        with open(os.path.join(home, ".env.toml"), "w") as f:
+            f.write("[daemon]\nmetrics_task_limit = 1\n")
+        d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+        d.start()
+        try:
+            c = Client(d.address)
+            c.import_plan(os.path.join(PLANS, "placebo"))
+            comp = {
+                "metadata": {"name": "p"},
+                "global": {
+                    "plan": "placebo",
+                    "case": "ok",
+                    "builder": "exec:py",
+                    "runner": "local:exec",
+                    "total_instances": 1,
+                },
+                "groups": [{"id": "all", "instances": {"count": 1}}],
+            }
+            for _ in range(2):
+                _wait(c, c.run(comp))
+            text = c.metrics()
+            assert "tg_scrape_tasks_total 2" in text
+            assert "tg_scrape_tasks_elided 1" in text
+            # exactly one task got per-task series under the limit
+            assert text.count("tg_task_queued_seconds{") == 1
+        finally:
+            d.stop()

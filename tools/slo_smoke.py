@@ -1,0 +1,230 @@
+"""``make slo-smoke``: the run health plane's end-to-end contract
+(docs/OBSERVABILITY.md "Run health plane") on the CPU backend, driving
+the ``plans/chaos`` smoke composition whose schedule makes the declared
+``fleet-mostly-alive`` SLO (crashed_fraction < 0.2, warn) breach
+DETERMINISTICALLY — 2/8 instances crash at t=6 and restart at t=20:
+
+- **warn severity**: the run still COMPLETES with outcome SUCCESS; the
+  breach is recorded — journal ``slo`` rule verdict with breaches > 0,
+  ``sim_slo.jsonl`` records, and the ``tg stats`` table's slo line;
+- **conservation of breach counts**: journal breach total ==
+  ``sim_slo.jsonl`` line count == the per-rule sums;
+- **determinism**: a second identical run produces the identical breach
+  record stream;
+- **fail severity**: the same rule at ``severity = "fail"`` cancels the
+  run at the breaching chunk boundary with a typed ``SloBreachError``
+  — task outcome FAILURE, the error names the rule, and the archived
+  journal KEEPS the run's telemetry record (the fail-fast soak must not
+  lose its evidence);
+- **loud refusal**: SLOs without ``telemetry = true`` refuse to run.
+
+Exits non-zero with a readable message on any violation. Self-contained:
+temporary $TESTGROUND_HOME, CPU backend — safe in CI (mirrors
+``tools/chaos_smoke.py``).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def fail(msg: str) -> "None":
+    print(f"slo-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _run_once(engine, comp, manifest, sources):
+    import time
+
+    from testground_tpu.engine import State
+
+    tid = engine.queue_run(comp, manifest, sources_dir=sources)
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        t = engine.get_task(tid)
+        if t is not None and t.state().state in (
+            State.COMPLETE,
+            State.CANCELED,
+        ):
+            return t
+        time.sleep(0.05)
+    fail(f"task {tid} did not finish within 300s")
+
+
+def _read_slo_rows(env, task):
+    from testground_tpu.sim.slo import SLO_FILE
+
+    path = os.path.join(env.dirs.outputs(), "chaos", task.id, SLO_FILE)
+    if not os.path.isfile(path):
+        fail(f"{SLO_FILE} was not written ({path})")
+    rows = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                fail(f"{SLO_FILE} line {i + 1} is not JSON: {e}")
+    return rows
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-slo-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from testground_tpu.api import TestPlanManifest, load_composition
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome
+    from testground_tpu.runners.pretty import render_telemetry_summary
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    plan_dir = os.path.join(REPO_ROOT, "plans", "chaos")
+    comp_path = os.path.join(plan_dir, "_compositions", "smoke.toml")
+    manifest = TestPlanManifest.load_file(
+        os.path.join(plan_dir, "manifest.toml")
+    )
+
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.start_workers()
+    try:
+        # -------------------------------------------- warn severity ×2
+        warn_tasks = [
+            _run_once(engine, load_composition(comp_path), manifest, plan_dir)
+            for _ in range(2)  # second run pins determinism
+        ]
+        # ------------------------------------------------ fail severity
+        comp_fail = load_composition(comp_path)
+        comp_fail.global_.run.slo = [
+            {
+                "name": "fleet-mostly-alive-fatal",
+                "metric": "crashed_fraction",
+                "op": "<",
+                "threshold": 0.2,
+                "severity": "fail",
+            }
+        ]
+        fail_task = _run_once(engine, comp_fail, manifest, plan_dir)
+        # ------------------------------------------------ loud refusal
+        comp_refuse = load_composition(comp_path)
+        comp_refuse.global_.run_config["telemetry"] = False
+        refuse_task = _run_once(engine, comp_refuse, manifest, plan_dir)
+    finally:
+        engine.stop()
+
+    # ---- warn: the run completes, the breach is a record, not a death
+    task = warn_tasks[0]
+    if task.outcome() != Outcome.SUCCESS:
+        fail(
+            f"warn-severity run outcome {task.outcome().value}: "
+            f"{task.error} — a warn SLO must record, never cancel"
+        )
+    slo = task.result["journal"].get("slo") or {}
+    rules = {r["name"]: r for r in slo.get("rules", [])}
+    rule = rules.get("fleet-mostly-alive")
+    if rule is None:
+        fail(f"journal slo block is missing the declared rule: {slo}")
+    if not rule.get("breaches"):
+        fail(
+            "fleet-mostly-alive recorded 0 breaches — the schedule "
+            "crashes 25% of the fleet at t=6, the rule must fire"
+        )
+    if rule.get("severity") != "warn":
+        fail(f"rule severity {rule.get('severity')!r} != 'warn'")
+    if slo.get("error"):
+        fail(f"warn-severity journal carries an error: {slo['error']}")
+
+    # ---- conservation of breach counts: journal == jsonl == rule sums
+    rows = _read_slo_rows(env, task)
+    total = slo.get("breaches")
+    if len(rows) != total:
+        fail(
+            f"{len(rows)} sim_slo.jsonl record(s) != journal breach "
+            f"total {total}"
+        )
+    per_rule = sum(r.get("breaches", 0) for r in slo.get("rules", []))
+    if per_rule != total:
+        fail(f"Σ per-rule breaches {per_rule} != journal total {total}")
+
+    # ---- determinism: identical breach record streams
+    rows2 = _read_slo_rows(env, warn_tasks[1])
+    strip = lambda rs: [  # noqa: E731
+        {k: v for k, v in r.items() if k != "run"} for r in rs
+    ]
+    if strip(rows) != strip(rows2):
+        fail("two runs of the same composition produced different "
+             "breach record streams — the SLO plane broke determinism")
+
+    # ---- the stats table renders the verdict
+    table = render_telemetry_summary(task.stats_payload())
+    if "slo fleet-mostly-alive" not in table:
+        fail(f"tg stats table has no slo line:\n{table}")
+
+    # ---- fail: typed cancel, journal preserved
+    if fail_task.outcome() != Outcome.FAILURE:
+        fail(
+            f"fail-severity run outcome {fail_task.outcome().value} — a "
+            "fail SLO breach must FAIL the task"
+        )
+    err = fail_task.result.get("error", "") or fail_task.error
+    if "SLO breach" not in err or "fleet-mostly-alive-fatal" not in err:
+        fail(f"task error does not name the typed SLO breach: {err!r}")
+    fj = fail_task.result.get("journal") or {}
+    if not (fj.get("slo") or {}).get("error"):
+        fail(f"fail-severity journal slo block has no error: {fj.get('slo')}")
+    if not fj.get("telemetry", {}).get("rows"):
+        fail(
+            "fail-fast run lost its telemetry journal — the typed error "
+            "must carry the fully-assembled result"
+        )
+    warn_ticks = task.result["journal"]["sim"]["ticks"]
+    fail_ticks = (fj.get("sim") or {}).get("ticks", 0)
+    if not 0 < fail_ticks < warn_ticks:
+        fail(
+            f"fail-fast run executed {fail_ticks} tick(s) vs the "
+            f"completed run's {warn_ticks} — it must cancel at the "
+            "breaching chunk boundary, not run to completion"
+        )
+
+    # ---- refusal: SLOs without telemetry never run silently unenforced
+    if refuse_task.outcome() != Outcome.FAILURE:
+        fail(
+            "declaring SLOs with telemetry=false must refuse loudly, "
+            f"got outcome {refuse_task.outcome().value}"
+        )
+    if "telemetry" not in (refuse_task.error or ""):
+        fail(
+            f"refusal error does not name the telemetry plane: "
+            f"{refuse_task.error!r}"
+        )
+
+    print(
+        "slo-smoke: OK — warn rule breached {b} time(s) (recorded, run "
+        "SUCCESS), records conserved + deterministic, fail rule canceled "
+        "at tick {ft} of {wt} with a typed SloBreachError (journal "
+        "preserved), telemetry-off refusal loud".format(
+            b=rule["breaches"], ft=fail_ticks, wt=warn_ticks
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
