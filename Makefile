@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
-	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke
+	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke \
+	phases-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -77,6 +78,15 @@ perf-smoke:
 # keeps the telemetry record; SLOs without telemetry refuse loudly
 slo-smoke:
 	$(PY) tools/slo_smoke.py
+
+# phase-attribution contract check (docs/OBSERVABILITY.md "Phase
+# attribution"): a tiny run with phases=true must journal sim.phases
+# (one cost row per compiled-in tick phase + the explicit residual and
+# whole-program rows, Σ phases + residual == whole by construction),
+# stamp every phase with a measured ms/tick (phases_measure), mirror
+# the rows to sim_phases.jsonl, and export tg_phase_* gauges
+phases-smoke:
+	$(PY) tools/phases_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -354,6 +354,14 @@ def main() -> int:
     # r5 weak #1). --only narrows to a comma-list of BENCH_WORKLOADS.
     p.add_argument("--build", action="store_true")
     p.add_argument("--only", default=None)
+    # phase attribution (sim/phases.py; docs/OBSERVABILITY.md "Phase
+    # attribution"): emit the per-phase cost ledger of the full-path
+    # program for THIS transport as a per-backend "phases" block in the
+    # BENCH json — the programmatic per-op breakdown the PERF.md tables
+    # were hand-transcribed from. --phase-reps > 0 adds the measured
+    # ms/tick calibration (per-phase jit + K timed reps, post-bench).
+    p.add_argument("--phases", action="store_true")
+    p.add_argument("--phase-reps", type=int, default=0)
     args = p.parse_args()
 
     # compiled programs are the framework's build artifact: warm processes
@@ -420,6 +428,40 @@ def main() -> int:
         # against this line
         "perf": perf_block,
     }
+
+    if args.phases:
+        # per-backend phase attribution of the full-path program
+        # (journal sim.phases schema), keyed by transport so merged
+        # BENCH lines across A/B rounds nest consistently; the whole-
+        # program cost is reused from the ledger's warm-recompile
+        # harvest above (no extra compile)
+        from testground_tpu.sim.phases import build_phase_ledger
+
+        plan, case, params, chunk = _bench_shape("sustained", n, ticks)
+        prog = _build(plan, case, n, params, chunk, args.transport)
+        result["phases"] = {
+            args.transport: build_phase_ledger(
+                prog,
+                whole=perf_block.get("compile"),
+                measure=max(0, args.phase_reps),
+            )
+        }
+        top = sorted(
+            result["phases"][args.transport]["phases"],
+            key=lambda r: r.get("bytes_accessed", 0.0) or 0.0,
+            reverse=True,
+        )
+        print(
+            "# phases[%s] (x of whole-program bytes/tick): %s"
+            % (
+                args.transport,
+                ", ".join(
+                    f"{r['phase']} x{r.get('bytes_frac', 0):.2f}"
+                    for r in top[:4]
+                ),
+            ),
+            file=sys.stderr,
+        )
 
     if not args.skip_secondary:
         flood, flood_compile = bench_flood(n, ticks, args.transport)

@@ -859,6 +859,20 @@ def register_perf(sub) -> None:
         "stays parseable)",
     )
     p.add_argument(
+        "--phases",
+        action="store_true",
+        help="print the per-phase tick attribution table (flops/bytes "
+        "per phase + residual + whole-program rows; requires the run "
+        "to have recorded it — --run-cfg phases=true)",
+    )
+    p.add_argument(
+        "--measure",
+        action="store_true",
+        help="with --phases: insist on the measured ms/tick calibration "
+        "column (recorded with --run-cfg phases_measure=K) — prints a "
+        "hint when the run only holds the static cost rows",
+    )
+    p.add_argument(
         "-f",
         "--follow",
         action="store_true",
@@ -896,6 +910,34 @@ def perf_cmd(args) -> int:
             print(json.dumps(data, indent=2, sort_keys=True))
         else:
             print(render_perf_summary(data))
+        if getattr(args, "phases", False):
+            from testground_tpu.runners.pretty import render_phase_table
+
+            # with --json, stdout stays the parseable payload (the
+            # phases block is inside it) — the table goes to stderr
+            out = sys.stderr if getattr(args, "json", False) else sys.stdout
+            print("-- phases --", file=out)
+            print(render_phase_table(data), file=out)
+            if getattr(args, "measure", False):
+                # same block resolution as render_phase_table (top-level
+                # payload or journal sim shape) — the hint and the table
+                # must never disagree about the same payload
+                block = (
+                    data.get("phases")
+                    or (data.get("sim") or {}).get("phases")
+                    or {}
+                )
+                rows = block.get("phases") or []
+                if not any(
+                    isinstance(r, dict) and r.get("measured_ms") is not None
+                    for r in rows
+                ):
+                    print(
+                        "no measured calibration recorded — re-run with "
+                        "--run-cfg phases=true phases_measure=30 for "
+                        "measured ms/tick per phase",
+                        file=out,
+                    )
         if getattr(args, "compare", ""):
             with open(args.compare) as f:
                 # BENCH_rNN.json files are one JSON object per line

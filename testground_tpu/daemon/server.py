@@ -616,6 +616,7 @@ class _Handler(BaseHTTPRequestHandler):
         "sim_timeseries.jsonl",
         "sim_latency.jsonl",
         "sim_perf.jsonl",
+        "sim_phases.jsonl",
         "sim_slo.jsonl",
         "run_spans.jsonl",
         "sim_trace.jsonl",
@@ -625,25 +626,43 @@ class _Handler(BaseHTTPRequestHandler):
     # still a closed basename whitelist, with every path component
     # validated, so the route cannot read outside the task's outputs.
     _ARTIFACT_NESTED = ("profile-cpu.pstats",)
+    # jax.profiler capture layout under the run dir: the xplane protos
+    # land at profiles/plugins/profile/<session>/<host>.xplane.pb —
+    # served so a remote `tg` session can fetch the capture the phase
+    # table (`tg perf --phases`) points at. Suffix-whitelisted (never
+    # client paths) with every component validated, like the nested
+    # instance artifacts.
+    _PROFILE_PREFIX = ("profiles", "plugins", "profile")
+    _PROFILE_SUFFIXES = (".xplane.pb",)
 
     @classmethod
     def _artifact_relpath(cls, name: str) -> str | None:
         """Validate an artifact name → safe run-dir-relative path, or
-        None. Accepts the flat whitelist, or a nested path (e.g.
+        None. Accepts the flat whitelist; a nested path (e.g.
         ``single/0/profile-cpu.pstats`` — the SDK's cProfile dump) whose
         basename is whitelisted and whose every component is a plain
-        path segment."""
+        path segment; or a profiler capture file under
+        ``profiles/plugins/profile/<session>/``."""
         if name in cls._ARTIFACT_FILES:
             return name
         parts = name.split("/")
+        safe_parts = all(
+            p and p not in (".", "..") and p == os.path.basename(p)
+            and "\\" not in p
+            for p in parts
+        )
         if (
             len(parts) in (2, 3, 4)
             and parts[-1] in cls._ARTIFACT_NESTED
-            and all(
-                p and p not in (".", "..") and p == os.path.basename(p)
-                and "\\" not in p
-                for p in parts
-            )
+            and safe_parts
+        ):
+            return os.path.join(*parts)
+        if (
+            len(parts) == len(cls._PROFILE_PREFIX) + 2
+            and tuple(parts[: len(cls._PROFILE_PREFIX)])
+            == cls._PROFILE_PREFIX
+            and parts[-1].endswith(cls._PROFILE_SUFFIXES)
+            and safe_parts
         ):
             return os.path.join(*parts)
         return None
@@ -691,7 +710,7 @@ class _Handler(BaseHTTPRequestHandler):
             "application/json"
             if name.endswith(".json")
             else "application/octet-stream"
-            if name.endswith(".pstats")
+            if name.endswith((".pstats", ".pb"))
             else "application/x-ndjson",
         )
         self.send_header("Content-Length", str(size))
@@ -834,6 +853,23 @@ class _Handler(BaseHTTPRequestHandler):
                     hits = sorted(
                         _glob.glob(os.path.join(run_dir, "*", "*", base))
                     )[:16]
+                    present.extend(
+                        os.path.relpath(p, run_dir).replace(os.sep, "/")
+                        for p in hits
+                    )
+                # profiler captures (profile=true / profile_chunks=N):
+                # link the xplane protos so a remote session can fetch
+                # the capture the phase table points at, capped like the
+                # instance profiles
+                for suffix in self._PROFILE_SUFFIXES:
+                    hits = sorted(
+                        _glob.glob(
+                            os.path.join(
+                                run_dir, *self._PROFILE_PREFIX, "*",
+                                "*" + suffix,
+                            )
+                        )
+                    )[:4]
                     present.extend(
                         os.path.relpath(p, run_dir).replace(os.sep, "/")
                         for p in hits

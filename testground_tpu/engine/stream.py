@@ -34,6 +34,7 @@ from typing import Callable, Iterator
 from testground_tpu.sim.slo import SLO_FILE
 from testground_tpu.sim.telemetry import (
     PERF_FILE,
+    PHASES_FILE,
     SIM_SERIES_FILE,
     SPAN_FILE,
 )
@@ -47,6 +48,9 @@ __all__ = ["STREAM_FAMILIES", "stream_task_rows"]
 STREAM_FAMILIES = (
     ("telemetry", SIM_SERIES_FILE),
     ("perf", PERF_FILE),
+    # phase attribution rows (sim/phases.py) — written once at collect
+    # time, so a follow replays them right before the task closes
+    ("phases", PHASES_FILE),
     ("slo", SLO_FILE),
     ("spans", SPAN_FILE),
 )

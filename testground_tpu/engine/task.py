@@ -229,8 +229,13 @@ class Task:
             "case": self.case,
             "state": self.state().state.value,
             "outcome": self.outcome().value,
-            "sim": {k: v for k, v in sim.items() if k != "perf"},
+            "sim": {
+                k: v for k, v in sim.items() if k not in ("perf", "phases")
+            },
             "perf": sim.get("perf", {}),
+            # phase attribution plane (sim/phases.py) — surfaced at top
+            # level beside the ledger for `tg perf --phases` consumers
+            "phases": sim.get("phases", {}),
             "task": result.get("perf", {})
             if isinstance(result.get("perf"), dict)
             else {},

@@ -290,4 +290,45 @@ def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
             ident,
             hbm.get("peak_bytes"),
         )
+        # phase attribution plane (journal["sim"]["phases"],
+        # docs/OBSERVABILITY.md "Phase attribution"): per-phase cost
+        # gauges plus the synthesized residual/total rows — the phase
+        # label space is the fixed TICK_PHASES set + {residual, total},
+        # so cardinality stays bounded
+        phases = (
+            sim.get("phases") if isinstance(sim.get("phases"), dict) else {}
+        )
+        if phases:
+            from testground_tpu.sim.phases import phase_rows
+
+            for row in phase_rows(phases):
+                pident = {
+                    **ident,
+                    "phase": row.get("phase", "?"),
+                    "transport": row.get("transport", "xla"),
+                }
+                exp.add(
+                    "tg_phase_flops",
+                    "gauge",
+                    "XLA cost-analysis FLOP estimate for one tick of one "
+                    "phase (phase=residual/total are the coverage rows).",
+                    pident,
+                    row.get("flops"),
+                )
+                exp.add(
+                    "tg_phase_bytes_accessed",
+                    "gauge",
+                    "XLA cost-analysis bytes-accessed estimate for one "
+                    "tick of one phase.",
+                    pident,
+                    row.get("bytes_accessed"),
+                )
+                exp.add(
+                    "tg_phase_measured_ms",
+                    "gauge",
+                    "Measured wall ms per call of one phase jitted in "
+                    "isolation (phases_measure calibration).",
+                    pident,
+                    row.get("measured_ms"),
+                )
     return exp.render()

@@ -16,7 +16,12 @@ from typing import IO
 from testground_tpu.rpc import OutputWriter
 from testground_tpu.sdk.events import parse_event_line
 
-__all__ = ["PrettyPrinter", "render_perf_summary", "render_telemetry_summary"]
+__all__ = [
+    "PrettyPrinter",
+    "render_perf_summary",
+    "render_phase_table",
+    "render_telemetry_summary",
+]
 
 
 # the shared ledger-consumer helpers (stdlib-only module, safe here):
@@ -342,6 +347,68 @@ def render_perf_summary(payload: dict) -> str:
         rows.append(("series", shown))
     width = max(len(k) for k, _ in rows)
     return "\n".join(f"{k:<{width}}  {v}" for k, v in rows)
+
+
+def render_phase_table(payload: dict) -> str:
+    """Render the phase attribution block as an aligned per-phase table
+    (``tg perf --phases``; docs/OBSERVABILITY.md "Phase attribution").
+
+    One row per tick phase (XLA cost-analysis flops / bytes accessed
+    per tick, the byte share of the whole program, and the measured
+    ms/tick when the run calibrated), then the explicit residual and
+    whole-program rows — the rows sum to the whole-program cost BY
+    CONSTRUCTION (residual := whole − Σ phases; a negative residual
+    means the standalone phases lose fusion the whole program has).
+    Shape-tolerant like every payload renderer: absent blocks render a
+    hint, never a crash."""
+    from testground_tpu.sim.phases import phase_rows
+
+    block = payload.get("phases") or (payload.get("sim") or {}).get(
+        "phases"
+    )
+    if not isinstance(block, dict) or not block.get("phases"):
+        return (
+            "no phase attribution recorded — run with --run-cfg "
+            "phases=true (and phases_measure=K for measured ms/tick); "
+            "cohorts and disable_metrics run phase-free"
+        )
+    rows = phase_rows(block)
+    measured = any(_num(r.get("measured_ms")) is not None for r in rows)
+    head = ["phase", "flops/tick", "bytes/tick", "byte-share"]
+    if measured:
+        head.append("ms/tick")
+    table = [head]
+    for r in rows:
+        share = _num(r.get("bytes_frac"))
+        line = [
+            str(r.get("phase", "?")),
+            _fmt_rate(r.get("flops")),
+            _fmt_bytes(r.get("bytes_accessed")),
+            f"{share * 100:.1f}%" if share is not None else "",
+        ]
+        if measured:
+            ms = _num(r.get("measured_ms"))
+            line.append(f"{ms:.3f}" if ms is not None else "")
+        table.append(line)
+    widths = [
+        max(len(row[i]) for row in table) for i in range(len(head))
+    ]
+    lines = [
+        "  ".join(
+            cell.ljust(w) if i == 0 else cell.rjust(w)
+            for i, (cell, w) in enumerate(zip(row, widths))
+        ).rstrip()
+        for row in table
+    ]
+    meta = (
+        f"transport={block.get('transport', '?')}  "
+        f"chunk={block.get('chunk', '?')}  "
+        f"instances={block.get('instances', '?')}"
+    )
+    cov = block.get("coverage") or {}
+    if _num(cov.get("bytes_frac")) is not None:
+        meta += f"  byte-coverage=x{cov['bytes_frac']:.2f}"
+    return "\n".join([meta] + lines)
 
 
 _CLASS = {

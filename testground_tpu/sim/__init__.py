@@ -20,5 +20,6 @@ __all__ = [
     "sync_kernel",
     "engine",
     "executor",
+    "phases",
     "runner",
 ]

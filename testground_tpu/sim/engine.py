@@ -632,24 +632,29 @@ class SimProgram:
         return carry
 
     # ---------------------------------------------------------------- tick
+    #
+    # The tick decomposes into named phases — fault point events, calendar
+    # delivery, the latency-histogram accumulate, the vmapped user step,
+    # the transport commit, the sync fold, and the telemetry row — each a
+    # method below and each executed under jax.named_scope("tg.<phase>").
+    # The scopes are name-stack metadata only: the traced jaxpr is
+    # unchanged (the zero-overhead pins stay green) and real-chip
+    # XProf/Perfetto captures (--run-cfg profile=true) become legible per
+    # phase and per transport backend. The same phase methods are lowered
+    # STANDALONE by sim/phases.py to harvest per-phase cost_analysis()
+    # into the run's PhaseLedger (docs/OBSERVABILITY.md "Phase
+    # attribution") — keep _tick and the phase methods in lockstep.
 
-    def _tick(self, carry: SimCarry) -> tuple[SimCarry, jax.Array]:
-        """One simulated tick. Returns (carry', telemetry vector) — the
-        vector is the per-tick counter block row ([K] int32, K = 0 when
-        telemetry is compiled out; see telemetry.TELEMETRY_FIXED_COLUMNS
-        for the column schema)."""
-        cls = type(self.tc)
-        t = carry.t
-        # status snapshot BEFORE the fault plane touches it — the flight
-        # recorder's status-transition events must capture scheduled
-        # crashes/restarts as well as plan-driven terminals
-        status_prev = carry.status
-
-        # --- fault plane, point events (docs/FAULTS.md): scheduled
-        # restarts then crashes apply at tick START — before delivery, so
-        # a message in flight toward an instance crashing this tick is
-        # purged (lost on the wire), never delivered posthumously. All
-        # of this is compiled out when no schedule is declared.
+    def _fault_phase(self, carry: SimCarry, t):
+        """Fault-plane point events at tick START (docs/FAULTS.md):
+        scheduled restarts revive CRASHED slots, then scheduled crashes
+        flip status and purge the victims' in-flight calendar rows (a
+        message in flight toward an instance crashing this tick is lost
+        on the wire, never delivered posthumously). Compiled out
+        entirely when no schedule is declared. Returns ``(carry,
+        crashed_t, restarted_t, purged_t, dead)`` — ``dead`` is the
+        post-event crashed-lane mask the transport uses to kill traffic
+        to dead lanes (None without a schedule)."""
         crashed_t = jnp.int32(0)
         restarted_t = jnp.int32(0)
         purged_t = jnp.int32(0)
@@ -733,31 +738,20 @@ class SimProgram:
         # crashed lanes kill traffic addressed to (or somehow from) them
         # at send time — counted as fault_dropped in the transport
         dead = (carry.status == CRASH) if faults is not None else None
+        return carry, crashed_t, restarted_t, purged_t, dead
+
+    def _step_phase(self, carry: SimCarry, inbox_all, t) -> dict:
+        """The vmapped user-step phase: per-group ``testcase.step`` under
+        ``jax.vmap`` (one vmap per group, so per-group params stay
+        static), terminal-instance freezing, the host-echo outbox merge,
+        and the per-group output planes concatenated to the full
+        instance axis (reconfig planes host-padded). Pure dataflow from
+        ``(carry, inbox)`` to the merged planes — a standalone phase so
+        ``sim/phases.py`` can lower and cost it in isolation."""
+        cls = type(self.tc)
         # live membership snapshot served to every instance's SyncView
         # (see sync_kernel.live_per_group — the degraded-barrier target)
         live_g = live_per_group(carry.status, self.groups)
-
-        cal, inbox_all = deliver(carry.cal, t, transport=self.transport)
-        # delivery-latency histogram (telemetry plane): bin this tick's
-        # deliveries by (t - enqueue tick) per receiver group. The etick
-        # row survives deliver's occupancy clear (only the occupancy
-        # plane is zeroed), so the pre-deliver calendar is read against
-        # the popped inbox's validity; host echo lanes are excluded by
-        # the out-of-range group map.
-        lat_hist_t = (
-            latency_histogram(
-                carry.cal,
-                inbox_all,
-                t,
-                self._lat_group_of,
-                len(self.groups),
-                LATENCY_BINS,
-            )
-            if self.telemetry
-            else None
-        )
-        # messages popped into inboxes this tick (incl. host echo lanes)
-        delivered_t = jnp.sum(inbox_all.valid.astype(jnp.int32))
         sub_payload, sub_valid = make_sub_window(carry.sync, cls.SUB_K)
         env_keys = jax.vmap(jax.random.fold_in)(
             carry.keys, jnp.broadcast_to(t, (self.n,))
@@ -899,33 +893,6 @@ class SimProgram:
             jnp.int32
         )
 
-        net_key, k_msg = jax.random.split(carry.net_key)
-        cal, fb = enqueue(
-            cal,
-            carry.link,
-            dst,
-            payload,
-            valid,
-            t,
-            self.tick_ms,
-            k_msg,
-            slot_mode=type(self.tc).SLOT_MODE,
-            features=tuple(type(self.tc).SHAPING),
-            control_start=self.n if self.hosts else None,
-            stacking=type(self.tc).CROSS_TICK_STACKING,
-            bw_queue_cap=type(self.tc).BW_QUEUE_MSGS,
-            validate=self.validate,
-            faults=faults,
-            dead=dead,
-            # flight recorder: per-message transport fate for traced
-            # send events (compiled out when no trace plan is declared)
-            want_fate=self.trace is not None,
-            transport=self.transport,
-        )
-        sync = update_sync(
-            carry.sync, signals, pub_payload, pub_valid, sub_consume
-        )
-
         net_shape = catl(lambda o: o.net_shape)  # [7, N]
         net_shape_valid = cat0(lambda o: o.net_shape_valid) & active
 
@@ -989,20 +956,71 @@ class SimProgram:
             if net_rules is not None:
                 net_rules = pad_cols(net_rules)
                 net_rules_valid = pad_cols(net_rules_valid, False)
-        link = apply_net_updates(
-            carry.link,
-            net_shape,
-            net_shape_valid,
-            net_filters,
-            net_filters_valid,
-            net_region,
-            net_region_valid,
-            net_rules,
-            net_rules_valid,
+        return {
+            "states": new_states,
+            "status": status,
+            "finished_at": finished_at,
+            "dst": dst,
+            "payload": payload,
+            "valid": valid,
+            "signals": signals,
+            "pub_payload": pub_payload,
+            "pub_valid": pub_valid,
+            "sub_consume": sub_consume,
+            "net_shape": net_shape,
+            "net_shape_valid": net_shape_valid,
+            "net_filters": net_filters,
+            "net_filters_valid": net_filters_valid,
+            "net_rules": net_rules,
+            "net_rules_valid": net_rules_valid,
+            "net_region": net_region,
+            "net_region_valid": net_region_valid,
+        }
+
+    def _net_commit_phase(self, cal, link, step: dict, t, k_msg, dead):
+        """Transport commit: enqueue this tick's sends into the calendar
+        (the PERF.md hot path — three scatter/gather ops under xla, the
+        hand-tiled kernels under pallas) and apply the plan-driven link
+        reconfigurations. Returns ``(cal, fb, link, bw_changed_t)`` —
+        the last is this tick's count of bandwidth changes under a
+        standing backlog (the HTB bound-approximation counter)."""
+        cls = type(self.tc)
+        cal, fb = enqueue(
+            cal,
+            link,
+            step["dst"],
+            step["payload"],
+            step["valid"],
+            t,
+            self.tick_ms,
+            k_msg,
+            slot_mode=cls.SLOT_MODE,
+            features=tuple(cls.SHAPING),
+            control_start=self.n if self.hosts else None,
+            stacking=cls.CROSS_TICK_STACKING,
+            bw_queue_cap=cls.BW_QUEUE_MSGS,
+            validate=self.validate,
+            faults=self.faults,
+            dead=dead,
+            # flight recorder: per-message transport fate for traced
+            # send events (compiled out when no trace plan is declared)
+            want_fate=self.trace is not None,
+            transport=self.transport,
         )
-        bw_rate_changed = carry.bw_rate_changed
+        new_link = apply_net_updates(
+            link,
+            step["net_shape"],
+            step["net_shape_valid"],
+            step["net_filters"],
+            step["net_filters_valid"],
+            step["net_region"],
+            step["net_region_valid"],
+            step["net_rules"],
+            step["net_rules_valid"],
+        )
+        bw_changed_t = jnp.int32(0)
         if fb.backlog is not None:  # HTB queue depths advance each tick
-            link = dataclasses.replace(link, backlog=fb.backlog)
+            new_link = dataclasses.replace(new_link, backlog=fb.backlog)
             # ADVICE r4: the queue-occupancy bound values standing busy
             # time at the CURRENT rate, so it is approximate exactly when
             # the rate changes under a nonzero backlog — count those
@@ -1010,10 +1028,117 @@ class SimProgram:
             from .net import BANDWIDTH as _BW
 
             changed = (
-                link.egress[_BW] != carry.link.egress[_BW]
+                new_link.egress[_BW] != link.egress[_BW]
             ) & (fb.backlog > 0)
-            bw_rate_changed = bw_rate_changed + jnp.sum(
-                changed.astype(jnp.int32)
+            bw_changed_t = jnp.sum(changed.astype(jnp.int32))
+        return cal, fb, new_link, bw_changed_t
+
+    def _telemetry_phase(
+        self,
+        t,
+        status,
+        sync,
+        delivered_t,
+        sent_t,
+        enqueued_t,
+        dropped_t,
+        rejected_t,
+        cal_depth,
+        crashed_t,
+        restarted_t,
+        fault_dropped_t,
+    ) -> jax.Array:
+        """Assemble the per-tick counter-block row
+        (TELEMETRY_FIXED_COLUMNS order, then one live-instance count per
+        group) — all scalar reductions over arrays the tick already
+        materialized, so the block costs no extra memory traffic of the
+        calendar's order."""
+        sig_occ, pub_occ = sync_occupancy(sync)
+        live = [
+            jnp.sum(
+                (status[g.offset : g.offset + g.count] == RUNNING).astype(
+                    jnp.int32
+                )
+            )
+            for g in self.groups
+        ]
+        return jnp.stack(
+            [
+                t,
+                delivered_t,
+                sent_t,
+                enqueued_t,
+                dropped_t,
+                rejected_t,
+                # int multiply: exact over the full int32 range (the
+                # float32 detour would round above 2^24 bytes/tick); the
+                # column wraps only past 2^31/MSG_BYTES ≈ 8.4M msgs/tick
+                enqueued_t * jnp.int32(MSG_BYTES),
+                cal_depth,
+                sig_occ,
+                pub_occ,
+                crashed_t,
+                restarted_t,
+                fault_dropped_t,
+                *live,
+            ]
+        ).astype(jnp.int32)
+
+    def _tick(self, carry: SimCarry) -> tuple[SimCarry, jax.Array]:
+        """One simulated tick. Returns (carry', telemetry vector, trace
+        rows) — the vector is the per-tick counter block row ([K] int32,
+        K = 0 when telemetry is compiled out; see
+        telemetry.TELEMETRY_FIXED_COLUMNS for the column schema)."""
+        t = carry.t
+        # status snapshot BEFORE the fault plane touches it — the flight
+        # recorder's status-transition events must capture scheduled
+        # crashes/restarts as well as plan-driven terminals
+        status_prev = carry.status
+
+        with jax.named_scope("tg.faults"):
+            carry, crashed_t, restarted_t, purged_t, dead = (
+                self._fault_phase(carry, t)
+            )
+
+        with jax.named_scope("tg.deliver"):
+            cal, inbox_all = deliver(carry.cal, t, transport=self.transport)
+        # delivery-latency histogram (telemetry plane): bin this tick's
+        # deliveries by (t - enqueue tick) per receiver group. The etick
+        # row survives deliver's occupancy clear (only the occupancy
+        # plane is zeroed), so the pre-deliver calendar is read against
+        # the popped inbox's validity; host echo lanes are excluded by
+        # the out-of-range group map.
+        if self.telemetry:
+            with jax.named_scope("tg.lat_hist"):
+                lat_hist_t = latency_histogram(
+                    carry.cal,
+                    inbox_all,
+                    t,
+                    self._lat_group_of,
+                    len(self.groups),
+                    LATENCY_BINS,
+                )
+        else:
+            lat_hist_t = None
+        # messages popped into inboxes this tick (incl. host echo lanes)
+        delivered_t = jnp.sum(inbox_all.valid.astype(jnp.int32))
+
+        with jax.named_scope("tg.step"):
+            step = self._step_phase(carry, inbox_all, t)
+        status = step["status"]
+
+        net_key, k_msg = jax.random.split(carry.net_key)
+        with jax.named_scope("tg.net_commit"):
+            cal, fb, link, bw_changed_t = self._net_commit_phase(
+                cal, carry.link, step, t, k_msg, dead
+            )
+        with jax.named_scope("tg.sync"):
+            sync = update_sync(
+                carry.sync,
+                step["signals"],
+                step["pub_payload"],
+                step["pub_valid"],
+                step["sub_consume"],
             )
 
         # first collision wins: keep the earliest (dst, slot) for the error
@@ -1038,9 +1163,9 @@ class SimProgram:
 
         new_carry = self._constrain(
             SimCarry(
-                states=new_states,
+                states=step["states"],
                 status=status,
-                finished_at=finished_at,
+                finished_at=step["finished_at"],
                 cal=cal,
                 link=link,
                 sync=sync,
@@ -1050,7 +1175,7 @@ class SimProgram:
                 t=t + 1,
                 clamped=carry.clamped + fb.clamped,
                 bw_dropped=carry.bw_dropped + fb.bw_dropped,
-                bw_rate_changed=bw_rate_changed,
+                bw_rate_changed=carry.bw_rate_changed + bw_changed_t,
                 collisions=carry.collisions + fb.collisions,
                 collision_where=collision_where,
                 msgs_delivered=_acc_add(carry.msgs_delivered, delivered_t),
@@ -1073,45 +1198,34 @@ class SimProgram:
         )
         # flight-recorder event rows for this tick ([R, 5] int32; R = 0
         # when no trace plan is compiled in)
-        trows = self._trace_tick_rows(
-            t, status_prev, status, signals, dst, valid, fb.fate, inbox_all
-        )
+        with jax.named_scope("tg.trace"):
+            trows = self._trace_tick_rows(
+                t,
+                status_prev,
+                status,
+                step["signals"],
+                step["dst"],
+                step["valid"],
+                fb.fate,
+                inbox_all,
+            )
         if not self.telemetry:
             return new_carry, jnp.zeros((0,), jnp.int32), trows
-        # per-tick counter block row (TELEMETRY_FIXED_COLUMNS order, then
-        # one live-instance count per group) — all scalar reductions over
-        # arrays the tick already materialized, so the block costs no
-        # extra memory traffic of the calendar's order
-        sig_occ, pub_occ = sync_occupancy(sync)
-        live = [
-            jnp.sum(
-                (status[g.offset : g.offset + g.count] == RUNNING).astype(
-                    jnp.int32
-                )
-            )
-            for g in self.groups
-        ]
-        tele = jnp.stack(
-            [
+        with jax.named_scope("tg.telemetry"):
+            tele = self._telemetry_phase(
                 t,
+                status,
+                sync,
                 delivered_t,
                 fb.sent,
                 fb.enqueued,
                 dropped_t,
                 rejected_t,
-                # int multiply: exact over the full int32 range (the
-                # float32 detour would round above 2^24 bytes/tick); the
-                # column wraps only past 2^31/MSG_BYTES ≈ 8.4M msgs/tick
-                fb.enqueued * jnp.int32(MSG_BYTES),
                 cal_depth,
-                sig_occ,
-                pub_occ,
                 crashed_t,
                 restarted_t,
                 fault_dropped_t,
-                *live,
-            ]
-        ).astype(jnp.int32)
+            )
         return new_carry, tele, trows
 
     def _trace_tick_rows(

@@ -87,6 +87,27 @@ class SimJaxConfig:
     # beside the per-group composition flag (Group.profiles); writes the
     # XLA op + host timeline under <run outputs>/profiles
     profile: bool = False
+    # bounded profiler capture: > 0 captures only this many chunks,
+    # starting after the warmup dispatch (chunk 0 carries trace + XLA
+    # compile — profiling it buries the steady-state ops), instead of
+    # wrapping the entire run (a million-tick soak under profile=true
+    # would write a multi-GB trace). 0 = whole run, as before. The
+    # capture window is journaled (journal["profile"]).
+    profile_chunks: int = 0
+    # phase attribution plane (docs/OBSERVABILITY.md "Phase
+    # attribution"): lower each tick phase standalone at the run's real
+    # shapes after the run completes and journal the per-phase XLA cost
+    # ledger (sim.phases + sim_phases.jsonl — the `tg perf --phases`
+    # backend). Off the hot path (runs at collect time) but opt-in: each
+    # phase pays one small out-of-line compile. Follows the telemetry
+    # plane's gating (disable_metrics wins, cohorts run phase-free).
+    phases: bool = False
+    # measured calibration for the phase plane: > 0 jits each phase in
+    # isolation and times this many repetitions (concrete inputs at the
+    # run's shapes), emitting measured ms/tick per phase beside the
+    # static cost rows. Requires phases=true; costs one extra carry
+    # init plus K dispatches per phase, all post-run.
+    phases_measure: int = 0
     # transport backend for the calendar hot path (PERF.md "Pallas
     # transport kernels"): "xla" (default — the scatter path, program
     # unchanged) or "pallas" (hand-tiled commit + delivery kernels,
@@ -794,6 +815,10 @@ def _execute_sim_run(
         spans.point(
             "chunk", ticks=ticks, wall_secs=round(time.monotonic() - t0, 6)
         )
+        if chunk_profiler is not None:
+            # bounded profiler capture: start after the warmup dispatch,
+            # stop once the configured chunk window is in the trace
+            chunk_profiler.on_chunk(ticks)
         if slo_eval is not None:
             # evaluate AFTER the loop delivered this chunk's telemetry
             # rows and latency delta (telemetry_cb/lat_hist_cb run
@@ -940,13 +965,29 @@ def _execute_sim_run(
     # jax.profiler trace (XLA ops + host timeline, viewable in
     # TensorBoard/Perfetto) into the run's outputs dir.
     profile_dir = None
+    chunk_profiler = None
     if run_dir is not None and (
         any(g.profiles for g in job.groups)
         or bool(getattr(cfg, "profile", False))
     ):
         profile_dir = os.path.join(run_dir, "profiles")
         os.makedirs(profile_dir, exist_ok=True)
-        ow.infof("capturing jax.profiler trace to %s", profile_dir)
+        # bounded capture (profile_chunks=N): only the first N chunks
+        # after the warmup dispatch are traced — a million-tick soak
+        # under whole-run capture writes a multi-GB trace, and the
+        # steady-state chunks N captures are the ones the phase table
+        # points at (chunk 0 is compile + trace, not steady state)
+        n_prof_chunks = int(getattr(cfg, "profile_chunks", 0) or 0)
+        if n_prof_chunks > 0:
+            chunk_profiler = _ChunkedProfiler(profile_dir, n_prof_chunks)
+            ow.infof(
+                "capturing jax.profiler trace to %s (%d chunk(s) after "
+                "warmup)",
+                profile_dir,
+                n_prof_chunks,
+            )
+        else:
+            ow.infof("capturing jax.profiler trace to %s", profile_dir)
 
     if multi:
         # cancellation must be a cohort decision: the leader's local event
@@ -1013,13 +1054,20 @@ def _execute_sim_run(
         )
 
     spans.start("execute")
-    if profile_dir is not None:
+    if profile_dir is not None and chunk_profiler is None:
         import jax
 
         with jax.profiler.trace(profile_dir):
             res = _run()
     else:
-        res = _run()
+        try:
+            res = _run()
+        finally:
+            # a run finishing (or failing) inside the capture window
+            # must still close the trace — an unterminated profiler
+            # session would poison the next run in this process
+            if chunk_profiler is not None:
+                chunk_profiler.close()
     wall = time.monotonic() - t0
     spans.point("compile", wall_secs=round(res.get("compile_secs", 0.0), 6))
     spans.end("execute", ticks=res["ticks"])
@@ -1232,6 +1280,80 @@ def _execute_sim_run(
                 ),
             )
 
+    # ------------------------------------------------ profiler capture
+    # the capture window is part of the run record: a remote `tg`
+    # session reading the phase table must be able to find (and fetch,
+    # via GET /artifact) the trace the table points at
+    if profile_dir is not None:
+        result.journal["profile"] = (
+            chunk_profiler.journal()
+            if chunk_profiler is not None
+            else {"dir": "profiles", "mode": "full"}
+        )
+
+    # -------------------------------------------- phase attribution plane
+    # per-phase device cost ledger (docs/OBSERVABILITY.md "Phase
+    # attribution"): each tick phase lowered standalone at the run's
+    # real shapes, its cost_analysis harvested beside the whole-program
+    # chunk cost with an explicit residual row. Runs AFTER the run (off
+    # the hot path), gated like telemetry (disable_metrics wins, cohorts
+    # run phase-free — the out-of-line lowers are leader-local), and
+    # best-effort: attribution must never fail the run it measures.
+    phases_block = None
+    phases_on = (
+        bool(getattr(cfg, "phases", False))
+        and not job.disable_metrics
+        and not getattr(cfg, "coordinator_address", "")
+    )
+    if phases_on:
+        from .phases import PHASES_FILE, build_phase_ledger, write_phase_rows
+
+        spans.start("phases")
+        try:
+            phases_block = build_phase_ledger(
+                prog,
+                # the perf ledger's AOT pass already harvested the
+                # whole-program chunk cost — reuse it instead of a
+                # second out-of-line lower/compile
+                whole=(perf_summary or {}).get("compile"),
+                measure=int(getattr(cfg, "phases_measure", 0) or 0),
+                seed=cfg.seed,
+            )
+        except Exception as e:  # noqa: BLE001 — attribution is best-effort
+            ow.warn(
+                "sim:jax %s: phase attribution failed: %s", job.run_id, e
+            )
+            phases_block = None
+        if phases_block is not None:
+            rows_written = (
+                write_phase_rows(
+                    os.path.join(run_dir, PHASES_FILE),
+                    row_ident,
+                    phases_block,
+                )
+                if run_dir is not None
+                else 0
+            )
+            if rows_written:
+                phases_block["series"] = {
+                    "rows": rows_written,
+                    "file": PHASES_FILE,
+                }
+            cov = (phases_block.get("coverage") or {}).get("bytes_frac")
+            ow.infof(
+                "sim:jax %s: phase attribution — %d phase(s), transport=%s"
+                "%s",
+                job.run_id,
+                len(phases_block.get("phases") or []),
+                phases_block.get("transport"),
+                (
+                    ", bytes coverage x%.2f of whole-program" % cov
+                    if cov
+                    else ""
+                ),
+            )
+        spans.end("phases")
+
     # ------------------------------------------------ metric time series
     # final sample at the last tick, then persist the run's series — written
     # even above write_outputs_max (per-group reductions stay small)
@@ -1369,6 +1491,9 @@ def _execute_sim_run(
         # throughput gauges; docs/OBSERVABILITY.md) — absent only under
         # disable_metrics, cohorts, or an explicit perf=false
         **({"perf": perf_summary} if perf_summary else {}),
+        # phase attribution plane (per-phase cost ledger + residual;
+        # docs/OBSERVABILITY.md "Phase attribution") — opt-in, phases=true
+        **({"phases": phases_block} if phases_block else {}),
     }
     result.update_outcome()
     if cancel.is_set():
@@ -1549,6 +1674,79 @@ def _push_sim_series(endpoint: str, rows_iter, base_ns: int) -> dict:
     if batch:
         push(batch)
     return journal
+
+
+class _ChunkedProfiler:
+    """Bounded ``jax.profiler`` capture: trace only the first N chunk
+    dispatches after warmup (``profile_chunks=N``), instead of wrapping
+    the whole run. ``on_chunk(ticks)`` fires at every chunk boundary:
+    the first call (the warmup dispatch — compile + trace — just
+    completed) starts the trace, and once N further chunks have
+    completed it stops. Best-effort like every observability hook: a
+    profiler failure disables the capture, never the run."""
+
+    def __init__(self, profile_dir: str, chunks: int):
+        self.dir = profile_dir
+        self.chunks = max(1, int(chunks))
+        self.started = False
+        self.done = False
+        self.from_tick: int | None = None
+        self.to_tick: int | None = None
+        self.captured = 0
+
+    def on_chunk(self, ticks: int) -> None:
+        if self.done:
+            return
+        if not self.started:
+            try:
+                import jax
+
+                jax.profiler.start_trace(self.dir)
+            except Exception:  # noqa: BLE001 — capture is best-effort
+                self.done = True
+                return
+            self.started = True
+            self.from_tick = int(ticks)
+            return
+        self.captured += 1
+        self.to_tick = int(ticks)
+        if self.captured >= self.chunks:
+            self._stop()
+
+    def _stop(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            pass
+        self.done = True
+
+    def close(self) -> None:
+        if self.started and not self.done:
+            self._stop()
+
+    def journal(self) -> dict:
+        out: dict = {
+            "dir": "profiles",
+            "mode": "chunks",
+            "chunks": self.captured,
+        }
+        if self.from_tick is not None:
+            out["from_tick"] = self.from_tick
+        if self.to_tick is not None:
+            out["to_tick"] = self.to_tick
+        if self.started and not self.captured:
+            # a run whose ticks fit the warmup dispatch ends before any
+            # post-warmup chunk: the trace exists but holds no
+            # steady-state ops — say so instead of reporting an empty
+            # capture as a window
+            out["note"] = (
+                "run ended before any post-warmup chunk completed — the "
+                "capture is empty; use profile_chunks=0 (whole-run) for "
+                "runs this short"
+            )
+        return out
 
 
 class _SimTelemetryWriter:

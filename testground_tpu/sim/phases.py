@@ -1,0 +1,355 @@
+"""Tick phase attribution plane: the per-phase device cost ledger
+(docs/OBSERVABILITY.md "Phase attribution").
+
+The jitted tick decomposes into named phases (``engine.SimProgram._tick``
+wraps each in ``jax.named_scope("tg.<phase>")``): calendar delivery, the
+latency-histogram accumulate, the vmapped user step, the transport
+commit, the sync fold, fault point events, and the telemetry row. This
+module turns that decomposition into a durable, regression-testable
+attribution surface — the PERF.md "3 ops = 84%" table computed
+programmatically, per transport backend, instead of hand-read profiler
+sessions:
+
+- **static attribution** — each phase method is lowered STANDALONE at
+  the run's real shapes (``jax.eval_shape`` avals, no device
+  allocation) and its compiled ``cost_analysis()`` harvested (flops,
+  bytes accessed, transcendentals). The whole-program chunk cost is
+  normalized per tick and an explicit **residual row** (whole − Σ
+  phases) makes the coverage claim airtight by construction: fusion
+  across phase boundaries, scan plumbing, and carry donation land in
+  the residual, never silently inside a phase.
+- **measured calibration** (opt-in, ``measure=K`` reps) — each phase is
+  jitted in isolation and timed over K repetitions with concrete
+  inputs, off the hot path, yielding measured ms/tick per phase — the
+  per-op A/B evidence the Pallas chip verdict needs
+  (``tools/bench_pallas_transport.py --phases``).
+
+Like every observability plane: the ledger shapes NO part of the run's
+program (the phase methods are re-lowered out-of-line; the run's chunk
+program is untouched — pinned by jaxpr equality in tests) and building
+it must never fail the run it measures (the executor wraps it
+best-effort). Module import stays jax-free so the Prometheus exposition
+and the console table can import the row helpers cheaply.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from .perf import cost_analysis_dict, num
+from .telemetry import LATENCY_BINS, PHASES_FILE
+
+__all__ = [
+    "PHASES_FILE",
+    "TICK_PHASES",
+    "build_phase_ledger",
+    "phase_rows",
+    "phase_specs",
+    "write_phase_rows",
+]
+
+# Canonical phase order — the tick's dataflow order (engine._tick). A
+# program variant compiles a subset: lat_hist/telemetry only under
+# telemetry=true, faults only with an armed schedule.
+TICK_PHASES = (
+    "faults",
+    "deliver",
+    "lat_hist",
+    "step",
+    "sync",
+    "net_commit",
+    "telemetry",
+)
+
+# cost_analysis fields the ledger carries per phase (the keys
+# cost_analysis_dict normalizes to)
+_COST_KEYS = ("flops", "bytes_accessed", "transcendentals")
+
+
+def phase_specs(prog, concrete: bool = False, seed: int = 0) -> list:
+    """``[(name, fn, args), ...]`` for the phases compiled into ``prog``
+    (an ``engine.SimProgram``), in :data:`TICK_PHASES` order.
+
+    ``fn`` is a standalone jittable closure over the program's static
+    config; ``args`` are its example inputs at the run's REAL shapes —
+    ``jax.ShapeDtypeStruct`` avals by default (lowering/cost analysis
+    allocates nothing), or concrete device values with
+    ``concrete=True`` (the measured-calibration path; costs one carry
+    init plus the derived intermediates)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .api import CRASH
+    from .net import deliver, latency_histogram
+    from .sync_kernel import update_sync
+
+    if concrete:
+        carry = jax.jit(lambda: prog.init_carry(seed))()
+
+        def derive(f, *args):
+            return jax.jit(f)(*args)
+
+    else:
+        carry = jax.eval_shape(lambda: prog.init_carry(seed))
+        derive = jax.eval_shape
+
+    t = carry.t
+    scalar = (
+        jnp.int32(0)
+        if concrete
+        else jax.ShapeDtypeStruct((), jnp.int32)
+    )
+
+    def f_deliver(cal, t_):
+        return deliver(cal, t_, transport=prog.transport)
+
+    def f_lat_hist(cal, inbox, t_):
+        return latency_histogram(
+            cal,
+            inbox,
+            t_,
+            prog._lat_group_of,
+            len(prog.groups),
+            LATENCY_BINS,
+        )
+
+    def f_step(carry_, inbox, t_):
+        return prog._step_phase(carry_, inbox, t_)
+
+    def f_sync(sync, signals, pub_payload, pub_valid, sub_consume):
+        return update_sync(sync, signals, pub_payload, pub_valid, sub_consume)
+
+    def f_faults(carry_, t_):
+        return prog._fault_phase(carry_, t_)
+
+    def f_net_commit(cal, link, step, t_, k_msg, dead):
+        return prog._net_commit_phase(cal, link, step, t_, k_msg, dead)
+
+    def f_telemetry(t_, status, sync, scalars):
+        return prog._telemetry_phase(t_, status, sync, *scalars)
+
+    # derived example inputs, chained exactly like the tick's dataflow
+    _, inbox = derive(f_deliver, carry.cal, t)
+    step = derive(f_step, carry, inbox, t)
+    k_msg = derive(lambda k: jax.random.split(k)[1], carry.net_key)
+    if prog.faults is not None:
+        dead = derive(
+            lambda c, t_: prog._fault_phase(c, t_)[4], carry, t
+        )
+        if dead is None:  # defensive: schedule without kill masks
+            dead = derive(lambda s: s == CRASH, carry.status)
+    else:
+        dead = None
+
+    specs: list = []
+    if prog.faults is not None:
+        specs.append(("faults", f_faults, (carry, t)))
+    specs.append(("deliver", f_deliver, (carry.cal, t)))
+    if prog.telemetry:
+        specs.append(("lat_hist", f_lat_hist, (carry.cal, inbox, t)))
+    specs.append(("step", f_step, (carry, inbox, t)))
+    specs.append(
+        (
+            "sync",
+            f_sync,
+            (
+                carry.sync,
+                step["signals"],
+                step["pub_payload"],
+                step["pub_valid"],
+                step["sub_consume"],
+            ),
+        )
+    )
+    specs.append(
+        ("net_commit", f_net_commit, (carry.cal, carry.link, step, t, k_msg, dead))
+    )
+    if prog.telemetry:
+        specs.append(
+            (
+                "telemetry",
+                f_telemetry,
+                (t, step["status"], carry.sync, (scalar,) * 9),
+            )
+        )
+    return specs
+
+
+def _phase_cost(fn, args) -> dict:
+    """Lower + compile one phase standalone and harvest its normalized
+    cost analysis. Never raises (the cost harvest is already
+    never-raising; a phase whose lowering fails contributes an empty
+    row rather than killing the ledger)."""
+    import jax
+
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+    except Exception:  # noqa: BLE001 — observability never raises
+        return {}
+    return cost_analysis_dict(compiled)
+
+
+def _measure_phases(specs, reps: int) -> dict[str, float]:
+    """Time each phase in isolation: jit, warm once (compile excluded),
+    then ``reps`` back-to-back calls bracketed by one block — measured
+    wall / reps = ms per call. Uses the concrete inputs
+    ``phase_specs(concrete=True)`` built, so every phase runs the real
+    shapes. A D2H read forces completion even on remotely-tunneled
+    backends where block_until_ready may return early (the bench.py
+    workaround)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    out: dict[str, float] = {}
+    for name, fn, args in specs:
+        try:
+            jfn = jax.jit(fn)
+            res = jfn(*args)
+            jax.block_until_ready(res)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                res = jfn(*args)
+            jax.block_until_ready(res)
+            leaves = jax.tree.leaves(res)
+            if leaves:
+                np.asarray(leaves[0])
+            out[name] = (time.perf_counter() - t0) * 1e3 / max(reps, 1)
+        except Exception:  # noqa: BLE001 — calibration is best-effort
+            continue
+    return out
+
+
+def build_phase_ledger(
+    prog,
+    whole: dict | None = None,
+    measure: int = 0,
+    seed: int = 0,
+) -> dict:
+    """Build the ``sim.phases`` journal block for one program.
+
+    ``whole`` is an optional pre-harvested whole-program cost dict for
+    one CHUNK dispatch (e.g. the perf ledger's ``compile`` block — it
+    may carry extra keys; only the cost fields are read). When absent,
+    the chunk program is lowered + compiled here (a persistent-cache
+    read when the run already compiled it). ``measure > 0`` adds the
+    measured ms/tick calibration at that many repetitions per phase.
+
+    Block shape::
+
+        {transport, chunk, instances,
+         phases: [{phase, flops?, bytes_accessed?, transcendentals?,
+                   flops_frac?, bytes_frac?, measured_ms?, measured_reps?}],
+         whole_per_tick: {flops?, bytes_accessed?, transcendentals?},
+         residual: {flops?, bytes_accessed?, transcendentals?},
+         coverage: {flops_frac?, bytes_frac?}}
+
+    The invariant consumers may rely on (and tests pin): for every cost
+    field present in ``whole_per_tick``, Σ phases + residual ==
+    whole_per_tick EXACTLY (the residual is defined as the difference,
+    and may be negative — standalone phases lose cross-phase fusion the
+    whole program has)."""
+    import jax
+
+    specs = phase_specs(prog)
+    rows: list[dict[str, Any]] = []
+    for name, fn, args in specs:
+        rows.append({"phase": name, **_phase_cost(fn, args)})
+    if not isinstance(whole, dict) or not any(
+        num(whole.get(k)) for k in _COST_KEYS
+    ):
+        carry = jax.eval_shape(lambda: prog.init_carry(seed))
+        try:
+            # same donation as the run's chunk program, so a warm
+            # persistent cache serves this instead of a second compile
+            whole = cost_analysis_dict(
+                jax.jit(prog._chunk_step, donate_argnums=0)
+                .lower(carry)
+                .compile()
+            )
+        except Exception:  # noqa: BLE001 — observability never raises
+            whole = {}
+    chunk = max(int(prog.chunk), 1)
+    whole_tick = {
+        k: float(num(whole.get(k)) or 0.0) / chunk
+        for k in _COST_KEYS
+        if num(whole.get(k))
+    }
+    sums = {
+        k: sum(float(r.get(k, 0.0) or 0.0) for r in rows) for k in _COST_KEYS
+    }
+    residual = {k: whole_tick[k] - sums[k] for k in whole_tick}
+    for r in rows:
+        for key, frac in (("flops", "flops_frac"), ("bytes_accessed", "bytes_frac")):
+            if whole_tick.get(key) and r.get(key) is not None:
+                r[frac] = round(float(r[key]) / whole_tick[key], 4)
+    if measure > 0:
+        measured = _measure_phases(
+            phase_specs(prog, concrete=True, seed=seed), int(measure)
+        )
+        for r in rows:
+            if r["phase"] in measured:
+                r["measured_ms"] = round(measured[r["phase"]], 6)
+                r["measured_reps"] = int(measure)
+    coverage = {}
+    for key, frac in (("flops", "flops_frac"), ("bytes_accessed", "bytes_frac")):
+        if whole_tick.get(key):
+            coverage[frac] = round(sums[key] / whole_tick[key], 4)
+    return {
+        "transport": prog.transport,
+        "chunk": int(prog.chunk),
+        "instances": int(prog.n),
+        "phases": rows,
+        "whole_per_tick": {k: round(v, 3) for k, v in whole_tick.items()},
+        "residual": {k: round(v, 3) for k, v in residual.items()},
+        "coverage": coverage,
+    }
+
+
+def phase_rows(block: dict) -> list[dict]:
+    """Flatten a ``sim.phases`` block into uniform per-row dicts — one
+    per phase, plus the synthesized ``residual`` and ``total`` rows —
+    the ONE row shape behind the jsonl artifact, the ``tg_phase_*``
+    Prometheus gauges, and the console table. Shape-tolerant: a foreign
+    or truncated block yields what it holds, never raises."""
+    if not isinstance(block, dict):
+        return []
+    rows: list[dict] = []
+    transport = block.get("transport", "xla")
+    for r in block.get("phases") or []:
+        if isinstance(r, dict) and r.get("phase"):
+            rows.append({"transport": transport, **r})
+    for name, key in (("residual", "residual"), ("total", "whole_per_tick")):
+        src = block.get(key)
+        if isinstance(src, dict) and src:
+            rows.append(
+                {
+                    "transport": transport,
+                    "phase": name,
+                    **{
+                        k: v
+                        for k, v in src.items()
+                        if num(v) is not None
+                    },
+                }
+            )
+    return rows
+
+
+def write_phase_rows(path: str, ident: dict, block: dict) -> int:
+    """Write the block's rows as ``sim_phases.jsonl`` (one row per phase
+    + residual + total, each carrying the run identity). Best-effort
+    like every observability writer: IO failure writes nothing and
+    returns 0 — the journal block remains the durable copy."""
+    rows = phase_rows(block)
+    if not rows:
+        return 0
+    try:
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({**ident, **row}) + "\n")
+    except (OSError, ValueError):
+        return 0
+    return len(rows)

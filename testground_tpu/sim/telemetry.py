@@ -30,6 +30,7 @@ __all__ = [
     "LATENCY_BINS",
     "LATENCY_FILE",
     "PERF_FILE",
+    "PHASES_FILE",
     "SIM_SERIES_FILE",
     "SPAN_FILE",
     "TELEMETRY_FIXED_COLUMNS",
@@ -52,6 +53,10 @@ LATENCY_FILE = "sim_latency.jsonl"
 # peer·ticks/s, achieved FLOP/s and bytes/s, device bytes-in-use) — the
 # ``sim.perf.*`` measurement family.
 PERF_FILE = "sim_perf.jsonl"
+# Per-phase tick attribution rows (sim/phases.py: per-phase XLA cost
+# analysis + optional measured ms/tick, one row per phase plus the
+# residual and whole-program rows) — the ``tg perf --phases`` backend.
+PHASES_FILE = "sim_phases.jsonl"
 
 # Delivery-latency histogram schema, shared by the device accumulator
 # (``sim/net.py::latency_histogram``) and every host-side consumer. Bins

@@ -1,0 +1,579 @@
+"""Tick phase attribution plane (docs/OBSERVABILITY.md "Phase
+attribution"; sim/phases.py).
+
+Pins the acceptance contract: each compiled-in tick phase lowers
+standalone and its cost rows sum to the whole-program chunk cost within
+the EXPLICIT residual row (both transport backends — pallas in
+interpret mode on CPU); the attribution is pure out-of-line bookkeeping
+(the run's chunk program is jaxpr-identical before and after building
+the ledger, and the named_scope annotations change no jaxpr); the
+measured calibration stamps every phase; the journal/jsonl/Prometheus/
+artifact surfaces agree.
+"""
+
+import json
+import os
+
+import pytest
+
+from testground_tpu.api import RunGroup
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import (
+    instantiate_testcase,
+    load_sim_testcases,
+)
+from testground_tpu.sim.phases import (
+    PHASES_FILE,
+    TICK_PHASES,
+    build_phase_ledger,
+    phase_rows,
+    write_phase_rows,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def make_groups(*counts, params=None):
+    return build_groups(
+        [
+            RunGroup(id=f"g{i}", instances=c, parameters=dict(params or {}))
+            for i, c in enumerate(counts)
+        ]
+    )
+
+
+def make_prog(case="ping-pong", plan="network", n=4, params=None, **kw):
+    factory = load_sim_testcases(os.path.join(PLANS, plan))[case]
+    groups = make_groups(n, params=params)
+    tc = instantiate_testcase(factory, groups, tick_ms=1.0)
+    return SimProgram(tc, groups, chunk=8, **kw)
+
+
+def assert_conserves(block):
+    """Σ phases + residual == whole_per_tick, for every cost field the
+    whole-program analysis produced (the block rounds to 3 decimals)."""
+    whole = block["whole_per_tick"]
+    assert whole, "no whole-program cost analysis on this backend"
+    for key, total in whole.items():
+        s = sum(float(r.get(key, 0.0) or 0.0) for r in block["phases"])
+        assert (
+            abs(s + block["residual"][key] - total)
+            <= 0.02 + 1e-6 * abs(total)
+        ), (key, s, block["residual"][key], total)
+
+
+# --------------------------------------------------------- static ledger
+
+
+class TestPhaseLedger:
+    def test_coverage_and_residual_conservation_xla(self):
+        """Telemetry program on the default backend: every compiled-in
+        phase contributes a cost row, in dataflow order, the rows sum
+        to the whole-program chunk cost within the explicit residual,
+        and the measured calibration stamps every phase (one program
+        build serves both assertions — tier-1 budget)."""
+        prog = make_prog(telemetry=True)
+        block = build_phase_ledger(prog, measure=2)
+        names = [r["phase"] for r in block["phases"]]
+        assert names == [
+            "deliver",
+            "lat_hist",
+            "step",
+            "sync",
+            "net_commit",
+            "telemetry",
+        ]
+        assert set(names) <= set(TICK_PHASES)
+        assert block["transport"] == "xla"
+        assert block["chunk"] == 8 and block["instances"] == 4
+        assert_conserves(block)
+        # fractions accompany every row where the whole-program analysis
+        # produced the denominator
+        for r in block["phases"]:
+            if "bytes_accessed" in r and block["whole_per_tick"].get(
+                "bytes_accessed"
+            ):
+                assert "bytes_frac" in r
+        # measured calibration: every phase timed, reps recorded
+        for r in block["phases"]:
+            assert r.get("measured_ms", 0) > 0, r
+            assert r.get("measured_reps") == 2
+
+    def test_pallas_backend_ledger_interpret_mode(self):
+        """transport=pallas (interpret mode on CPU) attributes the same
+        phase set minus the telemetry-gated rows, tagged with its
+        backend, and conserves against ITS whole-program cost."""
+        prog = make_prog(
+            case="pingpong-sustained",
+            params={
+                "duration_ticks": "64",
+                "latency_ms": "4",
+                "latency2_ms": "2",
+                "reshape_every": "1000",
+            },
+            transport="pallas",
+        )
+        block = build_phase_ledger(prog)
+        names = [r["phase"] for r in block["phases"]]
+        assert names == ["deliver", "step", "sync", "net_commit"]
+        assert block["transport"] == "pallas"
+        assert_conserves(block)
+
+    def test_faults_phase_present_when_scheduled(self):
+        from testground_tpu.sim.faults import build_fault_schedule
+
+        factory = load_sim_testcases(os.path.join(PLANS, "network"))[
+            "ping-pong"
+        ]
+        groups = make_groups(4)
+        tc = instantiate_testcase(factory, groups, tick_ms=1.0)
+        sched = build_fault_schedule(
+            groups,
+            {"g0": [{"kind": "crash", "start_ms": 3, "instances": "0:1"}]},
+            1.0,
+        )
+        prog = SimProgram(tc, groups, chunk=8, faults=sched)
+        block = build_phase_ledger(prog)
+        names = [r["phase"] for r in block["phases"]]
+        assert names[0] == "faults"
+        assert_conserves(block)
+
+    def test_ledger_leaves_the_program_untouched(self):
+        """The attribution is out-of-line bookkeeping: the run's chunk
+        program traces the identical jaxpr before and after building
+        the ledger (the zero-overhead contract, extended to this
+        plane)."""
+        import jax
+
+        prog = make_prog(telemetry=True)
+        carry = jax.eval_shape(lambda: prog.init_carry(0))
+        before = str(jax.make_jaxpr(prog._chunk_step)(carry))
+        build_phase_ledger(prog)
+        assert str(jax.make_jaxpr(prog._chunk_step)(carry)) == before
+
+    def test_whole_cost_reuse_normalizes_per_tick(self):
+        """A pre-harvested whole-program block (the perf ledger's
+        compile analysis — per CHUNK) is reused instead of recompiling,
+        normalized by the chunk length."""
+        prog = make_prog()
+        block = build_phase_ledger(
+            prog, whole={"flops": 800.0, "bytes_accessed": 1600.0}
+        )
+        assert block["whole_per_tick"]["flops"] == pytest.approx(100.0)
+        assert block["whole_per_tick"]["bytes_accessed"] == pytest.approx(
+            200.0
+        )
+        assert_conserves(block)
+
+
+# -------------------------------------------------------- named scopes
+
+
+class TestNamedScopes:
+    def test_tick_traces_under_phase_scopes(self, monkeypatch):
+        """Every tick phase executes under jax.named_scope("tg.<phase>")
+        — the XProf/Perfetto attribution contract. Recorded by
+        intercepting named_scope during a trace of the chunk program."""
+        import contextlib
+
+        import jax
+
+        seen = []
+        real = jax.named_scope
+
+        def recorder(name):
+            seen.append(name)
+            return (
+                real(name)
+                if isinstance(name, str)
+                else contextlib.nullcontext()
+            )
+
+        monkeypatch.setattr(jax, "named_scope", recorder)
+        prog = make_prog(telemetry=True)
+        jax.make_jaxpr(prog._chunk_step)(
+            jax.eval_shape(lambda: prog.init_carry(0))
+        )
+        for phase in (
+            "tg.faults",
+            "tg.deliver",
+            "tg.lat_hist",
+            "tg.step",
+            "tg.net_commit",
+            "tg.sync",
+            "tg.trace",
+            "tg.telemetry",
+        ):
+            assert phase in seen, (phase, sorted(set(seen)))
+
+    def test_default_program_jaxpr_matches_a_scopeless_trace(self):
+        """named_scope is name-stack metadata only: stripping the scopes
+        changes NO jaxpr — the pinned zero-overhead contract holds with
+        the annotations compiled in."""
+        import contextlib
+        from unittest import mock
+
+        import jax
+
+        prog = make_prog()
+        carry = jax.eval_shape(lambda: prog.init_carry(0))
+        with_scopes = str(jax.make_jaxpr(prog._chunk_step)(carry))
+        with mock.patch.object(
+            jax, "named_scope", lambda name: contextlib.nullcontext()
+        ):
+            without = str(jax.make_jaxpr(prog._chunk_step)(carry))
+        assert with_scopes == without
+
+
+# ------------------------------------------------------------ row shapes
+
+
+class TestPhaseRows:
+    BLOCK = {
+        "transport": "pallas",
+        "chunk": 8,
+        "phases": [
+            {"phase": "deliver", "flops": 10.0, "bytes_accessed": 100.0},
+            {"phase": "net_commit", "flops": 30.0},
+        ],
+        "whole_per_tick": {"flops": 50.0, "bytes_accessed": 120.0},
+        "residual": {"flops": 10.0, "bytes_accessed": 20.0},
+    }
+
+    def test_rows_include_residual_and_total(self):
+        rows = phase_rows(self.BLOCK)
+        assert [r["phase"] for r in rows] == [
+            "deliver",
+            "net_commit",
+            "residual",
+            "total",
+        ]
+        assert all(r["transport"] == "pallas" for r in rows)
+        assert rows[-1]["flops"] == 50.0
+
+    def test_tolerates_foreign_shapes(self):
+        assert phase_rows({}) == []
+        assert phase_rows(None) == []
+        assert phase_rows({"phases": [{"nope": 1}, "junk"]}) == []
+
+    def test_write_phase_rows_roundtrip(self, tmp_path):
+        path = os.path.join(tmp_path, PHASES_FILE)
+        n = write_phase_rows(path, {"run": "r1", "plan": "p"}, self.BLOCK)
+        assert n == 4
+        rows = [json.loads(ln) for ln in open(path)]
+        assert len(rows) == 4
+        assert rows[0]["run"] == "r1" and rows[0]["phase"] == "deliver"
+        assert rows[-2]["phase"] == "residual"
+
+    def test_render_phase_table(self):
+        from testground_tpu.runners.pretty import render_phase_table
+
+        table = render_phase_table({"phases": self.BLOCK})
+        assert "net_commit" in table and "residual" in table
+        assert "transport=pallas" in table
+        # absent block degrades to a hint, never a crash
+        hint = render_phase_table({"sim": {}})
+        assert "phases=true" in hint
+
+
+# ------------------------------------------------------------ prometheus
+
+
+class TestPrometheusPhases:
+    def _task(self, phases):
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+
+        return Task(
+            id="t1",
+            type=TaskType.RUN,
+            plan="network",
+            case="ping-pong",
+            states=[
+                DatedState(state=State.SCHEDULED, created=1.0),
+                DatedState(state=State.COMPLETE, created=2.0),
+            ],
+            result={
+                "outcome": "success",
+                "journal": {"sim": {"ticks": 16, "phases": phases}},
+            },
+        )
+
+    def test_phase_gauges_valid_and_labeled(self):
+        import re
+
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        block = dict(TestPhaseRows.BLOCK)
+        block["phases"] = [
+            {**block["phases"][0], "measured_ms": 0.25},
+            block["phases"][1],
+        ]
+        text = render_prometheus([self._task(block)])
+        line_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+            r"-?[0-9.e+-]+(\.[0-9]+)?$"
+        )
+        for line in text.strip().splitlines():
+            if line.startswith("# "):
+                continue
+            assert line_re.match(line), line
+        assert 'tg_phase_flops{task="t1"' in text
+        assert 'phase="deliver"' in text
+        assert 'phase="residual"' in text and 'phase="total"' in text
+        assert 'transport="pallas"' in text
+        assert "tg_phase_measured_ms{" in text
+        assert text.count("# TYPE tg_phase_flops") == 1
+
+    def test_absent_block_adds_no_phase_families(self):
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        text = render_prometheus([self._task({})])
+        assert "tg_phase_" not in text
+
+
+# ------------------------------------------------ payload + stream + artifact
+
+
+class TestSurfaces:
+    def test_perf_payload_surfaces_phases_top_level(self):
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+
+        block = {"phases": [{"phase": "deliver"}], "transport": "xla"}
+        t = Task(
+            id="t1",
+            type=TaskType.RUN,
+            plan="p",
+            case="c",
+            states=[DatedState(state=State.COMPLETE, created=1.0)],
+            result={"journal": {"sim": {"ticks": 8, "phases": block}}},
+        )
+        payload = t.perf_payload()
+        assert payload["phases"] == block
+        assert "phases" not in payload["sim"]  # surfaced, not duplicated
+
+    def test_stream_family_registered(self):
+        from testground_tpu.engine.stream import STREAM_FAMILIES
+
+        assert ("phases", PHASES_FILE) in STREAM_FAMILIES
+
+    def test_artifact_whitelist(self):
+        from testground_tpu.daemon.server import _Handler
+
+        rp = _Handler._artifact_relpath
+        assert rp(PHASES_FILE) == PHASES_FILE
+        ok = "profiles/plugins/profile/sess_1/host.xplane.pb"
+        assert rp(ok) == os.path.join(*ok.split("/"))
+        # traversal, wrong depth, wrong suffix, absolute: all refused
+        assert rp("profiles/plugins/profile/../x/host.xplane.pb") is None
+        assert rp("profiles/plugins/profile/host.xplane.pb") is None
+        assert rp("profiles/plugins/profile/a/b/host.xplane.pb") is None
+        assert rp("profiles/plugins/profile/sess/evil.pstats") is None
+        assert rp("plugins/profile/sess/host.xplane.pb") is None
+        assert rp("/etc/passwd") is None
+
+
+# ------------------------------------------------------- chunked profiler
+
+
+class TestChunkedProfiler:
+    def _patched(self, monkeypatch):
+        import jax
+
+        from testground_tpu.sim import executor as ex
+
+        calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+        )
+        return ex._ChunkedProfiler, calls
+
+    def test_window_starts_after_warmup_and_stops_after_n(self, monkeypatch):
+        cls, calls = self._patched(monkeypatch)
+        p = cls("/tmp/prof", chunks=2)
+        p.on_chunk(16)  # warmup chunk done → trace starts here
+        assert calls == [("start", "/tmp/prof")]
+        p.on_chunk(32)
+        assert p.captured == 1 and not p.done
+        p.on_chunk(48)  # second captured chunk → stop
+        assert calls[-1] == ("stop",)
+        p.on_chunk(64)  # past the window: no-op
+        assert len(calls) == 2
+        assert p.journal() == {
+            "dir": "profiles",
+            "mode": "chunks",
+            "chunks": 2,
+            "from_tick": 16,
+            "to_tick": 48,
+        }
+
+    def test_close_stops_an_open_capture(self, monkeypatch):
+        """A run finishing (or failing) inside the window still closes
+        the trace — an unterminated session would poison the process."""
+        cls, calls = self._patched(monkeypatch)
+        p = cls("/tmp/prof", chunks=8)
+        p.on_chunk(16)
+        p.on_chunk(32)
+        p.close()
+        assert calls[-1] == ("stop",)
+        p.close()  # idempotent
+        assert calls.count(("stop",)) == 1
+
+    def test_profiler_failure_disables_capture_not_the_run(
+        self, monkeypatch
+    ):
+        import jax
+
+        from testground_tpu.sim import executor as ex
+
+        def boom(d):
+            raise RuntimeError("profiler unavailable")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        p = ex._ChunkedProfiler("/tmp/prof", chunks=1)
+        p.on_chunk(16)  # swallowed
+        p.on_chunk(32)
+        p.close()
+        assert p.done and not p.started
+
+
+# ------------------------------------------------------------------ e2e
+# (tg_home fixture from tests/conftest.py: isolated $TESTGROUND_HOME)
+
+
+class TestExecutorE2E:
+    def _run(self, run_params, engine=None, env=None):
+        from tests.test_sim_runner import run_sim
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.engine import Engine, EngineConfig, Outcome
+        from testground_tpu.sim.runner import SimJaxRunner
+
+        from testground_tpu.config import EnvConfig
+
+        own = engine is None
+        if own:
+            env = EnvConfig.load()
+            engine = Engine(
+                EngineConfig(
+                    env=env,
+                    builders=[SimPlanBuilder()],
+                    runners=[SimJaxRunner()],
+                )
+            )
+            engine.start_workers()
+        try:
+            task = run_sim(
+                engine,
+                "network",
+                "ping-pong",
+                instances=2,
+                run_params=run_params,
+            )
+        finally:
+            if own:
+                engine.stop()
+        assert task.outcome() == Outcome.SUCCESS, task.error
+        return env, engine, task
+
+    def test_journal_and_jsonl_agree_and_off_by_default(self, tg_home):
+        """phases=true journals sim.phases and mirrors it row for row
+        to sim_phases.jsonl (phases + residual + total), conserving the
+        cost identity end-to-end; without the knob the run stays
+        phase-free (one engine serves both runs — tier-1 budget)."""
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.engine import Engine, EngineConfig
+        from testground_tpu.sim.runner import SimJaxRunner
+
+        env = EnvConfig.load()
+        engine = Engine(
+            EngineConfig(
+                env=env,
+                builders=[SimPlanBuilder()],
+                runners=[SimJaxRunner()],
+            )
+        )
+        engine.start_workers()
+        try:
+            _, _, task = self._run(
+                {"chunk": 16, "phases": True, "telemetry": True},
+                engine=engine,
+                env=env,
+            )
+            _, _, task_off = self._run(
+                {"chunk": 16}, engine=engine, env=env
+            )
+        finally:
+            engine.stop()
+        block = task.result["journal"]["sim"]["phases"]
+        assert_conserves(block)
+        names = [r["phase"] for r in block["phases"]]
+        assert "net_commit" in names and "telemetry" in names
+        path = os.path.join(
+            env.dirs.outputs(), "network", task.id, PHASES_FILE
+        )
+        rows = [json.loads(ln) for ln in open(path)]
+        assert [r["phase"] for r in rows] == names + ["residual", "total"]
+        assert block["series"] == {"rows": len(rows), "file": PHASES_FILE}
+        # static-only run: no measured column anywhere
+        assert not any("measured_ms" in r for r in block["phases"])
+        # off by default: no journal block, no jsonl
+        assert "phases" not in task_off.result["journal"]["sim"]
+        assert not os.path.isfile(
+            os.path.join(
+                env.dirs.outputs(), "network", task_off.id, PHASES_FILE
+            )
+        )
+
+    @pytest.mark.slow  # ~29s: jax.profiler start/stop + xplane
+    # serialization put it past the tier-1 ~20s ceiling (the whole-run
+    # profile capture test is slow-marked for the same reason); the
+    # window logic itself is covered by the fast TestChunkedProfiler
+    def test_bounded_profile_capture(self, tg_home):
+        """profile_chunks=N captures only the configured chunk window
+        after warmup (journaled), instead of wrapping the whole run in
+        jax.profiler.trace."""
+        env, _, task = self._run(
+            {"chunk": 16, "profile": True, "profile_chunks": 1},
+        )
+        prof = task.result["journal"]["profile"]
+        assert prof["mode"] == "chunks"
+        assert prof["chunks"] == 1
+        # window: starts at the first chunk boundary, spans one chunk
+        assert prof["from_tick"] == 16 and prof["to_tick"] == 32
+        cap_dir = os.path.join(
+            env.dirs.outputs(),
+            "network",
+            task.id,
+            "profiles",
+            "plugins",
+            "profile",
+        )
+        assert os.path.isdir(cap_dir)
+        captures = [
+            os.path.join(dp, f)
+            for dp, _, fs in os.walk(cap_dir)
+            for f in fs
+            if f.endswith(".xplane.pb")
+        ]
+        assert captures, "no xplane capture written"
+        # every capture file is fetchable through the artifact whitelist
+        from testground_tpu.daemon.server import _Handler
+
+        run_dir = os.path.join(env.dirs.outputs(), "network", task.id)
+        for p in captures:
+            rel = os.path.relpath(p, run_dir).replace(os.sep, "/")
+            assert _Handler._artifact_relpath(rel) is not None, rel

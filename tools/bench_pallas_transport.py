@@ -109,6 +109,45 @@ def _acc(limb) -> int:
     return _acc_total(np.asarray(limb))
 
 
+def _print_phase_ab(out: dict) -> None:
+    """Per-phase A/B split to stderr: measured ms/tick when the
+    calibration ran (the chip evidence), XLA bytes-accessed otherwise.
+    The transport phases (deliver + net_commit — the ops the kernels
+    replace) are where the verdict lives; the rest should be ~equal and
+    any drift there flags a mis-attributed win."""
+    from testground_tpu.sim.phases import TICK_PHASES
+
+    px = {
+        r["phase"]: r
+        for r in (out["xla"].get("phases") or {}).get("phases", [])
+    }
+    pp = {
+        r["phase"]: r
+        for r in (out["pallas"].get("phases") or {}).get("phases", [])
+    }
+    for name in TICK_PHASES:
+        a, b = px.get(name), pp.get(name)
+        if a is None and b is None:
+            continue
+        key, unit = (
+            ("measured_ms", "ms")
+            if (a or {}).get("measured_ms") is not None
+            and (b or {}).get("measured_ms") is not None
+            else ("bytes_accessed", "B")
+        )
+        va = (a or {}).get(key)
+        vb = (b or {}).get(key)
+        ratio = (
+            f" (pallas_vs_xla x{va / vb:.3f})" if va and vb else ""
+        )
+        print(
+            f"# phase {name}: xla "
+            f"{va if va is not None else '?'}{unit} vs pallas "
+            f"{vb if vb is not None else '?'}{unit}{ratio}",
+            file=sys.stderr,
+        )
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--instances", type=int, default=2048)
@@ -117,6 +156,14 @@ def main() -> int:
     p.add_argument(
         "--workload", choices=sorted(WORKLOADS), default="sustained"
     )
+    # per-backend phase attribution (sim/phases.py): bank the chip
+    # verdict WITH the per-phase split in one command (ROADMAP item 1) —
+    # each backend's ledger lands in the JSON line and the per-phase A/B
+    # ratio prints alongside the headline ms/tick. --phase-reps times
+    # each phase jitted in isolation (measured ms/tick — the per-op
+    # evidence); 0 keeps the static XLA cost rows only.
+    p.add_argument("--phases", action="store_true")
+    p.add_argument("--phase-reps", type=int, default=30)
     args = p.parse_args()
 
     from testground_tpu.utils.compile_cache import enable_compile_cache
@@ -151,11 +198,19 @@ def main() -> int:
             transport,
         )
         out[transport] = _measure(prog, args.ticks)
+        if args.phases:
+            from testground_tpu.sim.phases import build_phase_ledger
+
+            out[transport]["phases"] = build_phase_ledger(
+                prog, measure=max(0, args.phase_reps)
+            )
         print(
             f"# {transport}: {out[transport]['ms_per_tick']} ms/tick "
             f"(+{out[transport]['compile_secs']}s compile)",
             file=sys.stderr,
         )
+    if args.phases:
+        _print_phase_ab(out)
     if out["xla"]["flow"] != out["pallas"]["flow"]:
         print(
             "bench_pallas_transport: FAIL — flow totals diverge between "

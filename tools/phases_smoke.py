@@ -1,0 +1,150 @@
+"""``make phases-smoke``: run a tiny composition with the phase
+attribution plane armed and assert its contract end-to-end
+(docs/OBSERVABILITY.md "Phase attribution") —
+
+- the journal carries a ``sim.phases`` block: one row per compiled-in
+  tick phase (telemetry on → deliver / lat_hist / step / sync /
+  net_commit / telemetry; no faults declared → no faults row), plus the
+  whole-program and residual rows;
+- conservation BY CONSTRUCTION: for every cost field present,
+  Σ phases + residual == whole_per_tick (to the block's rounding);
+- the measured calibration (``phases_measure``) stamped every phase
+  with a positive ms/tick;
+- ``sim_phases.jsonl`` exists and mirrors the journal block row for
+  row (phases + residual + total, each tagged with the run identity
+  and transport);
+- the console table renders and the Prometheus exposition carries
+  ``tg_phase_*`` gauges for the task.
+
+Exits non-zero with a readable message on any violation; prints a
+one-line summary on success. Self-contained: runs against a temporary
+$TESTGROUND_HOME on the CPU backend, so it is safe in CI.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def fail(msg: str) -> "None":
+    print(f"phases-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-smoke-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tests.test_sim_runner import run_sim
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome
+    from testground_tpu.metrics.prometheus import render_prometheus
+    from testground_tpu.runners.pretty import render_phase_table
+    from testground_tpu.sim.phases import PHASES_FILE
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.start_workers()
+    try:
+        task = run_sim(
+            engine,
+            "network",
+            "ping-pong",
+            instances=2,
+            run_params={
+                "chunk": 16,
+                "telemetry": True,
+                "phases": True,
+                "phases_measure": 2,
+            },
+        )
+    finally:
+        engine.stop()
+    if task.outcome() != Outcome.SUCCESS:
+        fail(f"run outcome {task.outcome().value}: {task.error}")
+
+    sim = task.result["journal"]["sim"]
+    block = sim.get("phases")
+    if not block:
+        fail("journal sim.phases block is absent")
+    rows = block.get("phases") or []
+    names = [r.get("phase") for r in rows]
+    expected = ["deliver", "lat_hist", "step", "sync", "net_commit", "telemetry"]
+    if names != expected:
+        fail(f"phase rows {names} != expected {expected}")
+    whole = block.get("whole_per_tick") or {}
+    residual = block.get("residual") or {}
+    if not whole:
+        fail("whole_per_tick is empty (no cost analysis on CPU?)")
+    for key, total in whole.items():
+        s = sum(float(r.get(key, 0.0) or 0.0) for r in rows)
+        if abs(s + residual.get(key, 0.0) - total) > 0.02 + 1e-6 * abs(total):
+            fail(
+                f"Σ phases[{key}] {s} + residual {residual.get(key)} != "
+                f"whole {total}"
+            )
+    for r in rows:
+        if not (r.get("measured_ms") or 0) > 0:
+            fail(f"phase {r.get('phase')}: measured_ms missing or <= 0")
+    if block.get("transport") != "xla":
+        fail(f"transport tag {block.get('transport')!r} != 'xla'")
+
+    path = os.path.join(env.dirs.outputs(), "network", task.id, PHASES_FILE)
+    if not os.path.isfile(path):
+        fail(f"{PHASES_FILE} was not written ({path})")
+    jrows = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            try:
+                jrows.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                fail(f"line {i + 1} is not JSON: {e}")
+    jnames = [r.get("phase") for r in jrows]
+    if jnames != expected + ["residual", "total"]:
+        fail(f"jsonl rows {jnames} != journal phases + residual + total")
+    for r in jrows:
+        for col in ("run", "plan", "case", "transport", "phase"):
+            if col not in r:
+                fail(f"jsonl row {r.get('phase')} missing column {col!r}")
+    series = block.get("series") or {}
+    if series.get("rows") != len(jrows):
+        fail(f"series.rows {series.get('rows')} != {len(jrows)} jsonl rows")
+
+    table = render_phase_table({"phases": block})
+    if "residual" not in table or "net_commit" not in table:
+        fail(f"rendered table lacks expected rows:\n{table}")
+    text = render_prometheus([task])
+    for metric in (
+        "tg_phase_flops",
+        "tg_phase_bytes_accessed",
+        "tg_phase_measured_ms",
+    ):
+        if f"\n{metric}{{" not in text:
+            fail(f"{metric} absent from the Prometheus exposition")
+    if 'phase="residual"' not in text or 'phase="total"' not in text:
+        fail("residual/total phase rows absent from the exposition")
+
+    print(
+        f"phases-smoke: OK — {len(rows)} phases, byte-coverage "
+        f"x{(block.get('coverage') or {}).get('bytes_frac', 0):.2f}, "
+        f"{len(jrows)} jsonl rows"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
