@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
 	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke \
-	phases-smoke
+	phases-smoke checkpoint-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -87,6 +87,15 @@ slo-smoke:
 # the rows to sim_phases.jsonl, and export tg_phase_* gauges
 phases-smoke:
 	$(PY) tools/phases_smoke.py
+
+# checkpoint/resume contract check (docs/CHECKPOINT.md): the chaos
+# smoke composition checkpointed every chunk, interrupted at tick 32
+# mid-fault-schedule, then resumed, must journal IDENTICAL ticks/flow/
+# fault/SLO totals and byte-equal telemetry + SLO streams vs an
+# uninterrupted run; retention bounded to checkpoint_keep; a truncated
+# snapshot refuses loudly with the typed CheckpointError
+checkpoint-smoke:
+	$(PY) tools/checkpoint_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -231,6 +231,72 @@ def register_run(sub) -> None:
     _add_metadata_flags(ps)
     ps.set_defaults(func=run_single_cmd)
 
+    pr = psub.add_parser(
+        "resume",
+        help="resume an interrupted checkpointed run from its newest "
+        "snapshot (docs/CHECKPOINT.md): re-queues the task's own "
+        "composition with runner config resume_from=<task>, so the new "
+        "run seeds its carry from the snapshot and continues "
+        "bit-identically",
+    )
+    pr.add_argument("task", help="task id of the checkpointed run")
+    pr.add_argument(
+        "--run-cfg",
+        action="append",
+        default=[],
+        help="override runner configuration k=v on the resumed run "
+        "(repeatable) — e.g. max_ticks=10000000 to extend a "
+        "budget-interrupted soak; program-shaping options still "
+        "validate against the snapshot manifest",
+    )
+    pr.add_argument(
+        "--detach",
+        action="store_true",
+        help="queue the resumed task and exit without waiting",
+    )
+    _add_metadata_flags(pr)
+    pr.set_defaults(func=run_resume_cmd)
+
+
+def run_resume_cmd(args) -> int:
+    """``tg run resume <task>``: rebuild the interrupted task's own
+    composition (artifacts already resolved, so no rebuild — the
+    snapshot's build_key validates the sources anyway) and queue it with
+    ``resume_from`` pointing at the old run's outputs dir."""
+    engine = _engine(args)
+    try:
+        t = engine.get_task(args.task)
+        if t is None:
+            raise KeyError(f"unknown task {args.task}")
+        if not t.composition:
+            raise ValueError(
+                f"task {args.task} carries no composition to resume"
+            )
+        comp = Composition.from_dict(t.composition)
+        if len(comp.runs) > 1:
+            # multi-[[runs]] tasks write one outputs dir PER run
+            # (<task>-<run id>) and every run would share this single
+            # resume_from — refuse readably instead of failing each run
+            # with "no snapshots" inside the executor
+            raise ValueError(
+                f"task {t.id} is a multi-[[runs]] composition "
+                f"({len(comp.runs)} runs) — resume one run at a time by "
+                "re-running the composition framed to that run "
+                "(--run-ids <id>) with run config "
+                f"resume_from = \"{t.id}-<run id>\""
+            )
+        comp.global_.run_config = dict(comp.global_.run_config or {})
+        comp.global_.run_config.update(
+            parse_key_values(getattr(args, "run_cfg", []))
+        )
+        comp.global_.run_config["resume_from"] = t.id
+        print(
+            f"resuming task {t.id} ({t.name()}) from its newest snapshot"
+        )
+    finally:
+        engine.stop()
+    return _run(args, comp)
+
 
 def run_composition_cmd(args) -> int:
     comp = load_composition(args.file)
@@ -1440,17 +1506,18 @@ def register_sim_worker(sub) -> None:
 
 def sim_worker_cmd(args) -> int:
     from testground_tpu.config import EnvConfig
-    from testground_tpu.sim.executor import sim_worker_loop
+    from testground_tpu.sim.executor import run_sim_worker
 
     plans_dir = args.plans or EnvConfig.load().dirs.plans()
-    sim_worker_loop(
+    # the wrapper turns a dead leader into a one-line clean exit
+    # instead of a distributed-runtime LOG(FATAL) (sim/executor.py)
+    return run_sim_worker(
         args.coordinator,
         args.num_processes,
         args.process_id,
         plans_dir,
         once=args.once,
     )
-    return 0
 
 
 def register_version(sub) -> None:

@@ -634,6 +634,13 @@ class _Handler(BaseHTTPRequestHandler):
     # instance artifacts.
     _PROFILE_PREFIX = ("profiles", "plugins", "profile")
     _PROFILE_SUFFIXES = (".xplane.pb",)
+    # checkpoint snapshots (docs/CHECKPOINT.md) live at
+    # checkpoints/ckpt-<tick>.npz under the run dir — served so an
+    # operator can migrate a run between machines (`GET /artifact` →
+    # drop into the destination run dir → `tg run resume`). Exact
+    # depth + name-shape validated, like the profile captures.
+    _CHECKPOINT_PREFIX = "checkpoints"
+    _CHECKPOINT_NAME = ("ckpt-", ".npz")
 
     @classmethod
     def _artifact_relpath(cls, name: str) -> str | None:
@@ -662,6 +669,14 @@ class _Handler(BaseHTTPRequestHandler):
             and tuple(parts[: len(cls._PROFILE_PREFIX)])
             == cls._PROFILE_PREFIX
             and parts[-1].endswith(cls._PROFILE_SUFFIXES)
+            and safe_parts
+        ):
+            return os.path.join(*parts)
+        if (
+            len(parts) == 2
+            and parts[0] == cls._CHECKPOINT_PREFIX
+            and parts[-1].startswith(cls._CHECKPOINT_NAME[0])
+            and parts[-1].endswith(cls._CHECKPOINT_NAME[1])
             and safe_parts
         ):
             return os.path.join(*parts)
@@ -710,7 +725,7 @@ class _Handler(BaseHTTPRequestHandler):
             "application/json"
             if name.endswith(".json")
             else "application/octet-stream"
-            if name.endswith((".pstats", ".pb"))
+            if name.endswith((".pstats", ".pb", ".npz"))
             else "application/x-ndjson",
         )
         self.send_header("Content-Length", str(size))

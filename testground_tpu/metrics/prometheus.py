@@ -290,6 +290,44 @@ def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
             ident,
             hbm.get("peak_bytes"),
         )
+        # checkpoint/resume plane (journal["sim"]["checkpoint"],
+        # docs/CHECKPOINT.md): snapshot progress gauges so a scraper can
+        # alert on a soak whose last checkpoint is falling behind
+        ck = (
+            sim.get("checkpoint")
+            if isinstance(sim.get("checkpoint"), dict)
+            else {}
+        )
+        if ck:
+            exp.add(
+                "tg_checkpoint_count",
+                "gauge",
+                "Snapshots the run wrote (checkpoint plane).",
+                ident,
+                ck.get("count"),
+            )
+            exp.add(
+                "tg_checkpoint_last_tick",
+                "gauge",
+                "Sim tick of the run's newest snapshot.",
+                ident,
+                ck.get("last_tick"),
+            )
+            exp.add(
+                "tg_checkpoint_bytes",
+                "gauge",
+                "Size in bytes of the run's newest snapshot.",
+                ident,
+                ck.get("bytes"),
+            )
+            exp.add(
+                "tg_checkpoint_write_ms",
+                "gauge",
+                "Wall milliseconds the newest snapshot took to write "
+                "(fetch + serialize + fsync + rename).",
+                ident,
+                ck.get("write_ms"),
+            )
         # phase attribution plane (journal["sim"]["phases"],
         # docs/OBSERVABILITY.md "Phase attribution"): per-phase cost
         # gauges plus the synthesized residual/total rows — the phase

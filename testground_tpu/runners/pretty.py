@@ -140,6 +140,33 @@ def render_telemetry_summary(stats: dict) -> str:
                     ),
                 )
             )
+        # checkpoint/resume plane (docs/CHECKPOINT.md): last-snapshot
+        # tick + resume provenance at a glance
+        ck = sim.get("checkpoint") or {}
+        if ck:
+            parts = []
+            if _num(ck.get("count"), 0):
+                parts.append(
+                    "{n} snapshot(s), last at tick {t} "
+                    "({d}/, {b:.2f} MiB)".format(
+                        n=_fmt_count(ck.get("count")),
+                        t=_fmt_count(ck.get("last_tick")),
+                        d=ck.get("dir", "checkpoints"),
+                        b=(_num(ck.get("bytes"), 0) or 0) / 2**20,
+                    )
+                )
+            elif _num(ck.get("every_chunks"), 0):
+                parts.append("armed, none written")
+            resumed = ck.get("resumed") or {}
+            if resumed:
+                parts.append(
+                    "resumed from tick {t} of run {r}".format(
+                        t=_fmt_count(resumed.get("from_tick")),
+                        r=resumed.get("from_run", "?"),
+                    )
+                )
+            if parts:
+                rows.append(("checkpoint", "; ".join(parts)))
         # per-receiver-group delivery-latency percentiles (telemetry
         # plane histograms, docs/OBSERVABILITY.md) — one line per group
         for gid, pct in sorted((sim.get("latency") or {}).items()):

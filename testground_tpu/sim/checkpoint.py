@@ -1,0 +1,726 @@
+"""Checkpoint/resume plane: durable live-sim snapshots with bit-identical
+continuation (docs/CHECKPOINT.md).
+
+Every prior observability plane autopsies or watches a run; none can
+*revive* one. A preempted million-tick soak loses every tick even though
+the carry is already a closed pytree the chunk loop syncs on once per
+dispatch. This module closes that gap: snapshot the full run state every
+K chunks (``--run-cfg checkpoint_chunks=K``) into the run's artifact
+dir, and seed a later run from the newest snapshot so the resumed run is
+**leaf-for-leaf identical** to an uninterrupted one — the checkpointing
+trait preemptible-TPU economics (and run migration between chips)
+actually needs.
+
+What a snapshot holds — one atomic ``checkpoints/ckpt-<tick>.npz``:
+
+- the **device carry** pytree, leaf for leaf, host-fetched at the chunk
+  boundary the loop already syncs on (PRNG key leaves round-trip through
+  ``jax.random.key_data`` / ``wrap_key_data`` with the impl recorded);
+- the host-side **latency-histogram accumulator** (telemetry runs);
+- a JSON **manifest** embedded in the archive: tick, chunk index, the
+  composition identity + its hash, the plan-source ``build_key`` (the
+  sim:plan precompile's BuildKey analog — an edited plan refuses to
+  resume), transport backend, and the host-side **aux state** needed
+  for exact continuation (SLO evaluator state, stream-file byte
+  offsets, metric-recorder rows, writer counters).
+
+Contract (the discipline every plane in this repo carries):
+
+- **Zero overhead when off.** ``checkpoint_chunks`` shapes NOTHING: the
+  program is jaxpr-identical and the host-sync count unchanged with the
+  knob at 0 (pinned by ``tests/test_sim_checkpoint.py``). When on, the
+  only cost is a device→host carry read every K-th chunk boundary.
+- **Atomic, bounded, honest.** Snapshots write to a temp file and
+  ``os.replace`` into place (a crash mid-write can never leave a
+  half-snapshot under the final name); retention keeps the newest
+  ``checkpoint_keep``; every write is journaled (``sim.checkpoint``),
+  span-pointed, and exported (``tg_checkpoint_*``).
+- **Refuse loudly, never resume garbage.** A corrupt/truncated archive,
+  a manifest that fails validation, or a snapshot from a different
+  composition/plan-source/transport raises :class:`CheckpointError`
+  naming exactly what mismatched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+import zipfile
+
+import numpy as np
+
+__all__ = [
+    "CHECKPOINT_DIR",
+    "CheckpointError",
+    "ResumeState",
+    "RunCheckpointer",
+    "identity_hash",
+    "list_snapshots",
+    "load_latest",
+    "load_snapshot",
+    "prepare_resume",
+    "restore_carry",
+    "run_identity",
+    "save_snapshot",
+    "snapshot_carry",
+]
+
+# Snapshots live under <run outputs dir>/checkpoints/ckpt-<tick>.npz —
+# inside the run's artifact dir so `tg collect` tars them and the
+# daemon's GET /artifact whitelist can serve them for run migration.
+CHECKPOINT_DIR = "checkpoints"
+_PREFIX = "ckpt-"
+_SUFFIX = ".npz"
+_TICK_WIDTH = 12  # zero-padded so lexical order == tick order
+
+# Bumped when the archive layout changes; a mismatch refuses to resume
+# (an old snapshot must never be silently reinterpreted).
+FORMAT_VERSION = 1
+
+_MANIFEST_KEY = "__manifest__"
+_LEAF_FMT = "leaf_{:05d}"
+_AUX_LAT_KEY = "aux_lat_hist"
+
+
+class CheckpointError(RuntimeError):
+    """A snapshot could not be written, read, validated, or restored.
+
+    The typed refusal of the checkpoint plane: resuming from a corrupt,
+    truncated, or mismatched snapshot must fail HERE with a readable
+    reason — never seed a run with garbage state."""
+
+
+# --------------------------------------------------------------- identity
+
+
+def run_identity(
+    job,
+    cfg,
+    *,
+    telemetry: bool,
+    transport: str,
+    fault_specs: dict,
+    trace_specs: dict,
+    hosts,
+) -> dict:
+    """The resume-compatibility identity of a run: everything that shapes
+    the compiled program or the deterministic tick stream. A snapshot
+    taken under one identity refuses to seed a run built under another
+    (``validate_manifest``). ``max_ticks`` is deliberately ABSENT — it
+    is a stop budget, not a program shape, so a run interrupted by a
+    short budget can be resumed with a longer one.
+
+    ``sources`` digests each group's plan-source artifact (the sim:plan
+    precompile's ``_source_digest``) — the BuildKey ingredient that makes
+    an edited plan refuse to resume instead of silently diverging."""
+    from testground_tpu.builders.sim_plan import _source_digest
+
+    sources = {}
+    for g in job.groups:
+        try:
+            sources[g.id] = _source_digest(g.artifact_path)
+        except OSError:
+            sources[g.id] = ""
+    return {
+        "plan": job.test_plan,
+        "case": job.test_case,
+        "groups": [
+            {
+                "id": g.id,
+                "instances": g.instances,
+                "parameters": dict(g.parameters),
+            }
+            for g in job.groups
+        ],
+        "sources": sources,
+        "tick_ms": cfg.tick_ms,
+        "chunk": cfg.chunk,
+        "seed": cfg.seed,
+        "validate": bool(getattr(cfg, "validate", False)),
+        "telemetry": bool(telemetry),
+        "transport": str(transport),
+        "faults": fault_specs,
+        "trace": trace_specs,
+        "hosts": list(hosts),
+    }
+
+
+def identity_hash(identity: dict, drop: tuple = ()) -> str:
+    """Stable hash of an identity dict (the sim:plan BuildKey style:
+    sha256 of the sorted-key JSON, truncated)."""
+    d = {k: v for k, v in identity.items() if k not in drop}
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True).encode()
+    ).hexdigest()[:32]
+
+
+# ------------------------------------------------------------ carry <-> np
+
+
+def _is_prng_leaf(leaf) -> bool:
+    import jax
+
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        return False
+    try:
+        return jax.dtypes.issubdtype(dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def snapshot_carry(carry) -> tuple[list, list]:
+    """Flatten a live carry to host arrays: ``(leaves, metas)``.
+
+    Typed PRNG-key leaves (extended dtype — ``np.asarray`` would raise)
+    are exported via ``jax.random.key_data`` with the impl name recorded
+    so restore can refuse a cross-impl resume instead of producing a
+    silently different random stream. The device→host reads here are the
+    checkpoint plane's only cost, paid at K-chunk boundaries only."""
+    import jax
+
+    flat = jax.tree_util.tree_leaves(carry)
+    leaves: list = []
+    metas: list = []
+    for leaf in flat:
+        if _is_prng_leaf(leaf):
+            impl = str(jax.random.key_impl(leaf))
+            data = np.asarray(jax.random.key_data(leaf))
+            leaves.append(data)
+            metas.append(
+                {
+                    "kind": "prng",
+                    "impl": impl,
+                    "shape": list(data.shape),
+                    "dtype": str(data.dtype),
+                }
+            )
+        else:
+            data = np.asarray(leaf)
+            leaves.append(data)
+            metas.append(
+                {
+                    "kind": "array",
+                    "shape": list(data.shape),
+                    "dtype": str(data.dtype),
+                }
+            )
+    return leaves, metas
+
+
+def restore_carry(prog, seed: int, manifest: dict, leaves: list):
+    """Rebuild the device carry from snapshot leaves against ``prog``'s
+    OWN carry structure: ``eval_shape`` over ``init_carry`` supplies the
+    reference treedef and avals (no allocation, no compile), every leaf
+    is validated shape-and-dtype against it, PRNG leaves re-wrap through
+    ``wrap_key_data``, and the assembled pytree lands on device through
+    the same ``_constrain`` jit ``init_carry`` uses — so a mesh run
+    reshards the restored carry exactly as it would a fresh one."""
+    import jax
+
+    shapes = jax.eval_shape(lambda: prog.init_carry(seed))
+    ref_leaves, treedef = jax.tree_util.tree_flatten(shapes)
+    metas = manifest.get("leaves") or []
+    if len(leaves) != len(ref_leaves) or len(metas) != len(ref_leaves):
+        raise CheckpointError(
+            f"snapshot holds {len(leaves)} carry leaves but this program's "
+            f"carry has {len(ref_leaves)} — the snapshot was taken under a "
+            "different program shape (plan edit? different telemetry/"
+            "transport gates?); refusing to resume"
+        )
+    out = []
+    for i, (data, meta, ref) in enumerate(zip(leaves, metas, ref_leaves)):
+        kind = meta.get("kind", "array")
+        if kind == "prng":
+            if not _is_prng_leaf(ref):
+                raise CheckpointError(
+                    f"snapshot leaf {i} is a PRNG key but the program "
+                    "expects a plain array there — program shape drift; "
+                    "refusing to resume"
+                )
+            try:
+                restored = jax.random.wrap_key_data(np.asarray(data))
+            except (TypeError, ValueError) as e:
+                raise CheckpointError(
+                    f"snapshot PRNG leaf {i} does not re-wrap as key "
+                    f"data ({e}); refusing to resume"
+                ) from e
+            impl = str(jax.random.key_impl(restored))
+            if meta.get("impl") and meta["impl"] != impl:
+                raise CheckpointError(
+                    f"snapshot PRNG leaf {i} was saved under key impl "
+                    f"{meta.get('impl')!r} but this jax resolves "
+                    f"{impl!r} — resuming would change the random "
+                    "stream; refusing"
+                )
+            if restored.shape != ref.shape or str(restored.dtype) != str(
+                ref.dtype
+            ):
+                raise CheckpointError(
+                    f"snapshot PRNG leaf {i} restores as "
+                    f"{restored.dtype}{list(restored.shape)} but the "
+                    f"program expects {ref.dtype}{list(ref.shape)}; "
+                    "refusing to resume"
+                )
+            out.append(restored)
+            continue
+        if _is_prng_leaf(ref):
+            raise CheckpointError(
+                f"snapshot leaf {i} is a plain array but the program "
+                "expects a PRNG key there — program shape drift; "
+                "refusing to resume"
+            )
+        if tuple(data.shape) != tuple(ref.shape) or str(
+            data.dtype
+        ) != str(ref.dtype):
+            raise CheckpointError(
+                f"snapshot leaf {i} is {data.dtype}{list(data.shape)} but "
+                f"the program expects {ref.dtype}{list(ref.shape)} — the "
+                "snapshot was taken under a different composition; "
+                "refusing to resume"
+            )
+        out.append(data)
+    host_carry = jax.tree_util.tree_unflatten(treedef, out)
+    # same device/sharding treatment as init_carry: the identity-or-
+    # constrain jit materializes every leaf on device (and reshards
+    # under a mesh at the exact constraints a fresh carry gets)
+    return jax.jit(prog._constrain)(host_carry)
+
+
+# ------------------------------------------------------------ file format
+
+
+def _snapshot_name(tick: int) -> str:
+    return f"{_PREFIX}{int(tick):0{_TICK_WIDTH}d}{_SUFFIX}"
+
+
+def _tick_of(name: str) -> int | None:
+    if not (name.startswith(_PREFIX) and name.endswith(_SUFFIX)):
+        return None
+    digits = name[len(_PREFIX) : -len(_SUFFIX)]
+    return int(digits) if digits.isdigit() else None
+
+
+def list_snapshots(run_dir: str) -> list[tuple[int, str]]:
+    """``[(tick, path)]`` ascending by tick; unparseable names and
+    in-flight temp files are ignored."""
+    d = os.path.join(run_dir, CHECKPOINT_DIR)
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        tick = _tick_of(name)
+        if tick is not None:
+            out.append((tick, os.path.join(d, name)))
+    out.sort()
+    return out
+
+
+def save_snapshot(
+    run_dir: str, manifest: dict, leaves: list, lat_hist=None
+) -> tuple[str, int, float]:
+    """Write one snapshot atomically; returns ``(path, bytes, write_ms)``.
+
+    The archive is a plain (uncompressed) npz: carry leaves under
+    ``leaf_NNNNN``, the optional latency accumulator under
+    ``aux_lat_hist``, and the manifest JSON as a uint8 array under
+    ``__manifest__`` — ONE file, so ``os.replace`` makes the commit
+    atomic and a crash mid-write can never leave a half-snapshot under
+    a final name."""
+    t0 = time.perf_counter()
+    d = os.path.join(run_dir, CHECKPOINT_DIR)
+    try:
+        os.makedirs(d, exist_ok=True)
+        arrays = {
+            _LEAF_FMT.format(i): leaf for i, leaf in enumerate(leaves)
+        }
+        if lat_hist is not None:
+            arrays[_AUX_LAT_KEY] = np.asarray(lat_hist)
+        arrays[_MANIFEST_KEY] = np.frombuffer(
+            json.dumps(manifest).encode(), dtype=np.uint8
+        )
+        final = os.path.join(d, _snapshot_name(manifest["tick"]))
+        tmp = final + f".tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        size = os.path.getsize(final)
+    except OSError as e:
+        raise CheckpointError(f"snapshot write failed: {e}") from e
+    return final, size, (time.perf_counter() - t0) * 1000.0
+
+
+def prune_snapshots(run_dir: str, keep: int) -> int:
+    """Bounded retention: delete all but the newest ``keep`` snapshots.
+    Returns how many were removed. Best-effort (an undeletable old
+    snapshot must not fail the run that just wrote a new one)."""
+    if keep <= 0:
+        return 0
+    snaps = list_snapshots(run_dir)
+    removed = 0
+    for _, path in snaps[:-keep]:
+        try:
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
+def load_snapshot(path: str) -> tuple[dict, list]:
+    """Read one snapshot → ``(manifest, carry leaves)``.
+
+    Every failure mode — unreadable file, truncated zip, missing
+    manifest, malformed JSON, missing/extra leaf entries, version drift
+    — raises :class:`CheckpointError` naming the file and the defect:
+    a damaged snapshot must refuse loudly, never resume garbage."""
+    try:
+        # np.load streams members out of the zip on access — the
+        # archive is never materialized whole beside its leaves (a
+        # million-instance carry snapshot is GBs; doubling it on the
+        # resume path would OOM exactly the runs checkpointing is for)
+        with np.load(path, allow_pickle=False) as z:
+            names = set(z.files)
+            if _MANIFEST_KEY not in names:
+                raise CheckpointError(
+                    f"snapshot {path} has no embedded manifest — not a "
+                    "checkpoint archive (or one written by an "
+                    "incompatible version); refusing to resume"
+                )
+            try:
+                manifest = json.loads(bytes(z[_MANIFEST_KEY]).decode())
+            except (ValueError, UnicodeDecodeError) as e:
+                raise CheckpointError(
+                    f"snapshot {path} manifest is not valid JSON ({e}) — "
+                    "corrupt archive; refusing to resume"
+                ) from e
+            if manifest.get("version") != FORMAT_VERSION:
+                raise CheckpointError(
+                    f"snapshot {path} is format version "
+                    f"{manifest.get('version')!r}, this build reads "
+                    f"{FORMAT_VERSION} — refusing to reinterpret"
+                )
+            n = len(manifest.get("leaves") or [])
+            leaves = []
+            for i in range(n):
+                key = _LEAF_FMT.format(i)
+                if key not in names:
+                    raise CheckpointError(
+                        f"snapshot {path} is missing carry leaf {i} of "
+                        f"{n} — truncated or corrupt archive; refusing "
+                        "to resume"
+                    )
+                leaves.append(z[key])
+            if manifest.get("aux", {}).get("lat_hist"):
+                if _AUX_LAT_KEY not in names:
+                    raise CheckpointError(
+                        f"snapshot {path} manifest promises a latency "
+                        "accumulator but the archive has none — corrupt; "
+                        "refusing to resume"
+                    )
+                manifest["_lat_hist"] = z[_AUX_LAT_KEY]
+    except CheckpointError:
+        raise
+    except (zipfile.BadZipFile, ValueError, KeyError, OSError, EOFError) as e:
+        raise CheckpointError(
+            f"snapshot {path} is corrupt or truncated ({type(e).__name__}: "
+            f"{e}); refusing to resume"
+        ) from e
+    return manifest, leaves
+
+
+def load_latest(run_dir: str) -> tuple[dict, list, str]:
+    """Load the NEWEST snapshot of a run dir → ``(manifest, leaves,
+    path)``. No snapshots → :class:`CheckpointError`. A corrupt newest
+    snapshot refuses loudly too (no silent fallback to an older tick —
+    resuming further back than the operator believes is its own kind of
+    garbage); the error names the file so the operator can delete it and
+    fall back deliberately."""
+    snaps = list_snapshots(run_dir)
+    if not snaps:
+        raise CheckpointError(
+            f"no snapshots under {os.path.join(run_dir, CHECKPOINT_DIR)} — "
+            "was the run checkpointed (--run-cfg checkpoint_chunks=K)?"
+        )
+    _, path = snaps[-1]
+    manifest, leaves = load_snapshot(path)
+    return manifest, leaves, path
+
+
+def validate_manifest(manifest: dict, identity: dict) -> None:
+    """Refuse a snapshot whose identity does not match the run being
+    resumed — naming WHAT differs, because "hash mismatch" is not an
+    actionable error."""
+    want = identity_hash(identity)
+    got = manifest.get("build_key")
+    if got == want:
+        return
+    theirs = manifest.get("identity") or {}
+    diffs = [
+        k
+        for k in sorted(set(identity) | set(theirs))
+        if identity.get(k) != theirs.get(k)
+    ]
+    raise CheckpointError(
+        "snapshot was taken under a different run identity — "
+        f"mismatched field(s): {diffs or ['<unrecorded identity>']} "
+        f"(snapshot build_key {got!r}, this run {want!r}); a resumed run "
+        "must rebuild the exact program that wrote the snapshot"
+    )
+
+
+# ---------------------------------------------------------------- resume
+
+
+@dataclasses.dataclass
+class ResumeState:
+    """Everything the executor needs to continue a run from a snapshot."""
+
+    manifest: dict
+    leaves: list
+    path: str  # snapshot file the state came from
+    source_run_dir: str
+
+    @property
+    def tick(self) -> int:
+        return int(self.manifest.get("tick", 0))
+
+    @property
+    def lat_hist(self):
+        h = self.manifest.get("_lat_hist")
+        return None if h is None else np.asarray(h, dtype=np.int64)
+
+    @property
+    def aux(self) -> dict:
+        return self.manifest.get("aux") or {}
+
+
+def _sync_stream_files(
+    source_run_dir: str, dest_run_dir: str, offsets: dict
+) -> None:
+    """Make the destination run dir's stream files hold EXACTLY the
+    rows written up to the snapshot tick, so appended post-resume rows
+    continue the stream where the snapshot left it:
+
+    - in-place resume (same dir): truncate each file to its recorded
+      byte offset (rows the interrupted run wrote PAST the snapshot
+      would otherwise duplicate when the resumed run re-executes those
+      ticks);
+    - cross-run resume (new dir): copy each file's prefix bytes over.
+
+    Offsets were taken after the writers' per-chunk flush, so they land
+    exactly on row boundaries."""
+    for name, offset in (offsets or {}).items():
+        # stream names come from the snapshot manifest: constrain to
+        # plain basenames so a doctored manifest cannot path-traverse
+        if name != os.path.basename(name) or not isinstance(offset, int):
+            raise CheckpointError(
+                f"snapshot stream-offset entry {name!r} is not a plain "
+                "file name — refusing to resume from a doctored manifest"
+            )
+        src = os.path.join(source_run_dir, name)
+        dst = os.path.join(dest_run_dir, name)
+        try:
+            if os.path.abspath(src) == os.path.abspath(dst):
+                if os.path.exists(src):
+                    with open(src, "r+b") as f:
+                        f.truncate(offset)
+                continue
+            if not os.path.exists(src):
+                continue
+            with open(src, "rb") as fin, open(dst, "wb") as fout:
+                remaining = int(offset)
+                while remaining > 0:
+                    buf = fin.read(min(remaining, 4 << 20))
+                    if not buf:
+                        break
+                    fout.write(buf)
+                    remaining -= len(buf)
+        except OSError as e:
+            raise CheckpointError(
+                f"could not prepare stream file {name} for resume: {e}"
+            ) from e
+
+
+def prepare_resume(
+    source_run_dir: str, dest_run_dir: str | None, identity: dict
+) -> ResumeState:
+    """Load + validate the newest snapshot of ``source_run_dir`` and
+    align the destination run dir's stream files to the snapshot tick
+    (see :func:`_sync_stream_files`). The carry itself is restored later
+    by :func:`restore_carry`, against the rebuilt program."""
+    manifest, leaves, path = load_latest(source_run_dir)
+    validate_manifest(manifest, identity)
+    tick = int(manifest.get("tick", -1))
+    chunk = int(identity.get("chunk") or 0)
+    if tick < 0 or (chunk > 0 and tick % chunk != 0):
+        raise CheckpointError(
+            f"snapshot {path} records tick {tick}, which is not a "
+            f"{chunk}-tick chunk boundary — corrupt manifest; refusing "
+            "to resume"
+        )
+    if dest_run_dir is not None:
+        _sync_stream_files(
+            source_run_dir,
+            dest_run_dir,
+            (manifest.get("aux") or {}).get("streams") or {},
+        )
+    return ResumeState(
+        manifest=manifest,
+        leaves=leaves,
+        path=path,
+        source_run_dir=source_run_dir,
+    )
+
+
+# ------------------------------------------------------------ write side
+
+
+class RunCheckpointer:
+    """Per-run snapshot writer, driven from the chunk loop's observer
+    hook (``SimProgram.run(observer=...)`` — called after the chunk's
+    telemetry/trace/SLO callbacks, so the aux offsets it records are
+    flush-exact). Every K-th chunk boundary: fetch the carry, assemble
+    the manifest (identity + aux state from ``aux_cb``), write
+    atomically, prune retention, journal + span the write. Failures
+    raise nothing past the first warn — a run must never die because
+    its snapshot could not be written — but are recorded in the journal
+    (``errors``)."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        *,
+        every_chunks: int,
+        keep: int,
+        chunk: int,
+        identity: dict,
+        ident: dict,
+        aux_cb=None,
+        spans=None,
+        warn=None,
+        telemetry: bool = False,
+        resumed_from: dict | None = None,
+    ):
+        self.run_dir = run_dir
+        self.every = max(1, int(every_chunks))
+        self.keep = max(1, int(keep))
+        self.chunk = max(1, int(chunk))
+        self.identity = identity
+        self.ident = dict(ident or {})
+        self.aux_cb = aux_cb
+        self.spans = spans
+        self.warn = warn
+        self.telemetry = bool(telemetry)
+        self.resumed_from = resumed_from
+        self.count = 0
+        self.last_tick: int | None = None
+        self.last_bytes = 0
+        self.last_write_ms = 0.0
+        self.total_write_ms = 0.0
+        self.errors = 0
+        self._lat_hist = None  # [G, LATENCY_BINS] int64 mirror
+        self._warned = False
+
+    # fed from the run loop's lat_hist_cb (telemetry programs only):
+    # mirrors the engine's own accumulator so a snapshot can restore it
+    def on_lat_delta(self, delta) -> None:
+        d = np.asarray(delta, dtype=np.int64)
+        self._lat_hist = d if self._lat_hist is None else self._lat_hist + d
+
+    def seed_lat_hist(self, acc) -> None:
+        if acc is not None:
+            self._lat_hist = np.asarray(acc, dtype=np.int64).copy()
+
+    def observe(self, ticks: int, carry) -> None:
+        chunk_index = int(ticks) // self.chunk
+        if chunk_index % self.every != 0:
+            return
+        self.snapshot(int(ticks), carry)
+
+    def snapshot(self, ticks: int, carry) -> None:
+        import jax
+
+        try:
+            leaves, metas = snapshot_carry(carry)
+            aux = dict(self.aux_cb() if self.aux_cb is not None else {})
+            aux["lat_hist"] = self._lat_hist is not None
+            manifest = {
+                "version": FORMAT_VERSION,
+                "tick": int(ticks),
+                "chunk_index": int(ticks) // self.chunk,
+                "chunk": self.chunk,
+                "transport": self.identity.get("transport", "xla"),
+                "telemetry": self.telemetry,
+                "composition_hash": identity_hash(
+                    self.identity, drop=("sources",)
+                ),
+                "build_key": identity_hash(self.identity),
+                "identity": self.identity,
+                "leaves": metas,
+                "aux": aux,
+                "jax": jax.__version__,
+                **self.ident,
+            }
+            path, size, write_ms = save_snapshot(
+                self.run_dir, manifest, leaves, lat_hist=self._lat_hist
+            )
+            prune_snapshots(self.run_dir, self.keep)
+        except Exception as e:  # noqa: BLE001
+            # snapshotting is best-effort observability-style: the run
+            # it protects must never die because a write failed
+            self.errors += 1
+            if self.warn is not None and not self._warned:
+                self._warned = True
+                self.warn(
+                    "checkpoint at tick %d failed (further failures "
+                    "counted silently): %s",
+                    int(ticks),
+                    e,
+                )
+            return
+        self.count += 1
+        self.last_tick = int(ticks)
+        self.last_bytes = int(size)
+        self.last_write_ms = round(write_ms, 3)
+        self.total_write_ms += write_ms
+        if self.spans is not None:
+            self.spans.point(
+                "checkpoint",
+                tick=int(ticks),
+                bytes=int(size),
+                write_ms=round(write_ms, 3),
+                file=os.path.basename(path),
+            )
+
+    def journal(self) -> dict:
+        out: dict = {
+            "every_chunks": self.every,
+            "keep": self.keep,
+            "count": self.count,
+            "dir": CHECKPOINT_DIR,
+        }
+        if self.last_tick is not None:
+            out["last_tick"] = self.last_tick
+            out["bytes"] = self.last_bytes
+            out["write_ms"] = self.last_write_ms
+            out["total_write_ms"] = round(self.total_write_ms, 3)
+        if self.errors:
+            out["errors"] = self.errors
+        if self.resumed_from:
+            out["resumed"] = dict(self.resumed_from)
+        return out

@@ -1447,6 +1447,9 @@ class SimProgram:
         on_stall: Callable[[int, int], None] | None = None,
         nan_guard: bool = False,
         perf=None,
+        resume_carry=None,
+        resume_ticks: int = 0,
+        lat_hist_init=None,
     ) -> dict[str, Any]:
         """Step to completion. Returns host-side results:
 
@@ -1478,6 +1481,15 @@ class SimProgram:
         leaf and tick range — a debug flag (each scan is a device→host
         read of the whole carry).
 
+        ``resume_carry`` seeds the loop with an already-device-resident
+        carry instead of ``init_carry(seed)`` — the checkpoint plane's
+        restore path (``sim/checkpoint.py``): ``resume_ticks`` fast-
+        forwards the tick counter to the snapshot's chunk boundary and
+        ``lat_hist_init`` re-seeds the host-side latency-histogram
+        accumulator, so a resumed run's results are leaf-for-leaf those
+        of an uninterrupted one (pinned by
+        ``tests/test_sim_checkpoint.py``).
+
         ``perf`` is a performance-ledger hook object (``sim/perf.py``):
         ``on_compile(lower_secs, compile_secs, compiled)`` fires once
         from an AOT lower/compile pass before the first dispatch (only
@@ -1493,7 +1505,10 @@ class SimProgram:
         # init is traceable; jit it so construction is one dispatch rather
         # than hundreds of eager ops (matters on remote-tunneled devices).
         t0 = _time.perf_counter()
-        carry = jax.jit(lambda: self.init_carry(seed))()
+        if resume_carry is not None:
+            carry = resume_carry
+        else:
+            carry = jax.jit(lambda: self.init_carry(seed))()
         fn = self.compiled_chunk()
         if perf is not None and getattr(perf, "wants_aot", False):
             # AOT accounting pass: lower + compile the chunk program
@@ -1509,24 +1524,30 @@ class SimProgram:
                 perf.on_compile(*timed_lower_compile(fn, carry))
             except Exception:  # noqa: BLE001 — accounting only
                 pass
-        ticks = 0
+        ticks = int(resume_ticks) if resume_carry is not None else 0
+        start_ticks = ticks
         compile_secs = 0.0
         # host-side accumulator for the per-chunk histogram deltas —
-        # python/int64 arithmetic, so the totals never wrap
-        lat_hist_acc = (
-            np.zeros((len(self.groups), LATENCY_BINS), np.int64)
-            if self.telemetry
-            else None
-        )
+        # python/int64 arithmetic, so the totals never wrap; a resumed
+        # run re-seeds it from the snapshot so the final histogram
+        # equals an uninterrupted run's
+        lat_hist_acc = None
+        if self.telemetry:
+            lat_hist_acc = (
+                np.asarray(lat_hist_init, np.int64).copy()
+                if lat_hist_init is not None
+                else np.zeros((len(self.groups), LATENCY_BINS), np.int64)
+            )
         while ticks < max_ticks:
             # the first dispatch includes trace + XLA compile (and under
             # a mesh the second recompiles at the sharding fixed point —
             # see the compile_secs note below), so the watchdog budget —
             # sized for steady-state chunks — only arms from the third
-            # dispatch on; a hang during compile is bounded by the
-            # engine-level task controls instead
+            # dispatch on (counted from the resume point: a resumed
+            # run's first dispatch pays compile again); a hang during
+            # compile is bounded by the engine-level task controls
             watch = chunk_timeout and chunk_timeout > 0 and (
-                ticks >= 2 * self.chunk
+                ticks >= start_ticks + 2 * self.chunk
             )
             t_chunk = _time.perf_counter()
             if watch:

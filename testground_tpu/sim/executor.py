@@ -28,7 +28,12 @@ from testground_tpu.rpc import OutputWriter
 from testground_tpu.runners.outputs import instance_output_dir
 from testground_tpu.runners.result import Result
 
-__all__ = ["SimJaxConfig", "execute_sim_run", "load_sim_testcases"]
+__all__ = [
+    "SimJaxConfig",
+    "execute_sim_run",
+    "load_sim_testcases",
+    "run_sim_worker",
+]
 
 # Map sim status codes → lifecycle event names (pretty.go:163-175).
 _STATUS_NAME = {0: "incomplete", 1: "success", 2: "failure", 3: "crash"}
@@ -117,6 +122,30 @@ class SimJaxConfig:
     # option like telemetry: broadcast to cohort followers and keyed
     # into the precompile BuildKey. CLI: --run-cfg transport=pallas
     transport: str = "xla"
+    # checkpoint/resume plane (docs/CHECKPOINT.md): > 0 snapshots the
+    # full run state (device carry + RNG + telemetry/latency/SLO
+    # accumulators + manifest) every K chunks into the run's
+    # checkpoints/ dir with atomic write-then-rename, so a preempted
+    # soak resumes from the last boundary instead of losing every tick.
+    # NOT program-shaping: the jitted program is untouched and the
+    # default (0) adds zero host syncs — the only cost when on is a
+    # device→host carry read at each K-th chunk boundary. Cohorts run
+    # checkpoint-free (a leader-local read of the cross-process carry
+    # is not symmetric).
+    checkpoint_chunks: int = 0
+    # bounded retention: keep only the newest N snapshots (each is
+    # roughly the carry footprint on disk)
+    checkpoint_keep: int = 3
+    # resume this run from another run's newest snapshot (a task/run id
+    # under the same outputs tree — `tg run resume <task>` sets it).
+    # The snapshot manifest is validated against THIS run's rebuilt
+    # program (composition hash + plan-source build key + transport);
+    # any mismatch refuses with a typed CheckpointError. With
+    # checkpoint_chunks > 0 and no resume_from, a run whose own dir
+    # already holds snapshots auto-resumes in place — the engine-side
+    # recovery path for interrupted tasks rehydrated after a daemon
+    # restart.
+    resume_from: str = ""
     # whitelisted control-route service hosts (echo lanes past the instance
     # axis) — the ADDITIONAL_HOSTS analog (``local_docker.go:78``); plans
     # address them via ``env.host_index(name)``
@@ -799,6 +828,113 @@ def _execute_sim_run(
     )
     spans.end("build", carry_bytes=carry_bytes, instances=n)
 
+    # ------------------------------------------- checkpoint/resume plane
+    # (docs/CHECKPOINT.md) NOT program-shaping: the prog above is
+    # already final, and checkpoint_chunks=0 leaves this whole block
+    # inert (zero-overhead pin in tests/test_sim_checkpoint.py). The
+    # identity dict is what a snapshot manifest validates against on
+    # resume — everything that shapes the compiled program or the
+    # deterministic tick stream, plus the plan-source digests.
+    ckpt_every = int(getattr(cfg, "checkpoint_chunks", 0) or 0)
+    resume_from = str(getattr(cfg, "resume_from", "") or "")
+    if resume_from and getattr(cfg, "coordinator_address", ""):
+        raise ValueError(
+            "resume_from is not supported under a multi-host cohort "
+            "(checkpoints are leader-local reads of a cross-process "
+            "carry); run the resumed composition single-host"
+        )
+    if ckpt_every > 0 and getattr(cfg, "coordinator_address", ""):
+        ow.warn(
+            "sim:jax %s: checkpointing disabled for the cohort config "
+            "(a leader-local read of the cross-process-sharded carry "
+            "is not symmetric)",
+            job.run_id,
+        )
+        ckpt_every = 0
+    if resume_from and run_dir is None:
+        raise ValueError(
+            "resume_from requires a run outputs dir (no env attached "
+            "to this run input)"
+        )
+    if ckpt_every > 0 and run_dir is None:
+        ow.warn(
+            "sim:jax %s: checkpointing disabled — no run outputs dir "
+            "to hold snapshots",
+            job.run_id,
+        )
+        ckpt_every = 0
+    resume_state = None
+    resume_info = None
+    identity = None
+    if ckpt_every > 0 or resume_from:
+        from .checkpoint import (
+            list_snapshots,
+            prepare_resume,
+            run_identity,
+        )
+
+        identity = run_identity(
+            job,
+            cfg,
+            telemetry=telemetry_on,
+            transport=transport,
+            fault_specs=fault_specs,
+            # post-gate: a trace plan nulled by disable_metrics/cohort
+            # shapes nothing, so it must not key the identity either
+            trace_specs=trace_specs if trace_plan is not None else {},
+            hosts=hosts,
+        )
+        source_run = None
+        own_snaps = list_snapshots(run_dir) if run_dir is not None else []
+        if resume_from:
+            src_dir = os.path.join(outputs_root, job.test_plan, resume_from)
+            src_snaps = (
+                list_snapshots(src_dir) if os.path.isdir(src_dir) else []
+            )
+            # A restarted resume run prefers its OWN newest progress: a
+            # daemon restart mid-resume rehydrates this task with
+            # resume_from still set, and rolling back to the (older)
+            # source snapshot would discard every tick this run already
+            # re-earned — and the cross-run stream copy would overwrite
+            # its own stream files with the source's shorter prefix.
+            if own_snaps and (
+                not src_snaps or own_snaps[-1][0] >= src_snaps[-1][0]
+            ):
+                resume_state = prepare_resume(run_dir, run_dir, identity)
+                source_run = job.run_id
+            else:
+                if not src_snaps:
+                    from .checkpoint import CheckpointError
+
+                    raise CheckpointError(
+                        f"no snapshots for {resume_from!r} under "
+                        f"{os.path.join(outputs_root, job.test_plan)} — "
+                        "nothing to resume from"
+                    )
+                resume_state = prepare_resume(src_dir, run_dir, identity)
+                source_run = resume_from
+        elif ckpt_every > 0 and own_snaps:
+            # engine-side auto-resume: an interrupted task rehydrated
+            # from the queue after a daemon restart re-runs under the
+            # SAME id, so its run dir already holds its own snapshots —
+            # continue instead of replaying from tick 0
+            resume_state = prepare_resume(run_dir, run_dir, identity)
+            source_run = job.run_id
+        if resume_state is not None:
+            resume_info = {
+                "from_tick": resume_state.tick,
+                "from_run": source_run,
+                "snapshot": os.path.basename(resume_state.path),
+            }
+            ow.infof(
+                "sim:jax %s: resuming from snapshot %s (tick %d, run %s)",
+                job.run_id,
+                resume_info["snapshot"],
+                resume_state.tick,
+                resume_info["from_run"],
+            )
+            spans.point("resume", **resume_info)
+
     # duration math runs on the monotonic clock (a wall-clock step —
     # NTP slew, operator date change — must not produce negative chunk
     # timings or a wrong run wall); the wall-clock anchor survives only
@@ -876,6 +1012,7 @@ def _execute_sim_run(
         "plan": job.test_plan,
         "case": job.test_case,
     }
+    resume_aux = resume_state.aux if resume_state is not None else {}
     tele_writer = (
         _SimTelemetryWriter(
             tuple(g.id for g in groups),
@@ -883,6 +1020,11 @@ def _execute_sim_run(
             os.path.join(run_dir, SIM_SERIES_FILE)
             if run_dir is not None
             else None,
+            # resumed runs APPEND past the snapshot's truncated prefix
+            # (prepare_resume aligned the file to the snapshot tick) so
+            # the series stays contiguous from tick 0
+            append=resume_state is not None,
+            rows_offset=int(resume_aux.get("telemetry_rows", 0) or 0),
         )
         if telemetry_on
         else None
@@ -891,7 +1033,16 @@ def _execute_sim_run(
     # to sim_trace.jsonl as they arrive; a bounded buffer (the plan's
     # ``events`` cap) feeds the Chrome trace export written at close.
     trace_writer = (
-        _SimTraceWriter(groups, row_ident, run_dir, cfg.tick_ms, trace_plan)
+        _SimTraceWriter(
+            groups,
+            row_ident,
+            run_dir,
+            cfg.tick_ms,
+            trace_plan,
+            # resumed runs re-read the truncated jsonl prefix into the
+            # Chrome-export buffer and append new events after it
+            resume=resume_aux.get("trace") if resume_state else None,
+        )
         if trace_plan is not None
         else None
     )
@@ -918,7 +1069,13 @@ def _execute_sim_run(
                 else None
             ),
             cancel=slo_cancel.run_local,
+            append=resume_state is not None,
         )
+        if resume_state is not None and resume_aux.get("slo"):
+            # windowed rules continue from the snapshot's ring/cums —
+            # a resumed evaluation must judge the same history an
+            # uninterrupted run would
+            slo_eval.load_state(resume_aux["slo"])
     # Performance ledger (docs/OBSERVABILITY.md "Performance ledger"):
     # host-side only — the program is untouched — so the gate is NOT
     # program-shaping; it still follows the telemetry plane's rules
@@ -1033,15 +1190,140 @@ def _execute_sim_run(
     else:
         _tele_cb = tele_writer.on_block if tele_writer else None
 
+    # ---------------------------------------------- checkpoint write side
+    # (docs/CHECKPOINT.md) rides the chunk loop's observer hook (fires
+    # AFTER the chunk's telemetry/trace/SLO callbacks, so the stream
+    # offsets it records are flush-exact); inert at checkpoint_chunks=0.
+    checkpointer = None
+    if ckpt_every > 0:
+        from .checkpoint import RunCheckpointer
+        from .slo import SLO_FILE as _SLO_FILE
+        from .trace import TRACE_FILE as _TRACE_FILE
+
+        def _ckpt_aux() -> dict:
+            """Host-side continuation state beside the carry: stream-
+            file byte offsets (for truncate/copy on resume), writer
+            counters, the SLO evaluator's windows, and the metric
+            recorder's sampled rows — everything a resumed run needs to
+            be leaf-for-leaf an uninterrupted one."""
+            aux: dict = {}
+            streams: dict = {}
+            if tele_writer is not None:
+                aux["telemetry_rows"] = tele_writer.rows_written
+                if tele_writer.path is not None:
+                    try:
+                        streams[SIM_SERIES_FILE] = os.path.getsize(
+                            tele_writer.path
+                        )
+                    except OSError:
+                        pass
+            if slo_eval is not None:
+                aux["slo"] = slo_eval.state_dict()
+                if slo_eval.path is not None:
+                    try:
+                        streams[_SLO_FILE] = os.path.getsize(slo_eval.path)
+                    except OSError:
+                        pass
+            if trace_writer is not None:
+                aux["trace"] = {
+                    "events": trace_writer.events_written,
+                    "truncated": trace_writer.truncated,
+                }
+                if trace_writer.path is not None:
+                    try:
+                        streams[_TRACE_FILE] = os.path.getsize(
+                            trace_writer.path
+                        )
+                    except OSError:
+                        pass
+            if recorder.enabled:
+                aux["recorder"] = recorder.state_dict()
+            aux["streams"] = streams
+            return aux
+
+        checkpointer = RunCheckpointer(
+            run_dir,
+            every_chunks=ckpt_every,
+            keep=int(getattr(cfg, "checkpoint_keep", 3) or 3),
+            chunk=cfg.chunk,
+            identity=identity,
+            ident=row_ident,
+            aux_cb=_ckpt_aux,
+            spans=spans,
+            warn=ow.warn,
+            telemetry=telemetry_on,
+            resumed_from=resume_info,
+        )
+        ow.infof(
+            "sim:jax %s: checkpointing every %d chunk(s) (%d ticks), "
+            "keeping newest %d",
+            job.run_id,
+            ckpt_every,
+            ckpt_every * cfg.chunk,
+            checkpointer.keep,
+        )
+
+    # restore the host-side continuation state captured in the snapshot
+    resume_carry = None
+    if resume_state is not None:
+        from .checkpoint import restore_carry
+
+        if recorder.enabled and resume_aux.get("recorder"):
+            recorder.load_state(resume_aux["recorder"])
+        if checkpointer is not None and resume_state.lat_hist is not None:
+            checkpointer.seed_lat_hist(resume_state.lat_hist)
+        resume_carry = restore_carry(
+            prog, cfg.seed, resume_state.manifest, resume_state.leaves
+        )
+
+    # compose the per-chunk observer / latency-delta consumers: the
+    # checkpoint plane shares both hooks without disturbing the
+    # recorder or the run health plane
+    _observers = [
+        o
+        for o in (
+            recorder.observe if recorder.enabled else None,
+            checkpointer.observe if checkpointer is not None else None,
+        )
+        if o is not None
+    ]
+    if not _observers:
+        _observer = None
+    elif len(_observers) == 1:
+        _observer = _observers[0]
+    else:
+
+        def _observer(ticks, carry):
+            for o in _observers:
+                o(ticks, carry)
+
+    _lat_cbs = [
+        cb
+        for cb in (
+            slo_eval.on_lat_delta if slo_eval else None,
+            checkpointer.on_lat_delta if checkpointer is not None else None,
+        )
+        if cb is not None
+    ]
+    if not _lat_cbs:
+        _lat_cb = None
+    elif len(_lat_cbs) == 1:
+        _lat_cb = _lat_cbs[0]
+    else:
+
+        def _lat_cb(delta):
+            for cb in _lat_cbs:
+                cb(delta)
+
     def _run():
         return prog.run(
             seed=cfg.seed,
             max_ticks=cfg.max_ticks,
             cancel=run_cancel,
             on_chunk=on_chunk,
-            observer=recorder.observe if recorder.enabled else None,
+            observer=_observer,
             telemetry_cb=_tele_cb,
-            lat_hist_cb=slo_eval.on_lat_delta if slo_eval else None,
+            lat_hist_cb=_lat_cb,
             trace_cb=trace_writer.on_block if trace_writer else None,
             chunk_timeout=float(getattr(cfg, "chunk_timeout_secs", 0.0)),
             on_stall=on_stall,
@@ -1051,6 +1333,11 @@ def _execute_sim_run(
             # is single-process only
             nan_guard=bool(getattr(cfg, "nan_guard", False)) and not multi,
             perf=perf_ledger,
+            resume_carry=resume_carry,
+            resume_ticks=resume_state.tick if resume_state else 0,
+            lat_hist_init=(
+                resume_state.lat_hist if resume_state is not None else None
+            ),
         )
 
     spans.start("execute")
@@ -1451,6 +1738,30 @@ def _execute_sim_run(
                 outputs_root, job, g, st, res, metrics.get(g.id)
             )
 
+    # ------------------------------------------- checkpoint/resume plane
+    # journaled under sim.checkpoint whenever snapshots were armed OR
+    # the run was resumed — "resumed from tick T" is part of the run
+    # record (tg stats / Prometheus tg_checkpoint_* read this block)
+    checkpoint_block = None
+    if checkpointer is not None:
+        checkpoint_block = checkpointer.journal()
+        if checkpointer.count:
+            ow.infof(
+                "sim:jax %s: checkpoint plane — %d snapshot(s), last at "
+                "tick %d (%.2f MiB, %.1f ms write)",
+                job.run_id,
+                checkpointer.count,
+                checkpointer.last_tick,
+                checkpointer.last_bytes / 2**20,
+                checkpointer.last_write_ms,
+            )
+    elif resume_info is not None:
+        checkpoint_block = {
+            "every_chunks": 0,
+            "count": 0,
+            "resumed": resume_info,
+        }
+
     import jax as _jax
 
     result.journal["sim"] = {
@@ -1494,6 +1805,9 @@ def _execute_sim_run(
         # phase attribution plane (per-phase cost ledger + residual;
         # docs/OBSERVABILITY.md "Phase attribution") — opt-in, phases=true
         **({"phases": phases_block} if phases_block else {}),
+        # checkpoint/resume plane (docs/CHECKPOINT.md) — present when
+        # snapshots were armed or the run resumed from one
+        **({"checkpoint": checkpoint_block} if checkpoint_block else {}),
     }
     result.update_outcome()
     if cancel.is_set():
@@ -1624,6 +1938,55 @@ def sim_worker_loop(
             f"sim-worker: run {spec['run_id']} done — {res['ticks']} ticks"
         )
         served = True
+
+
+def run_sim_worker(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    plans_dir: str,
+    once: bool = False,
+    log=print,
+    _exit=os._exit,
+) -> int:
+    """The ``tg sim-worker`` entry: :func:`sim_worker_loop` wrapped so a
+    DEAD LEADER ends the worker with one readable line instead of a
+    distributed-runtime ``LOG(FATAL)`` stack (VERDICT r5 weak #4).
+
+    When the leader (or any member) dies, this worker's blocked
+    collective aborts with a catchable runtime error within ~1 s — but
+    the distributed runtime's error-poll thread will ``LOG(FATAL)`` the
+    whole process shortly after, without a Python hook. So: classify the
+    exception with the cohort child's typed-first classifier, print the
+    one-line diagnosis, and ``os._exit`` IMMEDIATELY — same sidestep the
+    leader child uses (``sim/cohort.py`` ``cohort_fatal``) — beating the
+    fatal poll to the exit. Non-cohort exceptions re-raise unchanged;
+    ``_exit`` is injectable for tests."""
+    try:
+        sim_worker_loop(
+            coordinator_address,
+            num_processes,
+            process_id,
+            plans_dir,
+            once=once,
+            log=log,
+        )
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — classified below
+        from .cohort import _is_cohort_fatal
+
+        if _is_cohort_fatal(e):
+            log(
+                "sim-worker: cohort lost (leader or member died: "
+                f"{type(e).__name__}) — exiting cleanly; restart every "
+                "sim-worker to form a new cohort"
+            )
+            sys.stdout.flush()
+            _exit(1)
+            return 1  # only reached when _exit is a test stub
+        raise
+    return 0
 
 
 def _tree_slice(state_group):
@@ -1760,15 +2123,25 @@ class _SimTelemetryWriter:
     writer only counts rows (and nothing downstream needs them: the
     Influx mirror requires an env, which also provides the dir)."""
 
-    def __init__(self, group_ids: tuple, ident: dict, path: str | None):
+    def __init__(
+        self,
+        group_ids: tuple,
+        ident: dict,
+        path: str | None,
+        append: bool = False,
+        rows_offset: int = 0,
+    ):
         self.group_ids = group_ids
         self.ident = ident
         self.path = path
-        self.rows_written = 0
+        # resumed runs (sim/checkpoint.py) continue the series: the
+        # file was truncated to the snapshot's byte offset, the row
+        # counter continues from the snapshot's count
+        self.rows_written = int(rows_offset)
         self._f = None
         if path is not None:
             try:
-                self._f = open(path, "w")
+                self._f = open(path, "a" if append else "w")
             except OSError:
                 self.path = None  # observe best-effort, never fail the run
 
@@ -1828,14 +2201,26 @@ class _SimTraceWriter:
     no outputs dir the writer only counts events (same rule as the
     telemetry writer)."""
 
-    def __init__(self, groups, ident: dict, run_dir, tick_ms: float, plan):
+    def __init__(
+        self,
+        groups,
+        ident: dict,
+        run_dir,
+        tick_ms: float,
+        plan,
+        resume: dict | None = None,
+    ):
         from .trace import TRACE_EVENTS_FILE, TRACE_FILE
 
         self.plan = plan
         self.ident = ident
         self.tick_ms = float(tick_ms)
-        self.events_written = 0
-        self.truncated = 0
+        # resumed runs (sim/checkpoint.py) continue the stream where
+        # the snapshot left it: counters come from the snapshot aux,
+        # the Chrome-export buffer is re-seeded from the truncated
+        # jsonl prefix below
+        self.events_written = int((resume or {}).get("events", 0) or 0)
+        self.truncated = int((resume or {}).get("truncated", 0) or 0)
         self._buffer: list[dict] = []
         self._groups = groups
         # lane → (group id, group-relative seq), for the TRACED lanes
@@ -1871,10 +2256,30 @@ class _SimTraceWriter:
         )
         self._f = None
         if self.path is not None:
+            if resume is not None:
+                self._seed_buffer_from_file()
             try:
-                self._f = open(self.path, "w")
+                self._f = open(self.path, "a" if resume is not None else "w")
             except OSError:  # observe best-effort, never fail the run
                 self.path = None
+
+    def _seed_buffer_from_file(self) -> None:
+        """Re-read the (truncated-to-snapshot) jsonl prefix into the
+        Chrome-export buffer so a resumed run's ``trace_events.json``
+        still covers the whole run. Bounded by the plan's ``events``
+        cap, exactly like the live path; best-effort."""
+        from testground_tpu.sim.telemetry import iter_jsonl
+
+        drop = set(self.ident)
+        try:
+            for row in iter_jsonl(self.path):
+                if len(self._buffer) >= self.plan.events_cap:
+                    break
+                self._buffer.append(
+                    {k: v for k, v in row.items() if k not in drop}
+                )
+        except OSError:
+            pass
 
     def on_block(self, block) -> None:
         from .trace import events_from_blocks
@@ -1967,6 +2372,20 @@ class _TimeSeriesRecorder:
     @property
     def enabled(self) -> bool:
         return callable(self._collect) and self.every > 0
+
+    # the recorder's sampled rows ride run checkpoints (sim/checkpoint.py)
+    # so a resumed run's timeseries.jsonl still covers the whole run
+    def state_dict(self) -> dict:
+        return {
+            "rows": list(self.rows),
+            "next_at": self._next_at,
+            "last_tick": self._last_tick,
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.rows = [dict(r) for r in state.get("rows", [])]
+        self._next_at = int(state.get("next_at", self.every))
+        self._last_tick = int(state.get("last_tick", -1))
 
     def observe(self, ticks: int, carry) -> None:
         if ticks < self._next_at:

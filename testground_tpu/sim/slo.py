@@ -361,6 +361,7 @@ class SloEvaluator:
         ident: dict | None = None,
         path: str | None = None,
         cancel=None,
+        append: bool = False,
     ):
         self.plan = plan
         self.group_ids = tuple(g.id for g in groups)
@@ -404,9 +405,68 @@ class SloEvaluator:
         self._f = None
         if path is not None:
             try:
-                self._f = open(path, "w")
+                # append mode: a resumed run (sim/checkpoint.py) continues
+                # the record stream past the snapshot's truncated prefix
+                self._f = open(path, "a" if append else "w")
             except OSError:  # observe best-effort, never fail the run
                 self.path = None
+
+    # ------------------------------------------------- checkpoint state
+    # The evaluator's whole mutable state is JSON-able by construction
+    # (python ints/floats + the int64 histogram arrays): it rides run
+    # checkpoints so a resumed run judges windowed rules against the
+    # same history an uninterrupted run would (docs/CHECKPOINT.md).
+
+    def state_dict(self) -> dict:
+        return {
+            "agg": {k: dict(v) for k, v in self._agg.items()},
+            "records": [dict(r) for r in self._records],
+            "records_written": self.records_written,
+            "cum": dict(self._cum),
+            "cum_lat": (
+                self._cum_lat.tolist() if self._cum_lat is not None else None
+            ),
+            "ring": [
+                {
+                    **{k: s[k] for k in s if k != "lat"},
+                    "lat": (
+                        s["lat"].tolist() if s["lat"] is not None else None
+                    ),
+                }
+                for s in self._ring
+            ],
+            "last_tick": self._last_tick,
+            "fatal": dict(self.fatal) if self.fatal is not None else None,
+        }
+
+    def load_state(self, state: dict) -> None:
+        for name, agg in (state.get("agg") or {}).items():
+            if name in self._agg:
+                self._agg[name] = dict(agg)
+        self._records = [dict(r) for r in state.get("records", [])]
+        self.records_written = int(state.get("records_written", 0))
+        for k in self._cum:
+            self._cum[k] = int((state.get("cum") or {}).get(k, 0))
+        cl = state.get("cum_lat")
+        self._cum_lat = (
+            np.asarray(cl, dtype=np.int64) if cl is not None else None
+        )
+        self._ring.clear()
+        for s in state.get("ring") or []:
+            lat = s.get("lat")
+            self._ring.append(
+                {
+                    **{k: v for k, v in s.items() if k != "lat"},
+                    "lat": (
+                        np.asarray(lat, dtype=np.int64)
+                        if lat is not None
+                        else None
+                    ),
+                }
+            )
+        self._last_tick = int(state.get("last_tick", -1))
+        fatal = state.get("fatal")
+        self.fatal = dict(fatal) if fatal else None
 
     # ------------------------------------------------------------- feeding
 

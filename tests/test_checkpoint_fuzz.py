@@ -1,0 +1,157 @@
+"""Property-based fuzz of the checkpoint archive: save → load must be
+the identity for ARBITRARY carry pytrees (docs/CHECKPOINT.md satellite).
+
+Two properties:
+
+1. **Round trip**: any pytree of numpy leaves (mixed dtypes/shapes,
+   nested dict/tuple/list containers, typed PRNG-key arrays sprinkled
+   in) survives ``snapshot_carry`` → ``save_snapshot`` →
+   ``load_snapshot`` leaf-for-leaf, dtype-exact, through the real
+   on-disk archive.
+2. **Damage refuses**: truncating the written archive at any byte
+   offset (or flipping its magic) raises the typed
+   :class:`CheckpointError` — a damaged snapshot must refuse loudly,
+   never load garbage.
+
+Gated on hypothesis like test_sync_fuzz / test_transport_fuzz /
+test_chaos_fuzz."""
+
+import os
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property-based tier needs hypothesis"
+)
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+import jax  # noqa: E402
+
+from testground_tpu.sim.checkpoint import (  # noqa: E402
+    FORMAT_VERSION,
+    CheckpointError,
+    load_snapshot,
+    save_snapshot,
+    snapshot_carry,
+)
+
+_DTYPES = (np.int32, np.int64, np.float32, np.float64, np.uint8, np.bool_)
+
+
+@st.composite
+def leaf_arrays(draw):
+    dtype = draw(st.sampled_from(_DTYPES))
+    shape = tuple(
+        draw(
+            st.lists(
+                st.integers(min_value=0, max_value=5),
+                min_size=0,
+                max_size=3,
+            )
+        )
+    )
+    if dtype == np.bool_:
+        return np.asarray(
+            draw(
+                st.lists(
+                    st.booleans(),
+                    min_size=int(np.prod(shape, dtype=int)),
+                    max_size=int(np.prod(shape, dtype=int)),
+                )
+            ),
+            dtype=dtype,
+        ).reshape(shape)
+    info_ints = st.integers(min_value=-(2**31), max_value=2**31 - 1)
+    floats = st.floats(
+        allow_nan=False, allow_infinity=False, width=32
+    )
+    vals = draw(
+        st.lists(
+            floats if np.issubdtype(dtype, np.floating) else info_ints,
+            min_size=int(np.prod(shape, dtype=int)),
+            max_size=int(np.prod(shape, dtype=int)),
+        )
+    )
+    return np.asarray(vals, dtype=dtype).reshape(shape)
+
+
+@st.composite
+def prng_leaves(draw):
+    seed = draw(st.integers(min_value=0, max_value=2**31 - 1))
+    n = draw(st.integers(min_value=1, max_value=4))
+    key = jax.random.key(seed)
+    return jax.random.split(key, n) if n > 1 else key
+
+
+def leaves():
+    return st.one_of(leaf_arrays(), prng_leaves())
+
+
+def trees():
+    return st.recursive(
+        leaves(),
+        lambda children: st.one_of(
+            st.lists(children, min_size=1, max_size=3).map(tuple),
+            st.dictionaries(
+                st.text(
+                    alphabet="abcdefgh", min_size=1, max_size=4
+                ),
+                children,
+                min_size=1,
+                max_size=3,
+            ),
+        ),
+        max_leaves=8,
+    )
+
+
+class TestRoundTripFuzz:
+    @settings(max_examples=25, deadline=None)
+    @given(tree=trees(), tick=st.integers(min_value=0, max_value=10**9))
+    def test_save_load_is_identity(self, tmp_path_factory, tree, tick):
+        run_dir = str(tmp_path_factory.mktemp("ckpt"))
+        leaves_in, metas = snapshot_carry(tree)
+        manifest = {
+            "version": FORMAT_VERSION,
+            "tick": tick,
+            "leaves": metas,
+            "aux": {},
+        }
+        path, size, _ = save_snapshot(run_dir, manifest, leaves_in)
+        m2, leaves_out = load_snapshot(path)
+        assert m2["tick"] == tick and m2["leaves"] == metas
+        assert len(leaves_out) == len(leaves_in)
+        for a, b in zip(leaves_in, leaves_out):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b)
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        tree=trees(),
+        frac=st.floats(min_value=0.01, max_value=0.99),
+    )
+    def test_truncation_anywhere_refuses_typed(
+        self, tmp_path_factory, tree, frac
+    ):
+        run_dir = str(tmp_path_factory.mktemp("ckpt"))
+        leaves_in, metas = snapshot_carry(tree)
+        path, size, _ = save_snapshot(
+            run_dir,
+            {
+                "version": FORMAT_VERSION,
+                "tick": 8,
+                "leaves": metas,
+                "aux": {},
+            },
+            leaves_in,
+        )
+        cut = max(1, int(size * frac))
+        if cut >= size:
+            cut = size - 1
+        with open(path, "r+b") as f:
+            f.truncate(cut)
+        assert os.path.getsize(path) == cut
+        with pytest.raises(CheckpointError):
+            load_snapshot(path)

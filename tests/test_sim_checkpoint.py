@@ -1,0 +1,785 @@
+"""Checkpoint/resume plane (docs/CHECKPOINT.md): durable live-sim
+snapshots with bit-identical continuation.
+
+The load-bearing contracts pinned here:
+
+1. **Determinism pin**: a run interrupted at an arbitrary chunk and
+   resumed from its snapshot produces results identical LEAF FOR LEAF to
+   an uninterrupted run — status, finished_at, every state leaf, every
+   flow total, the latency histogram — on both the xla and pallas
+   (interpret) transports, through the real on-disk snapshot format.
+2. **Refuse loudly, never resume garbage**: corrupted/truncated archives,
+   missing manifests, version drift, composition/transport mismatches
+   and program-shape drift all raise the typed :class:`CheckpointError`.
+3. **Zero overhead when off**: `checkpoint_chunks=0` leaves the host-sync
+   count (and the program — the knob is not program-shaping) unchanged;
+   armed checkpointing adds no `_poll_done` syncs either (the snapshot
+   read is a direct transfer at K-chunk boundaries).
+4. **The surface end to end**: executor resume (cross-run and in-place
+   auto-resume) with byte-equal telemetry streams, journal
+   `sim.checkpoint`, bounded retention, `tg stats` line, Prometheus
+   `tg_checkpoint_*`, GET /artifact whitelist, and `tg run resume`.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from testground_tpu.api import RunGroup, RunInput
+from testground_tpu.config import EnvConfig
+from testground_tpu.rpc import OutputWriter
+from testground_tpu.sim import engine as engine_mod
+from testground_tpu.sim.checkpoint import (
+    CHECKPOINT_DIR,
+    CheckpointError,
+    FORMAT_VERSION,
+    list_snapshots,
+    load_latest,
+    load_snapshot,
+    prune_snapshots,
+    restore_carry,
+    save_snapshot,
+    snapshot_carry,
+)
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import (
+    SimJaxConfig,
+    execute_sim_run,
+    load_sim_testcases,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+# the observable-outcome keys compared leaf-for-leaf between a resumed
+# and an uninterrupted run (the transport-equality discipline)
+RESULT_KEYS = (
+    "status",
+    "finished_at",
+    "ticks",
+    "sync_counts",
+    "pub_dropped",
+    "latency_clamped",
+    "bw_queue_dropped",
+    "collisions",
+    "msgs_delivered",
+    "msgs_sent",
+    "msgs_enqueued",
+    "msgs_dropped",
+    "msgs_rejected",
+    "cal_depth",
+    "faults_crashed",
+    "faults_restarted",
+    "fault_dropped",
+)
+
+
+def make_groups(*counts):
+    return build_groups(
+        [
+            RunGroup(id=f"g{i}", instances=c, parameters={})
+            for i, c in enumerate(counts)
+        ]
+    )
+
+
+def pingpong_prog(n=4, chunk=16, transport="xla", telemetry=True):
+    tc = load_sim_testcases(os.path.join(PLANS, "network"))["ping-pong"]()
+    return SimProgram(
+        tc,
+        make_groups(n),
+        chunk=chunk,
+        telemetry=telemetry,
+        transport=transport,
+    )
+
+
+def assert_results_equal(res_a, res_b, label=""):
+    for key in RESULT_KEYS:
+        a, b = np.asarray(res_a[key]), np.asarray(res_b[key])
+        assert np.array_equal(a, b), f"[{label}] {key}: {a} vs {b}"
+    la, ta = jax.tree.flatten(res_a["states"])
+    lb, tb = jax.tree.flatten(res_b["states"])
+    assert ta == tb, f"[{label}] state structure drifted"
+    for i, (a, b) in enumerate(zip(la, lb)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"[{label}] state leaf {i} differs"
+        )
+    assert res_a.get("lat_hist") == res_b.get("lat_hist"), (
+        f"[{label}] latency histogram differs"
+    )
+
+
+# ------------------------------------------------------------ file format
+
+
+class TestSnapshotFormat:
+    def _leaves(self):
+        key = jax.random.key(7)
+        carry = {
+            "a": np.arange(12, dtype=np.int32).reshape(3, 4),
+            "b": (np.ones((2,), np.float32), jax.random.split(key, 5)),
+        }
+        return snapshot_carry(carry)
+
+    def test_roundtrip_including_prng_keys(self, tmp_path):
+        leaves, metas = self._leaves()
+        assert [m["kind"] for m in metas] == ["array", "array", "prng"]
+        manifest = {
+            "version": FORMAT_VERSION,
+            "tick": 32,
+            "leaves": metas,
+            "aux": {},
+        }
+        path, size, ms = save_snapshot(str(tmp_path), manifest, leaves)
+        assert os.path.basename(path) == "ckpt-000000000032.npz"
+        assert size == os.path.getsize(path) and size > 0
+        m2, leaves2 = load_snapshot(path)
+        assert m2["tick"] == 32 and m2["leaves"] == metas
+        for a, b in zip(leaves, leaves2):
+            assert np.array_equal(a, b) and a.dtype == b.dtype
+
+    def test_atomic_no_tmp_left_and_listing_ignores_foreign(self, tmp_path):
+        leaves, metas = self._leaves()
+        for tick in (64, 16, 48):
+            save_snapshot(
+                str(tmp_path),
+                {
+                    "version": FORMAT_VERSION,
+                    "tick": tick,
+                    "leaves": metas,
+                    "aux": {},
+                },
+                leaves,
+            )
+        d = tmp_path / CHECKPOINT_DIR
+        # foreign noise + a fake in-flight temp file must be invisible
+        (d / "notes.txt").write_text("x")
+        (d / "ckpt-000000000064.npz.tmp-999").write_text("partial")
+        assert not [p for p in os.listdir(d) if p.endswith(f".tmp-{os.getpid()}")]
+        snaps = list_snapshots(str(tmp_path))
+        assert [t for t, _ in snaps] == [16, 48, 64]  # tick-ordered
+
+    def test_retention_keeps_newest(self, tmp_path):
+        leaves, metas = self._leaves()
+        for tick in (16, 32, 48, 64, 80):
+            save_snapshot(
+                str(tmp_path),
+                {
+                    "version": FORMAT_VERSION,
+                    "tick": tick,
+                    "leaves": metas,
+                    "aux": {},
+                },
+                leaves,
+            )
+        removed = prune_snapshots(str(tmp_path), keep=2)
+        assert removed == 3
+        assert [t for t, _ in list_snapshots(str(tmp_path))] == [64, 80]
+
+    def test_truncated_archive_refuses_typed(self, tmp_path):
+        leaves, metas = self._leaves()
+        path, size, _ = save_snapshot(
+            str(tmp_path),
+            {
+                "version": FORMAT_VERSION,
+                "tick": 8,
+                "leaves": metas,
+                "aux": {},
+            },
+            leaves,
+        )
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        with pytest.raises(CheckpointError, match="corrupt or truncated"):
+            load_snapshot(path)
+
+    def test_garbage_bytes_refuse_typed(self, tmp_path):
+        p = tmp_path / "ckpt-000000000001.npz"
+        p.write_bytes(b"this is not a zip archive at all")
+        with pytest.raises(CheckpointError):
+            load_snapshot(str(p))
+
+    def test_archive_without_manifest_refuses(self, tmp_path):
+        p = tmp_path / "ckpt-000000000002.npz"
+        np.savez(str(p), leaf_00000=np.zeros(3))
+        with pytest.raises(CheckpointError, match="no embedded manifest"):
+            load_snapshot(str(p))
+
+    def test_version_drift_refuses(self, tmp_path):
+        leaves, metas = self._leaves()
+        path, _, _ = save_snapshot(
+            str(tmp_path),
+            {
+                "version": FORMAT_VERSION + 1,
+                "tick": 8,
+                "leaves": metas,
+                "aux": {},
+            },
+            leaves,
+        )
+        with pytest.raises(CheckpointError, match="format version"):
+            load_snapshot(path)
+
+    def test_missing_leaf_refuses(self, tmp_path):
+        leaves, metas = self._leaves()
+        path, _, _ = save_snapshot(
+            str(tmp_path),
+            {
+                "version": FORMAT_VERSION,
+                "tick": 8,
+                # manifest promises one more leaf than the archive holds
+                "leaves": metas + [{"kind": "array", "shape": [1], "dtype": "int32"}],
+                "aux": {},
+            },
+            leaves,
+        )
+        with pytest.raises(CheckpointError, match="missing carry leaf"):
+            load_snapshot(path)
+
+    def test_load_latest_empty_dir_refuses(self, tmp_path):
+        with pytest.raises(CheckpointError, match="no snapshots"):
+            load_latest(str(tmp_path))
+
+
+# ----------------------------------------------------- restore validation
+
+
+class TestRestoreValidation:
+    def test_wrong_composition_shape_refuses(self, tmp_path):
+        prog4 = pingpong_prog(n=4)
+        carry = jax.jit(lambda: prog4.init_carry(0))()
+        leaves, metas = snapshot_carry(carry)
+        manifest = {"leaves": metas}
+        # a program built for a DIFFERENT instance count must refuse
+        prog8 = pingpong_prog(n=8)
+        with pytest.raises(CheckpointError, match="refusing to resume"):
+            restore_carry(prog8, 0, manifest, leaves)
+
+    def test_cross_transport_layout_refuses(self):
+        # xla keeps flat calendar planes, pallas keeps 2-D rows: a
+        # snapshot from one cannot silently seed the other
+        prog_x = pingpong_prog(n=4, transport="xla")
+        carry = jax.jit(lambda: prog_x.init_carry(0))()
+        leaves, metas = snapshot_carry(carry)
+        prog_p = pingpong_prog(n=4, transport="pallas")
+        with pytest.raises(CheckpointError):
+            restore_carry(prog_p, 0, {"leaves": metas}, leaves)
+
+    def test_kind_drift_refuses(self):
+        prog = pingpong_prog(n=2)
+        carry = jax.jit(lambda: prog.init_carry(0))()
+        leaves, metas = snapshot_carry(carry)
+        bad = [dict(m) for m in metas]
+        # claim the first PRNG leaf is a plain array
+        for m in bad:
+            if m["kind"] == "prng":
+                m["kind"] = "array"
+                break
+        with pytest.raises(CheckpointError):
+            restore_carry(prog, 0, {"leaves": bad}, leaves)
+
+
+# ------------------------------------------------ determinism pin (engine)
+
+
+class TestEngineResumeDeterminism:
+    @pytest.mark.parametrize("transport", ["xla", "pallas"])
+    def test_kill_at_chunk_resume_equals_uninterrupted(
+        self, tmp_path, transport
+    ):
+        """THE acceptance pin: interrupt at a chunk boundary, persist
+        through the real archive format, restore into a freshly built
+        program, continue — and compare every leaf against an
+        uninterrupted run. On CPU the pallas arm runs the real kernels
+        in interpret mode."""
+        cut = 48  # an arbitrary mid-run chunk boundary (chunk=16)
+        res_full = pingpong_prog(transport=transport).run(
+            seed=3, max_ticks=512
+        )
+        assert res_full["ticks"] > cut  # the cut is genuinely mid-run
+
+        prog_cut = pingpong_prog(transport=transport)
+        captured = {}
+
+        def observer(ticks, carry):
+            if ticks == cut:
+                captured["leaves"], captured["metas"] = snapshot_carry(
+                    carry
+                )
+
+        prog_cut.run(seed=3, max_ticks=cut, observer=observer)
+        path, _, _ = save_snapshot(
+            str(tmp_path),
+            {
+                "version": FORMAT_VERSION,
+                "tick": cut,
+                "leaves": captured["metas"],
+                "aux": {},
+            },
+            captured["leaves"],
+        )
+        manifest, leaves = load_snapshot(path)
+
+        prog_res = pingpong_prog(transport=transport)
+        carry = restore_carry(prog_res, 3, manifest, leaves)
+        res_res = prog_res.run(
+            seed=3, max_ticks=512, resume_carry=carry, resume_ticks=cut
+        )
+        assert_results_equal(res_full, res_res, label=transport)
+
+
+# ----------------------------------------------------------- zero overhead
+
+
+class TestZeroOverhead:
+    def test_program_jaxpr_untouched_by_checkpointing(self):
+        """The knob is not program-shaping: the chunk program traced for
+        a checkpointed run is the IDENTICAL jaxpr — snapshotting rides
+        the observer hook, never the compiled tick. (Guards against a
+        future change threading a checkpoint flag into SimProgram.)"""
+        prog = pingpong_prog(n=2, chunk=8)
+        carry = jax.jit(lambda: prog.init_carry(0))()
+        before = str(jax.make_jaxpr(prog._chunk_step)(carry))
+        # run WITH an armed checkpointing observer over the same program
+        prog.run(
+            seed=0,
+            max_ticks=16,
+            observer=lambda ticks, c: snapshot_carry(c),
+        )
+        carry2 = jax.jit(lambda: prog.init_carry(0))()
+        after = str(jax.make_jaxpr(prog._chunk_step)(carry2))
+        assert before == after
+
+    def test_sync_count_unchanged_by_checkpoint_knob(
+        self, tg_home, monkeypatch
+    ):
+        """The default program's one-blocking-sync-per-chunk contract is
+        untouched by the knob at 0 AND by armed checkpointing (snapshot
+        reads are direct transfers, never extra done-polls)."""
+        counts = []
+        real = engine_mod._poll_done
+
+        def run_once(run_id, **cfg_kw):
+            c = [0]
+
+            def counting(done):
+                c[0] += 1
+                return real(done)
+
+            monkeypatch.setattr(engine_mod, "_poll_done", counting)
+            out = _exec(run_id, max_ticks=128, **cfg_kw)
+            counts.append(c[0])
+            return out
+
+        run_once("sync-off")  # no knob at all
+        run_once("sync-zero", checkpoint_chunks=0)
+        run_once("sync-armed", checkpoint_chunks=1)
+        assert counts[0] == counts[1] == counts[2]
+
+
+# --------------------------------------------------------- executor e2e
+
+
+def _exec(run_id, cancel=None, env=None, **cfg_kw):
+    env = env or EnvConfig.load()
+    cfg_kw.setdefault("chunk", 16)
+    cfg_kw.setdefault("telemetry", True)
+    cfg_kw.setdefault("seed", 5)
+    cfg = SimJaxConfig(**cfg_kw)
+    job = RunInput(
+        run_id=run_id,
+        test_plan="network",
+        test_case="ping-pong",
+        total_instances=4,
+        groups=[
+            RunGroup(
+                id="single",
+                instances=4,
+                artifact_path=os.path.join(PLANS, "network"),
+            )
+        ],
+        runner_config=cfg,
+        env=env,
+    )
+    return execute_sim_run(
+        job, OutputWriter(sink=None), cancel or threading.Event()
+    )
+
+
+def _series_rows(env, run_id, name="sim_timeseries.jsonl"):
+    path = os.path.join(env.dirs.outputs(), "network", run_id, name)
+    with open(path) as f:
+        return [
+            {k: v for k, v in json.loads(line).items() if k != "run"}
+            for line in f
+        ]
+
+
+@pytest.fixture(scope="class")
+def resumed_runs(tmp_path_factory):
+    """One shared cut → resume → auto-resume sequence (compile once,
+    assert many)."""
+    home = tmp_path_factory.mktemp("tghome")
+    old = os.environ.get("TESTGROUND_HOME")
+    os.environ["TESTGROUND_HOME"] = str(home)
+    try:
+        env = EnvConfig.load()
+        out = {
+            "env": env,
+            "full": _exec(
+                "full", env=env, max_ticks=512, checkpoint_chunks=2
+            ),
+            "cut": _exec(
+                "cut",
+                env=env,
+                max_ticks=64,
+                checkpoint_chunks=2,
+                checkpoint_keep=2,
+            ),
+        }
+        out["res"] = _exec(
+            "res",
+            env=env,
+            max_ticks=512,
+            checkpoint_chunks=2,
+            resume_from="cut",
+        )
+        # in-place auto-resume: the interrupted task re-runs under its
+        # OWN id (the daemon-restart rehydration path) and continues
+        # from its own newest snapshot
+        out["auto"] = _exec(
+            "cut", env=env, max_ticks=512, checkpoint_chunks=2
+        )
+        yield out
+    finally:
+        if old is None:
+            os.environ.pop("TESTGROUND_HOME", None)
+        else:
+            os.environ["TESTGROUND_HOME"] = old
+
+
+class TestExecutorResume:
+    def test_cut_wrote_bounded_snapshots_and_journal(self, resumed_runs):
+        env = resumed_runs["env"]
+        ckpt_dir = os.path.join(
+            env.dirs.outputs(), "network", "cut", CHECKPOINT_DIR
+        )
+        jc = resumed_runs["cut"].result.journal["sim"]["checkpoint"]
+        assert jc["every_chunks"] == 2 and jc["count"] >= 2
+        assert jc["last_tick"] == 64 and jc["bytes"] > 0
+        assert jc["write_ms"] > 0 and jc["dir"] == CHECKPOINT_DIR
+        # retention: checkpoint_keep=2 bounds what survives on disk
+        # (the auto-resume run later continues with the default keep)
+        names = sorted(os.listdir(ckpt_dir))
+        assert all(n.startswith("ckpt-") and n.endswith(".npz") for n in names)
+        assert len(names) <= 3
+
+    def test_resumed_journal_equals_uninterrupted(self, resumed_runs):
+        jf = resumed_runs["full"].result.journal
+        for label in ("res", "auto"):
+            jr = resumed_runs[label].result.journal
+            for key in (
+                "ticks",
+                "msgs_delivered",
+                "msgs_sent",
+                "msgs_enqueued",
+                "msgs_dropped",
+                "msgs_rejected",
+                "msgs_in_flight",
+                "latency_clamped",
+            ):
+                assert jr["sim"][key] == jf["sim"][key], (label, key)
+            assert jr["sim"].get("latency") == jf["sim"].get("latency")
+            assert jr["telemetry"]["totals"] == jf["telemetry"]["totals"]
+            assert jr["telemetry"]["rows"] == jf["telemetry"]["rows"]
+            assert jr["events"] == jf["events"]
+
+    def test_resumed_telemetry_stream_is_byte_equal(self, resumed_runs):
+        env = resumed_runs["env"]
+        rows_full = _series_rows(env, "full")
+        assert rows_full, "reference run produced no telemetry rows"
+        assert _series_rows(env, "res") == rows_full
+        assert _series_rows(env, "cut") == rows_full  # in-place resume
+
+    def test_resume_provenance_recorded(self, resumed_runs):
+        jr = resumed_runs["res"].result.journal["sim"]["checkpoint"]
+        assert jr["resumed"]["from_run"] == "cut"
+        assert jr["resumed"]["from_tick"] == 64
+        assert jr["resumed"]["snapshot"].startswith("ckpt-")
+        ja = resumed_runs["auto"].result.journal["sim"]["checkpoint"]
+        assert ja["resumed"]["from_run"] == "cut"
+
+    def test_restart_mid_resume_prefers_own_newer_progress(
+        self, resumed_runs
+    ):
+        """A daemon restart rehydrates a resume task with resume_from
+        still set; it must continue from its OWN newest snapshot, not
+        roll back to the (older) source snapshot and re-earn every tick
+        — and must not overwrite its own streams with the source's
+        shorter prefix."""
+        env = resumed_runs["env"]
+        rows_before = _series_rows(env, "res")
+        out = _exec(
+            "res",
+            env=env,
+            max_ticks=512,
+            checkpoint_chunks=2,
+            resume_from="cut",  # still set, as a rehydrated task has it
+        )
+        ck = out.result.journal["sim"]["checkpoint"]
+        assert ck["resumed"]["from_run"] == "res"  # own, NOT "cut"
+        assert ck["resumed"]["from_tick"] > 64  # past cut's newest
+        jf = resumed_runs["full"].result.journal
+        for key in ("msgs_delivered", "msgs_sent", "msgs_enqueued"):
+            assert out.result.journal["sim"][key] == jf["sim"][key]
+        # the stream was not rolled back to cut's prefix
+        assert _series_rows(env, "res") == rows_before
+
+    def test_stats_table_and_prometheus_surface(self, resumed_runs):
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+        from testground_tpu.metrics.prometheus import render_prometheus
+        from testground_tpu.runners.pretty import render_telemetry_summary
+
+        result = resumed_runs["res"].result.to_dict()
+        t = Task(
+            id="res",
+            type=TaskType.RUN,
+            plan="network",
+            case="ping-pong",
+            states=[DatedState(state=State.COMPLETE, created=0.0)],
+            result=result,
+        )
+        table = render_telemetry_summary(t.stats_payload())
+        assert "checkpoint" in table
+        assert "resumed from tick 64 of run cut" in table
+        text = render_prometheus([t], per_task_limit=10)
+        for gauge in (
+            "tg_checkpoint_count{",
+            "tg_checkpoint_last_tick{",
+            "tg_checkpoint_bytes{",
+            "tg_checkpoint_write_ms{",
+        ):
+            assert gauge in text, f"{gauge} missing from exposition"
+
+    def test_artifact_whitelist_serves_snapshots_only_safely(self):
+        from testground_tpu.daemon.server import _Handler
+
+        rel = _Handler._artifact_relpath
+        assert rel("checkpoints/ckpt-000000000064.npz") == os.path.join(
+            "checkpoints", "ckpt-000000000064.npz"
+        )
+        assert rel("checkpoints/../secrets.npz") is None
+        assert rel("checkpoints/evil.npz") is None
+        assert rel("checkpoints/ckpt-1/extra.npz") is None
+        assert rel("ckpt-000000000064.npz") is None
+
+    def test_resume_from_unknown_run_refuses(self, resumed_runs):
+        with pytest.raises(CheckpointError, match="nothing to resume"):
+            _exec(
+                "res-none",
+                env=resumed_runs["env"],
+                max_ticks=64,
+                resume_from="no-such-run",
+            )
+
+    def test_identity_mismatch_refuses(self, resumed_runs):
+        # a different seed is a different deterministic stream: the
+        # snapshot manifest must refuse to seed it
+        with pytest.raises(CheckpointError, match="different run identity"):
+            _exec(
+                "res-seed",
+                env=resumed_runs["env"],
+                max_ticks=512,
+                resume_from="cut",
+                seed=6,
+            )
+
+    def test_corrupted_snapshot_refuses_resume(self, resumed_runs):
+        # LAST in the class: this damages cut's newest snapshot on disk
+        env = resumed_runs["env"]
+        ckpt_dir = os.path.join(
+            env.dirs.outputs(), "network", "cut", CHECKPOINT_DIR
+        )
+        newest = sorted(os.listdir(ckpt_dir))[-1]
+        path = os.path.join(ckpt_dir, newest)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 3)
+        with pytest.raises(CheckpointError, match="refusing to resume"):
+            _exec("res-bad", env=env, max_ticks=512, resume_from="cut")
+
+
+# -------------------------------------------------- SLO state continuation
+
+
+class TestSloStateRoundTrip:
+    def test_evaluator_state_roundtrips_exactly(self):
+        from testground_tpu.sim.slo import SloEvaluator, build_slo_plan
+
+        groups = make_groups(4)
+        plan = build_slo_plan(
+            groups,
+            {
+                "": [
+                    {
+                        "name": "rate",
+                        "metric": "delivered_per_tick",
+                        "op": ">=",
+                        "threshold": 1e9,  # breaches every chunk
+                        "window_ticks": 32,
+                    }
+                ]
+            },
+        )
+        ev = SloEvaluator(plan, groups, 1.0, 16)
+        for tick0 in (0, 16, 32):
+            ev.on_rows(
+                [
+                    {"tick": tick0 + i, "delivered": 3, "sent": 4}
+                    for i in range(16)
+                ]
+            )
+            ev.evaluate()
+        state = ev.state_dict()
+        assert json.loads(json.dumps(state)) == state  # JSON-able
+
+        ev2 = SloEvaluator(plan, groups, 1.0, 16)
+        ev2.load_state(state)
+        assert ev2.journal() == ev.journal()
+        # continued evaluation agrees with an uninterrupted evaluator
+        for e in (ev, ev2):
+            e.on_rows(
+                [{"tick": 48 + i, "delivered": 3, "sent": 4} for i in range(16)]
+            )
+            e.evaluate()
+        assert ev2.journal() == ev.journal()
+
+
+# ------------------------------------------------------------- CLI resume
+
+
+class TestCliResume:
+    def test_run_resume_continues_a_checkpointed_task(
+        self, tg_home, capsys
+    ):
+        from testground_tpu.cli.main import main
+
+        assert (
+            main(
+                [
+                    "plan",
+                    "import",
+                    "--from",
+                    os.path.join(PLANS, "network"),
+                ]
+            )
+            == 0
+        )
+        # interrupted-by-budget run: completes FAILURE (incomplete
+        # instances) but leaves snapshots at every chunk boundary
+        rc = main(
+            [
+                "run",
+                "single",
+                "network:ping-pong",
+                "-i",
+                "4",
+                "--run-cfg",
+                "checkpoint_chunks=1",
+                "--run-cfg",
+                "chunk=16",
+                "--run-cfg",
+                "max_ticks=48",
+                "--run-cfg",
+                "telemetry=true",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert "run is queued with ID:" in out
+        task_id = out.split("run is queued with ID:")[1].split()[0].strip()
+        assert rc == 1  # incomplete instances → FAILURE, by design
+
+        # resume it to completion through the real CLI verb, extending
+        # the budget past the interruption point
+        assert (
+            main(
+                ["run", "resume", task_id, "--run-cfg", "max_ticks=512"]
+            )
+            == 0
+        )
+        out2 = capsys.readouterr().out
+        assert f"resuming task {task_id}" in out2
+        assert "(outcome: success)" in out2
+
+    def test_multi_runs_composition_refuses_readably(
+        self, monkeypatch, capsys
+    ):
+        """One resume_from cannot serve a multi-[[runs]] task (each run
+        has its own outputs dir) — the CLI refuses with the per-run
+        recipe instead of letting every run fail inside the executor."""
+        import time as _time
+
+        from testground_tpu.api import (
+            Composition,
+            Global,
+            Group,
+            Instances,
+            generate_default_run,
+        )
+        from testground_tpu.cli import commands
+        from testground_tpu.cli.main import main
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+
+        comp = generate_default_run(
+            Composition(
+                global_=Global(
+                    plan="network",
+                    case="ping-pong",
+                    builder="sim:plan",
+                    runner="sim:jax",
+                ),
+                groups=[Group(id="all", instances=Instances(count=2))],
+            )
+        )
+        d = comp.to_dict()
+        d["runs"] = d["runs"] + [
+            {**d["runs"][0], "id": "second"}
+        ]  # two [[runs]]
+        tsk = Task(
+            id="multi1",
+            type=TaskType.RUN,
+            plan="network",
+            case="ping-pong",
+            states=[
+                DatedState(state=State.COMPLETE, created=_time.time())
+            ],
+            composition=d,
+        )
+
+        class _Stub:
+            def get_task(self, tid):
+                return tsk if tid == "multi1" else None
+
+            def stop(self):
+                pass
+
+        monkeypatch.setattr(commands, "_engine", lambda args: _Stub())
+        assert main(["run", "resume", "multi1"]) == 1
+        err = capsys.readouterr().err
+        assert "multi-[[runs]]" in err
+        assert "--run-ids" in err and "multi1-" in err

@@ -1,0 +1,253 @@
+"""``make checkpoint-smoke``: the checkpoint/resume plane's end-to-end
+contract (docs/CHECKPOINT.md) on the CPU backend, driving the most
+adversarial composition in the tree — the ``plans/chaos`` smoke (crash +
+restart + link_flap + partition faults, flight recorder, warn-severity
+SLO, telemetry) — so the snapshot must carry EVERY plane's state:
+
+- **bit-identical continuation**: a run interrupted by a short tick
+  budget at a chunk boundary, then resumed from its newest snapshot,
+  must journal the same ticks / flow totals / fault counters / SLO
+  breach totals as an uninterrupted run, with a byte-equal (ident-
+  stripped) per-tick telemetry stream and SLO record stream;
+- **bounded retention**: only the newest ``checkpoint_keep`` snapshots
+  survive on disk;
+- **provenance**: the resumed journal records what it resumed from, the
+  ``tg stats`` table renders the checkpoint line, and the Prometheus
+  exposition carries ``tg_checkpoint_*``;
+- **loud refusal**: a truncated newest snapshot fails the resume with
+  the typed CheckpointError — never resumes garbage.
+
+Exits non-zero with a readable message on any violation. Self-contained:
+temporary $TESTGROUND_HOME, CPU backend — safe in CI (mirrors
+``tools/slo_smoke.py``)."""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def fail(msg: str) -> "None":
+    print(f"checkpoint-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _run_once(engine, comp, manifest, sources):
+    import time
+
+    from testground_tpu.engine import State
+
+    tid = engine.queue_run(comp, manifest, sources_dir=sources)
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        t = engine.get_task(tid)
+        if t is not None and t.state().state in (
+            State.COMPLETE,
+            State.CANCELED,
+        ):
+            return t
+        time.sleep(0.05)
+    fail(f"task {tid} did not finish within 300s")
+
+
+def _rows(env, task_id, name):
+    path = os.path.join(env.dirs.outputs(), "chaos", task_id, name)
+    if not os.path.isfile(path):
+        return None
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                fail(f"{name} line {i + 1} of {task_id} is not JSON: {e}")
+            out.append({k: v for k, v in row.items() if k != "run"})
+    return out
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-ckpt-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from testground_tpu.api import TestPlanManifest, load_composition
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome
+    from testground_tpu.metrics.prometheus import render_prometheus
+    from testground_tpu.runners.pretty import render_telemetry_summary
+    from testground_tpu.sim.checkpoint import CHECKPOINT_DIR
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    plan_dir = os.path.join(REPO_ROOT, "plans", "chaos")
+    comp_path = os.path.join(plan_dir, "_compositions", "smoke.toml")
+    manifest = TestPlanManifest.load_file(
+        os.path.join(plan_dir, "manifest.toml")
+    )
+
+    def comp_with(**run_cfg):
+        comp = load_composition(comp_path)
+        comp.global_.run_config.update(run_cfg)
+        return comp
+
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.start_workers()
+    try:
+        # uninterrupted reference, checkpointing every chunk
+        full = _run_once(
+            engine,
+            comp_with(checkpoint_chunks=1, checkpoint_keep=2),
+            manifest,
+            plan_dir,
+        )
+        # interrupted at tick 32 (a chunk boundary, mid-fault-schedule:
+        # the partition is still open and the heal is still to come)
+        cut = _run_once(
+            engine,
+            comp_with(checkpoint_chunks=1, checkpoint_keep=2, max_ticks=32),
+            manifest,
+            plan_dir,
+        )
+        # resumed with the full budget
+        resumed = _run_once(
+            engine,
+            comp_with(
+                checkpoint_chunks=1,
+                checkpoint_keep=2,
+                resume_from=cut.id,
+            ),
+            manifest,
+            plan_dir,
+        )
+        # corrupt the newest snapshot, then try to resume again: typed
+        # refusal, never garbage
+        ckpt_dir = os.path.join(
+            env.dirs.outputs(), "chaos", cut.id, CHECKPOINT_DIR
+        )
+        names = sorted(os.listdir(ckpt_dir))
+        if not (1 <= len(names) <= 2):
+            fail(
+                f"retention: expected <= 2 snapshot(s) under {ckpt_dir} "
+                f"(checkpoint_keep=2), found {names}"
+            )
+        newest = os.path.join(ckpt_dir, names[-1])
+        with open(newest, "r+b") as f:
+            f.truncate(os.path.getsize(newest) // 3)
+        refused = _run_once(
+            engine,
+            comp_with(checkpoint_chunks=1, resume_from=cut.id),
+            manifest,
+            plan_dir,
+        )
+    finally:
+        engine.stop()
+
+    # ---- the uninterrupted reference behaves like chaos-smoke
+    if full.outcome() != Outcome.SUCCESS:
+        fail(f"reference run outcome {full.outcome().value}: {full.error}")
+    jf = full.result["journal"]
+    ck = jf["sim"].get("checkpoint") or {}
+    if not ck.get("count"):
+        fail(f"reference run journaled no snapshots: {ck}")
+    if ck.get("bytes", 0) <= 0 or ck.get("write_ms", 0) <= 0:
+        fail(f"checkpoint journal lacks bytes/write_ms gauges: {ck}")
+
+    # ---- the cut run was interrupted mid-schedule, snapshots on disk
+    jc = cut.result["journal"]
+    if jc["sim"]["ticks"] != 32:
+        fail(f"cut run executed {jc['sim']['ticks']} ticks, wanted 32")
+
+    # ---- bit-identical continuation
+    if resumed.outcome() != Outcome.SUCCESS:
+        fail(
+            f"resumed run outcome {resumed.outcome().value}: "
+            f"{resumed.error}"
+        )
+    jr = resumed.result["journal"]
+    res_ck = jr["sim"].get("checkpoint") or {}
+    if (res_ck.get("resumed") or {}).get("from_run") != cut.id:
+        fail(f"resumed journal lacks provenance: {res_ck}")
+    for key in (
+        "ticks",
+        "msgs_delivered",
+        "msgs_sent",
+        "msgs_enqueued",
+        "msgs_dropped",
+        "msgs_rejected",
+        "msgs_in_flight",
+        "msgs_fault_dropped",
+        "faults_crashed",
+        "faults_restarted",
+    ):
+        if jr["sim"].get(key) != jf["sim"].get(key):
+            fail(
+                f"resumed vs uninterrupted journal sim.{key}: "
+                f"{jr['sim'].get(key)} != {jf['sim'].get(key)}"
+            )
+    slo_f = (jf.get("slo") or {}).get("breaches")
+    slo_r = (jr.get("slo") or {}).get("breaches")
+    if slo_f != slo_r:
+        fail(f"SLO breach totals diverged: resumed {slo_r} != full {slo_f}")
+    tr_f = (jf.get("trace") or {}).get("events")
+    tr_r = (jr.get("trace") or {}).get("events")
+    if tr_f != tr_r:
+        fail(f"flight-recorder event counts diverged: {tr_r} != {tr_f}")
+    for name in ("sim_timeseries.jsonl", "sim_slo.jsonl"):
+        rows_f = _rows(env, full.id, name)
+        rows_r = _rows(env, resumed.id, name)
+        if rows_f != rows_r:
+            fail(
+                f"{name} streams diverged between the resumed and the "
+                f"uninterrupted run ({len(rows_r or [])} vs "
+                f"{len(rows_f or [])} rows)"
+            )
+
+    # ---- surfaces: stats table + Prometheus gauges
+    table = render_telemetry_summary(resumed.stats_payload())
+    if "checkpoint" not in table or f"of run {cut.id}" not in table:
+        fail(f"tg stats table has no checkpoint/resume line:\n{table}")
+    text = render_prometheus([full], per_task_limit=10)
+    for gauge in ("tg_checkpoint_count{", "tg_checkpoint_last_tick{"):
+        if gauge not in text:
+            fail(f"{gauge} missing from the Prometheus exposition")
+
+    # ---- corrupted snapshot refused loudly, typed
+    if refused.outcome() != Outcome.FAILURE:
+        fail(
+            "resume from a truncated snapshot must FAIL, got "
+            f"{refused.outcome().value}"
+        )
+    if "refusing to resume" not in (refused.error or ""):
+        fail(
+            f"refusal error is not the typed CheckpointError message: "
+            f"{refused.error!r}"
+        )
+
+    print(
+        "checkpoint-smoke: OK — {n} snapshot(s) (keep=2 enforced), cut at "
+        "tick 32 mid-schedule, resumed run == uninterrupted run "
+        "(journal + telemetry + SLO streams, {t} ticks), provenance + "
+        "tg_checkpoint_* exported, truncated snapshot refused "
+        "loudly".format(n=ck["count"], t=jr["sim"]["ticks"])
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
