@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
 	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke \
-	phases-smoke checkpoint-smoke
+	phases-smoke checkpoint-smoke crosshost-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -96,6 +96,16 @@ phases-smoke:
 # snapshot refuses loudly with the typed CheckpointError
 checkpoint-smoke:
 	$(PY) tools/checkpoint_smoke.py
+
+# cross-host control-plane contract check (docs/CROSSHOST.md): a
+# two-"host" ping-pong with instances split across engine-less process
+# groups joining the sync service purely by address (both backends, with
+# a mid-run partition/reconnect round), then the 3-"host" chaos cohort —
+# member-death (occupancy evicted, survivors complete), sync-partition-
+# and-heal (barrier re-armed, subscription resumed), leader-death (one-
+# line clean member exit, no LOG(FATAL)) — journaled per event; < 60 s
+crosshost-smoke:
+	$(PY) tools/crosshost_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -1478,6 +1478,66 @@ def daemon_cmd(args) -> int:
     return serve(listen=args.listen)
 
 
+def register_sync_service(sub) -> None:
+    p = sub.add_parser(
+        "sync-service",
+        help="run a standalone network-reachable sync service (the "
+        "shared coordination plane of a cross-host local:exec run — "
+        "docs/CROSSHOST.md); prints 'LISTENING <host> <port>' once "
+        "bound and serves until SIGTERM",
+    )
+    p.add_argument(
+        "--host",
+        default="127.0.0.1",
+        help="bind address (0.0.0.0 serves other hosts; default loopback)",
+    )
+    p.add_argument(
+        "--port", type=int, default=0, help="bind port (0 = ephemeral)"
+    )
+    p.add_argument(
+        "--backend",
+        choices=("auto", "python", "native"),
+        default="auto",
+        help="native C++ event-loop server when a toolchain exists "
+        "(auto), or force one implementation",
+    )
+    p.add_argument(
+        "--idle-timeout",
+        type=float,
+        default=30.0,
+        help="evict connections silent for this many seconds "
+        "(heartbeating clients are never idle; 0 disables)",
+    )
+    p.add_argument(
+        "--evict-grace",
+        type=float,
+        default=2.0,
+        help="window an abnormally-disconnected instance has to "
+        "reconnect before its eviction event is published",
+    )
+    p.set_defaults(func=sync_service_cmd)
+
+
+def sync_service_cmd(args) -> int:
+    from testground_tpu.sync.boot import boot_sync_service
+    from testground_tpu.sync.server import serve_until_signal
+
+    try:
+        svc = boot_sync_service(
+            mode=args.backend,
+            host=args.host,
+            port=args.port,
+            idle_timeout=args.idle_timeout,
+            evict_grace=args.evict_grace,
+            bin_dir=os.path.join(EnvConfig.load().dirs.work(), "bin"),
+            log=lambda msg: print(msg, file=sys.stderr),
+        )
+    except Exception as e:  # noqa: BLE001 — boot failures exit readably
+        print(f"sync-service: {e}", file=sys.stderr)
+        return 1
+    return serve_until_signal(svc)
+
+
 def register_sim_worker(sub) -> None:
     p = sub.add_parser(
         "sim-worker",
@@ -1501,6 +1561,19 @@ def register_sim_worker(sub) -> None:
     p.add_argument(
         "--once", action="store_true", help="exit after one job (tests)"
     )
+    p.add_argument(
+        "--connect-attempts",
+        type=int,
+        default=3,
+        help="bounded retries joining the coordinator (a worker "
+        "commonly races the leader's startup across hosts)",
+    )
+    p.add_argument(
+        "--connect-timeout",
+        type=float,
+        default=60.0,
+        help="per-attempt coordinator join timeout in seconds",
+    )
     p.set_defaults(func=sim_worker_cmd)
 
 
@@ -1517,6 +1590,8 @@ def sim_worker_cmd(args) -> int:
         args.process_id,
         plans_dir,
         once=args.once,
+        connect_attempts=args.connect_attempts,
+        connect_timeout_secs=args.connect_timeout,
     )
 
 

@@ -153,6 +153,13 @@ class EnvConfig:
         sch.task_repo_type = sch.task_repo_type or DEFAULT_TASK_REPO_TYPE
         sch.task_timeout_min = sch.task_timeout_min or DEFAULT_TASK_TIMEOUT_MIN
 
+    def runner_config(self, runner_id: str) -> dict:
+        """The raw .env.toml config map for a runner (``{}`` when absent)
+        — the layer healthchecks read to probe the CONFIGURED
+        environment (e.g. the sync bind host) rather than defaults."""
+        cfg = self.runners.get(runner_id, {})
+        return dict(cfg) if isinstance(cfg, dict) else {}
+
     def runner_is_disabled(self, runner_id: str) -> bool:
         """Whether .env.toml marks the runner disabled
         (``pkg/engine/supervisor.go:568-571`` semantics)."""

@@ -25,6 +25,7 @@ __all__ = [
     "check_file_exists",
     "check_executable_on_path",
     "check_port_bindable",
+    "check_sync_service",
     "not_",
 ]
 
@@ -97,6 +98,43 @@ def check_dialable(host: str, port: int, timeout: float = 2.0) -> Checker:
                 return True, f"{host}:{port} is dialable"
         except OSError as e:
             return False, f"{host}:{port} not dialable: {e}"
+
+    return check
+
+
+def check_sync_service(host: str, port: int, timeout: float = 2.0) -> Checker:
+    """A (possibly remote) sync service answers a real ``ping`` RPC at
+    ``host:port`` — connect-level reachability alone can lie (a stopped
+    or wedged server still completes TCP handshakes via the listen
+    backlog). Used by ``tg healthcheck`` when the local:exec runner is
+    configured with an external ``sync_service_address``
+    (docs/CROSSHOST.md)."""
+    import json
+
+    def check() -> tuple[bool, str]:
+        try:
+            with socket.create_connection((host, port), timeout=timeout) as s:
+                s.settimeout(timeout)
+                s.sendall(b'{"id": 1, "op": "ping"}\n')
+                buf = b""
+                while not buf.endswith(b"\n"):
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+            msg = json.loads(buf or b"{}")
+            if msg.get("pong"):
+                boot = msg.get("boot", "")
+                return True, (
+                    f"sync service at {host}:{port} answered ping"
+                    + (f" (boot {boot[:12]})" if boot else "")
+                )
+            return False, (
+                f"{host}:{port} spoke, but not the sync protocol: "
+                f"{buf[:80]!r}"
+            )
+        except (OSError, ValueError) as e:
+            return False, f"sync service at {host}:{port} unreachable: {e}"
 
     return check
 

@@ -9,9 +9,15 @@
 //   reply:    {"id": N, ...result}\n             exactly one, except
 //   subscribe streams {"id": N, "entry": <raw>, "seq": i} frames.
 //
-// Ops: signal_entry(state), counter(state), barrier(state, target[,
-// timeout]), signal_and_wait(state, target[, timeout]),
-// publish(topic, payload), subscribe(topic).
+// Ops: signal_entry(state[, token]), counter(state), barrier(state,
+// target[, timeout]), signal_and_wait(state, target[, timeout][,
+// token]), publish(topic, payload[, token]), subscribe(topic), plus the
+// liveness/identity plane (docs/CROSSHOST.md, spec'd by server.py):
+// ping (pong + boot id), hello (instance identity; abnormal disconnect
+// publishes an eviction event to its events_topic), bye (clean close),
+// sync_stats (conns/waiters/subs occupancy). `token` is an idempotency
+// key: re-sent mutations from a reconnecting client answer with the
+// original seq instead of mutating twice.
 //
 // Design notes:
 // - publish payloads are NEVER parsed: the raw JSON value text is stored
@@ -21,7 +27,13 @@
 //   parked records flushed when counters/topics advance — the C++ twin
 //   of the Python server's per-request threads without the threads;
 // - stdout handshake: "LISTENING <port>" once bound (the runner reads
-//   this to learn an ephemeral port).
+//   this to learn an ephemeral port);
+// - --host picks the bind address (default loopback; 0.0.0.0 makes the
+//   service a network citizen other hosts can dial — the
+//   cluster_k8s.go:302 analog); --idle-timeout S evicts connections
+//   that sent nothing (not even a heartbeat ping) for S seconds, so a
+//   SIGSTOPped or half-open peer releases its parked waiters instead of
+//   leaking occupancy forever.
 //
 // Build: g++ -O2 -std=c++17 -o tg-syncsvc syncsvc.cc
 // (testground_tpu/native/syncsvc.py wraps build + spawn + lifecycle).
@@ -32,6 +44,7 @@
 #include <cstdio>
 #include <cstring>
 #include <ctime>
+#include <deque>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -212,6 +225,12 @@ struct Conn {
   int fd;
   std::string rbuf;
   std::string wbuf;  // unsent reply bytes; drained on POLLOUT
+  double last_active = 0.0;  // last byte read (idle-sweep clock)
+  bool hello = false;        // identity registered
+  bool clean = false;        // said bye — no eviction event
+  std::string events_topic;
+  std::string group;
+  long instance = -1;
 };
 
 // A reply backlog beyond this marks the client dead (it stopped reading);
@@ -242,6 +261,44 @@ std::unordered_map<int, Conn> conns;
 std::unordered_map<std::string, long> counters;
 std::vector<Waiter> waiters;
 std::unordered_map<std::string, Topic> topics;
+// idempotency tokens (key: state/topic + '\x1f' + token → original seq),
+// FIFO-bounded: only a reconnecting client's unacked window (seconds of
+// traffic) ever needs a token, so capping at kMaxTokens bounds memory
+// over week-long runs without risking a realistic double-apply.
+constexpr size_t kMaxTokens = 65536;
+struct TokenMap {
+  std::unordered_map<std::string, long> map;
+  std::deque<std::string> order;
+  long* find(const std::string& key) {
+    auto it = map.find(key);
+    return it == map.end() ? nullptr : &it->second;
+  }
+  void put(const std::string& key, long seq) {
+    if (map.emplace(key, seq).second) {
+      order.push_back(key);
+      while (order.size() > kMaxTokens) {
+        map.erase(order.front());
+        order.pop_front();
+      }
+    }
+  }
+};
+TokenMap sig_tokens;
+TokenMap pub_tokens;
+std::string boot_id;       // changes every server start (restart detector)
+double idle_timeout = 0.0;  // seconds; 0 = sweep disabled
+double evict_grace = 2.0;   // reconnect window before eviction publishes
+
+// live connection count per hello'd identity, plus evictions waiting out
+// their grace window (canceled when the identity reconnects in time)
+std::unordered_map<std::string, int> live_ids;
+struct PendingEvict {
+  std::string key;
+  double due;
+  std::string topic;
+  std::string payload;
+};
+std::vector<PendingEvict> pending_evictions;
 
 std::vector<int> dead_conns;  // drop after the current dispatch completes
 
@@ -316,6 +373,30 @@ void flush_subs(const std::string& topic_name) {
 
 void expire_waiters();  // defined below; used for zero-timeout barriers
 
+// Signal with optional idempotency token: a re-sent request (reconnect
+// replay) answers with the original seq instead of double-counting.
+long signal_with_token(const std::string& state, const std::string& token) {
+  if (!token.empty()) {
+    std::string key = state + '\x1f' + token;
+    if (long* prev = sig_tokens.find(key)) return *prev;
+    long seq = ++counters[state];
+    sig_tokens.put(key, seq);
+    return seq;
+  }
+  return ++counters[state];
+}
+
+// Append a server-generated entry (eviction events) to a topic.
+void publish_entry(const std::string& topic, const std::string& payload) {
+  topics[topic].entries.push_back(payload);
+  flush_subs(topic);
+}
+
+std::string ident_key(const Conn& c) {
+  return c.events_topic + '\x1f' + c.group + '\x1f' +
+         std::to_string(c.instance);
+}
+
 void handle_line(int fd, const std::string& line) {
   long id = field_long(line, "id", -1);
   std::string op = json_unescape(find_field(line, "op"));
@@ -326,10 +407,43 @@ void handle_line(int fd, const std::string& line) {
   char buf[160];
   if (op == "signal_entry") {
     std::string state = json_unescape(find_field(line, "state"));
-    long seq = ++counters[state];
+    std::string token = json_unescape(find_field(line, "token"));
+    long seq = signal_with_token(state, token);
     snprintf(buf, sizeof buf, "{\"id\": %ld, \"seq\": %ld}", id, seq);
     send_line(fd, buf);
     flush_waiters(state);
+  } else if (op == "ping") {
+    send_line(fd, "{\"id\": " + std::to_string(id) +
+                      ", \"pong\": true, \"boot\": \"" + boot_id + "\"}");
+  } else if (op == "hello") {
+    auto it = conns.find(fd);
+    if (it != conns.end()) {
+      Conn& c = it->second;
+      if (c.hello) {  // re-hello on the same conn: retag the identity
+        auto lit = live_ids.find(ident_key(c));
+        if (lit != live_ids.end() && --lit->second <= 0) live_ids.erase(lit);
+      }
+      c.hello = true;
+      c.events_topic = json_unescape(find_field(line, "events_topic"));
+      c.group = json_unescape(find_field(line, "group"));
+      c.instance = field_long(line, "instance", -1);
+      live_ids[ident_key(c)]++;
+    }
+    send_line(fd, "{\"id\": " + std::to_string(id) +
+                      ", \"ok\": true, \"boot\": \"" + boot_id + "\"}");
+  } else if (op == "bye") {
+    auto it = conns.find(fd);
+    if (it != conns.end()) it->second.clean = true;
+    snprintf(buf, sizeof buf, "{\"id\": %ld, \"ok\": true}", id);
+    send_line(fd, buf);
+  } else if (op == "sync_stats") {
+    size_t nsubs = 0;
+    for (const auto& kv : topics) nsubs += kv.second.subs.size();
+    snprintf(buf, sizeof buf,
+             "{\"id\": %ld, \"conns\": %zu, \"waiters\": %zu, \"subs\": %zu, "
+             "\"boot\": \"%s\"}",
+             id, conns.size(), waiters.size(), nsubs, boot_id.c_str());
+    send_line(fd, buf);
   } else if (op == "counter") {
     std::string state = json_unescape(find_field(line, "state"));
     snprintf(buf, sizeof buf, "{\"id\": %ld, \"count\": %ld}", id,
@@ -342,7 +456,8 @@ void handle_line(int fd, const std::string& line) {
     // non-blocking check (the Python spec server's wait_for(timeout=0))
     double timeout = field_double(line, "timeout", -1.0);
     long seq = -1;
-    if (op == "signal_and_wait") seq = ++counters[state];
+    if (op == "signal_and_wait")
+      seq = signal_with_token(state, json_unescape(find_field(line, "token")));
     Waiter w{fd, id, state, target, seq,
              timeout >= 0 ? now_secs() + timeout : 0.0};
     waiters.push_back(w);
@@ -352,10 +467,19 @@ void handle_line(int fd, const std::string& line) {
     std::string topic = json_unescape(find_field(line, "topic"));
     std::string payload = find_field(line, "payload");
     if (payload.empty()) payload = "null";
-    Topic& t = topics[topic];
-    t.entries.push_back(payload);
-    snprintf(buf, sizeof buf, "{\"id\": %ld, \"seq\": %zu}", id,
-             t.entries.size());
+    std::string token = json_unescape(find_field(line, "token"));
+    long seq;
+    long* prev =
+        token.empty() ? nullptr : pub_tokens.find(topic + '\x1f' + token);
+    if (prev) {  // replayed publish
+      seq = *prev;
+    } else {
+      Topic& t = topics[topic];
+      t.entries.push_back(payload);
+      seq = (long)t.entries.size();
+      if (!token.empty()) pub_tokens.put(topic + '\x1f' + token, seq);
+    }
+    snprintf(buf, sizeof buf, "{\"id\": %ld, \"seq\": %ld}", id, seq);
     send_line(fd, buf);
     flush_subs(topic);
   } else if (op == "subscribe") {
@@ -367,7 +491,38 @@ void handle_line(int fd, const std::string& line) {
   }
 }
 
+volatile sig_atomic_t stop_flag = 0;  // set by SIGTERM/SIGINT
+
 void drop_conn(int fd) {
+  // salvage identity before erasing: an abnormal disconnect of a
+  // hello'd instance SCHEDULES an eviction event AFTER its occupancy
+  // (parked waiters, subscriptions) is released — published only if no
+  // connection with the same identity is back within evict_grace (a
+  // client dropping its socket to reconnect is not dead)
+  auto it = conns.find(fd);
+  if (it != conns.end()) {
+    Conn& c = it->second;
+    if (c.hello) {
+      std::string key = ident_key(c);
+      auto lit = live_ids.find(key);
+      int remaining = 0;
+      if (lit != live_ids.end() && --lit->second <= 0) {
+        live_ids.erase(lit);
+      } else if (lit != live_ids.end()) {
+        remaining = lit->second;
+      }
+      if (!c.clean && !c.events_topic.empty() && !stop_flag &&
+          remaining == 0) {
+        pending_evictions.push_back(PendingEvict{
+            key, now_secs() + evict_grace, c.events_topic,
+            std::string("{\"type\": \"evicted\", \"group\": \"") +
+                json_escape(c.group) + "\", \"instance\": " +
+                std::to_string(c.instance) +
+                ", \"error\": \"connection lost (killed, partitioned, or "
+                "idle-evicted)\"}"});
+      }
+    }
+  }
   close(fd);
   conns.erase(fd);
   for (size_t i = 0; i < waiters.size();) {
@@ -391,6 +546,39 @@ void drop_conn(int fd) {
   }
 }
 
+// Publish due evictions whose identity never came back; an identity
+// that reconnected inside its grace window is silently canceled.
+void flush_evictions() {
+  if (pending_evictions.empty()) return;
+  double now = now_secs();
+  for (size_t i = 0; i < pending_evictions.size();) {
+    PendingEvict& pe = pending_evictions[i];
+    if (live_ids.count(pe.key)) {  // came back — cancel
+      pe = pending_evictions.back();
+      pending_evictions.pop_back();
+    } else if (now >= pe.due) {
+      publish_entry(pe.topic, pe.payload);
+      pending_evictions[i] = pending_evictions.back();
+      pending_evictions.pop_back();
+    } else {
+      i++;
+    }
+  }
+}
+
+// Mark connections silent past the idle window dead: a heartbeating
+// client is never idle, so only dead/partitioned peers (whose kernel
+// may keep the socket ESTABLISHED forever) trip this. Deferred via
+// dead_conns — dropping mid-cycle would let accept() reuse an fd that
+// stale pfds entries still reference.
+void sweep_idle() {
+  if (idle_timeout <= 0) return;
+  double now = now_secs();
+  for (const auto& kv : conns)
+    if (now - kv.second.last_active > idle_timeout)
+      dead_conns.push_back(kv.first);
+}
+
 void expire_waiters() {
   double now = now_secs();
   for (size_t i = 0; i < waiters.size();) {
@@ -405,15 +593,31 @@ void expire_waiters() {
   }
 }
 
-volatile sig_atomic_t stop_flag = 0;
+// declared above drop_conn; shutdown disconnects are not evictions
 void on_term(int) { stop_flag = 1; }
 
 }  // namespace
 
 int main(int argc, char** argv) {
   int port = 0;
-  for (int i = 1; i + 1 < argc; i += 2)
+  const char* host = "127.0.0.1";
+  for (int i = 1; i + 1 < argc; i += 2) {
     if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
+    if (strcmp(argv[i], "--host") == 0) host = argv[i + 1];
+    if (strcmp(argv[i], "--idle-timeout") == 0)
+      idle_timeout = atof(argv[i + 1]);
+    if (strcmp(argv[i], "--evict-grace") == 0)
+      evict_grace = atof(argv[i + 1]);
+  }
+
+  {  // boot id: distinguishes restarts for reconnecting clients
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    char buf[48];
+    snprintf(buf, sizeof buf, "%lx-%lx-%x", (unsigned long)ts.tv_sec,
+             (unsigned long)ts.tv_nsec, (unsigned)getpid());
+    boot_id = buf;
+  }
 
   signal(SIGTERM, on_term);
   signal(SIGINT, on_term);
@@ -424,7 +628,12 @@ int main(int argc, char** argv) {
   setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (strcmp(host, "localhost") == 0) host = "127.0.0.1";
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    fprintf(stderr, "tg-syncsvc: bad --host %s (want an IPv4 address)\n",
+            host);
+    return 1;
+  }
   addr.sin_port = htons((uint16_t)port);
   if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0 || listen(lfd, 512) != 0) {
     perror("tg-syncsvc: bind/listen");
@@ -445,7 +654,8 @@ int main(int argc, char** argv) {
           {kv.first,
            (short)(POLLIN | (kv.second.wbuf.empty() ? 0 : POLLOUT)), 0});
 
-    // poll timeout tracks the nearest barrier deadline
+    // poll timeout tracks the nearest barrier deadline (and the idle
+    // sweep cadence when eviction is enabled)
     int tmo = -1;
     double now = now_secs();
     for (const Waiter& w : waiters)
@@ -454,12 +664,19 @@ int main(int argc, char** argv) {
         if (ms < 0) ms = 0;
         if (tmo < 0 || ms < tmo) tmo = ms;
       }
+    if (idle_timeout > 0) {
+      int sweep_ms = (int)(idle_timeout * 250);  // idle_timeout / 4
+      if (sweep_ms < 100) sweep_ms = 100;
+      if (tmo < 0 || sweep_ms < tmo) tmo = sweep_ms;
+    }
+    if (!pending_evictions.empty() && (tmo < 0 || tmo > 100)) tmo = 100;
     int rc = poll(pfds.data(), pfds.size(), tmo);
     if (rc < 0) {
       if (errno == EINTR) continue;
       break;
     }
     expire_waiters();
+    flush_evictions();
     for (const pollfd& p : pfds) {
       if (p.fd != lfd && (p.revents & POLLOUT)) {
         auto it = conns.find(p.fd);
@@ -470,7 +687,10 @@ int main(int argc, char** argv) {
         int cfd = accept(lfd, nullptr, nullptr);
         if (cfd >= 0) {
           setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-          conns[cfd] = Conn{cfd, std::string()};
+          Conn c;
+          c.fd = cfd;
+          c.last_active = now_secs();
+          conns[cfd] = std::move(c);
         }
         continue;
       }
@@ -481,6 +701,7 @@ int main(int argc, char** argv) {
         drop_conn(p.fd);
         continue;
       }
+      it->second.last_active = now_secs();
       it->second.rbuf.append(rbuf, (size_t)n);
       std::string& b = it->second.rbuf;
       size_t start = 0, nl;
@@ -492,7 +713,10 @@ int main(int argc, char** argv) {
       }
       if (conns.find(p.fd) != conns.end()) b.erase(0, start);
     }
-    // reap connections whose peer vanished or stopped reading
+    // reap connections whose peer vanished, stopped reading, or idled
+    // out — the ONE place conns are dropped, after dispatch, so no
+    // stale pfds entry can touch a reused fd this cycle
+    sweep_idle();
     for (int fd : dead_conns)
       if (conns.count(fd)) drop_conn(fd);
     dead_conns.clear();

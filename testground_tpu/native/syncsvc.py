@@ -55,11 +55,33 @@ def build_syncsvc(bin_dir: str) -> str:
 
 class NativeSyncService:
     """Drop-in lifecycle twin of ``SyncServiceServer``: ``.address`` and
-    ``.stop()``; the server is a child process."""
+    ``.stop()``; the server is a child process.
 
-    def __init__(self, bin_path: str):
+    ``host`` is the bind address (default loopback; ``0.0.0.0`` serves
+    other hosts); ``idle_timeout`` (seconds, 0 = off) evicts silent
+    connections server-side (docs/CROSSHOST.md)."""
+
+    def __init__(
+        self,
+        bin_path: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        idle_timeout: float = 0.0,
+        evict_grace: float = 2.0,
+    ):
+        argv = [
+            bin_path,
+            "--port",
+            str(int(port)),
+            "--host",
+            host,
+            "--evict-grace",
+            str(float(evict_grace)),
+        ]
+        if idle_timeout > 0:
+            argv += ["--idle-timeout", str(float(idle_timeout))]
         self._proc = subprocess.Popen(
-            [bin_path, "--port", "0"],
+            argv,
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
             text=True,
@@ -70,7 +92,11 @@ class NativeSyncService:
             raise RuntimeError(
                 f"native sync service failed to start (got {line!r})"
             )
-        self.address = ("127.0.0.1", int(line.split()[1]))
+        self.address = (host, int(line.split()[1]))
+
+    @property
+    def pid(self) -> int:
+        return self._proc.pid
 
     def start(self) -> "NativeSyncService":
         return self  # already serving (constructor handshake)

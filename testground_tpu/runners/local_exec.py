@@ -22,7 +22,7 @@ from testground_tpu.api import RunInput, RunOutput
 from testground_tpu.engine.task import Outcome
 from testground_tpu.rpc import OutputWriter
 from testground_tpu.sdk.runparams import RunParams
-from testground_tpu.sync import RUN_EVENTS_TOPIC, SyncServiceServer
+from testground_tpu.sync import RUN_EVENTS_TOPIC
 
 from .base import HealthcheckedRunner, Runner, Terminatable
 from .outputs import instance_output_dir
@@ -35,6 +35,24 @@ DEFAULT_SUBNET = "127.1.0.0/16"  # local_exec.go:32
 OUTCOME_COLLECTION_TIMEOUT = 45.0  # local_docker.go:94
 START_CONCURRENCY = 16  # local_docker.go:512
 
+# terminal lifecycle event types an instance publishes itself; a
+# server-side "evicted" event never overrides one of these
+_TERMINAL_EVENTS = ("success", "failure", "crash")
+
+
+class _ExternalSyncService:
+    """Address-only handle on a sync service another host (or a
+    standalone ``tg sync-service``) owns; lifecycle is not ours."""
+
+    def __init__(self, address: tuple[str, int]):
+        self.address = address
+
+    def start(self):
+        return self
+
+    def stop(self) -> None:  # the owner stops it
+        pass
+
 
 @dataclass
 class LocalExecConfig:
@@ -46,6 +64,31 @@ class LocalExecConfig:
     # (testground_tpu/native/syncsvc.cc, built on demand), "python" = the
     # in-process server, "auto" = native when a toolchain is available
     sync_service: str = "auto"
+    # --- cross-host sync plane (docs/CROSSHOST.md) -----------------------
+    # bind address for the per-run sync service; the loopback default
+    # keeps single-host runs private, "0.0.0.0" makes the service a
+    # network citizen other hosts can join (cluster_k8s.go:302 analog)
+    sync_bind_host: str = "127.0.0.1"
+    # what instances (possibly on other hosts) should DIAL; empty =
+    # derived from the bind host (a wildcard bind advertises this
+    # machine's primary interface)
+    sync_advertise_host: str = ""
+    # "host:port" of an EXTERNAL sync service (e.g. `tg sync-service` on
+    # another host); when set this runner starts no server of its own —
+    # the run joins the shared coordination plane by address
+    sync_service_address: str = ""
+    # client failure budget injected into instances via RunParams
+    sync_connect_timeout_secs: float = 30.0
+    sync_retry_attempts: int = 8
+    sync_retry_deadline_secs: float = 60.0
+    sync_heartbeat_secs: float = 5.0
+    # server-side liveness: evict connections silent for this long (a
+    # heartbeating client is never idle), releasing their barrier/
+    # subscribe occupancy; 0 disables the sweep
+    sync_idle_timeout_secs: float = 30.0
+    # window an abnormally-disconnected instance has to reconnect before
+    # its eviction event is published (reconnects are not deaths)
+    sync_evict_grace_secs: float = 2.0
 
 
 class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
@@ -84,11 +127,43 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
                 checkers.check_dir_writable(d),
                 fixers.create_directory(d),
             )
+        # probe the CONFIGURED sync bind host, not a hardcoded loopback:
+        # a runner configured to serve other hosts must learn at
+        # healthcheck time (not mid-run) that its interface can't bind
+        rcfg = env.runner_config("local:exec")
+        bind_host = str(rcfg.get("sync_bind_host", "") or "127.0.0.1")
         h.enlist(
             "sync-service-port-bindable",
-            checkers.check_port_bindable("127.0.0.1"),
-            fixers.requires_manual_fixing("free local TCP ports / ulimit"),
+            checkers.check_port_bindable(bind_host),
+            fixers.requires_manual_fixing(
+                f"free TCP ports / ulimit on {bind_host}, or fix the "
+                "runner's sync_bind_host"
+            ),
         )
+        # a configured EXTERNAL sync service must answer a real ping RPC
+        remote = str(rcfg.get("sync_service_address", "") or "")
+        if remote:
+            from testground_tpu.sync import parse_hostport
+
+            try:
+                rhost, rport = parse_hostport(remote)
+            except ValueError as e:
+                h.enlist(
+                    "sync-service-reachable",
+                    lambda e=e: (False, str(e)),
+                    fixers.requires_manual_fixing(
+                        "fix the runner's sync_service_address"
+                    ),
+                )
+            else:
+                h.enlist(
+                    "sync-service-reachable",
+                    checkers.check_sync_service(rhost, rport),
+                    fixers.requires_manual_fixing(
+                        "start `tg sync-service` on the sync host / open "
+                        "the firewall between the hosts"
+                    ),
+                )
         h.enlist(
             "python-interpreter-runs",
             checkers.check_command_status(sys.executable, "-c", "pass"),
@@ -99,40 +174,42 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
     # ------------------------------------------------------------------ run
 
     def _start_sync_service(self, cfg, job, ow: OutputWriter):
-        """Boot the per-run sync service: the native C++ server when the
-        config allows and a toolchain exists, else the Python one (both
-        expose .address/.stop and speak the same wire protocol)."""
-        mode = getattr(cfg, "sync_service", "auto")
-        if mode not in ("auto", "python", "native"):
-            raise ValueError(f"unknown sync_service mode {mode!r}")
-        if mode in ("auto", "native"):
-            from testground_tpu.native import (
-                NativeSyncService,
-                build_syncsvc,
-                native_available,
-            )
+        """Boot (or join) the per-run sync service.
 
-            if native_available():
-                try:
-                    path = build_syncsvc(
-                        os.path.join(job.env.dirs.work(), "bin")
-                    )
-                    svc = NativeSyncService(path)
-                    ow.infof("sync service: native (%s)", path)
-                    return svc
-                except Exception as e:  # noqa: BLE001 — auto falls back
-                    if mode == "native":
-                        raise
-                    ow.warn(
-                        "native sync service unavailable (%s); "
-                        "falling back to python",
-                        e,
-                    )
-            elif mode == "native":
+        With ``sync_service_address`` set, the run joins an EXTERNAL
+        service by address (the shared coordination plane of a
+        cross-host run — docs/CROSSHOST.md) after verifying it answers a
+        ping RPC. Otherwise boot the native C++ server when the config
+        allows and a toolchain exists, else the Python one (both expose
+        .address/.stop and speak the same wire protocol), bound to the
+        configured ``sync_bind_host``."""
+        remote = getattr(cfg, "sync_service_address", "") or ""
+        if remote:
+            from testground_tpu.healthcheck.checkers import check_sync_service
+            from testground_tpu.sync import parse_hostport
+
+            rhost, rport = parse_hostport(remote)
+            ok, msg = check_sync_service(rhost, rport)()
+            if not ok:
                 raise RuntimeError(
-                    "sync_service='native' but no C++ toolchain (g++) found"
+                    f"configured external sync service is not usable: {msg}"
                 )
-        return SyncServiceServer().start()
+            ow.infof("sync service: external at %s:%d", rhost, rport)
+            return _ExternalSyncService((rhost, rport))
+
+        from testground_tpu.sync.boot import boot_sync_service
+
+        return boot_sync_service(
+            mode=getattr(cfg, "sync_service", "auto"),
+            host=getattr(cfg, "sync_bind_host", "") or "127.0.0.1",
+            port=0,
+            idle_timeout=float(
+                getattr(cfg, "sync_idle_timeout_secs", 30.0) or 0.0
+            ),
+            evict_grace=float(getattr(cfg, "sync_evict_grace_secs", 2.0)),
+            bin_dir=os.path.join(job.env.dirs.work(), "bin"),
+            log=lambda msg: ow.infof("%s", msg),
+        )
 
     @staticmethod
     def _dep_targets(artifact_path: str, ow: OutputWriter) -> list[str]:
@@ -183,7 +260,14 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
         pretty = PrettyPrinter(ow)
 
         sync_server = self._start_sync_service(cfg, job, ow)
-        host, port = sync_server.address
+        bind_host, port = sync_server.address
+        # instances (possibly on another machine) dial the ADVERTISED
+        # host: a wildcard bind must not hand them "0.0.0.0"
+        from testground_tpu.sync import advertise_host
+
+        host = advertise_host(
+            bind_host, getattr(cfg, "sync_advertise_host", "") or ""
+        )
 
         # runner-side outcome collection: subscribe to the run's lifecycle
         # events before instances start (local_docker.go:217-256). The
@@ -202,6 +286,15 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
                 for evt in collector_client.subscribe(topic):
                     with outcomes_lock:
                         key = (evt.get("group", ""), int(evt.get("instance", -1)))
+                        # a server-side eviction (killed / partitioned
+                        # instance) fills the slot so survivors and the
+                        # runner stop waiting — but never rewrites a
+                        # terminal event the instance published itself
+                        if (
+                            evt.get("type") == "evicted"
+                            and outcomes.get(key) in _TERMINAL_EVENTS
+                        ):
+                            continue
                         outcomes[key] = evt.get("type", "")
                         if len(outcomes) >= expected:
                             all_outcomes_in.set()
@@ -260,6 +353,18 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
                         test_group_seq=i,
                         sync_service_host=host,
                         sync_service_port=port,
+                        sync_connect_timeout=float(
+                            getattr(cfg, "sync_connect_timeout_secs", 30.0)
+                        ),
+                        sync_retry_attempts=int(
+                            getattr(cfg, "sync_retry_attempts", 8)
+                        ),
+                        sync_retry_deadline=float(
+                            getattr(cfg, "sync_retry_deadline_secs", 60.0)
+                        ),
+                        sync_heartbeat=float(
+                            getattr(cfg, "sync_heartbeat_secs", 5.0)
+                        ),
                     )
                     env = {**os.environ, **params.to_env()}
                     # Instances are plain CPU processes; drop accelerator

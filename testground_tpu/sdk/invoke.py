@@ -27,14 +27,30 @@ class InitContext:
 
 
 def _connect_sync(env: RunEnv):
-    from testground_tpu.sync.client import SyncClient
+    from testground_tpu.sync import RUN_EVENTS_TOPIC
+    from testground_tpu.sync.client import SyncClient, SyncRetry
 
-    if env.params.sync_service_port == 0:
+    p = env.params
+    if p.sync_service_port == 0:
         return None
     return SyncClient(
-        env.params.sync_service_host,
-        env.params.sync_service_port,
-        namespace=f"run:{env.params.test_run}:",
+        p.sync_service_host,
+        p.sync_service_port,
+        namespace=f"run:{p.test_run}:",
+        # failure budget from the runner config (docs/CROSSHOST.md)
+        retry=SyncRetry(
+            connect_timeout=p.sync_connect_timeout,
+            attempts=p.sync_retry_attempts,
+            deadline_secs=p.sync_retry_deadline,
+            heartbeat_secs=p.sync_heartbeat,
+        ),
+        # identity for server-side eviction events: if this process dies
+        # abnormally, the service tells the run's event stream
+        identity={
+            "events_topic": f"run:{p.test_run}:{RUN_EVENTS_TOPIC}",
+            "group": p.test_group_id,
+            "instance": p.test_instance_seq,
+        },
     )
 
 
@@ -55,9 +71,26 @@ def invoke_map(testcases: dict[str, Callable]) -> None:
         print(f"unknown test case: {case}", file=sys.stderr)
         sys.exit(2)
 
-    sync_client = _connect_sync(env)
+    try:
+        sync_client = _connect_sync(env)
+    except Exception as e:  # noqa: BLE001 — SyncLostError et al.
+        # the coordination plane is unreachable within the configured
+        # budget: crash readably (address is in the message) — never hang
+        env.record_crash(e)
+        print(f"sync service unreachable: {e}", file=sys.stderr)
+        env.close()
+        sys.exit(1)
     if sync_client is not None:
         env.attach_sync_client(sync_client)
+
+    def _close_sync() -> None:
+        # clean close (sync `bye`): the server must not publish an
+        # eviction event for a normally-exiting instance
+        if sync_client is not None:
+            try:
+                sync_client.close()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
     net_client = NetworkClient(sync_client, env)
     init_ctx = InitContext(sync_client, net_client)
 
@@ -109,18 +142,22 @@ def invoke_map(testcases: dict[str, Callable]) -> None:
         finally:
             _stop_profile()
     except SystemExit:
+        _close_sync()
         raise
     except BaseException as e:  # noqa: BLE001 — crash semantics
         env.record_crash(e)
         print(traceback.format_exc(), file=sys.stderr)
+        _close_sync()
         env.close()
         sys.exit(1)
 
     if err:
         env.record_failure(str(err))
+        _close_sync()
         env.close()
         sys.exit(1)
 
     env.record_success()
+    _close_sync()
     env.close()
     sys.exit(0)

@@ -53,6 +53,14 @@ class RunParams:
     test_group_seq: int = 0  # 0-based index within the group
     sync_service_host: str = "127.0.0.1"
     sync_service_port: int = 0
+    # sync-client failure budget (docs/CROSSHOST.md), threaded from the
+    # runner config: per-attempt connect timeout (was a hardcoded 30 s),
+    # per-outage reconnect attempts/deadline, and the heartbeat cadence
+    # that feeds the server's idle sweep
+    sync_connect_timeout: float = 30.0
+    sync_retry_attempts: int = 8
+    sync_retry_deadline: float = 60.0
+    sync_heartbeat: float = 5.0
 
     def to_env(self) -> dict[str, str]:
         return {
@@ -74,6 +82,10 @@ class RunParams:
             "TEST_GROUP_SEQ": str(self.test_group_seq),
             "SYNC_SERVICE_HOST": self.sync_service_host,
             "SYNC_SERVICE_PORT": str(self.sync_service_port),
+            "SYNC_CONNECT_TIMEOUT": str(self.sync_connect_timeout),
+            "SYNC_RETRY_ATTEMPTS": str(self.sync_retry_attempts),
+            "SYNC_RETRY_DEADLINE": str(self.sync_retry_deadline),
+            "SYNC_HEARTBEAT": str(self.sync_heartbeat),
         }
 
     @classmethod
@@ -100,4 +112,8 @@ class RunParams:
             test_group_seq=int(e.get("TEST_GROUP_SEQ", "0")),
             sync_service_host=e.get("SYNC_SERVICE_HOST", "127.0.0.1"),
             sync_service_port=int(e.get("SYNC_SERVICE_PORT", "0")),
+            sync_connect_timeout=float(e.get("SYNC_CONNECT_TIMEOUT", "30") or 30),
+            sync_retry_attempts=int(e.get("SYNC_RETRY_ATTEMPTS", "8") or 8),
+            sync_retry_deadline=float(e.get("SYNC_RETRY_DEADLINE", "60") or 60),
+            sync_heartbeat=float(e.get("SYNC_HEARTBEAT", "5") or 5),
         )

@@ -285,7 +285,16 @@ def _is_cohort_fatal(exc: BaseException) -> bool:
     ``ValueError`` that happens to mention "barrier" (plans use
     barriers!) is an ordinary run failure — killing the cohort
     generation for it would force a needless fleet-wide sim-worker
-    restart."""
+    restart.
+
+    A :class:`~testground_tpu.sync.errors.SyncLostError` IS fatal: the
+    host-side coordination plane is gone past its reconnect budget, so
+    barriers/pubsub can never complete for this generation — the sync
+    analog of a dead ``jax.distributed`` member (docs/CROSSHOST.md)."""
+    from testground_tpu.sync.errors import SyncLostError
+
+    if isinstance(exc, SyncLostError):
+        return True
     if not _is_runtime_error(exc):
         return False
     text = f"{type(exc).__name__}: {exc}".lower()

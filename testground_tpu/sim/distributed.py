@@ -52,11 +52,31 @@ _SPEC_BYTES = SPEC_BYTES
 _initialized = False
 
 
+# error-text markers of a coordinator that is not (yet) reachable — the
+# retryable class of initialize() failures (a worker racing the leader's
+# startup, a transient DCN blip); everything else re-raises immediately
+_CONNECT_MARKERS = (
+    "deadline",
+    "unavailable",
+    "connection refused",
+    "failed to connect",
+    "timed out",
+    "timeout",
+    "connection reset",
+)
+
+
+def _is_connect_error(exc: BaseException) -> bool:
+    return any(m in str(exc).lower() for m in _CONNECT_MARKERS)
+
+
 def init_distributed(
     coordinator_address: str,
     num_processes: int,
     process_id: int,
     heartbeat_timeout_seconds: int = 20,
+    connect_attempts: int = 3,
+    connect_timeout_seconds: float = 60.0,
 ) -> None:
     """Join the jax.distributed cohort (idempotent). The coordinator is
     process 0's ``host:port`` — the DCN control endpoint.
@@ -68,11 +88,20 @@ def init_distributed(
     still (the transport notices the closed connection in ~1 s). The
     kwarg only exists on newer jax releases — on older ones the cohort
     joins with the default heartbeat rather than dying on a TypeError
-    (member death is still detected, just slower in the SIGKILL case)."""
+    (member death is still detected, just slower in the SIGKILL case).
+
+    Joining retries: a worker commonly races the leader's startup across
+    hosts, so connect-class failures (refused / deadline / unavailable)
+    are retried up to ``connect_attempts`` times with backoff inside a
+    per-attempt ``connect_timeout_seconds`` budget (threaded to jax's
+    ``initialization_timeout`` where supported) before failing with an
+    error that names the coordinator address — the cross-host twin of
+    the sync client's bounded reconnect (docs/CROSSHOST.md)."""
     global _initialized
     if _initialized:
         return
     import inspect
+    import time
 
     import jax
 
@@ -85,23 +114,44 @@ def init_distributed(
         sig = inspect.signature(jax.distributed.initialize)
         if "heartbeat_timeout_seconds" in sig.parameters:
             kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout_seconds
+        if "initialization_timeout" in sig.parameters:
+            kwargs["initialization_timeout"] = int(connect_timeout_seconds)
     except (TypeError, ValueError):  # unsignaturable shim — be safe
         pass
-    try:
-        jax.distributed.initialize(**kwargs)
-    except RuntimeError as e:
-        if "before" in str(e):
-            # jax's constraint: distributed must precede backend init. A
-            # warm engine (an earlier single-host run touched devices)
-            # cannot join a cohort mid-life.
-            raise RuntimeError(
-                "cannot join a multi-host cohort: this process already "
-                "initialized its jax backend (an earlier run?). Multi-host "
-                "jobs need a fresh engine process whose FIRST sim run "
-                "carries the coordinator_address config."
-            ) from e
-        raise
-    _initialized = True
+    attempts = max(1, int(connect_attempts))
+    last: BaseException | None = None
+    for attempt in range(1, attempts + 1):
+        try:
+            jax.distributed.initialize(**kwargs)
+            _initialized = True
+            return
+        except RuntimeError as e:
+            if "before" in str(e):
+                # jax's constraint: distributed must precede backend
+                # init. A warm engine (an earlier single-host run touched
+                # devices) cannot join a cohort mid-life.
+                raise RuntimeError(
+                    "cannot join a multi-host cohort: this process already "
+                    "initialized its jax backend (an earlier run?). "
+                    "Multi-host jobs need a fresh engine process whose "
+                    "FIRST sim run carries the coordinator_address config."
+                ) from e
+            if not _is_connect_error(e):
+                raise  # not a join problem — keep the original diagnosis
+            if attempt >= attempts:
+                raise RuntimeError(
+                    f"could not join cohort coordinator at "
+                    f"{coordinator_address} after {attempts} attempt(s): {e}"
+                ) from e
+            last = e
+        except Exception as e:  # noqa: BLE001 — jaxlib/grpc error types
+            if not _is_connect_error(e) or attempt >= attempts:
+                raise
+            last = e
+        time.sleep(min(5.0, 0.5 * (2 ** (attempt - 1))))
+    raise RuntimeError(  # unreachable; loop raises on its last attempt
+        f"could not join cohort coordinator at {coordinator_address}: {last}"
+    )
 
 
 def is_multiprocess() -> bool:

@@ -1842,6 +1842,8 @@ def sim_worker_loop(
     plans_dir: str,
     once: bool = False,
     log=print,
+    connect_attempts: int = 3,
+    connect_timeout_secs: float = 60.0,
 ) -> None:
     """Follower half of a multi-host cohort (the ``tg sim-worker`` verb).
 
@@ -1858,7 +1860,16 @@ def sim_worker_loop(
     from testground_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
-    init_distributed(coordinator_address, num_processes, process_id)
+    # a worker routinely starts before the leader across hosts: join
+    # with the bounded-retry budget (readable failure naming the
+    # coordinator, not a 5-minute silent hang)
+    init_distributed(
+        coordinator_address,
+        num_processes,
+        process_id,
+        connect_attempts=connect_attempts,
+        connect_timeout_seconds=connect_timeout_secs,
+    )
     import jax
 
     log(
@@ -1948,6 +1959,8 @@ def run_sim_worker(
     once: bool = False,
     log=print,
     _exit=os._exit,
+    connect_attempts: int = 3,
+    connect_timeout_secs: float = 60.0,
 ) -> int:
     """The ``tg sim-worker`` entry: :func:`sim_worker_loop` wrapped so a
     DEAD LEADER ends the worker with one readable line instead of a
@@ -1970,6 +1983,8 @@ def run_sim_worker(
             plans_dir,
             once=once,
             log=log,
+            connect_attempts=connect_attempts,
+            connect_timeout_secs=connect_timeout_secs,
         )
     except KeyboardInterrupt:
         raise

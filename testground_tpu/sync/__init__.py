@@ -15,11 +15,21 @@ Event streams (instance lifecycle Success/Failure/Crash consumed by runners
 via ``SubscribeEvents``) ride the same pub/sub as a reserved per-run topic.
 """
 
+from .addr import advertise_host, parse_hostport
+from .errors import SyncLostError
 from .inmem import InMemSyncService
-from .client import SyncClient
+from .client import SyncClient, SyncRetry
 from .server import SyncServiceServer
 
-__all__ = ["InMemSyncService", "SyncClient", "SyncServiceServer"]
+__all__ = [
+    "InMemSyncService",
+    "SyncClient",
+    "SyncLostError",
+    "SyncRetry",
+    "SyncServiceServer",
+    "advertise_host",
+    "parse_hostport",
+]
 
 # Reserved topic carrying instance lifecycle events for a run; the runner
 # subscribes to it to collect outcomes (``local_docker.go:217-256``).

@@ -16,9 +16,15 @@ Semantics (matching the reference sync service as used by
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Any, Iterator
 
 __all__ = ["InMemSyncService"]
+
+# FIFO bound on remembered idempotency tokens: only a reconnecting
+# client's unacked window (seconds of traffic) ever replays, so the cap
+# bounds memory over week-long runs without a realistic double-apply.
+MAX_TOKENS = 65536
 
 
 class InMemSyncService:
@@ -32,13 +38,39 @@ class InMemSyncService:
         self._lock = threading.Condition()
         self._counters: dict[str, int] = {}
         self._topics: dict[str, list[Any]] = {}
+        # idempotency tokens: a reconnecting client re-sends unacked
+        # mutations with the token of the original attempt, and the
+        # service answers with the original result instead of mutating
+        # twice (at-least-once wire delivery → exactly-once effect);
+        # FIFO-bounded at MAX_TOKENS entries each
+        self._sig_tokens: dict[tuple[str, str], int] = {}
+        self._sig_token_order: deque[tuple[str, str]] = deque()
+        self._pub_tokens: dict[tuple[str, str], int] = {}
+        self._pub_token_order: deque[tuple[str, str]] = deque()
+
+    @staticmethod
+    def _remember(tokens: dict, order: deque, key: tuple, seq: int) -> None:
+        if key in tokens:
+            return
+        tokens[key] = seq
+        order.append(key)
+        while len(order) > MAX_TOKENS:
+            tokens.pop(order.popleft(), None)
 
     # ------------------------------------------------------------- signals
 
-    def signal_entry(self, state: str) -> int:
+    def signal_entry(self, state: str, token: str | None = None) -> int:
         with self._lock:
+            if token is not None:
+                prev = self._sig_tokens.get((state, token))
+                if prev is not None:
+                    return prev
             self._counters[state] = self._counters.get(state, 0) + 1
             seq = self._counters[state]
+            if token is not None:
+                self._remember(
+                    self._sig_tokens, self._sig_token_order, (state, token), seq
+                )
             self._lock.notify_all()
             return seq
 
@@ -71,17 +103,29 @@ class InMemSyncService:
         target: int,
         timeout: float | None = None,
         cancel: threading.Event | None = None,
+        token: str | None = None,
     ) -> int:
-        seq = self.signal_entry(state)
+        seq = self.signal_entry(state, token=token)
         self.barrier(state, target, timeout=timeout, cancel=cancel)
         return seq
 
     # -------------------------------------------------------------- pub/sub
 
-    def publish(self, topic: str, payload: Any) -> int:
+    def publish(self, topic: str, payload: Any, token: str | None = None) -> int:
         with self._lock:
+            if token is not None:
+                prev = self._pub_tokens.get((topic, token))
+                if prev is not None:
+                    return prev
             entries = self._topics.setdefault(topic, [])
             entries.append(payload)
+            if token is not None:
+                self._remember(
+                    self._pub_tokens,
+                    self._pub_token_order,
+                    (topic, token),
+                    len(entries),
+                )
             self._lock.notify_all()
             return len(entries)
 
@@ -134,4 +178,8 @@ class InMemSyncService:
         with self._lock:
             self._counters.clear()
             self._topics.clear()
+            self._sig_tokens.clear()
+            self._sig_token_order.clear()
+            self._pub_tokens.clear()
+            self._pub_token_order.clear()
             self._lock.notify_all()

@@ -12,9 +12,33 @@ Wire protocol (one JSON object per line):
     subscribe streams {"id": N, "entry": payload, "seq": i} frames until the
     connection closes.
 
-Ops: ``signal_entry(state)``, ``barrier(state, target)``,
-``signal_and_wait(state, target)``, ``publish(topic, payload)``,
-``subscribe(topic)``, ``counter(state)``.
+Ops: ``signal_entry(state[, token])``, ``barrier(state, target)``,
+``signal_and_wait(state, target[, token])``, ``publish(topic, payload[,
+token])``, ``subscribe(topic)``, ``counter(state)``, plus the liveness/
+identity plane (docs/CROSSHOST.md):
+
+- ``ping`` → ``{"pong": true, "boot": <id>}`` — heartbeat + boot-id probe
+  (a changed boot id tells a reconnecting client the service restarted
+  and lost its state);
+- ``hello(events_topic, group, instance)`` — registers the connection's
+  instance identity; an ABNORMAL disconnect (anything but ``bye``)
+  publishes ``{"type": "evicted", ...}`` to ``events_topic`` so runners
+  and surviving instances observe the death;
+- ``bye`` — clean-shutdown marker (no eviction event);
+- ``sync_stats`` → ``{"conns", "waiters", "subs"}`` — live occupancy,
+  the observable that pins "a dead client's barrier occupancy is
+  released".
+
+``token`` is an idempotency key: reconnecting clients re-send unacked
+mutations with the original token and the service replies with the
+original seq instead of mutating twice.
+
+The server binds ``host`` (default loopback; ``0.0.0.0`` opens it to
+other hosts — the ``cluster_k8s.go:302`` network-citizen analog) and,
+when ``idle_timeout`` is set, sweeps connections that have sent nothing
+(not even a heartbeat) for that long: a SIGSTOPped or half-open peer is
+evicted, its parked barrier/subscribe waiters released, and its eviction
+published, rather than leaking occupancy forever.
 
 This Python server is the behavioral spec; a wire-compatible native C++
 event-loop implementation lives at ``testground_tpu/native/syncsvc.cc``
@@ -23,6 +47,14 @@ available (runner config ``sync_service``, default "auto"). Either
 comfortably covers the local:exec envelope (2-300 real processes,
 ``README.md:136-139`` — the at-scale path is the on-device sync kernel,
 not these servers).
+
+Runnable standalone (the cross-host deployment unit, also wrapped by
+``tg sync-service``)::
+
+    python -m testground_tpu.sync.server --host 0.0.0.0 --port 9042
+
+prints ``LISTENING <host> <port>`` once bound and serves until
+SIGTERM/SIGINT.
 """
 
 from __future__ import annotations
@@ -30,6 +62,8 @@ from __future__ import annotations
 import json
 import socketserver
 import threading
+import time
+import uuid
 
 from testground_tpu.logging_ import S
 
@@ -38,12 +72,51 @@ from .inmem import InMemSyncService
 __all__ = ["SyncServiceServer"]
 
 
+class _AnyEvent:
+    """is_set() over several events — lets inmem waits observe both the
+    server-wide stop and the per-connection eviction."""
+
+    def __init__(self, *events: threading.Event):
+        self._events = events
+
+    def is_set(self) -> bool:
+        return any(e.is_set() for e in self._events)
+
+
 class _Handler(socketserver.StreamRequestHandler):
     daemon_threads = True
+
+    def setup(self) -> None:
+        super().setup()
+        self.last_activity = time.monotonic()
+        self.conn_cancel = threading.Event()
+        self.hello: dict | None = None
+        self.clean = False
+        with self.server.conns_lock:  # type: ignore[attr-defined]
+            self.server.conns.add(self)  # type: ignore[attr-defined]
+
+    def finish(self) -> None:
+        with self.server.conns_lock:  # type: ignore[attr-defined]
+            self.server.conns.discard(self)  # type: ignore[attr-defined]
+        super().finish()
+
+    def evict(self) -> None:
+        """Server-side eviction (idle sweep / stop): release parked
+        waiters and unblock the read loop."""
+        self.conn_cancel.set()
+        svc: InMemSyncService = self.server.service  # type: ignore[attr-defined]
+        with svc._lock:
+            svc._lock.notify_all()
+        try:
+            self.connection.shutdown(2)  # SHUT_RDWR: EOFs the read loop
+        except OSError:
+            pass
 
     def handle(self) -> None:
         svc: InMemSyncService = self.server.service  # type: ignore[attr-defined]
         stop: threading.Event = self.server.stop_event  # type: ignore[attr-defined]
+        occupancy = self.server.occupancy  # type: ignore[attr-defined]
+        cancel = _AnyEvent(stop, self.conn_cancel)
         write_lock = threading.Lock()
         pending: list[threading.Thread] = []
 
@@ -56,23 +129,26 @@ class _Handler(socketserver.StreamRequestHandler):
             except (BrokenPipeError, OSError):
                 pass
 
-        def run_async(fn, req_id: int) -> None:
+        def run_async(fn, req_id: int, kind: str) -> None:
             def runner():
-                try:
-                    fn()
-                except TimeoutError as e:
-                    reply({"id": req_id, "error": str(e)})
-                except InterruptedError:
-                    pass
-                except Exception as e:  # noqa: BLE001
-                    reply({"id": req_id, "error": str(e)})
+                with occupancy.held(kind):
+                    try:
+                        fn()
+                    except TimeoutError as e:
+                        reply({"id": req_id, "error": str(e)})
+                    except InterruptedError:
+                        pass
+                    except Exception as e:  # noqa: BLE001
+                        reply({"id": req_id, "error": str(e)})
 
             t = threading.Thread(target=runner, daemon=True)
             t.start()
             pending.append(t)
 
+        boot = self.server.boot_id  # type: ignore[attr-defined]
         try:
             for raw in self.rfile:
+                self.last_activity = time.monotonic()
                 try:
                     req = json.loads(raw)
                 except json.JSONDecodeError:
@@ -82,12 +158,52 @@ class _Handler(socketserver.StreamRequestHandler):
                 op = req.get("op")
                 try:
                     if op == "signal_entry":
-                        reply({"id": rid, "seq": svc.signal_entry(req["state"])})
+                        reply(
+                            {
+                                "id": rid,
+                                "seq": svc.signal_entry(
+                                    req["state"], token=req.get("token")
+                                ),
+                            }
+                        )
                     elif op == "counter":
                         reply({"id": rid, "count": svc.counter(req["state"])})
                     elif op == "publish":
                         reply(
-                            {"id": rid, "seq": svc.publish(req["topic"], req["payload"])}
+                            {
+                                "id": rid,
+                                "seq": svc.publish(
+                                    req["topic"],
+                                    req["payload"],
+                                    token=req.get("token"),
+                                ),
+                            }
+                        )
+                    elif op == "ping":
+                        reply({"id": rid, "pong": True, "boot": boot})
+                    elif op == "hello":
+                        hello = {
+                            "events_topic": req.get("events_topic", ""),
+                            "group": req.get("group", ""),
+                            "instance": req.get("instance", -1),
+                        }
+                        _ident_retag(self.server, self.hello, hello)
+                        self.hello = hello
+                        reply({"id": rid, "ok": True, "boot": boot})
+                    elif op == "bye":
+                        self.clean = True
+                        reply({"id": rid, "ok": True})
+                    elif op == "sync_stats":
+                        with self.server.conns_lock:  # type: ignore[attr-defined]
+                            n_conns = len(self.server.conns)  # type: ignore[attr-defined]
+                        reply(
+                            {
+                                "id": rid,
+                                "conns": n_conns,
+                                "waiters": occupancy.waiters,
+                                "subs": occupancy.subs,
+                                "boot": boot,
+                            }
                         )
                     elif op == "barrier":
 
@@ -96,39 +212,143 @@ class _Handler(socketserver.StreamRequestHandler):
                                 req["state"],
                                 int(req["target"]),
                                 timeout=req.get("timeout"),
-                                cancel=stop,
+                                cancel=cancel,
                             )
                             reply({"id": rid, "ok": True})
 
-                        run_async(do_barrier, rid)
+                        run_async(do_barrier, rid, "waiters")
                     elif op == "signal_and_wait":
 
                         def do_sw(rid=rid, req=req):
-                            seq = svc.signal_entry(req["state"])
+                            seq = svc.signal_entry(
+                                req["state"], token=req.get("token")
+                            )
                             svc.barrier(
                                 req["state"],
                                 int(req["target"]),
                                 timeout=req.get("timeout"),
-                                cancel=stop,
+                                cancel=cancel,
                             )
                             reply({"id": rid, "seq": seq, "ok": True})
 
-                        run_async(do_sw, rid)
+                        run_async(do_sw, rid, "waiters")
                     elif op == "subscribe":
 
                         def do_sub(rid=rid, req=req):
                             for i, entry in enumerate(
-                                svc.subscribe(req["topic"], cancel=stop)
+                                svc.subscribe(req["topic"], cancel=cancel)
                             ):
                                 reply({"id": rid, "entry": entry, "seq": i + 1})
 
-                        run_async(do_sub, rid)
+                        run_async(do_sub, rid, "subs")
                     else:
                         reply({"id": rid, "error": f"unknown op {op!r}"})
                 except KeyError as e:
                     reply({"id": rid, "error": f"missing field {e}"})
         except (ConnectionResetError, OSError):
             pass
+        finally:
+            # connection gone (EOF, reset, or eviction): release this
+            # connection's parked waiters/subscriptions promptly —
+            # occupancy must not outlive the client
+            self.conn_cancel.set()
+            with svc._lock:
+                svc._lock.notify_all()
+            if self.hello and not stop.is_set():
+                _note_disconnect(self.server, self.hello, self.clean)
+            for t in pending:
+                t.join(timeout=2)
+
+
+def _ident_key(hello: dict) -> tuple:
+    return (
+        hello.get("events_topic", ""),
+        hello.get("group", ""),
+        hello.get("instance", -1),
+    )
+
+
+def _ident_retag(server, old: dict | None, new: dict) -> None:
+    """Track live connection count per instance identity (hello)."""
+    with server.ident_lock:
+        if old is not None:
+            k = _ident_key(old)
+            n = server.identities.get(k, 0) - 1
+            if n <= 0:
+                server.identities.pop(k, None)
+            else:
+                server.identities[k] = n
+        k = _ident_key(new)
+        server.identities[k] = server.identities.get(k, 0) + 1
+
+
+def _note_disconnect(server, hello: dict, clean: bool) -> None:
+    """Identity bookkeeping + GRACE-windowed eviction: an abnormal
+    disconnect only becomes an ``evicted`` event if no connection with
+    the same identity is back within ``evict_grace`` seconds — a client
+    dropping its socket to RECONNECT (heartbeat force-close, partition
+    heal) must not be announced dead to the run."""
+    key = _ident_key(hello)
+    with server.ident_lock:
+        n = server.identities.get(key, 0) - 1
+        if n <= 0:
+            server.identities.pop(key, None)
+        else:
+            server.identities[key] = n
+    if clean or n > 0 or not hello.get("events_topic"):
+        return
+
+    def fire() -> None:
+        if server.stop_event.is_set():
+            return
+        with server.ident_lock:
+            if server.identities.get(key, 0) > 0:
+                return  # the instance came back inside the grace window
+        try:
+            server.service.publish(
+                hello["events_topic"],
+                {
+                    "type": "evicted",
+                    "group": hello.get("group", ""),
+                    "instance": hello.get("instance", -1),
+                    "error": "connection lost (killed, partitioned, or "
+                    "idle-evicted)",
+                },
+            )
+        except Exception:  # noqa: BLE001 — eviction is best-effort
+            pass
+
+    grace = float(getattr(server, "evict_grace", 0.0))
+    if grace <= 0:
+        fire()
+        return
+    t = threading.Timer(grace, fire)
+    t.daemon = True
+    t.start()
+
+
+class _Occupancy:
+    """Live waiter/subscriber accounting exposed via ``sync_stats``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.waiters = 0
+        self.subs = 0
+
+    def held(self, kind: str):
+        occ = self
+
+        class _Held:
+            def __enter__(self):
+                with occ._lock:
+                    setattr(occ, kind, getattr(occ, kind) + 1)
+
+            def __exit__(self, *exc):
+                with occ._lock:
+                    setattr(occ, kind, getattr(occ, kind) - 1)
+                return False
+
+        return _Held()
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -137,26 +357,78 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class SyncServiceServer:
-    """Lifecycle wrapper; bind to an ephemeral port with ``port=0``."""
+    """Lifecycle wrapper; bind to an ephemeral port with ``port=0``.
 
-    def __init__(self, service: InMemSyncService | None = None, port: int = 0):
+    ``host`` is the bind address (default loopback — pass ``"0.0.0.0"``
+    to serve other hosts); ``idle_timeout`` (seconds, 0 = disabled)
+    evicts connections that have been silent for that long. Heartbeating
+    clients (the SDK's default) are never idle while alive, so only
+    dead/partitioned peers trip the sweep.
+    """
+
+    def __init__(
+        self,
+        service: InMemSyncService | None = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        idle_timeout: float = 0.0,
+        evict_grace: float = 2.0,
+    ):
         self.service = service or InMemSyncService()
-        self._server = _Server(("127.0.0.1", port), _Handler)
+        self.idle_timeout = float(idle_timeout)
+        self._server = _Server((host, port), _Handler)
         self._server.service = self.service  # type: ignore[attr-defined]
         self._server.stop_event = threading.Event()  # type: ignore[attr-defined]
+        self._server.conns = set()  # type: ignore[attr-defined]
+        self._server.conns_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._server.occupancy = _Occupancy()  # type: ignore[attr-defined]
+        self._server.boot_id = uuid.uuid4().hex  # type: ignore[attr-defined]
+        # hello'd-identity → live connection count; disconnects below a
+        # count of zero arm the evict_grace timer (see _note_disconnect)
+        self._server.identities = {}  # type: ignore[attr-defined]
+        self._server.ident_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._server.evict_grace = float(evict_grace)  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
+        self._sweeper: threading.Thread | None = None
 
     @property
     def address(self) -> tuple[str, int]:
         return self._server.server_address  # type: ignore[return-value]
+
+    @property
+    def boot_id(self) -> str:
+        return self._server.boot_id  # type: ignore[attr-defined]
 
     def start(self) -> "SyncServiceServer":
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="tg-sync-service"
         )
         self._thread.start()
+        if self.idle_timeout > 0:
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, daemon=True, name="tg-sync-sweep"
+            )
+            self._sweeper.start()
         S().debug("sync service listening on %s:%d", *self.address)
         return self
+
+    def _sweep_loop(self) -> None:
+        stop: threading.Event = self._server.stop_event  # type: ignore[attr-defined]
+        interval = max(0.1, self.idle_timeout / 4.0)
+        while not stop.wait(interval):
+            now = time.monotonic()
+            with self._server.conns_lock:  # type: ignore[attr-defined]
+                stale = [
+                    h
+                    for h in self._server.conns  # type: ignore[attr-defined]
+                    if now - h.last_activity > self.idle_timeout
+                ]
+            for h in stale:
+                S().debug(
+                    "sync service: evicting idle connection (%.1fs silent)",
+                    now - h.last_activity,
+                )
+                h.evict()
 
     def stop(self) -> None:
         self._server.stop_event.set()  # type: ignore[attr-defined]
@@ -167,3 +439,64 @@ class SyncServiceServer:
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=2)
+        if self._sweeper:
+            self._sweeper.join(timeout=2)
+
+
+def _main(argv: list[str] | None = None) -> int:
+    """``python -m testground_tpu.sync.server``: the standalone,
+    cross-host deployment unit (also behind ``tg sync-service``)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="testground_tpu.sync.server",
+        description="standalone sync service (JSON-lines TCP)",
+    )
+    ap.add_argument("--host", default="127.0.0.1", help="bind address")
+    ap.add_argument("--port", type=int, default=0, help="bind port (0=ephemeral)")
+    ap.add_argument(
+        "--idle-timeout",
+        type=float,
+        default=0.0,
+        help="evict connections silent for this many seconds (0=off)",
+    )
+    ap.add_argument(
+        "--evict-grace",
+        type=float,
+        default=2.0,
+        help="window an abnormally-disconnected instance has to "
+        "reconnect before its eviction is published (0=immediate)",
+    )
+    args = ap.parse_args(argv)
+
+    srv = SyncServiceServer(
+        port=args.port,
+        host=args.host,
+        idle_timeout=args.idle_timeout,
+        evict_grace=args.evict_grace,
+    ).start()
+    return serve_until_signal(srv)
+
+
+def serve_until_signal(svc) -> int:
+    """Announce ``LISTENING <host> <port>`` and serve until
+    SIGTERM/SIGINT — the one serve loop behind both ``python -m
+    testground_tpu.sync.server`` and ``tg sync-service``. ``svc`` is any
+    backend exposing ``.address``/``.stop()``."""
+    import signal
+    import sys
+
+    host, port = svc.address
+    print(f"LISTENING {host} {port}", flush=True)
+
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    svc.stop()
+    print("sync service stopped", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

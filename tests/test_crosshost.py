@@ -1,0 +1,319 @@
+"""Cross-host sync plane (docs/CROSSHOST.md): the acceptance pin for
+ISSUE 10 — a two-"host" ping-pong with instances split across two
+process groups as hosts, the second one ENGINE-LESS (separate
+$TESTGROUND_HOME, joining purely by sync-service address), green on both
+sync backends; plus the runner's external-service mode and the
+bind/advertise address logic."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from testground_tpu.sdk.runparams import RunParams
+from testground_tpu.sync import (
+    SyncClient,
+    SyncRetry,
+    advertise_host,
+    parse_hostport,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+@pytest.fixture(scope="session")
+def native_bin(tmp_path_factory):
+    from testground_tpu.native import build_syncsvc, native_available
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    return build_syncsvc(str(tmp_path_factory.mktemp("syncsvc-bin")))
+
+
+def _spawn_service(backend, native_bin, host="127.0.0.1", idle=5.0):
+    """External standalone sync service of either backend; returns
+    (proc, dial_host, port)."""
+    if backend == "python":
+        code = (
+            "from testground_tpu.sync.server import _main; "
+            f"_main(['--host', '{host}', '--port', '0', "
+            f"'--idle-timeout', '{idle}'])"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT},
+        )
+        parts = proc.stdout.readline().split()
+        assert parts and parts[0] == "LISTENING", parts
+        port = int(parts[2])
+    else:
+        argv = [native_bin, "--port", "0", "--host", host,
+                "--idle-timeout", str(idle)]
+        proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+        )
+        parts = proc.stdout.readline().split()
+        assert parts and parts[0] == "LISTENING", parts
+        port = int(parts[1])
+    return proc, advertise_host(host), port
+
+
+@pytest.fixture(params=["python", "native"])
+def external_service(request, tmp_path):
+    native = None
+    if request.param == "native":
+        native = request.getfixturevalue("native_bin")
+    # wildcard bind: the service is a network citizen; instances dial the
+    # machine's advertised (non-wildcard) address
+    proc, host, port = _spawn_service(request.param, native, host="0.0.0.0")
+    yield host, port
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def _spawn_engineless_instance(
+    group: str,
+    instance_seq: int,
+    run_id: str,
+    sync_host: str,
+    sync_port: int,
+    home: str,
+    total: int = 2,
+):
+    """One instance process driven purely by the RunParams env contract —
+    no engine, no runner: the 'second host' of a cross-host run (the
+    scheduler on that host injected the same run id + sync address, the
+    ``cluster_k8s.go:302`` pattern)."""
+    out_dir = os.path.join(home, "outputs", group, str(instance_seq))
+    tmp_dir = os.path.join(home, "tmp", group, str(instance_seq))
+    params = RunParams(
+        test_plan="network",
+        test_case="ping-pong",
+        test_run=run_id,
+        test_instance_count=total,
+        test_group_id=group,
+        test_group_instance_count=1,
+        test_outputs_path=out_dir,
+        test_temp_path=tmp_dir,
+        test_instance_seq=instance_seq,
+        test_group_seq=0,
+        sync_service_host=sync_host,
+        sync_service_port=sync_port,
+        sync_connect_timeout=2.0,
+        sync_retry_attempts=20,
+        sync_retry_deadline=30.0,
+        sync_heartbeat=0.5,
+    )
+    env = {**os.environ, **params.to_env()}
+    env["PYTHONPATH"] = REPO_ROOT
+    artifact = os.path.join(PLANS, "network", "main.py")
+    return subprocess.Popen(
+        [sys.executable, artifact],
+        env=env,
+        cwd=os.path.dirname(artifact),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+class TestTwoHostPingPong:
+    def test_split_instances_across_two_hosts(
+        self, external_service, tmp_path
+    ):
+        """The acceptance pin: one run, two instances, each in its own
+        process group with its own $TESTGROUND_HOME ("hosts"), meeting
+        only through the network-reachable sync service — address
+        exchange via pubsub, rendezvous via signal_and_wait, then real
+        TCP ping-pong rounds. Both backends (fixture param)."""
+        host, port = external_service
+        run_id = f"xhost-{int(time.time() * 1000) % 10**9:09d}"
+        homes = [str(tmp_path / "hostA"), str(tmp_path / "hostB")]
+        procs = [
+            _spawn_engineless_instance(
+                f"host{chr(65 + i)}", i, run_id, host, port, homes[i]
+            )
+            for i in range(2)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0, f"instance failed rc={rc}\n{out}\n{err}"
+        # the dialer measured real RTTs; both recorded success events
+        assert any('"success"' in out for _, out, _ in outs)
+
+    def test_second_host_sees_first_hosts_barriers_and_pubsub(
+        self, external_service
+    ):
+        """An engine-less joiner (bare SyncClient by address) observes
+        host A's signals, meets its barrier, and reads its topic — the
+        primitives themselves, without a plan around them."""
+        host, port = external_service
+        ns = f"run:join-{port}:"
+        a = SyncClient(host, port, namespace=ns, retry=SyncRetry(heartbeat_secs=0.5))
+        b = SyncClient(host, port, namespace=ns, retry=SyncRetry(heartbeat_secs=0.5))
+        try:
+            a.publish("topic", {"from": "hostA"})
+            assert next(b.subscribe("topic", timeout=10)) == {"from": "hostA"}
+            import threading
+
+            seqs: list = []
+            t = threading.Thread(
+                target=lambda: seqs.append(a.signal_and_wait("gate", 2, timeout=15)),
+                daemon=True,
+            )
+            t.start()
+            time.sleep(0.2)
+            seqs.append(b.signal_and_wait("gate", 2, timeout=15))
+            t.join(timeout=15)
+            assert sorted(seqs) == [1, 2]
+        finally:
+            a.close()
+            b.close()
+
+
+class TestRunnerExternalServiceMode:
+    @pytest.fixture()
+    def engine(self, tg_home):
+        from testground_tpu.builders.exec_py import ExecPyBuilder
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.engine import Engine, EngineConfig
+        from testground_tpu.runners.local_exec import LocalExecRunner
+
+        env = EnvConfig.load()
+        e = Engine(
+            EngineConfig(
+                env=env, builders=[ExecPyBuilder()], runners=[LocalExecRunner()]
+            )
+        )
+        e.start_workers()
+        yield e
+        e.stop()
+
+    def _run(self, engine, plan, case, instances, run_config, timeout=90):
+        from testground_tpu.api import (
+            Composition,
+            Global,
+            Group,
+            Instances,
+            TestPlanManifest,
+            generate_default_run,
+        )
+        from testground_tpu.engine import State
+
+        comp = generate_default_run(
+            Composition(
+                global_=Global(
+                    plan=plan,
+                    case=case,
+                    builder="exec:py",
+                    runner="local:exec",
+                    run_config=dict(run_config),
+                ),
+                groups=[Group(id="all", instances=Instances(count=instances))],
+            )
+        )
+        manifest = TestPlanManifest.load_file(
+            os.path.join(PLANS, plan, "manifest.toml")
+        )
+        tid = engine.queue_run(
+            comp, manifest, sources_dir=os.path.join(PLANS, plan)
+        )
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            t = engine.get_task(tid)
+            if t is not None and t.state().state in (
+                State.COMPLETE,
+                State.CANCELED,
+            ):
+                return t
+            time.sleep(0.05)
+        raise TimeoutError(f"task {tid} did not finish")
+
+    def test_run_joins_external_service_and_does_not_stop_it(
+        self, engine, external_service
+    ):
+        """A runner configured with sync_service_address starts no server
+        of its own, completes green through the shared plane, and leaves
+        the external service running (its owner stops it)."""
+        from testground_tpu.engine import Outcome
+
+        host, port = external_service
+        t = self._run(
+            engine,
+            "placebo",
+            "ok",
+            2,
+            {"sync_service_address": f"{host}:{port}"},
+        )
+        assert t.outcome() == Outcome.SUCCESS
+        assert t.result["outcomes"]["all"] == {"total": 2, "ok": 2}
+        # still alive and answering after the run tore down
+        probe = SyncClient(host, port, retry=SyncRetry(heartbeat_secs=0))
+        try:
+            assert probe.ping(timeout=5)
+        finally:
+            probe.close()
+
+    def test_unreachable_external_service_fails_fast_and_readably(
+        self, engine
+    ):
+        import socket
+
+        from testground_tpu.engine import Outcome
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        t = self._run(
+            engine,
+            "placebo",
+            "ok",
+            1,
+            {"sync_service_address": f"127.0.0.1:{port}"},
+        )
+        assert t.outcome() == Outcome.FAILURE
+
+
+class TestAddressing:
+    def test_parse_hostport(self):
+        assert parse_hostport("10.0.0.5:9042") == ("10.0.0.5", 9042)
+        assert parse_hostport("somehost", default_port=7) == ("somehost", 7)
+        with pytest.raises(ValueError):
+            parse_hostport(":9042")
+        with pytest.raises(ValueError):
+            parse_hostport("h:not-a-port")
+        with pytest.raises(ValueError):
+            parse_hostport("h:70000")
+
+    def test_advertise_host(self):
+        assert advertise_host("192.168.1.7") == "192.168.1.7"
+        assert advertise_host("0.0.0.0", explicit="10.1.2.3") == "10.1.2.3"
+        resolved = advertise_host("0.0.0.0")
+        assert resolved not in ("", "0.0.0.0", "::")
+
+    def test_loopback_remains_the_default_bind(self):
+        """The default runner config binds loopback — cross-host exposure
+        is opt-in."""
+        from testground_tpu.runners.local_exec import LocalExecConfig
+        from testground_tpu.sync import SyncServiceServer
+
+        assert LocalExecConfig().sync_bind_host == "127.0.0.1"
+        srv = SyncServiceServer().start()
+        try:
+            assert srv.address[0] == "127.0.0.1"
+        finally:
+            srv.stop()

@@ -57,6 +57,63 @@ class TestLocalExecHealthcheck:
             os.unlink(env.dirs.outputs())
 
 
+class TestSyncServiceChecks:
+    """Cross-host sync-plane checks (docs/CROSSHOST.md): the bindability
+    probe must target the CONFIGURED bind host, and a configured remote
+    sync service must answer a real ping RPC."""
+
+    def test_bindability_probes_configured_host(self, tg_home):
+        env = EnvConfig.load()
+        # an address this machine cannot bind (TEST-NET-1)
+        env.runners["local:exec"] = {"sync_bind_host": "192.0.2.1"}
+        report = LocalExecRunner().healthcheck(False, discard_writer(), env=env)
+        by_name = {c.name: c for c in report.checks}
+        assert by_name["sync-service-port-bindable"].status == "failed"
+        assert "192.0.2.1" in by_name["sync-service-port-bindable"].message
+
+    def test_remote_sync_service_checked_by_ping(self, tg_home):
+        from testground_tpu.sync import SyncServiceServer
+
+        env = EnvConfig.load()
+        srv = SyncServiceServer().start()
+        try:
+            host, port = srv.address
+            env.runners["local:exec"] = {
+                "sync_service_address": f"{host}:{port}"
+            }
+            report = LocalExecRunner().healthcheck(
+                False, discard_writer(), env=env
+            )
+            by_name = {c.name: c for c in report.checks}
+            assert by_name["sync-service-reachable"].status == "ok"
+            assert "answered ping" in by_name["sync-service-reachable"].message
+        finally:
+            srv.stop()
+        # dead endpoint: the check fails with the address in the message
+        env.runners["local:exec"] = {"sync_service_address": f"{host}:{port}"}
+        report = LocalExecRunner().healthcheck(False, discard_writer(), env=env)
+        by_name = {c.name: c for c in report.checks}
+        assert by_name["sync-service-reachable"].status == "failed"
+        assert f"{host}:{port}" in by_name["sync-service-reachable"].message
+
+    def test_connect_level_liveness_is_not_enough(self, tg_home):
+        """A plain TCP listener that never speaks the protocol must fail
+        the ping check (the listen-backlog lie)."""
+        import socket
+
+        from testground_tpu.healthcheck.checkers import check_sync_service
+
+        lis = socket.socket()
+        lis.bind(("127.0.0.1", 0))
+        lis.listen(1)
+        try:
+            host, port = lis.getsockname()
+            ok, msg = check_sync_service(host, port, timeout=0.5)()
+            assert not ok
+        finally:
+            lis.close()
+
+
 class TestEnvThreading:
     def test_engine_env_wins_over_environ(self, tmp_path, monkeypatch):
         """An explicitly-constructed env must be what gets checked, not a

@@ -1,0 +1,454 @@
+"""Sync-plane failure hardening (docs/CROSSHOST.md): server death yields
+a typed ``SyncLostError`` (no hang), partitions heal via bounded
+reconnect (barrier re-arm + subscription resume), dead clients are
+evicted with their barrier occupancy released, and mutations are
+idempotent under reconnect replay — on BOTH wire-compatible backends."""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from testground_tpu.sync import (
+    InMemSyncService,
+    SyncClient,
+    SyncLostError,
+    SyncRetry,
+    SyncServiceServer,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fast_retry(**over) -> SyncRetry:
+    kw = dict(
+        connect_timeout=0.5,
+        attempts=3,
+        deadline_secs=3.0,
+        backoff_base=0.05,
+        backoff_cap=0.3,
+        heartbeat_secs=0.2,
+    )
+    kw.update(over)
+    return SyncRetry(**kw)
+
+
+@pytest.fixture(scope="session")
+def native_bin(tmp_path_factory):
+    from testground_tpu.native import build_syncsvc, native_available
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    return build_syncsvc(str(tmp_path_factory.mktemp("syncsvc-bin")))
+
+
+def _spawn_server(backend: str, native_bin: str | None, port=0, idle=0.0):
+    """A killable sync-server SUBPROCESS of either backend; returns
+    (proc, host, port)."""
+    if backend == "python":
+        code = (
+            "from testground_tpu.sync.server import _main; "
+            f"_main(['--port', '{port}', '--idle-timeout', '{idle}'])"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT},
+        )
+        parts = proc.stdout.readline().split()
+        assert parts and parts[0] == "LISTENING", parts
+        return proc, parts[1], int(parts[2])
+    argv = [native_bin, "--port", str(port)]
+    if idle:
+        argv += ["--idle-timeout", str(idle)]
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+    )
+    parts = proc.stdout.readline().split()
+    assert parts and parts[0] == "LISTENING", parts
+    return proc, "127.0.0.1", int(parts[1])
+
+
+@pytest.fixture(params=["python", "native"])
+def killable_server(request):
+    native = None
+    if request.param == "native":
+        native = request.getfixturevalue("native_bin")
+    proc, host, port = _spawn_server(request.param, native)
+    yield proc, host, port
+    if proc.poll() is None:
+        proc.kill()
+    proc.wait(timeout=10)
+
+
+@pytest.fixture(params=["python", "native"])
+def idle_server(request):
+    """In-process-managed server of either backend with a fast idle
+    sweep; yields an object with .address/.stop()."""
+    if request.param == "python":
+        srv = SyncServiceServer(idle_timeout=0.8, evict_grace=0.3).start()
+        yield srv
+        srv.stop()
+    else:
+        from testground_tpu.native import NativeSyncService
+
+        srv = NativeSyncService(
+            request.getfixturevalue("native_bin"),
+            idle_timeout=0.8,
+            evict_grace=0.3,
+        )
+        yield srv
+        srv.stop()
+
+
+def _wait_stats(client, key, value, timeout=8.0):
+    deadline = time.time() + timeout
+    s = {}
+    while time.time() < deadline:
+        s = client.sync_stats(timeout=2)
+        if s.get(key) == value:
+            return s
+        time.sleep(0.05)
+    raise AssertionError(f"sync_stats never reached {key}={value}: {s}")
+
+
+class TestServerDeath:
+    """Acceptance pin: a killed sync server yields a typed SyncLostError
+    within the reconnect budget — never an indefinite block."""
+
+    def test_sigkill_mid_barrier_raises_typed(self, killable_server):
+        proc, host, port = killable_server
+        c = SyncClient(host, port, retry=_fast_retry(attempts=2, deadline_secs=2))
+        got: list = []
+
+        def park():
+            try:
+                c.barrier("never", 5, timeout=60)
+            except BaseException as e:  # noqa: BLE001
+                got.append(e)
+
+        t = threading.Thread(target=park, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        start = time.time()
+        proc.kill()
+        proc.wait(timeout=10)
+        t.join(timeout=15)
+        assert not t.is_alive(), "barrier waiter hung past the budget"
+        assert got and isinstance(got[0], SyncLostError), got
+        assert f"{host}:{port}" in str(got[0])
+        assert time.time() - start < 12
+        c.close()
+
+    def test_sigkill_mid_subscribe_raises_typed(self, killable_server):
+        proc, host, port = killable_server
+        c = SyncClient(host, port, retry=_fast_retry(attempts=2, deadline_secs=2))
+        c.publish("topic", "a")
+        sub = c.subscribe("topic", timeout=30)
+        assert next(sub) == "a"
+        got: list = []
+
+        def drain():
+            try:
+                for _ in sub:
+                    pass
+            except BaseException as e:  # noqa: BLE001
+                got.append(e)
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        proc.kill()
+        proc.wait(timeout=10)
+        t.join(timeout=15)
+        assert not t.is_alive(), "subscriber hung past the budget"
+        assert got and isinstance(got[0], SyncLostError), got
+        c.close()
+
+    def test_initial_connect_failure_names_address(self):
+        import socket
+
+        with socket.socket() as s:  # a port with nothing listening
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        start = time.time()
+        with pytest.raises(SyncLostError) as ei:
+            SyncClient(
+                "127.0.0.1",
+                port,
+                retry=_fast_retry(attempts=2, deadline_secs=1.5),
+            )
+        assert f"127.0.0.1:{port}" in str(ei.value)
+        assert ei.value.attempts == 2
+        assert time.time() - start < 10
+
+
+class TestReconnect:
+    def test_partition_heal_rearms_barrier_and_resumes_subscribe(
+        self, killable_server
+    ):
+        """SIGSTOP the server (half-open partition): the client detects
+        it by pong timeout, retries within budget, and after SIGCONT the
+        in-flight barrier completes and the subscription resumes without
+        duplicates or loss."""
+        proc, host, port = killable_server
+        retry = _fast_retry(attempts=60, deadline_secs=30)
+        c = SyncClient(host, port, namespace="run:z:", retry=retry)
+        helper = SyncClient(
+            host, port, namespace="run:z:", retry=_fast_retry(
+                attempts=60, deadline_secs=30
+            )
+        )
+        c.publish("topic", "a")
+        sub = c.subscribe("topic", timeout=25)
+        assert next(sub) == "a"
+        got: list = []
+        t = threading.Thread(
+            target=lambda: got.append(c.signal_and_wait("gate", 2, timeout=25)),
+            daemon=True,
+        )
+        t.start()
+        time.sleep(0.3)
+        os.kill(proc.pid, signal.SIGSTOP)
+        time.sleep(1.5)  # heartbeat must declare the conn half-open
+        os.kill(proc.pid, signal.SIGCONT)
+        helper.publish("topic", "b")
+        assert next(sub) == "b"  # no replayed "a", no lost "b"
+        seq = helper.signal_and_wait("gate", 2, timeout=15)
+        t.join(timeout=15)
+        assert got and sorted([got[0], seq]) == [1, 2]
+        c.close()
+        helper.close()
+
+    def test_server_restart_detected_by_boot_id(self, killable_server):
+        """Reconnecting to a RESTARTED (state-lost) service must surface
+        SyncLostError — never silently resume against an empty world."""
+        proc, host, port = killable_server
+        c = SyncClient(
+            host, port, retry=_fast_retry(attempts=40, deadline_secs=20)
+        )
+        assert c.signal_entry("s") == 1
+        proc.kill()
+        proc.wait(timeout=10)
+        # new server, same port, fresh boot id
+        if proc.args[0] == sys.executable:
+            proc2, _, _ = _spawn_server("python", None, port=port)
+        else:
+            proc2, _, _ = _spawn_server("native", proc.args[0], port=port)
+        try:
+            with pytest.raises(SyncLostError, match="restart"):
+                deadline = time.time() + 20
+                while time.time() < deadline:
+                    c.counter("s")
+                    time.sleep(0.1)
+        finally:
+            c.close()
+            proc2.kill()
+            proc2.wait(timeout=10)
+
+
+class TestEviction:
+    """Acceptance pin: a killed sync client never wedges survivors — its
+    barrier occupancy is evicted and its death is published."""
+
+    def test_sigkilled_client_releases_occupancy_and_publishes(
+        self, idle_server
+    ):
+        host, port = idle_server.address
+        watcher = SyncClient(host, port, retry=_fast_retry())
+        events = watcher.subscribe("run:r:__run_events__", timeout=15)
+        victim_code = f"""
+import sys
+sys.path.insert(0, {REPO_ROOT!r})
+from testground_tpu.sync import SyncClient, SyncRetry
+c = SyncClient({host!r}, {port}, namespace="run:r:",
+               retry=SyncRetry(heartbeat_secs=0.2),
+               identity={{"events_topic": "run:r:__run_events__",
+                          "group": "g", "instance": 5}})
+print("READY", flush=True)
+c.barrier("never", 9, timeout=60)
+"""
+        victim = subprocess.Popen(
+            [sys.executable, "-c", victim_code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        try:
+            assert victim.stdout.readline().strip() == "READY"
+            _wait_stats(watcher, "waiters", 1)
+            victim.kill()
+            victim.wait(timeout=10)
+            evt = next(events)
+            assert evt["type"] == "evicted"
+            assert evt["group"] == "g" and evt["instance"] == 5
+            _wait_stats(watcher, "waiters", 0)
+        finally:
+            if victim.poll() is None:
+                victim.kill()
+            watcher.close()
+
+    def test_half_open_client_swept_by_idle_timeout(self, idle_server):
+        """A client that stops heartbeating (the SIGSTOP/partition
+        shape, where no FIN ever arrives) is evicted by the idle sweep
+        and its parked waiter released."""
+        host, port = idle_server.address
+        watcher = SyncClient(host, port, retry=_fast_retry())
+        silent = SyncClient(
+            host,
+            port,
+            namespace="run:r:",
+            retry=_fast_retry(heartbeat_secs=0.0, attempts=0, deadline_secs=0.5),
+            identity={
+                "events_topic": "run:r:__run_events__",
+                "group": "g2",
+                "instance": 3,
+            },
+        )
+        events = watcher.subscribe("run:r:__run_events__", timeout=15)
+        got: list = []
+
+        def park():
+            try:
+                silent.barrier("never", 9, timeout=30)
+            except BaseException as e:  # noqa: BLE001
+                got.append(e)
+
+        t = threading.Thread(target=park, daemon=True)
+        t.start()
+        _wait_stats(watcher, "waiters", 1)
+        evt = next(events)  # the sweep evicts the silent client
+        assert evt["type"] == "evicted" and evt["instance"] == 3
+        _wait_stats(watcher, "waiters", 0)
+        t.join(timeout=15)
+        assert got and isinstance(got[0], SyncLostError), got
+        watcher.close()
+        silent.close()
+
+    def test_transient_reconnect_is_not_an_eviction(self, idle_server):
+        """A client whose connection drops abnormally but who RECONNECTS
+        within the grace window (the heartbeat force-close / partition
+        heal shape) must not be announced dead — otherwise every
+        reconnect would spuriously evict a live instance."""
+        host, port = idle_server.address
+        watcher = SyncClient(host, port, retry=_fast_retry())
+        c = SyncClient(
+            host,
+            port,
+            namespace="run:r:",
+            retry=_fast_retry(attempts=30, deadline_secs=15),
+            identity={
+                "events_topic": "run:r:__run_events__",
+                "group": "g",
+                "instance": 9,
+            },
+        )
+        # drop the socket out from under the client (what the heartbeat
+        # does on pong timeout); the reconnect re-hellos immediately
+        c._sock.close()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                c.ping(timeout=1)
+                break
+            except (TimeoutError, RuntimeError):
+                time.sleep(0.1)
+        assert c.signal_entry("alive") >= 1  # recovered
+        sub = watcher.subscribe("run:r:__run_events__", timeout=1.2)
+        with pytest.raises(TimeoutError):  # grace canceled the eviction
+            evt = next(sub)
+            raise AssertionError(f"spurious eviction: {evt}")
+        c.close()
+        watcher.close()
+
+    def test_clean_close_publishes_no_eviction(self, idle_server):
+        host, port = idle_server.address
+        watcher = SyncClient(host, port, retry=_fast_retry())
+        c = SyncClient(
+            host,
+            port,
+            namespace="run:r:",
+            retry=_fast_retry(),
+            identity={
+                "events_topic": "run:r:__run_events__",
+                "group": "g",
+                "instance": 1,
+            },
+        )
+        c.signal_entry("s")
+        c.close()
+        sub = watcher.subscribe("run:r:__run_events__", timeout=1.2)
+        with pytest.raises(TimeoutError):
+            next(sub)
+        watcher.close()
+
+
+class TestIdempotencyTokens:
+    def test_inmem_signal_token_dedup(self):
+        s = InMemSyncService()
+        assert s.signal_entry("x", token="t1") == 1
+        assert s.signal_entry("x", token="t1") == 1  # replay: same seq
+        assert s.signal_entry("x", token="t2") == 2
+        assert s.counter("x") == 2
+
+    def test_inmem_publish_token_dedup(self):
+        s = InMemSyncService()
+        assert s.publish("t", "a", token="p1") == 1
+        assert s.publish("t", "a", token="p1") == 1
+        assert s.topic_len("t") == 1
+
+    def test_wire_replay_does_not_double_count(self, killable_server):
+        """Re-sending a tokened op over the wire (what the reconnect
+        replay does) must not double-signal/publish."""
+        import json
+        import socket
+
+        proc, host, port = killable_server
+        with socket.create_connection((host, port), timeout=5) as s:
+            f = s.makefile("rw", encoding="utf-8")
+            for rid in (1, 2):  # identical token, two sends
+                f.write(
+                    json.dumps(
+                        {
+                            "id": rid,
+                            "op": "signal_entry",
+                            "state": "st",
+                            "token": "tok",
+                        }
+                    )
+                    + "\n"
+                )
+                f.flush()
+            seqs = [json.loads(f.readline())["seq"] for _ in range(2)]
+            assert seqs == [1, 1]
+            f.write(
+                json.dumps({"id": 3, "op": "counter", "state": "st"}) + "\n"
+            )
+            f.flush()
+            assert json.loads(f.readline())["count"] == 1
+
+
+class TestRunParamsThreading:
+    def test_sync_budget_round_trips_env(self):
+        from testground_tpu.sdk.runparams import RunParams
+
+        p = RunParams(
+            sync_connect_timeout=3.5,
+            sync_retry_attempts=4,
+            sync_retry_deadline=12.0,
+            sync_heartbeat=1.5,
+        )
+        env = p.to_env()
+        assert env["SYNC_CONNECT_TIMEOUT"] == "3.5"
+        assert env["SYNC_RETRY_ATTEMPTS"] == "4"
+        q = RunParams.from_env(env)
+        assert q.sync_connect_timeout == 3.5
+        assert q.sync_retry_attempts == 4
+        assert q.sync_retry_deadline == 12.0
+        assert q.sync_heartbeat == 1.5

@@ -1,0 +1,507 @@
+"""``make crosshost-smoke``: the cross-host control-plane proof
+(docs/CROSSHOST.md, ISSUE 10 acceptance):
+
+Phase 1 — two-"host" ping-pong, BOTH sync backends: one run's instances
+split across two process groups as hosts (separate $TESTGROUND_HOME
+each, engine-less, joining purely by sync-service address — the
+``cluster_k8s.go:302`` pattern), exchanging addresses via pubsub,
+rendezvousing via signal_and_wait, and ping-ponging over real TCP; plus
+one kill/reconnect round: the sync service is partitioned (SIGSTOP)
+while host A is mid-subscribe and host B is still CONNECTING, then
+healed — both must complete through the bounded-reconnect path.
+
+Phase 2 — the 3-"host" chaos cohort, one composition of three host-level
+events driven against one shared sync service:
+
+- **member-death**: a host parked on a barrier is SIGKILLed; the server
+  evicts it (occupancy released) and publishes the eviction, and the
+  survivors' degraded rendezvous completes instead of deadlocking;
+- **sync-partition-and-heal**: the service is unreachable for a window
+  (SIGSTOP) with a barrier armed and a subscription waiting, then
+  healed; clients reconnect with backoff, re-arm the barrier, resume the
+  subscription, and the round completes;
+- **leader-death**: the leader host is SIGKILLed; the surviving member
+  observes the eviction, classifies the typed ``SyncLostError`` with
+  the cohort-fatal classifier (the PR 9 clean-exit path), and exits
+  with a one-line diagnosis — exit code 0, no LOG(FATAL), no traceback.
+
+Every event is journaled to ``crosshost_journal.jsonl`` (one record per
+event with its observations). Exits non-zero with a readable message on
+any violation. Self-contained: temporary $TESTGROUND_HOME, no jax —
+safe in CI, budget well under 60 s.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+START = time.monotonic()
+JOURNAL: list = []
+
+
+def fail(msg: str) -> None:
+    print(f"crosshost-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def journal(phase: str, event: str, **observed) -> None:
+    rec = {
+        "phase": phase,
+        "event": event,
+        "t_rel_secs": round(time.monotonic() - START, 3),
+        "observed": observed,
+    }
+    JOURNAL.append(rec)
+    print(f"crosshost-smoke: [{phase}] {event} {observed}")
+
+
+def wait_until(pred, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    fail(f"timed out after {timeout:.0f}s waiting for {what}")
+
+
+# ------------------------------------------------------------ services
+
+
+def spawn_service(backend: str, native_bin: str | None, idle: float = 3.0):
+    """Standalone sync-service subprocess; returns (proc, host, port).
+    evict-grace is tightened so real deaths announce fast while
+    reconnects (which land in well under 0.5 s here) stay silent."""
+    if backend == "python":
+        code = (
+            "from testground_tpu.sync.server import _main; "
+            f"_main(['--port', '0', '--idle-timeout', '{idle}', "
+            "'--evict-grace', '0.5'])"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT},
+        )
+        parts = proc.stdout.readline().split()
+        return proc, parts[1], int(parts[2])
+    proc = subprocess.Popen(
+        [native_bin, "--port", "0", "--idle-timeout", str(idle),
+         "--evict-grace", "0.5"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    parts = proc.stdout.readline().split()
+    return proc, "127.0.0.1", int(parts[1])
+
+
+# ------------------------------------------- phase 1: two-host ping-pong
+
+
+def pingpong_instance(workdir, group, seq, run_id, host, port):
+    from testground_tpu.sdk.runparams import RunParams
+
+    out_dir = os.path.join(workdir, group, "outputs")
+    params = RunParams(
+        test_plan="network",
+        test_case="ping-pong",
+        test_run=run_id,
+        test_instance_count=2,
+        test_group_id=group,
+        test_group_instance_count=1,
+        test_outputs_path=out_dir,
+        test_temp_path=os.path.join(workdir, group, "tmp"),
+        test_instance_seq=seq,
+        test_group_seq=0,
+        sync_service_host=host,
+        sync_service_port=port,
+        sync_connect_timeout=1.0,
+        sync_retry_attempts=60,
+        sync_retry_deadline=30.0,
+        sync_heartbeat=0.25,
+    )
+    env = {**os.environ, **params.to_env(), "PYTHONPATH": REPO_ROOT}
+    artifact = os.path.join(REPO_ROOT, "plans", "network", "main.py")
+    return subprocess.Popen(
+        [sys.executable, artifact],
+        env=env,
+        cwd=os.path.dirname(artifact),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def phase1(backend: str, native_bin, workdir: str) -> None:
+    proc, host, port = spawn_service(backend, native_bin)
+    journal("pingpong", f"service-started[{backend}]", address=f"{host}:{port}")
+    try:
+        run_id = f"pp-{backend}-{os.getpid()}"
+        a = pingpong_instance(workdir, f"hostA-{backend}", 0, run_id, host, port)
+        time.sleep(0.6)  # A is now mid-subscribe awaiting B's address
+        os.kill(proc.pid, signal.SIGSTOP)  # the kill/reconnect round:
+        journal("pingpong", f"partition[{backend}]", note="service SIGSTOPped")
+        b = pingpong_instance(workdir, f"hostB-{backend}", 1, run_id, host, port)
+        time.sleep(1.2)  # B's INITIAL connect retries; A's heartbeat trips
+        os.kill(proc.pid, signal.SIGCONT)
+        journal("pingpong", f"heal[{backend}]", note="service SIGCONTed")
+        outs = {}
+        for name, p in (("hostA", a), ("hostB", b)):
+            try:
+                out, err = p.communicate(timeout=45)
+            except subprocess.TimeoutExpired:
+                a.kill()
+                b.kill()
+                fail(f"{backend}: {name} did not finish the ping-pong")
+            outs[name] = (p.returncode, out, err)
+        for name, (rc, out, err) in outs.items():
+            if rc != 0:
+                fail(
+                    f"{backend}: {name} exited {rc}\n--- stdout\n{out}"
+                    f"\n--- stderr\n{err}"
+                )
+        if not any('"success"' in out for _, out, _ in outs.values()):
+            fail(f"{backend}: no success events recorded")
+        journal(
+            "pingpong",
+            f"complete[{backend}]",
+            hosts={k: v[0] for k, v in outs.items()},
+            reconnect_round="survived",
+        )
+    finally:
+        if proc.poll() is None:
+            try:
+                os.kill(proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            proc.kill()
+        proc.wait(timeout=10)
+
+
+# ------------------------------------------ phase 2: 3-host chaos cohort
+
+# One inline host program, role-driven: leader (0), member (1),
+# victim (2). Coordination is pure sync-plane (barriers + pubsub +
+# eviction events) — the host-side control plane under test.
+HOST_SCRIPT = r"""
+import os, sys, threading, time
+sys.path.insert(0, os.environ["TG_REPO"])
+from testground_tpu.sync import SyncClient, SyncRetry, SyncLostError
+
+role, inst = sys.argv[1], int(sys.argv[2])
+host, port, run = sys.argv[3], int(sys.argv[4]), sys.argv[5]
+ns = f"run:{run}:"
+retry = SyncRetry(connect_timeout=1.0, attempts=80, deadline_secs=40.0,
+                  backoff_base=0.05, backoff_cap=0.4, heartbeat_secs=0.25)
+c = SyncClient(host, port, namespace=ns, retry=retry,
+               identity={"events_topic": ns + "__run_events__",
+                         "group": "hosts", "instance": inst})
+
+dead = set()
+control = []
+
+def drain(topic, sink):
+    def loop():
+        try:
+            for entry in c.subscribe(topic):
+                sink(entry)
+        except Exception:
+            pass
+    threading.Thread(target=loop, daemon=True).start()
+
+drain("__run_events__",
+      lambda e: dead.add(int(e.get("instance", -1)))
+      if isinstance(e, dict) and e.get("type") == "evicted" else None)
+drain("control", lambda e: control.append(e))
+
+def progress(msg):
+    c.publish("progress", {"inst": inst, "msg": msg})
+
+def rendezvous(name, expect, timeout=40.0):
+    # degraded rendezvous: arrivals OR evictions cover the cohort — a
+    # dead host must complete the round for the survivors, not wedge it
+    seen = set()
+    drain(name, lambda e: seen.add(int(e["arrived"])))
+    c.publish(name, {"arrived": inst})
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if expect <= (seen | dead):
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"rendezvous {name}: seen={seen} dead={dead}")
+
+ALL = {0, 1, 2}
+rendezvous("start", ALL)
+progress("started")
+
+if role == "victim":
+    progress("parked")
+    c.barrier("never", 9, timeout=120)  # killed while parked (occupancy)
+    sys.exit(1)  # unreachable
+
+# r1: member-death — the victim dies parked; we must complete anyway
+rendezvous("r1", ALL)
+progress("r1-done")
+
+if role == "leader":
+    # r2: arm the barrier BEFORE the partition so reconnect must re-arm it
+    progress("r2-armed")
+    c.signal_and_wait("r2b", 2, timeout=60)
+    progress("r2-done")
+    time.sleep(120)  # killed by the orchestrator (leader-death)
+    sys.exit(1)  # unreachable
+
+# member: wait for the healed-partition go signal (the subscription
+# itself rides the partition via resubscribe-at-seq)
+def _saw_go():
+    return any(isinstance(e, dict) and e.get("go") == "r2" for e in control)
+
+deadline = time.time() + 40
+while time.time() < deadline and not _saw_go():
+    time.sleep(0.05)
+if not _saw_go():
+    raise TimeoutError("member never saw the go-r2 control entry")
+c.signal_and_wait("r2b", 2, timeout=60)
+progress("r2-done")
+
+# r3: leader-death — observe the eviction, classify it with the
+# cohort-fatal classifier (the PR 9 clean-exit path), exit in one line
+deadline = time.time() + 40
+while time.time() < deadline and 0 not in dead:
+    time.sleep(0.05)
+if 0 not in dead:
+    raise TimeoutError("member never observed the leader eviction")
+progress("r3-observed")
+err = SyncLostError("cohort leader evicted; coordination plane lost")
+from testground_tpu.sim.cohort import _is_cohort_fatal
+assert _is_cohort_fatal(err), "SyncLostError must classify cohort-fatal"
+print("sync-host: cohort lost (leader died: SyncLostError) — exiting "
+      "cleanly", flush=True)
+os._exit(0)
+"""
+
+
+def spawn_host(role, inst, host, port, run_id):
+    return subprocess.Popen(
+        [sys.executable, "-c", HOST_SCRIPT, role, str(inst), host,
+         str(port), run_id],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env={**os.environ, "TG_REPO": REPO_ROOT},
+    )
+
+
+def phase2(backend: str, native_bin) -> None:
+    from testground_tpu.sync import SyncClient, SyncRetry
+
+    proc, host, port = spawn_service(backend, native_bin)
+    journal("chaos", f"service-started[{backend}]", address=f"{host}:{port}")
+    run_id = f"chaos-{os.getpid()}"
+    ns = f"run:{run_id}:"
+    obs = SyncClient(
+        host,
+        port,
+        namespace=ns,
+        retry=SyncRetry(
+            connect_timeout=1.0,
+            attempts=80,
+            deadline_secs=40.0,
+            backoff_base=0.05,
+            backoff_cap=0.4,
+            heartbeat_secs=0.25,
+        ),
+    )
+    progress: list = []
+    evicted: list = []
+
+    def _drain(topic, sink):
+        def loop():
+            try:
+                for entry in obs.subscribe(topic):
+                    sink(entry)
+            except Exception:  # noqa: BLE001 — observer is best-effort
+                pass
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    _drain("progress", progress.append)
+    _drain(
+        "__run_events__",
+        lambda e: evicted.append(int(e.get("instance", -1)))
+        if isinstance(e, dict) and e.get("type") == "evicted"
+        else None,
+    )
+
+    def saw(inst, msg):
+        return any(
+            p.get("inst") == inst and p.get("msg") == msg for p in progress
+        )
+
+    hosts = {
+        0: spawn_host("leader", 0, host, port, run_id),
+        1: spawn_host("member", 1, host, port, run_id),
+        2: spawn_host("victim", 2, host, port, run_id),
+    }
+    try:
+        wait_until(
+            lambda: all(saw(i, "started") for i in (0, 1, 2)),
+            20,
+            "3-host cohort start",
+        )
+        journal("chaos", "cohort-started", hosts=3)
+
+        # ---- event 1: member-death (victim SIGKILLed while parked)
+        wait_until(lambda: saw(2, "parked"), 15, "victim parked on barrier")
+        wait_until(
+            lambda: obs.sync_stats(timeout=5).get("waiters", 0) >= 1,
+            10,
+            "victim's barrier occupancy visible",
+        )
+        waiters_before = obs.sync_stats(timeout=5)["waiters"]
+        hosts[2].kill()
+        hosts[2].wait(timeout=10)
+        wait_until(lambda: 2 in evicted, 15, "victim eviction event")
+        wait_until(
+            lambda: saw(0, "r1-done") and saw(1, "r1-done"),
+            20,
+            "survivors completing the degraded r1 rendezvous",
+        )
+        journal(
+            "chaos",
+            "member-death",
+            killed_instance=2,
+            waiters_before_kill=waiters_before,
+            eviction_published=True,
+            survivors_completed_round=True,
+        )
+
+        # ---- event 2: sync-partition-and-heal (barrier armed across it)
+        wait_until(lambda: saw(0, "r2-armed"), 15, "leader arming r2 barrier")
+        os.kill(proc.pid, signal.SIGSTOP)
+        t_partition = time.monotonic()
+        journal("chaos", "sync-partition", note="service SIGSTOPped")
+        time.sleep(1.5)
+        os.kill(proc.pid, signal.SIGCONT)
+        journal(
+            "chaos",
+            "sync-heal",
+            window_secs=round(time.monotonic() - t_partition, 2),
+        )
+        obs.publish("control", {"go": "r2"})
+        wait_until(
+            lambda: saw(0, "r2-done") and saw(1, "r2-done"),
+            30,
+            "barrier re-armed across the partition completing",
+        )
+        journal(
+            "chaos",
+            "partition-healed-round-complete",
+            barrier_rearmed=True,
+            subscription_resumed=True,
+        )
+
+        # ---- event 3: leader-death (clean member exit, PR 9 path)
+        hosts[0].kill()
+        hosts[0].wait(timeout=10)
+        wait_until(lambda: 0 in evicted, 15, "leader eviction event")
+        wait_until(lambda: saw(1, "r3-observed"), 20, "member observing it")
+        try:
+            m_out, m_err = hosts[1].communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            hosts[1].kill()
+            fail("member did not exit after leader death")
+        if hosts[1].returncode != 0:
+            fail(
+                f"member exited {hosts[1].returncode} (want clean 0)\n"
+                f"--- stdout\n{m_out}\n--- stderr\n{m_err}"
+            )
+        if "cohort lost (leader died" not in m_out:
+            fail(f"member missing the one-line clean exit:\n{m_out}")
+        for blob, where in ((m_out, "stdout"), (m_err, "stderr")):
+            for marker in ("LOG(FATAL)", "Traceback", "FATAL"):
+                if marker in blob:
+                    fail(f"member {where} shows {marker!r}:\n{blob}")
+        journal(
+            "chaos",
+            "leader-death",
+            killed_instance=0,
+            eviction_published=True,
+            member_exit_code=0,
+            member_clean_line=True,
+        )
+    finally:
+        for p in hosts.values():
+            if p.poll() is None:
+                p.kill()
+        obs.close()
+        if proc.poll() is None:
+            try:
+                os.kill(proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            proc.kill()
+        proc.wait(timeout=10)
+
+
+def main() -> None:
+    os.environ.setdefault("TESTGROUND_HOME", tempfile.mkdtemp(prefix="tg-xh-"))
+    workdir = tempfile.mkdtemp(prefix="tg-xh-work-")
+
+    native_bin = None
+    try:
+        from testground_tpu.native import build_syncsvc, native_available
+
+        if native_available():
+            native_bin = build_syncsvc(os.path.join(workdir, "bin"))
+    except Exception as e:  # noqa: BLE001 — python backend still proves it
+        print(f"crosshost-smoke: native backend unavailable: {e}")
+
+    # phase 1 on BOTH backends (the acceptance demands backend parity)
+    phase1("python", None, workdir)
+    if native_bin:
+        phase1("native", native_bin, workdir)
+    else:
+        print("crosshost-smoke: WARNING — no C++ toolchain; native "
+              "ping-pong not exercised")
+
+    # phase 2 prefers the native backend (a real separate server process)
+    phase2("native" if native_bin else "python", native_bin)
+
+    journal_path = os.path.join(workdir, "crosshost_journal.jsonl")
+    with open(journal_path, "w") as f:
+        for rec in JOURNAL:
+            f.write(json.dumps(rec) + "\n")
+    expected_events = {
+        "member-death",
+        "sync-partition",
+        "sync-heal",
+        "partition-healed-round-complete",
+        "leader-death",
+    }
+    got_events = {r["event"] for r in JOURNAL if r["phase"] == "chaos"}
+    missing = expected_events - got_events
+    if missing:
+        fail(f"journal missing chaos events: {missing}")
+
+    total = time.monotonic() - START
+    if total > 60:
+        fail(f"smoke exceeded its 60s budget: {total:.1f}s")
+    print(
+        f"crosshost-smoke: PASS — {len(JOURNAL)} journaled events "
+        f"({journal_path}), {total:.1f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()
