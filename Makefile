@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
 	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke \
-	phases-smoke checkpoint-smoke crosshost-smoke
+	phases-smoke checkpoint-smoke crosshost-smoke pack-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -106,6 +106,16 @@ checkpoint-smoke:
 # line clean member exit, no LOG(FATAL)) — journaled per event; < 60 s
 crosshost-smoke:
 	$(PY) tools/crosshost_smoke.py
+
+# multi-tenant serving contract check (PERF.md "Serving: buckets +
+# packing"): warm the bucket ladder once (`tg build --buckets`
+# semantics, pack widths included), then 8 concurrent small runs at
+# DIFFERENT instance counts against one engine must report zero cold
+# compiles (sim.bucket.compile_cache == hit for every run), execute as
+# ONE width-8 vmapped pack, keep exact-N all-success results, and beat
+# N/2 × the isolated single-run throughput in aggregate
+pack-smoke:
+	$(PY) tools/pack_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -147,7 +147,60 @@ def build_bench_programs(n, ticks, transport="xla", only=None):
     return walls
 
 
-def _build(plan, case, n, params, chunk, transport="xla"):
+def build_bucket_programs(n, ticks, ladder=None, only=None):
+    """``--build --buckets``: the `tg build --buckets` parity pass for
+    the bench surface — precompile the canonical shape-bucket ladder
+    (sim/buckets.py) for each bench workload, emitting per-bucket
+    compile walls, so a bucketed serving daemon on this machine answers
+    ANY instance count warm. Rungs below the bench's own ``n`` are
+    warmed too (that is the point: small tenant runs), rungs above it
+    are skipped unless they hold it."""
+    import jax
+    import numpy as np
+
+    from testground_tpu.sim.buckets import parse_ladder, plan_buckets
+
+    ladder = parse_ladder(ladder)
+    walls = {}
+    for name in _workloads_for("xla", n, only):
+        plan, case, params, chunk = _bench_shape(name, n, ticks)
+        for rung in ladder:
+            bp = plan_buckets([min(n, rung)], rung, (rung,))
+            if bp is None:
+                continue
+            t0 = time.perf_counter()
+            try:
+                prog = _build(
+                    plan,
+                    case,
+                    rung,
+                    params,
+                    chunk,
+                    "xla",
+                    live_counts=bp.live_counts,
+                )
+                carry = jax.jit(
+                    lambda s, lc: prog.init_carry(s, lc)  # noqa: B023
+                )(np.int32(0), np.asarray(bp.live_counts, np.int32))
+                carry = prog.compiled_chunk()(carry)[0]
+                np.asarray(carry.t)
+            except Exception as e:  # noqa: BLE001 — per-rung best-effort
+                print(
+                    f"# build[{name}@bucket{rung}]: skipped ({e})",
+                    file=sys.stderr,
+                )
+                continue
+            secs = round(time.perf_counter() - t0, 2)
+            walls[f"{name}@bucket{rung}"] = secs
+            print(
+                f"# build[{name}@bucket{rung}]: traced+compiled+1 chunk "
+                f"in {secs}s",
+                file=sys.stderr,
+            )
+    return walls
+
+
+def _build(plan, case, n, params, chunk, transport="xla", live_counts=None):
     from testground_tpu.api import RunGroup
     from testground_tpu.sim.engine import SimProgram, build_groups
     from testground_tpu.sim.executor import (
@@ -168,7 +221,7 @@ def _build(plan, case, n, params, chunk, transport="xla"):
     # scatter IS the mesh traffic): A/B runs compare one chip's hot path
     mesh = (
         jax.sharding.Mesh(np.asarray(devs), ("i",))
-        if len(devs) > 1 and transport != "pallas"
+        if len(devs) > 1 and transport != "pallas" and live_counts is None
         else None
     )
     return SimProgram(
@@ -180,6 +233,7 @@ def _build(plan, case, n, params, chunk, transport="xla"):
         mesh=mesh,
         chunk=chunk,
         transport=transport,
+        live_counts=live_counts,
     )
 
 
@@ -354,6 +408,13 @@ def main() -> int:
     # r5 weak #1). --only narrows to a comma-list of BENCH_WORKLOADS.
     p.add_argument("--build", action="store_true")
     p.add_argument("--only", default=None)
+    # `tg build --buckets` parity (PERF.md "Serving: buckets +
+    # packing"): with --build, additionally precompile the canonical
+    # shape-bucket ladder for each workload so a serving daemon on this
+    # machine answers ANY instance count warm; per-bucket compile walls
+    # land in the emitted JSON. --bucket-ladder overrides the rungs.
+    p.add_argument("--buckets", action="store_true")
+    p.add_argument("--bucket-ladder", default=None)
     # phase attribution (sim/phases.py; docs/OBSERVABILITY.md "Phase
     # attribution"): emit the per-phase cost ledger of the full-path
     # program for THIS transport as a per-backend "phases" block in the
@@ -392,8 +453,17 @@ def main() -> int:
             print(f"unknown workloads: {sorted(unknown)}", file=sys.stderr)
             return 2
         walls = build_bench_programs(n, ticks, args.transport, only=only)
+        if args.buckets:
+            walls.update(
+                build_bucket_programs(
+                    n, ticks, ladder=args.bucket_ladder, only=only
+                )
+            )
         print(json.dumps({"built": walls, "transport": args.transport}))
         return 0
+    if args.buckets:
+        print("--buckets is a --build option", file=sys.stderr)
+        return 2
 
     full, full_compile, warm_compile, perf_block = bench_sustained(
         n, ticks, args.transport

@@ -49,7 +49,9 @@ class AdditionalHosts(SimTestcase):
         # request once the (possible) DROP filter is installed + applied,
         # staggered two senders per tick so the host's IN_MSGS-slot accept
         # queue never overflows at any instance count
-        window = max(1, -(-env.test_instance_count // 2))
+        # jnp.maximum, not python max: test_instance_count may be a
+        # TRACED scalar under shape bucketing (same value either way)
+        window = jnp.maximum(1, -(-env.test_instance_count // 2))
         send = t == 2 + jnp.mod(env.global_seq, window)
         ob = Outbox.single(
             jnp.int32(host),

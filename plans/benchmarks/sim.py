@@ -545,8 +545,10 @@ class Storm(SimTestcase):
         n = env.test_instance_count
         k_targets, k_delay = jax.random.split(env.key)
         # conn_outgoing random peers, self-index skipped by shifting
+        # (jnp.maximum, not python max: n may be a TRACED scalar under
+        # shape bucketing — same value either way)
         targets = jax.random.randint(
-            k_targets, (cls.OUT_MSGS,), 0, max(n - 1, 1)
+            k_targets, (cls.OUT_MSGS,), 0, jnp.maximum(n - 1, 1)
         )
         targets = targets + (targets >= env.global_seq)
         delay_max = (

@@ -87,9 +87,19 @@ class _SplitBrain(SimTestcase):
 
     @staticmethod
     def _region_counts(n):
-        # SignalEntry seqs are 1..N; region = seq % 3 (main.go:85-88)
+        # SignalEntry seqs are 1..N; region = seq % 3 (main.go:85-88).
+        # n may be a TRACED scalar under shape bucketing (docs/PERF.md
+        # "Serving: buckets + packing"), where the exact instance count
+        # is runtime data — the traced arm is the closed form of the
+        # same count (x in [1, n] with x % 3 == r).
+        if isinstance(n, int):
+            return [
+                sum(1 for x in range(1, n + 1) if x % 3 == r)
+                for r in range(3)
+            ]
         return [
-            sum(1 for x in range(1, n + 1) if x % 3 == r) for r in range(3)
+            n // 3 if r == 0 else jnp.where(n >= r, (n - r) // 3 + 1, 0)
+            for r in range(3)
         ]
 
     def step(self, env, state, inbox, sync, t):

@@ -114,6 +114,7 @@ class SimPlanBuilder(Builder, Precompiler):
             fault_specs_of,
             load_and_specialize,
             make_sim_program,
+            resolve_buckets,
             resolve_transport,
             slo_specs_of,
             trace_specs_of,
@@ -204,6 +205,23 @@ class SimPlanBuilder(Builder, Precompiler):
                 if telemetry
                 else {}
             )
+            # shape bucketing mirrors the executor's resolve_buckets
+            # gate exactly: the padded layout is part of the BuildKey
+            # (a bucketed and an exact build are different programs),
+            # and the program below compiles the runtime-N variant the
+            # run will read from the cache
+            bucket_plan = resolve_buckets(
+                cfg,
+                [
+                    rg.calculated_instance_count for rg in run.groups
+                ],
+                mesh=(
+                    None
+                    if getattr(cfg, "coordinator_address", "")
+                    else _make_mesh(cfg.shard)
+                ),
+                warn=ow.warn,
+            )
             spec = {
                 "sources": digests[
                     artifacts[
@@ -236,6 +254,13 @@ class SimPlanBuilder(Builder, Precompiler):
                 "backend": jax.default_backend(),
                 "devices": jax.device_count(),
                 "jax": jax.__version__,
+                # keyed only when bucketed — exact builds keep their
+                # pre-bucket BuildKeys (and their existing markers)
+                **(
+                    {"bucket": list(bucket_plan.padded_counts)}
+                    if bucket_plan is not None
+                    else {}
+                ),
             }
             key = hashlib.sha256(
                 json.dumps(spec, sort_keys=True).encode()
@@ -259,20 +284,68 @@ class SimPlanBuilder(Builder, Precompiler):
 
             # same load/specialize/construct helpers as the executor and
             # the sim-worker — the single-code-path guarantee behind the
-            # "identical HLO" claim above
+            # "identical HLO" claim above. Under bucketing the testcase
+            # specializes against the PADDED layout (executor rule),
+            # fault selectors lower over the exact layout and remap,
+            # and the flight recorder is off (the executor's gate).
+            run_groups_in = [
+                RunGroup(
+                    id=rg.id,
+                    instances=rg.calculated_instance_count,
+                    parameters=dict(rg.test_params),
+                )
+                for rg in run.groups
+            ]
+            if bucket_plan is not None:
+                padded_in = [
+                    RunGroup(
+                        id=rg.id,
+                        instances=p,
+                        parameters=dict(rg.parameters),
+                    )
+                    for rg, p in zip(
+                        run_groups_in, bucket_plan.padded_counts
+                    )
+                ]
+            else:
+                padded_in = run_groups_in
             testcase, groups = load_and_specialize(
                 artifacts[first.id],
                 comp.global_.case,
-                [
-                    RunGroup(
-                        id=rg.id,
-                        instances=rg.calculated_instance_count,
-                        parameters=dict(rg.test_params),
-                    )
-                    for rg in run.groups
-                ],
+                padded_in,
                 cfg.tick_ms,
             )
+            if (
+                bucket_plan is not None
+                and "filter_rules" in type(testcase).SHAPING
+                and len(groups) > 1
+            ):
+                # executor fallback mirrored: this combination runs
+                # exact shapes, so warm the exact program
+                bucket_plan = None
+                spec.pop("bucket", None)
+                testcase, groups = load_and_specialize(
+                    artifacts[first.id],
+                    comp.global_.case,
+                    run_groups_in,
+                    cfg.tick_ms,
+                )
+            from testground_tpu.sim.engine import build_groups as _bg
+
+            vgroups = (
+                _bg(run_groups_in) if bucket_plan is not None else groups
+            )
+            fault_schedule = build_fault_schedule(
+                vgroups, run_fault_specs, cfg.tick_ms
+            )
+            if fault_schedule is not None and bucket_plan is not None:
+                from testground_tpu.sim.faults import remap_schedule
+
+                fault_schedule = remap_schedule(
+                    fault_schedule,
+                    bucket_plan.index_map(),
+                    bucket_plan.padded_n,
+                )
             mesh = _make_mesh(cfg.shard)
             prog = make_sim_program(
                 testcase,
@@ -286,11 +359,18 @@ class SimPlanBuilder(Builder, Precompiler):
                 hosts=hosts,
                 validate=bool(getattr(cfg, "validate", False)),
                 telemetry=telemetry,
-                faults=build_fault_schedule(
-                    groups, run_fault_specs, cfg.tick_ms
+                faults=fault_schedule,
+                trace=(
+                    build_trace_plan(vgroups, run_trace_specs)
+                    if bucket_plan is None
+                    else None
                 ),
-                trace=build_trace_plan(groups, run_trace_specs),
                 transport=transport,
+                live_counts=(
+                    bucket_plan.live_counts
+                    if bucket_plan is not None
+                    else None
+                ),
             )
             # same capacity precheck as the run: an oversized composition
             # must refuse readably at BUILD time too, not die as an XLA
@@ -302,8 +382,20 @@ class SimPlanBuilder(Builder, Precompiler):
             # state leaves its own (GSPMD) shardings, so the second call
             # retraces at that fixed point (one iteration — verified; see
             # SimProgram.run). Execute one chunk here so both variants
-            # land in the cache; the run then compiles nothing.
-            carry = jax.jit(lambda: prog.init_carry(cfg.seed))()  # noqa: B023
+            # land in the cache; the run then compiles nothing. Bucketed
+            # programs init with runtime (seed, live_counts) inputs —
+            # the same traced signature the run uses.
+            if bucket_plan is not None:
+                import numpy as _np
+
+                carry = jax.jit(
+                    lambda s, lc: prog.init_carry(s, lc)  # noqa: B023
+                )(
+                    _np.int32(cfg.seed),
+                    _np.asarray(bucket_plan.live_counts, _np.int32),
+                )
+            else:
+                carry = jax.jit(lambda: prog.init_carry(cfg.seed))()  # noqa: B023
             fn = prog.compiled_chunk()
             # compiles variant 1 + runs one chunk (telemetry programs
             # return (carry, done, block) — take the carry positionally)
@@ -338,3 +430,194 @@ class SimPlanBuilder(Builder, Precompiler):
                 secs,
                 key,
             )
+
+        # ---------------------------------------- bucket-ladder warming
+        # `tg build --buckets` (build_buckets=true): beyond the
+        # composition's own rung, precompile EVERY canonical bucket of
+        # the ladder for this (plan, case, params) — one command makes
+        # the persistent cache warm for any instance count a tenant may
+        # ask for, with per-bucket compile_secs journaled in the
+        # markers so the warmup cost is a recorded number, not a guess.
+        if getattr(cfg, "build_buckets", False) and not cancel.is_set():
+            self._warm_bucket_ladder(
+                comp, cfg, artifacts, hosts, telemetry, cache_dir, ow, cancel
+            )
+
+    def _warm_bucket_ladder(
+        self, comp, cfg, artifacts, hosts, telemetry, cache_dir, ow, cancel
+    ) -> None:
+        """Compile the canonical bucket ladder for the composition's
+        first [[runs]] entry (same group structure/params, each group
+        padded to each rung). Best-effort per rung: an over-budget rung
+        (memory precheck) is skipped loudly, not fatal."""
+        import time as _time
+
+        import numpy as _np
+
+        from testground_tpu.api import RunGroup
+        from testground_tpu.sim.buckets import parse_ladder
+        from testground_tpu.sim.executor import (
+            _make_mesh,
+            _precheck_device_memory,
+            load_and_specialize,
+            make_sim_program,
+            resolve_transport,
+        )
+
+        import jax
+
+        if getattr(cfg, "coordinator_address", ""):
+            ow.warn("bucket-ladder warming skipped under a cohort config")
+            return
+        mesh = _make_mesh(cfg.shard)
+        if mesh is not None:
+            ow.warn(
+                "bucket-ladder warming skipped on a %d-device mesh "
+                "(shape bucketing is single-device for now)",
+                int(mesh.devices.size),
+            )
+            return
+        transport = resolve_transport(cfg, mesh)
+        ladder = parse_ladder(getattr(cfg, "bucket_ladder", "") or None)
+        run = comp.runs[0]
+        first = comp.get_group(run.groups[0].effective_group_id())
+        counts = [rg.calculated_instance_count for rg in run.groups]
+        warmed = []
+        for rung in ladder:
+            if cancel.is_set():
+                return
+            if any(c > rung for c in counts):
+                continue  # this rung cannot hold the composition
+            t0 = _time.perf_counter()
+            try:
+                testcase, groups = load_and_specialize(
+                    artifacts[first.id],
+                    comp.global_.case,
+                    [
+                        RunGroup(
+                            id=rg.id,
+                            instances=rung,
+                            parameters=dict(rg.test_params),
+                        )
+                        for rg in run.groups
+                    ],
+                    cfg.tick_ms,
+                )
+                prog = make_sim_program(
+                    testcase,
+                    groups,
+                    test_plan=comp.global_.plan,
+                    test_case=comp.global_.case,
+                    test_run="build",
+                    tick_ms=cfg.tick_ms,
+                    mesh=None,
+                    chunk=cfg.chunk,
+                    hosts=hosts,
+                    validate=bool(getattr(cfg, "validate", False)),
+                    telemetry=telemetry,
+                    faults=None,
+                    trace=None,
+                    transport=transport,
+                    live_counts=tuple(counts),
+                )
+                _precheck_device_memory(prog, cfg, None, ow)
+                carry = jax.jit(
+                    lambda s, lc: prog.init_carry(s, lc)  # noqa: B023
+                )(
+                    _np.int32(cfg.seed),
+                    _np.asarray(counts, _np.int32),
+                )
+                prog.compiled_chunk()(carry)
+                del carry
+            except Exception as e:  # noqa: BLE001 — per-rung best-effort
+                ow.warn(
+                    "bucket %d warmup failed (skipped): %s", rung, e
+                )
+                continue
+            secs = round(_time.perf_counter() - t0, 3)
+            warmed.append({"bucket": rung, "compile_secs": secs})
+            ow.infof(
+                "sim:plan bucket %d warmed in %.1fs (%s:%s)",
+                rung,
+                secs,
+                comp.global_.plan,
+                comp.global_.case,
+            )
+            # run packing compiles its own HLO per (bucket, vmapped
+            # width): when the composition opts into packing, warm the
+            # power-of-two width ladder too — bounded to packs whose
+            # total lane count stays inside the bucket ladder's top
+            # rung, the envelope packs are for (small tenants)
+            pack_on = str(
+                getattr(cfg, "pack", False)
+            ).strip().lower() in ("1", "true", "yes", "on")
+            if pack_on:
+                from testground_tpu.sim.pack import (
+                    PackRunner,
+                    pack_width,
+                )
+
+                pack_max = int(getattr(cfg, "pack_max", 8) or 8)
+                # packed-lane budget: a full pack of smallest-rung runs
+                # — larger rungs warm proportionally fewer widths (a
+                # width-8 pack of 1M-lane buckets is not a serving
+                # shape, and its compile would dwarf the build)
+                lane_budget = pack_max * ladder[0]
+                w = 2
+                while w <= pack_width(pack_max, pack_max):
+                    if w * rung > lane_budget:
+                        break  # packed lanes past the serving envelope
+                    t1 = _time.perf_counter()
+                    try:
+                        runner = PackRunner(prog, w)
+                        seeds = _np.zeros((w,), _np.int32)
+                        lcs = _np.asarray(
+                            [counts] * w, _np.int32
+                        )
+                        live = _np.ones((w,), bool)
+                        pc = runner.packed_init()(seeds, lcs, live)
+                        runner.packed_chunk()(pc)
+                        del pc
+                    except Exception as e:  # noqa: BLE001
+                        ow.warn(
+                            "bucket %d pack width %d warmup failed "
+                            "(skipped): %s",
+                            rung,
+                            w,
+                            e,
+                        )
+                        w *= 2
+                        continue
+                    psecs = round(_time.perf_counter() - t1, 3)
+                    warmed.append(
+                        {
+                            "bucket": rung,
+                            "pack_width": w,
+                            "compile_secs": psecs,
+                        }
+                    )
+                    ow.infof(
+                        "sim:plan bucket %d pack-width %d warmed in "
+                        "%.1fs",
+                        rung,
+                        w,
+                        psecs,
+                    )
+                    w *= 2
+        if warmed:
+            marker = os.path.join(
+                cache_dir,
+                "precompiled",
+                f"buckets-{comp.global_.plan}-{comp.global_.case}.json",
+            )
+            os.makedirs(os.path.dirname(marker), exist_ok=True)
+            with open(marker, "w") as f:
+                json.dump(
+                    {
+                        "plan": comp.global_.plan,
+                        "case": comp.global_.case,
+                        "ladder": list(ladder),
+                        "buckets": warmed,
+                    },
+                    f,
+                )

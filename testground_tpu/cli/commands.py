@@ -472,6 +472,21 @@ def register_build(sub) -> None:
     pc = psub.add_parser("composition")
     pc.add_argument("-f", "--file", required=True)
     pc.add_argument("--write-artifacts", action="store_true")
+    pc.add_argument(
+        "--buckets",
+        action="store_true",
+        help="also precompile the canonical shape-bucket ladder for the "
+        "composition's case (PERF.md 'Serving: buckets + packing') — "
+        "one command makes the compile cache warm for ANY instance "
+        "count a bucketed run may ask for",
+    )
+    pc.add_argument(
+        "--run-cfg",
+        action="append",
+        default=[],
+        help="override runner configuration k=v for the precompile "
+        "(repeatable) — e.g. bucket_ladder=4096,32768",
+    )
     _add_metadata_flags(pc)
     pc.set_defaults(func=build_composition_cmd)
     ps = psub.add_parser("single")
@@ -481,6 +496,20 @@ def register_build(sub) -> None:
         "builders (sim:plan) precompile that case into the compile cache",
     )
     ps.add_argument("--builder", default="")
+    ps.add_argument(
+        "--buckets",
+        action="store_true",
+        help="also precompile the canonical shape-bucket ladder for "
+        "this case (requires <plan>:<case>); per-bucket compile_secs "
+        "land in the build markers",
+    )
+    ps.add_argument(
+        "--run-cfg",
+        action="append",
+        default=[],
+        help="override runner configuration k=v for the precompile "
+        "(repeatable) — e.g. bucket_ladder=4096,32768",
+    )
     _add_metadata_flags(ps)
     ps.set_defaults(func=build_single_cmd)
 
@@ -492,10 +521,27 @@ def register_build(sub) -> None:
     pp.set_defaults(func=build_purge_cmd)
 
 
+def _apply_bucket_build_flags(comp, args) -> None:
+    """``tg build --buckets`` / ``--run-cfg``: thread the ladder-warming
+    request through the composition's run config (the channel the
+    sim:plan precompile coalesces); bucketed runs default to
+    bucket=auto so they read the programs the build just warmed."""
+    overrides = parse_key_values(getattr(args, "run_cfg", []) or [])
+    if overrides:
+        comp.global_.run_config = dict(comp.global_.run_config or {})
+        comp.global_.run_config.update(overrides)
+    if not getattr(args, "buckets", False):
+        return
+    comp.global_.run_config = dict(comp.global_.run_config or {})
+    comp.global_.run_config["build_buckets"] = True
+    comp.global_.run_config.setdefault("bucket", "auto")
+
+
 def build_composition_cmd(args) -> int:
     from testground_tpu.client import RemoteEngine
 
     comp = load_composition(args.file)
+    _apply_bucket_build_flags(comp, args)
     engine = _engine(args)
     try:
         created_by = _created_by(args, engine.env)
@@ -566,6 +612,12 @@ def build_single_cmd(args) -> int:
                 Group(id="single", instances=Instances(count=instances))
             ],
         )
+        if getattr(args, "buckets", False) and not case:
+            raise ValueError(
+                "--buckets needs a test case to resolve a program from: "
+                "use `tg build single <plan>:<case> --buckets`"
+            )
+        _apply_bucket_build_flags(comp, args)
         created_by = _created_by(args, engine.env)
         if isinstance(engine, RemoteEngine):
             task_id = engine.queue_build(comp, created_by=created_by)

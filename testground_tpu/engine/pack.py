@@ -1,0 +1,220 @@
+"""Pack admission: group queued compatible sim runs into one device
+program (PERF.md "Serving: buckets + packing"; the device half is
+``sim/pack.py``).
+
+A worker that pops a pack-opted task (``--run-cfg pack=true``) asks the
+queue for other QUEUED tasks with the same **pack signature** — the
+host-side compatibility key over everything that shapes the compiled
+program or the deterministic loop:
+
+- plan, case, group structure + parameters;
+- the padded bucket layout when shape bucketing is on (members may then
+  differ in EXACT instance count within a bucket — seeds and live
+  counts are runtime inputs), or the exact counts when it is off;
+- the program gates: transport, telemetry, validate, chunk, tick_ms,
+  max_ticks, disable_metrics;
+- and the structural exclusions: no faults, no flight recorder, no
+  additional hosts, no cohort, no checkpoint/resume, no profiles —
+  compositions carrying those run solo.
+
+Claiming respects queue priority: candidates are taken in heap order
+(priority desc, FIFO), so a high-priority tenant is packed first, never
+skipped — the per-tenant ordering PR 6's SLO rules feed.
+
+Import-light on purpose (stdlib + the composition model): the worker
+thread decides admission without touching jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from testground_tpu.logging_ import S
+
+__all__ = ["claim_pack", "pack_signature"]
+
+
+def _cfg_get(run_config: dict, key: str, default=None):
+    v = (run_config or {}).get(key, default)
+    return default if v is None else v
+
+
+def _truthy(v) -> bool:
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+def pack_signature(tsk, env=None) -> str | None:
+    """The compatibility key of a queued task, or None when the task
+    must run solo. Works on the raw task record (composition dict +
+    coalesced-ish run config) — no plan loading, no jax.
+
+    The runner-level ``.env.toml`` layer is coalesced in by the caller
+    passing ``env`` so two tasks differing only in where a knob was
+    set (composition vs daemon config) still pack together.
+    """
+    from testground_tpu.engine.task import TaskType
+
+    if tsk.type != TaskType.RUN or tsk.runner != "sim:jax":
+        return None
+    comp = tsk.composition or {}
+    runs = comp.get("runs") or []
+    if len(runs) != 1:
+        return None  # multi-[[runs]] compositions keep their own loop
+    run = runs[0]
+    glob = comp.get("global") or {}
+    grun = glob.get("run") or {}
+    # structural exclusions: program-shaping declarations that cannot
+    # share a vmapped program (or whose host planes are per-run device
+    # reads the pack cannot demux). Queued compositions are
+    # PRE-preparation, so backing-group [groups.run] tables — which
+    # merge_group only folds into the run groups at prepare time — must
+    # be checked here too, or a group-level chaos/trace declaration
+    # would slip past admission and silently never be injected.
+    if grun.get("faults") or grun.get("trace"):
+        return None
+    groups_decl = {g.get("id"): g for g in comp.get("groups") or []}
+    backing_runs = {}
+    for rg in run.get("groups") or []:
+        if rg.get("faults") or rg.get("trace"):
+            return None
+        decl = groups_decl.get(rg.get("group_id") or rg.get("id")) or {}
+        brun = decl.get("run") or {}
+        if brun.get("faults") or brun.get("trace"):
+            return None
+        backing_runs[rg.get("id")] = brun
+    cfgs = [dict(env or {}), dict(glob.get("run_config") or {})]
+    cfg: dict = {}
+    for layer in cfgs:
+        cfg.update(layer)
+    if not _truthy(cfg.get("pack")):
+        return None
+    if (
+        cfg.get("coordinator_address")
+        or cfg.get("resume_from")
+        or _truthy(cfg.get("profile"))
+        or _truthy(cfg.get("phases"))
+        or cfg.get("additional_hosts")
+        or int(cfg.get("checkpoint_chunks") or 0) > 0
+    ):
+        return None
+
+    # instance counts: the padded bucket layout when bucketing is on
+    # (the shared-program identity), exact counts otherwise. Queued
+    # compositions are pre-preparation, so resolve the explicit count
+    # (run group, else backing group); percentage-based groups resolve
+    # only at prepare time — those run solo.
+    counts = []
+    for rg in run.get("groups") or []:
+        inst = rg.get("instances") or {}
+        c = inst.get("count") if isinstance(inst, dict) else inst
+        if not c:
+            decl = groups_decl.get(
+                rg.get("group_id") or rg.get("id"), {}
+            )
+            dinst = decl.get("instances") or {}
+            c = (
+                dinst.get("count")
+                if isinstance(dinst, dict)
+                else dinst
+            )
+        if not c:
+            return None
+        counts.append(int(c))
+    from testground_tpu.sim.buckets import (
+        bucketed_counts,
+        parse_bucket_mode,
+        parse_ladder,
+    )
+
+    try:
+        mode = parse_bucket_mode(cfg.get("bucket"))
+        ladder = parse_ladder(cfg.get("bucket_ladder") or None)
+    except ValueError:
+        return None  # a bad knob fails in the executor, readably
+    padded = (
+        bucketed_counts(counts, mode, ladder)
+        if mode != "off"
+        else None
+    )
+    sig = {
+        "plan": glob.get("plan"),
+        "case": glob.get("case"),
+        # plan identity: two tasks queued around a plan edit (different
+        # manifest or sources snapshot) must not share a program
+        "manifest": hashlib.sha256(
+            json.dumps(
+                (tsk.input or {}).get("manifest") or {}, sort_keys=True
+            ).encode()
+        ).hexdigest()[:16],
+        "sources_dir": (tsk.input or {}).get("sources_dir") or "",
+        "groups": [
+            {
+                "id": rg.get("id"),
+                # the EFFECTIVE parameter view: prepare_for_run fills
+                # missing run-group params from the backing group's
+                # [groups.run] and the global [global.run] tables, so
+                # all three layers key the signature — two tasks whose
+                # merged params differ must never share a program
+                "params": dict(rg.get("test_params") or {}),
+                "backing_params": dict(
+                    (backing_runs.get(rg.get("id")) or {}).get(
+                        "test_params"
+                    )
+                    or {}
+                ),
+            }
+            for rg in run.get("groups") or []
+        ],
+        "global_params": dict(grun.get("test_params") or {}),
+        "counts": list(padded) if padded is not None else counts,
+        "bucketed": padded is not None,
+        "disable_metrics": bool(glob.get("disable_metrics")),
+        # program gates — defaults mirror SimJaxConfig
+        "tick_ms": float(cfg.get("tick_ms") or 1.0),
+        "chunk": int(cfg.get("chunk") or 128),
+        "max_ticks": int(cfg.get("max_ticks") or 100_000),
+        "transport": str(cfg.get("transport") or "xla").lower(),
+        "telemetry": _truthy(cfg.get("telemetry")),
+        "validate": _truthy(cfg.get("validate")),
+        "pack_max": int(cfg.get("pack_max") or 8),
+    }
+    return hashlib.sha256(
+        json.dumps(sig, sort_keys=True).encode()
+    ).hexdigest()[:32]
+
+
+def claim_pack(engine, tsk) -> list:
+    """Given a just-popped task, claim every queued compatible task (in
+    priority order) up to ``pack_max`` and return the pack — ``[tsk]``
+    alone when packing does not apply. Claimed tasks are marked
+    processing exactly like a pop; the caller owns their lifecycle."""
+    env_layer = engine.env.runners.get("sim:jax") or {}
+    try:
+        sig = pack_signature(tsk, env_layer)
+    except Exception as e:  # noqa: BLE001 — admission must never wedge
+        S().warning("pack admission failed for %s: %s", tsk.id, e)
+        return [tsk]
+    if sig is None:
+        return [tsk]
+    cfg = dict(env_layer)
+    cfg.update((tsk.composition.get("global") or {}).get("run_config") or {})
+    pack_max = max(2, int(cfg.get("pack_max") or 8))
+
+    def match(other) -> bool:
+        try:
+            return pack_signature(other, env_layer) == sig
+        except Exception:  # noqa: BLE001
+            return False
+
+    extras = engine.queue.claim_matching(match, pack_max - 1)
+    if extras:
+        S().info(
+            "packed %d queued run(s) onto task %s (signature %s)",
+            len(extras),
+            tsk.id,
+            sig[:8],
+        )
+    return [tsk] + extras

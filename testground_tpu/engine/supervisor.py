@@ -43,7 +43,12 @@ DEFAULT_TASK_TIMEOUT_SECS = 10 * 60  # supervisor.go:49-52
 
 
 def worker(engine: Engine, idx: int) -> None:
-    """One worker loop (``supervisor.go:47-190``)."""
+    """One worker loop (``supervisor.go:47-190``). A popped task that
+    opted into run packing (``--run-cfg pack=true``) additionally
+    claims every queued compatible run — the whole pack then executes
+    as ONE vmapped device program (engine/pack.py, sim/pack.py)."""
+    from .pack import claim_pack
+
     S().debug("supervisor worker %d started", idx)
     while not engine._stop.is_set():
         try:
@@ -52,7 +57,11 @@ def worker(engine: Engine, idx: int) -> None:
             engine._queue_kick.wait(timeout=0.2)
             engine._queue_kick.clear()
             continue
-        process_task(engine, tsk)
+        pack = claim_pack(engine, tsk)
+        if len(pack) > 1:
+            process_task_pack(engine, pack)
+        else:
+            process_task(engine, tsk)
 
 
 def process_task(engine: Engine, tsk: Task) -> None:
@@ -104,6 +113,238 @@ def process_task(engine: Engine, tsk: Task) -> None:
         # (supervisor.go:176-183)
         notify_task_finished(engine.env, tsk)
         S().info("task %s finished: %s", tsk.id, tsk.outcome().value)
+
+
+def _prepare_pack_run_input(
+    engine: Engine, tsk: Task, ow: OutputWriter, cancel: threading.Event
+) -> RunInput:
+    """The head of :func:`do_run` for a single-[[runs]] pack member:
+    build missing artifacts (BuildKey-deduped, so N members of one pack
+    build once), prepare + validate, coalesce the runner config, and
+    assemble the RunInput. Raises on any refusal — the member then
+    fails alone and the pack continues without it."""
+    comp = Composition.from_dict(tsk.composition)
+    manifest = TestPlanManifest.from_dict(tsk.input["manifest"])
+    sources_dir = tsk.input.get("sources_dir", "")
+    runner_id = comp.global_.runner
+    if engine.env.runner_is_disabled(runner_id):
+        raise ValueError(f"runner {runner_id} is disabled in .env.toml")
+    if any(not g.run.artifact for g in comp.groups):
+        comp = do_build(engine, comp, manifest, sources_dir, tsk.id, ow, cancel)
+        tsk.composition = comp.to_dict()
+        engine.storage.update_current(tsk)
+    comp = prepare_for_run(comp, manifest)
+    validate_for_run(comp)
+    coalesced = (
+        CoalescedConfig()
+        .append(engine.env.runners.get(runner_id))
+        .append(comp.global_.run_config)
+    )
+    runner = engine.runner_by_name(runner_id)
+    cfg_type = runner.config_type()
+    runner_cfg = (
+        coalesced.coalesce_into(cfg_type)
+        if cfg_type is not None
+        else coalesced.flatten()
+    )
+    run = comp.runs[0]
+    artifacts = {g.id: g.run.artifact for g in comp.groups}
+    groups = []
+    for rg in run.groups:
+        backing = comp.get_group(rg.effective_group_id())
+        groups.append(
+            RunGroup(
+                id=rg.id,
+                instances=rg.calculated_instance_count,
+                artifact_path=artifacts[backing.id],
+                builder=backing.builder or comp.global_.builder,
+                parameters=dict(rg.test_params),
+                profiles=dict(rg.profiles),
+                resources=rg.resources,
+                slo=[dict(s) for s in getattr(rg, "slo", [])],
+            )
+        )
+    return RunInput(
+        run_id=tsk.id,
+        test_plan=comp.global_.plan,
+        test_case=comp.global_.case,
+        total_instances=run.total_instances,
+        groups=groups,
+        runner_config=runner_cfg,
+        disable_metrics=comp.global_.disable_metrics,
+        slo=[
+            dict(s)
+            for s in (
+                comp.global_.run.slo
+                if comp.global_.run is not None
+                else []
+            )
+        ],
+        env=engine.env,
+    )
+
+
+def process_task_pack(engine: Engine, tasks: list[Task]) -> None:
+    """Execute a claimed pack end-to-end: each task keeps its own log
+    file, cancel channel, timeout timer, result, and archive record —
+    only the device program is shared (one vmapped dispatch per chunk,
+    ``sim/pack.py``). A member whose preparation or collection fails
+    fails ALONE; if the pack shrinks below two members the survivors
+    run the ordinary solo path."""
+    timeout = engine.env.daemon.scheduler.task_timeout_min * 60 or (
+        DEFAULT_TASK_TIMEOUT_SECS
+    )
+    ctxs = []
+    for tsk in tasks:
+        cancel = engine.register_cancel(tsk.id)
+        timer = threading.Timer(timeout, cancel.set)
+        timer.daemon = True
+        timer.start()
+        log_file = open(engine.task_log_path(tsk.id), "w")
+        ctxs.append(
+            {
+                "tsk": tsk,
+                "cancel": cancel,
+                "timer": timer,
+                "log": log_file,
+                "ow": OutputWriter(sink=log_file),
+                "result": None,
+                "error": "",
+            }
+        )
+        engine.storage.update_current(tsk)
+        notify_task_started(engine.env, tsk)
+
+    try:
+        # ---------------------------------------------------- preparation
+        ready = []
+        for ctx in ctxs:
+            try:
+                ctx["job"] = _prepare_pack_run_input(
+                    engine, ctx["tsk"], ctx["ow"], ctx["cancel"]
+                )
+                ready.append(ctx)
+            except Exception as e:  # noqa: BLE001 — member-local failure
+                S().error("pack member %s failed: %s", ctx["tsk"].id, e)
+                ctx["ow"].write_error(str(e))
+                ctx["error"] = str(e)
+                ctx["result"] = {"outcome": Outcome.FAILURE.value}
+
+        if len(ready) >= 2:
+            from testground_tpu.sim.executor import (
+                execute_packed_sim_runs,
+            )
+            from testground_tpu.sim.slo import SloBreachError as _Slo
+
+            try:
+                outs = execute_packed_sim_runs(
+                    [c["job"] for c in ready],
+                    [c["ow"] for c in ready],
+                    [c["cancel"] for c in ready],
+                )
+            except Exception as e:  # noqa: BLE001 — whole-pack failure
+                S().error("pack execution failed: %s", e)
+                S().debug("%s", traceback.format_exc())
+                for ctx in ready:
+                    ctx["ow"].write_error(str(e))
+                    ctx["error"] = str(e)
+                    ctx["result"] = {
+                        "outcome": (
+                            Outcome.CANCELED.value
+                            if ctx["cancel"].is_set()
+                            else Outcome.FAILURE.value
+                        )
+                    }
+            else:
+                for ctx, out in zip(ready, outs):
+                    comp_dict = ctx["tsk"].composition
+                    if isinstance(out, _Slo):
+                        bo = out.run_output
+                        rd = (
+                            bo.result.to_dict()
+                            if bo is not None
+                            and hasattr(bo.result, "to_dict")
+                            else {"outcome": Outcome.FAILURE.value}
+                        )
+                        ctx["ow"].write_error(str(out))
+                        ctx["error"] = str(out)
+                        ctx["result"] = {
+                            **rd,
+                            "outcome": Outcome.FAILURE.value,
+                            "composition": comp_dict,
+                        }
+                    elif isinstance(out, Exception):
+                        ctx["ow"].write_error(str(out))
+                        ctx["error"] = str(out)
+                        ctx["result"] = {
+                            "outcome": Outcome.FAILURE.value,
+                            "composition": comp_dict,
+                        }
+                    else:
+                        rd = (
+                            out.result.to_dict()
+                            if hasattr(out.result, "to_dict")
+                            else (out.result or {})
+                        )
+                        ctx["result"] = {
+                            **rd,
+                            "outcome": rd.get(
+                                "outcome", Outcome.FAILURE.value
+                            ),
+                            "composition": comp_dict,
+                        }
+        elif len(ready) == 1:
+            # the pack shrank to one — run the ordinary solo path so
+            # the member loses nothing (full plane support)
+            ctx = ready[0]
+            try:
+                ctx["result"] = do_run(
+                    engine, ctx["tsk"], ctx["ow"], ctx["cancel"]
+                )
+            except Exception as e:  # noqa: BLE001
+                ctx["ow"].write_error(str(e))
+                ctx["error"] = str(e)
+                ctx["result"] = {
+                    "outcome": (
+                        Outcome.CANCELED.value
+                        if ctx["cancel"].is_set()
+                        else Outcome.FAILURE.value
+                    )
+                }
+    finally:
+        for ctx in ctxs:
+            tsk = ctx["tsk"]
+            tsk.result = ctx["result"] or {
+                "outcome": Outcome.FAILURE.value
+            }
+            if ctx["error"]:
+                tsk.error = ctx["error"]
+            else:
+                try:
+                    ctx["ow"].write_result(tsk.result)
+                except Exception:  # noqa: BLE001 — log-only
+                    pass
+            ctx["timer"].cancel()
+            engine.drop_cancel(tsk.id)
+            final = (
+                State.CANCELED
+                if ctx["cancel"].is_set() and tsk.error
+                else State.COMPLETE
+            )
+            tsk.states.append(
+                DatedState(state=final, created=time.time())
+            )
+            engine.storage.archive(tsk)
+            notify_task_finished(engine.env, tsk)
+            try:
+                ctx["log"].close()
+            except OSError:
+                pass
+            S().info(
+                "task %s finished: %s (packed)",
+                tsk.id,
+                tsk.outcome().value,
+            )
 
 
 # ----------------------------------------------------------------- builds

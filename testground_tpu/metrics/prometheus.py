@@ -328,6 +328,59 @@ def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
                 ident,
                 ck.get("write_ms"),
             )
+        # shape bucketing (journal["sim"]["bucket"], PERF.md "Serving:
+        # buckets + packing"): the hit/miss counter pair makes a cold
+        # compile in production observable, not silent — alert when
+        # misses move after a `tg build --buckets` warmup
+        bk = (
+            sim.get("bucket") if isinstance(sim.get("bucket"), dict) else {}
+        )
+        if bk:
+            verdict = bk.get("compile_cache")
+            exp.add(
+                "tg_compile_bucket_hit",
+                "counter",
+                "Bucketed runs whose program was served by the warm "
+                "persistent compile cache (1 per run; sum across tasks).",
+                ident,
+                1 if verdict == "hit" else 0,
+            )
+            exp.add(
+                "tg_compile_bucket_miss",
+                "counter",
+                "Bucketed runs that paid a cold XLA compile — the "
+                "bucket ladder was not warmed for this program "
+                "(tg build --buckets).",
+                ident,
+                1 if verdict == "miss" else 0,
+            )
+            exp.add(
+                "tg_bucket_padded_instances",
+                "gauge",
+                "Canonical padded instance count of the run's bucket "
+                "(live exact count rides tg_task_info/sim totals).",
+                ident,
+                bk.get("padded_instances"),
+            )
+        # run packing (journal["sim"]["pack"]): pack width + member
+        # index so a scraper can see batched tenancy per task
+        pk = sim.get("pack") if isinstance(sim.get("pack"), dict) else {}
+        if pk:
+            exp.add(
+                "tg_pack_width",
+                "gauge",
+                "Vmapped run-axis width of the pack this run executed "
+                "in (dummy padding lanes included).",
+                ident,
+                pk.get("width"),
+            )
+            exp.add(
+                "tg_pack_members",
+                "gauge",
+                "Live member runs batched into this run's pack.",
+                ident,
+                pk.get("members"),
+            )
         # phase attribution plane (journal["sim"]["phases"],
         # docs/OBSERVABILITY.md "Phase attribution"): per-phase cost
         # gauges plus the synthesized residual/total rows — the phase

@@ -294,7 +294,34 @@ def render_perf_summary(payload: dict) -> str:
                 f"{_fmt(sim.get('compile_secs'))}s first dispatch{split}",
             )
         )
+    # ``instances`` in the ledger is the EXACT live count — padded or
+    # packed runs must never render inflated peer·ticks/s (the bucket
+    # size is a separate annotation line below)
     n_inst = _num(perf.get("instances"), 0)
+    bucket = perf.get("bucket") or (sim.get("bucket") or {}).get(
+        "padded_instances"
+    )
+    if _num(bucket) and _num(bucket) != n_inst:
+        cache = (sim.get("bucket") or {}).get("compile_cache")
+        rows.append(
+            (
+                "bucket",
+                f"{_fmt_count(n_inst)} live instance(s) padded to "
+                f"{_fmt_count(bucket)}"
+                + (f" — compile cache {cache}" if cache else ""),
+            )
+        )
+    pack = sim.get("pack") or {}
+    if _num(pack.get("width")):
+        rows.append(
+            (
+                "pack",
+                # journal index is 0-based; humans count from 1
+                f"run {_fmt_count(_num(pack.get('index'), 0) + 1)} of a "
+                f"{_fmt_count(pack.get('members'))}-member pack "
+                f"(vmapped width {_fmt_count(pack.get('width'))})",
+            )
+        )
     if ex:
         rows.append(
             (

@@ -105,6 +105,7 @@ def run_identity(
     fault_specs: dict,
     trace_specs: dict,
     hosts,
+    bucket=None,
 ) -> dict:
     """The resume-compatibility identity of a run: everything that shapes
     the compiled program or the deterministic tick stream. A snapshot
@@ -145,6 +146,11 @@ def run_identity(
         "faults": fault_specs,
         "trace": trace_specs,
         "hosts": list(hosts),
+        # shape bucketing (sim/buckets.py): the padded per-group layout
+        # shapes every carry leaf, so a snapshot taken under one bucket
+        # refuses to seed a program built under another. Keyed only when
+        # bucketed, so pre-bucket snapshots keep resuming unchanged.
+        **({"bucket": list(bucket)} if bucket else {}),
     }
 
 
@@ -221,7 +227,16 @@ def restore_carry(prog, seed: int, manifest: dict, leaves: list):
     reshards the restored carry exactly as it would a fresh one."""
     import jax
 
-    shapes = jax.eval_shape(lambda: prog.init_carry(seed))
+    if getattr(prog, "live_counts", None) is not None:
+        # bucketed programs init against runtime live counts (shapes
+        # depend only on the padded layout the identity validated)
+        shapes = jax.eval_shape(
+            lambda: prog.init_carry(
+                seed, np.asarray(prog.live_counts, np.int32)
+            )
+        )
+    else:
+        shapes = jax.eval_shape(lambda: prog.init_carry(seed))
     ref_leaves, treedef = jax.tree_util.tree_flatten(shapes)
     metas = manifest.get("leaves") or []
     if len(leaves) != len(ref_leaves) or len(metas) != len(ref_leaves):

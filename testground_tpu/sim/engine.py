@@ -222,6 +222,13 @@ class SimCarry:
     # never wrap however long the run (same overflow discipline as the
     # limb-pair totals, without the limb arithmetic per bin).
     lat_hist: jax.Array | None = None
+    # --- shape bucketing (sim/buckets.py; None when the run is not
+    # bucketed): [G] int32 EXACT per-group instance counts, carried as
+    # RUNTIME data so every composition in the same bucket shares one
+    # compiled program — the whole point of the plane. Constant across
+    # ticks (threaded through unchanged); the env virtualization, the
+    # dst translation, and the PRNG derivation all read it.
+    live_counts: jax.Array | None = None
 
 
 def build_groups(run_groups, parameters_of=None) -> tuple[GroupSpec, ...]:
@@ -258,10 +265,50 @@ class SimProgram:
         faults=None,
         trace=None,
         transport: str = "xla",
+        live_counts: tuple | None = None,
     ):
         self.tc = testcase
         self.groups = groups
         self.n = sum(g.count for g in groups)
+        # Shape bucketing (sim/buckets.py): ``groups`` is the PADDED
+        # physical layout and ``live_counts`` the exact per-group sizes.
+        # When set, the program becomes RUNTIME-N: exact counts ride the
+        # carry (SimCarry.live_counts), plans see a virtualized env, and
+        # any composition in the same bucket compiles the same HLO — the
+        # persistent compile cache then serves every ``-i`` in the
+        # bucket from one entry. None (default) compiles the identical
+        # pre-bucket program (zero-overhead contract, pinned by tests).
+        if live_counts is not None:
+            live_counts = tuple(int(c) for c in live_counts)
+            if len(live_counts) != len(groups):
+                raise ValueError(
+                    f"live_counts has {len(live_counts)} entries for "
+                    f"{len(groups)} group(s) — the bucket plan must be "
+                    "built from the same group layout"
+                )
+            for lc, g in zip(live_counts, groups):
+                if not (0 < lc <= g.count):
+                    raise ValueError(
+                        f"group {g.id!r}: live count {lc} outside "
+                        f"(0, {g.count}] — padding only ever adds lanes"
+                    )
+            if trace is not None:
+                raise ValueError(
+                    "the flight recorder is not supported with shape "
+                    "bucketing (trace lanes are virtual-layout selectors "
+                    "baked into the program) — run with bucket=off to "
+                    "trace"
+                )
+            cls0 = type(testcase)
+            if "filter_rules" in cls0.SHAPING and len(groups) > 1:
+                raise ValueError(
+                    "shape bucketing with multiple groups is incompatible "
+                    "with 'filter_rules' shaping: rule ranges address the "
+                    "exact (virtual) instance layout, and multi-group "
+                    "padding shifts physical ids non-contiguously — run "
+                    "with bucket=off or a single group"
+                )
+        self.live_counts = live_counts
         self.tick_ms = float(tick_ms)
         self.mesh = mesh
         self.chunk = int(chunk)
@@ -524,9 +571,177 @@ class SimProgram:
             rejected=wsc(carry.rejected, self._ishard(0)),
         )
 
+    # ------------------------------------------------------------ buckets
+
+    def _virt(self, live_counts):
+        """Traced virtual-layout context under shape bucketing: exact
+        per-group counts ``lc [G]``, virtual offsets ``voff [G+1]``, and
+        the exact total ``ln`` — all derived from the carry's runtime
+        ``live_counts`` leaf so they never bake into the program. None
+        when the run is unbucketed."""
+        if self.live_counts is None or live_counts is None:
+            return None
+        lc = jnp.asarray(live_counts, jnp.int32)
+        voff = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(lc)]
+        )
+        return {"lc": lc, "voff": voff, "ln": voff[-1]}
+
+    def _vgroups(self, virt):
+        """Virtualized GroupSpec tuple: ids/params stay static, counts
+        and offsets become traced scalars — what a bucketed plan's env
+        must see so its behavior matches the exact-size run."""
+        return tuple(
+            GroupSpec(
+                id=g.id,
+                index=g.index,
+                offset=virt["voff"][gi],
+                count=virt["lc"][gi],
+                params=g.params,
+            )
+            for gi, g in enumerate(self.groups)
+        )
+
+    def _derive_keys(self, inst_root, virt):
+        """Per-lane PRNG keys under bucketing, bit-matching the unpadded
+        run's ``jax.random.split(inst_root, live_n)``.
+
+        ``split(key, n)`` lowers to ``threefry_2x32(key, iota(2n))``
+        whose counter pairs are ``(k, k+n)`` (the iota is split in
+        half), so element ``k`` of the flat key data is
+        ``hash(k, k+n).a`` for ``k < n`` and ``hash(k-n, k).b`` past it
+        — reproducible per index with ``n`` as a TRACED value (verified
+        against jax.random.split by tests/test_sim_buckets.py). Real
+        lane v therefore gets exactly the key the exact-size run's
+        split gave it; dead pad lanes draw from a disjoint counter
+        range (their keys are never observable — frozen from tick 0)."""
+        from jax.extend import random as xrandom
+
+        raw = jax.random.key_data(inst_root)
+        impl = jax.random.key_impl(inst_root)
+        ln = virt["ln"].astype(jnp.uint32)
+
+        # physical lane → (virtual id, live?) from the static layout
+        gseq = np.concatenate(
+            [np.arange(g.count, dtype=np.int32) for g in self.groups]
+        )
+        gi_of = np.repeat(
+            np.arange(len(self.groups), dtype=np.int32),
+            [g.count for g in self.groups],
+        )
+        gseq = jnp.asarray(gseq)
+        vid = (virt["voff"][jnp.asarray(gi_of)] + gseq).astype(jnp.uint32)
+        live = gseq < virt["lc"][jnp.asarray(gi_of)]
+        # pad lanes: unique counters past every live pair's range
+        pad_vid = (
+            ln + jnp.arange(self.n, dtype=jnp.uint32)
+        )
+        vid = jnp.where(live, vid, pad_vid)
+        nn = jnp.where(live, ln, jnp.uint32(2 * self.n) + ln)
+
+        def split_at(v, n_):
+            def elem(k):
+                a = xrandom.threefry_2x32(
+                    raw, jnp.stack([k, k + n_]).astype(jnp.uint32)
+                )
+                b = xrandom.threefry_2x32(
+                    raw, jnp.stack([k - n_, k]).astype(jnp.uint32)
+                )
+                return jnp.where(k < n_, a[0], b[1])
+
+            return jnp.stack([elem(2 * v), elem(2 * v + 1)])
+
+        data = jax.vmap(split_at)(vid, nn)
+        return jax.random.wrap_key_data(data, impl=impl)
+
+    def _translate_dst(self, dst, virt):
+        """Plan-emitted VIRTUAL destinations → physical lanes: each
+        virtual segment (every group's live span, then the host lanes)
+        shifts by its own static physical offset; anything outside the
+        virtual address space maps to -1 — the same out-of-range drop
+        the exact-size run applies (net.enqueue bounds mask)."""
+        phys = jnp.full_like(dst, -1)
+        for gi, g in enumerate(self.groups):
+            lo, hi = virt["voff"][gi], virt["voff"][gi + 1]
+            in_seg = (dst >= lo) & (dst < hi)
+            phys = jnp.where(in_seg, dst - lo + g.offset, phys)
+        if self.hosts:
+            ln = virt["ln"]
+            in_h = (dst >= ln) & (dst < ln + len(self.hosts))
+            phys = jnp.where(in_h, dst - ln + self.n, phys)
+        return phys
+
+    def _translate_src(self, src, virt):
+        """Inverse map for delivered provenance: the calendar stores
+        PHYSICAL sender lanes, but a bucketed plan must see the same
+        ``inbox.src`` values the exact-size run serves (plans reply to
+        them), so delivered src ids map back to virtual before the step
+        phase. Cleared slots hold 0 and map to 0 (group 0's first lane
+        in both layouts)."""
+        v = src
+        for gi, g in enumerate(self.groups):
+            in_seg = (src >= g.offset) & (src < g.offset + g.count)
+            v = jnp.where(in_seg, src - g.offset + virt["voff"][gi], v)
+        if self.hosts:
+            in_h = src >= self.n
+            v = jnp.where(in_h, src - self.n + virt["ln"], v)
+        return v
+
+    def _virtual_midx(self, rows: int, virt):
+        """Virtual message indices for the transport's shaping dice
+        (net.enqueue ``midx``): the exact-size run hashes per-feature
+        uniforms from the FLAT message index ``o·n_lanes + src``, so a
+        bucketed run must feed the dice the virtual flat index or every
+        stochastic shaping draw (loss, jitter, duplicate, chaos
+        loss-bursts) diverges from the unpadded run. Pad lanes draw
+        from past the virtual range (their messages are never valid)."""
+        gseq = np.concatenate(
+            [np.arange(g.count, dtype=np.int32) for g in self.groups]
+        )
+        gi_of = np.repeat(
+            np.arange(len(self.groups), dtype=np.int32),
+            [g.count for g in self.groups],
+        )
+        vsrc = (
+            virt["voff"][jnp.asarray(gi_of)] + jnp.asarray(gseq)
+        ).astype(jnp.int32)
+        live = jnp.asarray(gseq) < virt["lc"][jnp.asarray(gi_of)]
+        n_vlanes = virt["ln"] + jnp.int32(len(self.hosts))
+        # dead pad lanes: indices past rows·n_vlanes, per-lane unique
+        vsrc = jnp.where(
+            live, vsrc, n_vlanes + jnp.arange(self.n, dtype=jnp.int32)
+        )
+        if self.hosts:
+            vsrc = jnp.concatenate(
+                [
+                    vsrc,
+                    virt["ln"]
+                    + jnp.arange(len(self.hosts), dtype=jnp.int32),
+                ]
+            )
+        o = jnp.arange(rows, dtype=jnp.int32)[:, None]
+        return (o * n_vlanes + vsrc[None, :]).reshape(-1)
+
     # ---------------------------------------------------------------- init
 
-    def _env_for(self, gspec: GroupSpec, gs, gseq, key) -> SimEnv:
+    def _env_for(self, gspec: GroupSpec, gs, gseq, key, virt=None) -> SimEnv:
+        if virt is not None:
+            vgroups = self._vgroups(virt)
+            return SimEnv(
+                test_plan=self.meta["test_plan"],
+                test_case=self.meta["test_case"],
+                test_run=self.meta["test_run"],
+                # exact values as TRACED scalars — the program stays
+                # identical across every composition in the bucket
+                test_instance_count=virt["ln"],
+                tick_ms=self.tick_ms,
+                groups=vgroups,
+                group=vgroups[gspec.index],
+                global_seq=virt["voff"][gspec.index] + gseq,
+                group_seq=gseq,
+                key=key,
+                hosts=self.hosts,
+            )
         return SimEnv(
             test_plan=self.meta["test_plan"],
             test_case=self.meta["test_case"],
@@ -541,11 +756,20 @@ class SimProgram:
             hosts=self.hosts,
         )
 
-    def init_carry(self, seed: int = 0) -> SimCarry:
+    def init_carry(self, seed: int = 0, live_counts=None) -> SimCarry:
         cls = type(self.tc)
+        if (self.live_counts is not None) != (live_counts is not None):
+            raise ValueError(
+                "init_carry live_counts must be provided exactly when "
+                "the program was built with a bucket plan"
+            )
         root = jax.random.key(seed)
         net_key, inst_root = jax.random.split(root)
-        keys = jax.random.split(inst_root, self.n)
+        virt = self._virt(live_counts)
+        if virt is not None:
+            keys = self._derive_keys(inst_root, virt)
+        else:
+            keys = jax.random.split(inst_root, self.n)
 
         states = []
         for g in self.groups:
@@ -554,7 +778,9 @@ class SimProgram:
             gkeys = keys[g.offset : g.offset + g.count]
 
             def init_one(gs_, gseq_, k_, _g=g):
-                return self.tc.init(self._env_for(_g, gs_, gseq_, k_))
+                return self.tc.init(
+                    self._env_for(_g, gs_, gseq_, k_, virt=virt)
+                )
 
             states.append(jax.vmap(init_one)(gs, gseq, gkeys))
 
@@ -565,9 +791,27 @@ class SimProgram:
             region_of = jnp.concatenate(
                 [region_of, jnp.zeros((len(self.hosts),), jnp.int32)]
             )
+        status0 = jnp.full((self.n_lanes,), RUNNING, jnp.int32)
+        if virt is not None:
+            # dead pad lanes: CRASH from tick 0, frozen by the engine's
+            # terminal-instance masking — the live-lane machinery the
+            # faults plane already exercises (docs/FAULTS.md). They
+            # never step, send, signal, or gate the done check.
+            gseq_all = jnp.concatenate(
+                [
+                    jnp.arange(g.count, dtype=jnp.int32)
+                    for g in self.groups
+                ]
+            )
+            live_mask = gseq_all < virt["lc"][self._group_of]
+            if self.hosts:
+                live_mask = jnp.concatenate(
+                    [live_mask, jnp.ones((len(self.hosts),), bool)]
+                )
+            status0 = jnp.where(live_mask, status0, CRASH)
         carry = SimCarry(
             states=tuple(states),
-            status=jnp.full((self.n_lanes,), RUNNING, jnp.int32),
+            status=status0,
             finished_at=jnp.full((self.n_lanes,), -1, jnp.int32),
             cal=Calendar.empty(
                 cls.MAX_LINK_TICKS,
@@ -626,6 +870,11 @@ class SimProgram:
                 if self.telemetry
                 else None
             ),
+            live_counts=(
+                jnp.asarray(live_counts, jnp.int32)
+                if virt is not None
+                else None
+            ),
         )
         if self.mesh is not None:
             carry = jax.jit(self._constrain)(carry)
@@ -680,6 +929,7 @@ class SimProgram:
 
             def _revive(states):
                 out = []
+                virt = self._virt(carry.live_counts)
                 for gi, g in enumerate(self.groups):
                     gs = jnp.arange(
                         g.offset, g.offset + g.count, dtype=jnp.int32
@@ -687,9 +937,9 @@ class SimProgram:
                     gseq = jnp.arange(g.count, dtype=jnp.int32)
                     gkeys = carry.keys[g.offset : g.offset + g.count]
 
-                    def init_one(gs_, gseq_, k_, _g=g):
+                    def init_one(gs_, gseq_, k_, _g=g, _virt=virt):
                         return self.tc.init(
-                            self._env_for(_g, gs_, gseq_, k_)
+                            self._env_for(_g, gs_, gseq_, k_, virt=_virt)
                         )
 
                     fresh = jax.vmap(init_one)(gs, gseq, gkeys)
@@ -756,6 +1006,7 @@ class SimProgram:
         env_keys = jax.vmap(jax.random.fold_in)(
             carry.keys, jnp.broadcast_to(t, (self.n,))
         )
+        virt = self._virt(carry.live_counts)
 
         outs: list[StepOut] = []
         for gi, g in enumerate(self.groups):
@@ -778,7 +1029,7 @@ class SimProgram:
             )
 
             def step_one(gs_, gseq_, k_, state_, inbox_, syncv_, _g=g):
-                env = self._env_for(_g, gs_, gseq_, k_)
+                env = self._env_for(_g, gs_, gseq_, k_, virt=virt)
                 return self.tc.step(env, state_, inbox_, syncv_, t)
 
             # Outputs come back in plane layout (instance axis LAST via
@@ -977,18 +1228,29 @@ class SimProgram:
             "net_region_valid": net_region_valid,
         }
 
-    def _net_commit_phase(self, cal, link, step: dict, t, k_msg, dead):
+    def _net_commit_phase(self, cal, link, step: dict, t, k_msg, dead, virt=None):
         """Transport commit: enqueue this tick's sends into the calendar
         (the PERF.md hot path — three scatter/gather ops under xla, the
         hand-tiled kernels under pallas) and apply the plan-driven link
         reconfigurations. Returns ``(cal, fb, link, bw_changed_t)`` —
         the last is this tick's count of bandwidth changes under a
-        standing backlog (the HTB bound-approximation counter)."""
+        standing backlog (the HTB bound-approximation counter).
+
+        Under shape bucketing (``virt``), plan-emitted VIRTUAL
+        destinations translate to physical lanes here — one select per
+        group over the already-materialized dst plane — and the
+        transport's shaping dice hash VIRTUAL message indices, so every
+        stochastic draw matches the unpadded run's."""
         cls = type(self.tc)
+        dst = step["dst"]
+        midx = None
+        if virt is not None:
+            dst = self._translate_dst(dst, virt)
+            midx = self._virtual_midx(dst.shape[0], virt)
         cal, fb = enqueue(
             cal,
             link,
-            step["dst"],
+            dst,
             step["payload"],
             step["valid"],
             t,
@@ -1006,6 +1268,7 @@ class SimProgram:
             # send events (compiled out when no trace plan is declared)
             want_fate=self.trace is not None,
             transport=self.transport,
+            dice_idx=midx,
         )
         new_link = apply_net_updates(
             link,
@@ -1100,8 +1363,17 @@ class SimProgram:
                 self._fault_phase(carry, t)
             )
 
+        virt = self._virt(carry.live_counts)
         with jax.named_scope("tg.deliver"):
             cal, inbox_all = deliver(carry.cal, t, transport=self.transport)
+        if virt is not None:
+            # delivered provenance back to virtual ids (plans reply to
+            # inbox.src — the values must match the unpadded run's)
+            inbox_all = Inbox(
+                payload=inbox_all.payload,
+                src=self._translate_src(inbox_all.src, virt),
+                valid=inbox_all.valid,
+            )
         # delivery-latency histogram (telemetry plane): bin this tick's
         # deliveries by (t - enqueue tick) per receiver group. The etick
         # row survives deliver's occupancy clear (only the occupancy
@@ -1130,7 +1402,7 @@ class SimProgram:
         net_key, k_msg = jax.random.split(carry.net_key)
         with jax.named_scope("tg.net_commit"):
             cal, fb, link, bw_changed_t = self._net_commit_phase(
-                cal, carry.link, step, t, k_msg, dead
+                cal, carry.link, step, t, k_msg, dead, virt=virt
             )
         with jax.named_scope("tg.sync"):
             sync = update_sync(
@@ -1194,6 +1466,7 @@ class SimProgram:
                     if self.telemetry
                     else None
                 ),
+                live_counts=carry.live_counts,
             )
         )
         # flight-recorder event rows for this tick ([R, 5] int32; R = 0
@@ -1312,7 +1585,14 @@ class SimProgram:
         capacity precheck (executor) compares a multiple of this against
         device memory — the analog of the reference's cluster capacity
         precheck (``pkg/runner/cluster_k8s.go:958-1012``)."""
-        shapes = jax.eval_shape(lambda: self.init_carry(0))
+        if self.live_counts is not None:
+            shapes = jax.eval_shape(
+                lambda: self.init_carry(
+                    0, np.asarray(self.live_counts, np.int32)
+                )
+            )
+        else:
+            shapes = jax.eval_shape(lambda: self.init_carry(0))
         return sum(
             int(np.prod(l.shape)) * l.dtype.itemsize
             for l in jax.tree.leaves(shapes)
@@ -1450,6 +1730,7 @@ class SimProgram:
         resume_carry=None,
         resume_ticks: int = 0,
         lat_hist_init=None,
+        live_counts=None,
     ) -> dict[str, Any]:
         """Step to completion. Returns host-side results:
 
@@ -1505,8 +1786,17 @@ class SimProgram:
         # init is traceable; jit it so construction is one dispatch rather
         # than hundreds of eager ops (matters on remote-tunneled devices).
         t0 = _time.perf_counter()
+        if self.live_counts is not None and live_counts is None:
+            live_counts = self.live_counts
         if resume_carry is not None:
             carry = resume_carry
+        elif live_counts is not None:
+            # bucketed init: the exact counts AND the seed are RUNTIME
+            # inputs, so every composition in the bucket traces (and
+            # caches) the same init program too
+            carry = jax.jit(lambda s, lc: self.init_carry(s, lc))(
+                np.int32(seed), np.asarray(live_counts, np.int32)
+            )
         else:
             carry = jax.jit(lambda: self.init_carry(seed))()
         fn = self.compiled_chunk()
@@ -1605,7 +1895,7 @@ class SimProgram:
                 break
             if cancel is not None and cancel.is_set():
                 break
-        res = self.results(carry, ticks)
+        res = self.results(carry, ticks, live_counts=live_counts)
         res["compile_secs"] = compile_secs
         if lat_hist_acc is not None:
             # per-receiver-group delivery-latency bin counts (see
@@ -1614,19 +1904,91 @@ class SimProgram:
             res["lat_hist"] = lat_hist_acc.tolist()
         return res
 
-    def results(self, carry: SimCarry, ticks: int) -> dict[str, Any]:
+    def virtual_groups(self, live_counts=None) -> tuple[GroupSpec, ...]:
+        """The EXACT (virtual) group layout of a bucketed program —
+        static python ints, the layout every host-side reporting surface
+        works in. ``live_counts`` overrides the construction-time plan
+        (run packing re-uses one program across members whose exact
+        sizes differ within the bucket)."""
+        live = tuple(
+            int(c) for c in (live_counts or self.live_counts or ())
+        )
+        out, off = [], 0
+        for gi, g in enumerate(self.groups):
+            out.append(
+                GroupSpec(
+                    id=g.id,
+                    index=gi,
+                    offset=off,
+                    count=live[gi],
+                    params=g.params,
+                )
+            )
+            off += live[gi]
+        return tuple(out)
+
+    def results(
+        self, carry: SimCarry, ticks: int, live_counts=None
+    ) -> dict[str, Any]:
         # to_host assembles cross-host shards when the mesh spans multiple
         # processes (a collective — every process must call results());
         # single-process it is a plain device→host copy
         from .distributed import to_host
 
-        return {
+        if self.live_counts is not None:
+            # bucketed run: demux the padded physical arrays back to the
+            # EXACT layout — telemetry/results/callers never see a dead
+            # lane, and the returned groups carry exact counts/offsets
+            live = tuple(
+                int(c) for c in (live_counts or self.live_counts)
+            )
+            vgroups = self.virtual_groups(live)
+            status_h = to_host(carry.status)
+            fin_h = to_host(carry.finished_at)
+            segs = [
+                (g.offset, g.offset + lv)
+                for g, lv in zip(self.groups, live)
+            ]
+            status_x = np.concatenate(
+                [status_h[lo:hi] for lo, hi in segs]
+            )
+            fin_x = np.concatenate([fin_h[lo:hi] for lo, hi in segs])
+            states_x = tuple(
+                jax.tree.map(
+                    lambda leaf, _lv=lv: to_host(leaf)[:_lv],
+                    carry.states[gi],
+                )
+                for gi, (g, lv) in enumerate(zip(self.groups, live))
+            )
+            base = self._results_tail(carry, ticks)
+            base.update(
+                status=status_x,
+                finished_at=fin_x,
+                states=states_x,
+                groups=vgroups,
+            )
+            return base
+
+        base = self._results_tail(carry, ticks)
+        base.update(
             # host lanes are internal plumbing — plan instances only
-            "status": to_host(carry.status)[: self.n],
-            "finished_at": to_host(carry.finished_at)[: self.n],
+            status=to_host(carry.status)[: self.n],
+            finished_at=to_host(carry.finished_at)[: self.n],
+            states=jax.tree.map(to_host, carry.states),
+            groups=self.groups,
+        )
+        return base
+
+    def _results_tail(self, carry: SimCarry, ticks: int) -> dict[str, Any]:
+        """The layout-independent part of :meth:`results`: sync state,
+        flow totals, fault counters, footprint — identical between the
+        exact and the bucket-demuxed paths (dead lanes contribute
+        nothing to any of these by construction)."""
+        from .distributed import to_host
+
+        return {
             "ticks": ticks,
             "tick_ms": self.tick_ms,
-            "states": jax.tree.map(to_host, carry.states),
             "sync_counts": to_host(carry.sync.counts),
             "pub_dropped": to_host(carry.sync.dropped),
             "latency_clamped": int(to_host(carry.clamped)),
@@ -1652,5 +2014,4 @@ class SimProgram:
             # device-resident carry footprint (eval_shape — no compile):
             # always reported so memory is part of every run's record
             "carry_bytes": self.estimate_carry_bytes(),
-            "groups": self.groups,
         }

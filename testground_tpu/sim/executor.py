@@ -30,6 +30,7 @@ from testground_tpu.runners.result import Result
 
 __all__ = [
     "SimJaxConfig",
+    "execute_packed_sim_runs",
     "execute_sim_run",
     "load_sim_testcases",
     "run_sim_worker",
@@ -122,6 +123,38 @@ class SimJaxConfig:
     # option like telemetry: broadcast to cohort followers and keyed
     # into the precompile BuildKey. CLI: --run-cfg transport=pallas
     transport: str = "xla"
+    # shape bucketing (PERF.md "Serving: buckets + packing",
+    # sim/buckets.py): "off" (default — exact shapes, the pre-bucket
+    # program unchanged), "auto" (pad every group's instance count up to
+    # the canonical ladder, dead lanes masked out, exact counts as
+    # runtime data), or an explicit "<n>" (pad every group to exactly
+    # n). Any composition in the same bucket then compiles — and the
+    # persistent cache serves — ONE program, so `tg build --buckets`
+    # makes the cache warm-for-anyone. Results/telemetry stay exact-N,
+    # pinned bit-equal to an unpadded run. Single-device, trace-free,
+    # cohort-free. CLI: --run-cfg bucket=auto
+    bucket: str = "off"
+    # the canonical instance-count ladder, comma-separated (default
+    # sim/buckets.DEFAULT_LADDER: 4096,32768,131072,1048576); a group
+    # above the top rung runs unbucketed with a warning
+    bucket_ladder: str = ""
+    # run packing (PERF.md "Serving: buckets + packing", sim/pack.py):
+    # opt this run into the engine's pack admission — queued compatible
+    # small runs (same plan/case/bucket/program gates, seeds free) batch
+    # into ONE vmapped device program with a leading run axis and one
+    # dispatch per chunk, instead of serializing through the queue.
+    # Per-run results/telemetry/SLO demux host-side, bit-equal per run
+    # to an isolated run; a run finishing early no-ops its lanes rather
+    # than blocking the pack. CLI: --run-cfg pack=true
+    pack: bool = False
+    # most runs one pack may absorb (the vmapped run-axis width is
+    # padded up to a power of two ≤ this, dead dummy runs masked out)
+    pack_max: int = 8
+    # `tg build --buckets` / `bench.py --build --buckets`: the sim:plan
+    # precompile additionally warms the WHOLE canonical bucket ladder
+    # (per-bucket compile_secs in the build markers) so a daemon serves
+    # any instance count warm. A build-time flag — runs ignore it.
+    build_buckets: bool = False
     # checkpoint/resume plane (docs/CHECKPOINT.md): > 0 snapshots the
     # full run state (device carry + RNG + telemetry/latency/SLO
     # accumulators + manifest) every K chunks into the run's
@@ -242,6 +275,7 @@ def make_sim_program(
     faults,
     trace,
     transport,
+    live_counts,
 ):
     """The ONE construction site for a run's SimProgram. Every
     program-shaping option is a REQUIRED keyword: adding one here forces
@@ -264,6 +298,7 @@ def make_sim_program(
         faults=faults,
         trace=trace,
         transport=transport,
+        live_counts=live_counts,
     )
 
 
@@ -290,6 +325,53 @@ def resolve_transport(cfg, mesh, warn=None) -> str:
             )
         return "xla"
     return transport
+
+
+def resolve_buckets(cfg, counts, mesh=None, warn=None):
+    """The ONE shape-bucketing gate (the ``resolve_transport``
+    discipline): validate the ``bucket``/``bucket_ladder`` knobs and
+    apply the structural bounds. Returns a
+    :class:`~testground_tpu.sim.buckets.BucketPlan` or None (exact
+    shapes). Shared by the executor, the sim:plan precompile, and the
+    engine-side pack admission so all three resolve the same padded
+    layout. ``warn`` is a ``(fmt, *args)`` callable for loud fallbacks."""
+    from .buckets import parse_bucket_mode, parse_ladder, plan_buckets
+
+    mode = parse_bucket_mode(getattr(cfg, "bucket", "off"))
+    if mode == "off":
+        return None
+    if getattr(cfg, "coordinator_address", ""):
+        if warn is not None:
+            warn(
+                "shape bucketing disabled for the cohort config (the "
+                "runtime-N carry input is leader-local state a follower "
+                "cannot reproduce symmetrically)"
+            )
+        return None
+    if mesh is not None:
+        if warn is not None:
+            warn(
+                "shape bucketing supports a single device only for now "
+                "(the padded instance axis would reshard per bucket) — "
+                "running exact shapes on this %d-device mesh",
+                int(mesh.devices.size),
+            )
+        return None
+    ladder = parse_ladder(getattr(cfg, "bucket_ladder", "") or None)
+    plan = plan_buckets(counts, mode, ladder)
+    if plan is None:
+        if warn is not None:
+            warn(
+                "shape bucketing skipped: a group's %s instances exceed "
+                "the bucket coverage (ladder %s) — running exact shapes; "
+                "raise bucket_ladder to bucket runs this large",
+                max(counts),
+                ",".join(str(r) for r in ladder)
+                if mode == "auto"
+                else mode,
+            )
+        return None
+    return plan
 
 
 def fault_specs_of(run_groups, global_faults=None) -> dict:
@@ -617,11 +699,63 @@ def _execute_sim_run(
 
     artifact = job.groups[0].artifact_path
     spans.start("build")
+    # shape bucketing (PERF.md "Serving: buckets + packing"): resolve
+    # the bucket/ladder knobs BEFORE specialization — the padded layout
+    # is what the testcase specializes against (canonical static bounds
+    # per bucket), while every lowering that addresses instances (fault
+    # selectors, SLO scoping, reporting) works in the EXACT virtual
+    # layout and is remapped or demuxed at the edges.
+    bucket_plan = resolve_buckets(
+        cfg,
+        [g.instances for g in job.groups],
+        mesh=(
+            None
+            if getattr(cfg, "coordinator_address", "")
+            else _make_mesh(cfg.shard)
+        ),
+        warn=ow.warn,
+    )
+    if bucket_plan is not None:
+        padded_in = [
+            dataclasses.replace(g, instances=p)
+            for g, p in zip(job.groups, bucket_plan.padded_counts)
+        ]
+    else:
+        padded_in = job.groups
     # per-run static narrowing from resolved params (SimTestcase.specialize)
     testcase, groups = load_and_specialize(
-        artifact, job.test_case, job.groups, cfg.tick_ms
+        artifact, job.test_case, padded_in, cfg.tick_ms
     )
-    n = sum(g.count for g in groups)
+    if (
+        bucket_plan is not None
+        and "filter_rules" in type(testcase).SHAPING
+        and len(groups) > 1
+    ):
+        ow.warn(
+            "sim:jax %s: shape bucketing disabled — 'filter_rules' "
+            "shaping with multiple groups addresses the exact layout "
+            "(rule ranges cannot survive per-group padding); running "
+            "exact shapes",
+            job.run_id,
+        )
+        bucket_plan = None
+        testcase, groups = load_and_specialize(
+            artifact, job.test_case, job.groups, cfg.tick_ms
+        )
+    from .engine import build_groups as _build_groups
+
+    # the EXACT layout every host-side surface reports in; identical to
+    # ``groups`` when unbucketed
+    vgroups = (
+        _build_groups(job.groups) if bucket_plan is not None else groups
+    )
+    n = sum(g.count for g in vgroups)
+    if bucket_plan is not None:
+        ow.infof(
+            "sim:jax %s: shape bucket — %s",
+            job.run_id,
+            bucket_plan.summary(),
+        )
     hosts = _parse_hosts(getattr(cfg, "additional_hosts", None))
 
     # fault-injection plane (docs/FAULTS.md): lower the composition's
@@ -635,7 +769,16 @@ def _execute_sim_run(
     fault_specs = fault_specs_of(
         job.groups, getattr(job, "faults", None)
     )
-    fault_schedule = build_fault_schedule(groups, fault_specs, cfg.tick_ms)
+    # selectors resolve against the EXACT layout the operator declared;
+    # under bucketing the lowered masks then scatter onto the padded
+    # physical axis (dead pad lanes are never selected)
+    fault_schedule = build_fault_schedule(vgroups, fault_specs, cfg.tick_ms)
+    if fault_schedule is not None and bucket_plan is not None:
+        from .faults import remap_schedule
+
+        fault_schedule = remap_schedule(
+            fault_schedule, bucket_plan.index_map(), bucket_plan.padded_n
+        )
     if fault_schedule is not None:
         ow.infof(
             "sim:jax %s: fault schedule armed — %s",
@@ -653,8 +796,16 @@ def _execute_sim_run(
     from .trace import build_trace_plan
 
     trace_specs = trace_specs_of(job.groups, getattr(job, "trace", None))
-    trace_plan = build_trace_plan(groups, trace_specs)
+    trace_plan = build_trace_plan(vgroups, trace_specs)
     if trace_plan is not None and job.disable_metrics:
+        trace_plan = None
+    if trace_plan is not None and bucket_plan is not None:
+        ow.warn(
+            "sim:jax %s: flight recorder disabled under shape bucketing "
+            "(trace lanes are exact-layout selectors baked into the "
+            "program; run with bucket=off to trace)",
+            job.run_id,
+        )
         trace_plan = None
     if trace_plan is not None and getattr(cfg, "coordinator_address", ""):
         ow.warn(
@@ -702,7 +853,7 @@ def _execute_sim_run(
     from .slo import build_slo_plan
 
     slo_specs = slo_specs_of(job.groups, getattr(job, "slo", None))
-    slo_plan = build_slo_plan(groups, slo_specs)
+    slo_plan = build_slo_plan(vgroups, slo_specs)
     if slo_plan is not None and getattr(cfg, "coordinator_address", ""):
         ow.warn(
             "sim:jax %s: SLO assertions disabled for the cohort config "
@@ -814,6 +965,9 @@ def _execute_sim_run(
         faults=fault_schedule,
         trace=trace_plan,
         transport=transport,
+        live_counts=(
+            bucket_plan.live_counts if bucket_plan is not None else None
+        ),
     )
     _precheck_device_memory(prog, cfg, mesh, ow)
     # the device-resident carry footprint is ALWAYS part of the run
@@ -883,6 +1037,14 @@ def _execute_sim_run(
             # shapes nothing, so it must not key the identity either
             trace_specs=trace_specs if trace_plan is not None else {},
             hosts=hosts,
+            # the padded layout shapes every carry leaf — a snapshot
+            # from one bucket must refuse to seed another (keyed only
+            # when bucketed, so pre-bucket snapshots keep resuming)
+            bucket=(
+                bucket_plan.padded_counts
+                if bucket_plan is not None
+                else None
+            ),
         )
         source_run = None
         own_snaps = list_snapshots(run_dir) if run_dir is not None else []
@@ -999,9 +1161,12 @@ def _execute_sim_run(
     )
     recorder = _TimeSeriesRecorder(
         testcase,
-        groups,
+        vgroups,
         getattr(cfg, "timeseries_every", 0) if ts_enabled else 0,
         ow,
+        # bucketed carries are padded: mid-run samples slice each
+        # group's live span out of the physical layout first
+        phys_groups=groups if bucket_plan is not None else None,
     )
     # Per-tick telemetry sink: blocks arrive once per chunk from the
     # jitted program (engine telemetry_cb) and stream straight to the
@@ -1059,7 +1224,7 @@ def _execute_sim_run(
         slo_cancel = _SloRunCancel(cancel)
         slo_eval = SloEvaluator(
             slo_plan,
-            groups,
+            vgroups,
             cfg.tick_ms,
             cfg.chunk,
             ident=row_ident,
@@ -1115,6 +1280,11 @@ def _execute_sim_run(
             # journal sim.perf block name the transport, so A/B runs
             # (`tg perf --compare`, bench) are never cross-attributed
             transport=transport,
+            # padded-bucket annotation — peer·ticks/s above divide by
+            # the exact live N, never the bucket size
+            bucket=(
+                bucket_plan.padded_n if bucket_plan is not None else None
+            ),
         )
     # Profile capture — the pprof analog (``pkg/api/composition.go:153-162``
     # → TestCaptureProfiles): any group requesting profiles — or the
@@ -1341,6 +1511,11 @@ def _execute_sim_run(
         )
 
     spans.start("execute")
+    # persistent-cache traffic around the run classifies whether the
+    # (bucketed) program was served warm — the bucket hit/miss signal
+    from testground_tpu.utils.compile_cache import cache_event_counts
+
+    cache_before = cache_event_counts()
     if profile_dir is not None and chunk_profiler is None:
         import jax
 
@@ -1359,6 +1534,49 @@ def _execute_sim_run(
     spans.point("compile", wall_secs=round(res.get("compile_secs", 0.0), 6))
     spans.end("execute", ticks=res["ticks"])
     status = res["status"]
+    # ------------------------------------------------- bucket journal
+    # bucketed results are already demuxed to the EXACT layout
+    # (SimProgram.results) — every reporting surface below works in it
+    bucket_block = None
+    if bucket_plan is not None:
+        groups = res["groups"]
+        hits_delta = (
+            cache_event_counts()["hits"] - cache_before["hits"]
+        )
+        if not compile_cache_on:
+            cache_verdict = "off"
+        elif resume_state is not None:
+            # a resumed run skips the init compile — the delta is not a
+            # clean signal for the chunk program alone
+            cache_verdict = "unknown"
+        else:
+            cache_verdict = "hit" if hits_delta > 0 else "miss"
+        bucket_block = {
+            "instances": bucket_plan.live_n,
+            "padded_instances": bucket_plan.padded_n,
+            "dead_lanes": bucket_plan.padded_n - bucket_plan.live_n,
+            "per_group": {
+                g.id: {"live": lv, "padded": pv}
+                for g, lv, pv in zip(
+                    vgroups,
+                    bucket_plan.live_counts,
+                    bucket_plan.padded_counts,
+                )
+            },
+            # "hit" = the persistent cache served this bucket's program
+            # (zero cold compiles — what `tg build --buckets` warms);
+            # "miss" = a cold compile paid in production, observable
+            # here and via tg_compile_bucket_miss_total instead of
+            # silent
+            "compile_cache": cache_verdict,
+        }
+        ow.infof(
+            "sim:jax %s: bucket %d (live %d) — compile cache %s",
+            job.run_id,
+            bucket_plan.padded_n,
+            bucket_plan.live_n,
+            cache_verdict,
+        )
     ow.infof(
         "sim:jax %s: done — %d ticks in %.2fs wall (%.0f instance·ticks/s)",
         job.run_id,
@@ -1808,6 +2026,10 @@ def _execute_sim_run(
         # checkpoint/resume plane (docs/CHECKPOINT.md) — present when
         # snapshots were armed or the run resumed from one
         **({"checkpoint": checkpoint_block} if checkpoint_block else {}),
+        # shape bucketing (PERF.md "Serving: buckets + packing") —
+        # present when the run was padded to a canonical bucket; all
+        # totals above remain exact-N (dead lanes contribute nothing)
+        **({"bucket": bucket_block} if bucket_block else {}),
     }
     result.update_outcome()
     if cancel.is_set():
@@ -1831,6 +2053,526 @@ def _execute_sim_run(
         result.journal["slo"]["error"] = str(err)
         err.run_output = RunOutput(run_id=job.run_id, result=result)
         raise err
+    spans.end("run", outcome=result.outcome.value, ticks=res["ticks"])
+    return RunOutput(run_id=job.run_id, result=result)
+
+
+def execute_packed_sim_runs(
+    jobs: list[RunInput], ows: list[OutputWriter], cancels: list
+) -> list:
+    """Execute N compatible sim runs as ONE vmapped device program (run
+    packing — PERF.md "Serving: buckets + packing"; the device half is
+    ``sim/pack.py``). Every job keeps its own task identity: outputs
+    dir, telemetry/SLO/perf streams, journal, Result — demuxed from the
+    pack's ``[R, ...]`` blocks each chunk.
+
+    The engine's pack admission (``engine/pack.py``) guarantees the
+    jobs share a program (same plan/case/params/bucket layout/gates, no
+    faults/trace/hosts/cohort/checkpoint); this function asserts the
+    essentials and returns one ``RunOutput`` OR ``Exception`` per job
+    (a member's failure is its own task's failure, never the pack's).
+
+    Supported planes per member: telemetry, latency histograms, SLO
+    assertions (a fail cancels only that member — its lanes freeze via
+    snapshot while the pack continues), performance ledger, metrics,
+    instance outputs. Out of scope in packs (the admission key refuses
+    them): faults, flight recorder, checkpoints, profiles, phases,
+    cohorts, additional hosts.
+    """
+    from testground_tpu.utils.compile_cache import (
+        cache_event_counts,
+        enable_compile_cache,
+    )
+
+    from .engine import build_groups as _build_groups
+    from .pack import PackMember, PackRunner, pack_width
+    from .telemetry import SIM_SERIES_FILE, SpanTracer, SPAN_FILE
+
+    assert len(jobs) == len(ows) == len(cancels) and len(jobs) >= 2
+    job0, cfg = jobs[0], jobs[0].runner_config or SimJaxConfig()
+    compile_cache_on = (
+        enable_compile_cache(
+            job0.env.dirs.home if job0.env is not None else None
+        )
+        is not None
+    )
+    outputs_root = (
+        job0.env.dirs.outputs() if job0.env is not None else None
+    )
+
+    # ---------------------------------------------------- shared program
+    # a pack is single-device BY CONSTRUCTION (the run axis takes the
+    # vmap; make_sim_program below gets mesh=None), so the bucket gate
+    # must see the same single-device world — otherwise a multi-device
+    # host would silently drop bucketing AFTER the admission signature
+    # promised a shared bucketed program, and members of different
+    # sizes would run the wrong program
+    bucket_plan = resolve_buckets(
+        cfg,
+        [g.instances for g in job0.groups],
+        mesh=None,
+        warn=ows[0].warn,
+    )
+    if bucket_plan is None:
+        for j in jobs[1:]:
+            if [g.instances for g in j.groups] != [
+                g.instances for g in job0.groups
+            ]:
+                raise ValueError(
+                    "pack admission bug: unbucketed members with "
+                    "different instance counts share a pack"
+                )
+    if bucket_plan is not None:
+        padded_in = [
+            dataclasses.replace(g, instances=p)
+            for g, p in zip(job0.groups, bucket_plan.padded_counts)
+        ]
+    else:
+        padded_in = job0.groups
+    testcase, groups = load_and_specialize(
+        job0.groups[0].artifact_path,
+        job0.test_case,
+        padded_in,
+        cfg.tick_ms,
+    )
+    transport = resolve_transport(cfg, None, ows[0].warn)
+    telemetry_on = bool(getattr(cfg, "telemetry", False)) and not any(
+        j.disable_metrics for j in jobs
+    )
+    prog = make_sim_program(
+        testcase,
+        groups,
+        test_plan=job0.test_plan,
+        test_case=job0.test_case,
+        test_run=job0.run_id,
+        tick_ms=cfg.tick_ms,
+        mesh=None,
+        chunk=cfg.chunk,
+        hosts=(),
+        validate=bool(getattr(cfg, "validate", False)),
+        telemetry=telemetry_on,
+        faults=None,
+        trace=None,
+        transport=transport,
+        live_counts=(
+            bucket_plan.live_counts if bucket_plan is not None else None
+        ),
+    )
+    width = pack_width(len(jobs), int(getattr(cfg, "pack_max", 8) or 8))
+    runner = PackRunner(prog, width)
+
+    # ------------------------------------------------ per-member plumbing
+    members: list[PackMember] = []
+    contexts: list[dict] = []
+    cache_before = cache_event_counts()
+    for idx, (job, ow, cancel) in enumerate(zip(jobs, ows, cancels)):
+        jcfg = job.runner_config or cfg
+        run_dir = None
+        if outputs_root is not None:
+            run_dir = os.path.join(
+                outputs_root, job.test_plan, job.run_id
+            )
+            os.makedirs(run_dir, exist_ok=True)
+        spans = SpanTracer(
+            os.path.join(run_dir, SPAN_FILE)
+            if run_dir is not None and not job.disable_metrics
+            else None
+        )
+        spans.start(
+            "run",
+            run_id=job.run_id,
+            plan=job.test_plan,
+            case=job.test_case,
+            pack_index=idx,
+        )
+        vgroups = _build_groups(job.groups)
+        member_bucket = (
+            resolve_buckets(jcfg, [g.instances for g in job.groups])
+            if bucket_plan is not None
+            else None
+        )
+        n_live = sum(g.count for g in vgroups)
+        row_ident = {
+            "run": job.run_id,
+            "plan": job.test_plan,
+            "case": job.test_case,
+        }
+        tele_writer = (
+            _SimTelemetryWriter(
+                tuple(g.id for g in vgroups),
+                row_ident,
+                os.path.join(run_dir, SIM_SERIES_FILE)
+                if run_dir is not None
+                else None,
+            )
+            if telemetry_on
+            else None
+        )
+        slo_eval = None
+        slo_cancel = None
+        slo_specs = slo_specs_of(job.groups, getattr(job, "slo", None))
+        from .slo import build_slo_plan
+
+        slo_plan = build_slo_plan(vgroups, slo_specs)
+        if slo_plan is not None and not telemetry_on:
+            raise ValueError(
+                f"pack member {job.run_id} declares SLO rules but the "
+                "pack's telemetry plane is off"
+            )
+        if slo_plan is not None:
+            from .slo import SLO_FILE, SloEvaluator
+
+            slo_cancel = _SloRunCancel(cancel)
+            slo_eval = SloEvaluator(
+                slo_plan,
+                vgroups,
+                cfg.tick_ms,
+                cfg.chunk,
+                ident=row_ident,
+                path=(
+                    os.path.join(run_dir, SLO_FILE)
+                    if run_dir is not None
+                    else None
+                ),
+                cancel=slo_cancel.run_local,
+            )
+        perf_ledger = None
+        if bool(getattr(jcfg, "perf", True)) and not job.disable_metrics:
+            from .perf import PERF_FILE, PerfLedger
+
+            perf_ledger = PerfLedger(
+                n_live,
+                cfg.chunk,
+                ident=row_ident,
+                path=(
+                    os.path.join(run_dir, PERF_FILE)
+                    if run_dir is not None
+                    else None
+                ),
+                aot=False,  # one AOT pass per pack member would
+                # serialize compiles the pack exists to amortize
+                bucket=(
+                    bucket_plan.padded_n
+                    if bucket_plan is not None
+                    else None
+                ),
+                transport=transport,
+            )
+
+        def _tele_cb(block, _w=tele_writer, _s=slo_eval):
+            rows = _w.on_block(block) if _w is not None else []
+            if _s is not None:
+                _s.on_rows(rows)
+
+        def _on_chunk(ticks, _s=slo_eval, _ow=ow, _r=job.run_id):
+            # the run health plane judges AFTER this chunk's rows and
+            # latency delta landed (telemetry_cb/lat_hist_cb run first
+            # in PackRunner) — the solo executor's on_chunk contract
+            if _s is None:
+                return
+            for breach in _s.evaluate():
+                _ow.warn(
+                    "sim:jax %s: SLO breach (%s): %s — %s = %g "
+                    "violates %s %g at tick %d%s",
+                    _r,
+                    breach["severity"],
+                    breach["rule"],
+                    breach["metric"],
+                    breach["observed"],
+                    breach["op"],
+                    breach["threshold"],
+                    breach["tick"],
+                    " — stopping this pack member"
+                    if breach["severity"] == "fail"
+                    else "",
+                )
+
+        def _cancel_check(_c=cancel, _sc=slo_cancel):
+            return _c.is_set() or (
+                _sc is not None and _sc.run_local.is_set()
+            )
+
+        ow.infof(
+            "sim:jax %s: packed run %d/%d (width %d) — plan=%s case=%s "
+            "instances=%d%s",
+            job.run_id,
+            idx + 1,
+            len(jobs),
+            width,
+            job.test_plan,
+            job.test_case,
+            n_live,
+            (
+                f", bucket {bucket_plan.padded_n}"
+                if bucket_plan is not None
+                else ""
+            ),
+        )
+        members.append(
+            PackMember(
+                seed=int(getattr(jcfg, "seed", 0) or 0),
+                live_counts=(
+                    member_bucket.live_counts
+                    if member_bucket is not None
+                    else None
+                ),
+                max_ticks=int(getattr(jcfg, "max_ticks", 10_000)),
+                telemetry_cb=_tele_cb if telemetry_on else None,
+                lat_hist_cb=(
+                    slo_eval.on_lat_delta if slo_eval is not None else None
+                ),
+                on_chunk=_on_chunk if slo_eval is not None else None,
+                cancel_check=_cancel_check,
+                perf=perf_ledger,
+            )
+        )
+        contexts.append(
+            {
+                "job": job,
+                "ow": ow,
+                "cancel": cancel,
+                "spans": spans,
+                "vgroups": vgroups,
+                "run_dir": run_dir,
+                "tele_writer": tele_writer,
+                "slo_eval": slo_eval,
+                "perf": perf_ledger,
+                "row_ident": row_ident,
+                "bucket": member_bucket,
+                "n": n_live,
+                "testcase": testcase,
+                "leader_run": job0.run_id,
+            }
+        )
+
+    # ------------------------------------------------------- one dispatch
+    t0 = time.monotonic()
+    for ctx in contexts:
+        ctx["spans"].start("execute")
+    try:
+        pack_results = runner.run(members)
+    except BaseException as e:  # noqa: BLE001 — whole-pack failure
+        for ctx in contexts:
+            ctx["spans"].end("execute", outcome="error")
+            ctx["spans"].end("run", outcome="error", error=str(e)[:200])
+            ctx["spans"].close()
+        raise
+    wall = time.monotonic() - t0
+    hits_delta = cache_event_counts()["hits"] - cache_before["hits"]
+
+    # ------------------------------------------------- per-member collect
+    outs: list = []
+    for idx, (ctx, m, res) in enumerate(
+        zip(contexts, members, pack_results)
+    ):
+        job, ow, spans = ctx["job"], ctx["ow"], ctx["spans"]
+        try:
+            outs.append(
+                _collect_pack_member(
+                    idx,
+                    ctx,
+                    m,
+                    res,
+                    width,
+                    len(jobs),
+                    wall,
+                    telemetry_on,
+                    transport,
+                    bucket_plan,
+                    compile_cache_on,
+                    hits_delta,
+                    outputs_root,
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — member-local failure
+            spans.end("run", outcome="error", error=str(e)[:200])
+            outs.append(e)
+        finally:
+            spans.close()
+    return outs
+
+
+def _collect_pack_member(
+    idx,
+    ctx,
+    member,
+    res,
+    width,
+    n_members,
+    wall,
+    telemetry_on,
+    transport,
+    bucket_plan,
+    compile_cache_on,
+    hits_delta,
+    outputs_root,
+):
+    """Assemble one pack member's RunOutput: outcomes, metrics, journal
+    (sim block + pack/bucket annotations), instance outputs — the
+    reduced-plane analog of ``_execute_sim_run``'s collect phase."""
+    job, ow, spans = ctx["job"], ctx["ow"], ctx["spans"]
+    cancel = ctx["cancel"]
+    groups = res["groups"]
+    status = res["status"]
+    n = ctx["n"]
+    spans.end("execute", ticks=res["ticks"])
+    spans.start("collect")
+    result = Result.for_input(job)
+    result.journal["events"] = {}
+
+    if member.canceled and cancel.is_set():
+        ow.warn("sim:jax %s: pack member canceled", job.run_id)
+
+    metrics: dict = {}
+    collect = getattr(ctx["testcase"], "collect_metrics", None)
+    if callable(collect):
+        for gi, g in enumerate(groups):
+            try:
+                metrics[g.id] = collect(
+                    g,
+                    res["states"][gi],
+                    status[g.offset : g.offset + g.count],
+                )
+            except Exception as e:  # noqa: BLE001 — best-effort
+                ow.warn(
+                    "collect_metrics failed for group %s: %s", g.id, e
+                )
+    if metrics:
+        result.journal["metrics"] = {
+            gid: _aggregate_metrics(m) for gid, m in metrics.items()
+        }
+
+    if ctx["tele_writer"] is not None:
+        ctx["tele_writer"].close()
+        result.journal["telemetry"] = {
+            "rows": ctx["tele_writer"].rows_written,
+            **(
+                {"file": "sim_timeseries.jsonl"}
+                if ctx["tele_writer"].path is not None
+                else {}
+            ),
+            "totals": {
+                "delivered": res["msgs_delivered"],
+                "sent": res["msgs_sent"],
+                "enqueued": res["msgs_enqueued"],
+                "dropped": res["msgs_dropped"],
+                "rejected": res["msgs_rejected"],
+                "in_flight": res["cal_depth"],
+                "fault_dropped": res.get("fault_dropped", 0),
+            },
+        }
+    latency = {}
+    if res.get("lat_hist") is not None:
+        from .telemetry import latency_percentiles
+
+        latency = {
+            g.id: latency_percentiles(
+                res["lat_hist"][gi], res["tick_ms"]
+            )
+            for gi, g in enumerate(groups)
+        }
+    if ctx["slo_eval"] is not None:
+        ctx["slo_eval"].close()
+        result.journal["slo"] = ctx["slo_eval"].journal()
+    perf_summary = None
+    if ctx["perf"] is not None:
+        ctx["perf"].close()
+        perf_summary = ctx["perf"].summary()
+
+    write_outputs = (
+        outputs_root is not None
+        and n <= int(getattr(job.runner_config, "write_outputs_max", 2048)
+                     if job.runner_config is not None else 2048)
+    )
+    for gi, g in enumerate(groups):
+        st = status[g.offset : g.offset + g.count]
+        result.outcomes[g.id].ok = int(np.sum(st == 1))
+        result.journal["events"][g.id] = {
+            name: int(np.sum(st == code))
+            for code, name in _STATUS_NAME.items()
+        }
+        if write_outputs:
+            _write_instance_outputs(
+                outputs_root, job, g, st, res, metrics.get(g.id)
+            )
+
+    bucket_block = None
+    if bucket_plan is not None and ctx["bucket"] is not None:
+        mb = ctx["bucket"]
+        bucket_block = {
+            "instances": mb.live_n,
+            "padded_instances": mb.padded_n,
+            "dead_lanes": mb.padded_n - mb.live_n,
+            "per_group": {
+                g.id: {"live": lv, "padded": pv}
+                for g, lv, pv in zip(
+                    ctx["vgroups"], mb.live_counts, mb.padded_counts
+                )
+            },
+            "compile_cache": (
+                "off"
+                if not compile_cache_on
+                else ("hit" if hits_delta > 0 else "miss")
+            ),
+        }
+    result.journal["sim"] = {
+        "ticks": res["ticks"],
+        "tick_ms": res["tick_ms"],
+        "wall_secs": wall,
+        "processes": 1,
+        "compile_secs": round(res.get("compile_secs", 0.0), 3),
+        "devices": 1,
+        "pub_dropped": res["pub_dropped"].tolist(),
+        "latency_clamped": res.get("latency_clamped", 0),
+        "bw_queue_dropped": res.get("bw_queue_dropped", 0),
+        "bw_rate_change_backlogged": res.get(
+            "bw_rate_change_backlogged", 0
+        ),
+        "msgs_delivered": res.get("msgs_delivered", 0),
+        "msgs_sent": res.get("msgs_sent", 0),
+        "msgs_enqueued": res.get("msgs_enqueued", 0),
+        "msgs_dropped": res.get("msgs_dropped", 0),
+        "msgs_rejected": res.get("msgs_rejected", 0),
+        "msgs_in_flight": res.get("cal_depth", 0),
+        "faults_crashed": res.get("faults_crashed", 0),
+        "faults_restarted": res.get("faults_restarted", 0),
+        "msgs_fault_dropped": res.get("fault_dropped", 0),
+        "carry_bytes": res.get("carry_bytes", 0),
+        # run packing: this member's slot in the shared device program
+        "pack": {
+            "width": width,
+            "members": n_members,
+            "index": idx,
+            "leader_run": ctx["leader_run"],
+        },
+        **({"latency": latency} if latency else {}),
+        **({"perf": perf_summary} if perf_summary else {}),
+        **({"bucket": bucket_block} if bucket_block else {}),
+    }
+    result.update_outcome()
+    if member.canceled and cancel.is_set():
+        result.outcome = Outcome.CANCELED
+    if (
+        ctx["slo_eval"] is not None
+        and ctx["slo_eval"].fatal is not None
+        and not cancel.is_set()
+    ):
+        from .slo import SloBreachError
+
+        result.outcome = Outcome.FAILURE
+        err = SloBreachError(ctx["slo_eval"].fatal)
+        result.journal["slo"]["error"] = str(err)
+        err.run_output = RunOutput(run_id=job.run_id, result=result)
+        spans.end("collect")
+        spans.end("run", outcome=result.outcome.value, ticks=res["ticks"])
+        raise err
+    ow.infof(
+        "sim:jax %s: packed run done — %d ticks, %s",
+        job.run_id,
+        res["ticks"],
+        result.outcome.value,
+    )
+    spans.end("collect")
     spans.end("run", outcome=result.outcome.value, ticks=res["ticks"])
     return RunOutput(run_id=job.run_id, result=result)
 
@@ -1939,6 +2681,10 @@ def sim_worker_loop(
                 groups, spec.get("faults") or {}, spec["tick_ms"]
             ),
             trace=_build_trace(groups, spec.get("trace") or {}),
+            # cohorts run bucket-free (the resolve_buckets gate): the
+            # runtime-N carry input is leader-local and a padded layout
+            # would have to ride the broadcast symmetrically
+            live_counts=None,
         )
         res = prog.run(
             seed=spec["seed"],
@@ -2374,9 +3120,22 @@ class _TimeSeriesRecorder:
     ``collect_metrics`` on the in-flight state and reduces it per group;
     rows land in ``timeseries.jsonl`` under the run's outputs dir."""
 
-    def __init__(self, testcase, groups, every: int, ow: OutputWriter):
+    def __init__(
+        self,
+        testcase,
+        groups,
+        every: int,
+        ow: OutputWriter,
+        phys_groups=None,
+    ):
         self._collect = getattr(testcase, "collect_metrics", None)
+        # ``groups`` is always the EXACT (virtual) layout samples report
+        # in; ``phys_groups`` is the padded physical layout of a
+        # bucketed carry (sim/buckets.py) — live-run samples then slice
+        # each group's live span before reducing, so dead pad lanes
+        # never enter a metric
         self.groups = groups
+        self._phys = phys_groups
         self.every = int(every or 0)
         self._next_at = self.every
         self._last_tick = -1
@@ -2406,7 +3165,24 @@ class _TimeSeriesRecorder:
         if ticks < self._next_at:
             return
         self._next_at = ticks + self.every
-        self.sample(ticks, carry.states, np.asarray(carry.status))
+        states, status = carry.states, np.asarray(carry.status)
+        if self._phys is not None:
+            import jax
+
+            states = tuple(
+                jax.tree.map(
+                    lambda leaf, _lv=g.count: np.asarray(leaf)[:_lv],
+                    states[gi],
+                )
+                for gi, g in enumerate(self.groups)
+            )
+            status = np.concatenate(
+                [
+                    status[pg.offset : pg.offset + g.count]
+                    for pg, g in zip(self._phys, self.groups)
+                ]
+            )
+        self.sample(ticks, states, status)
 
     def sample(self, tick: int, states, status) -> None:
         import jax

@@ -42,6 +42,7 @@ __all__ = [
     "FaultSchedule",
     "build_fault_schedule",
     "parse_fault",
+    "remap_schedule",
 ]
 
 # Every supported nemesis kind. Point events (crash/restart) fire once at
@@ -338,6 +339,41 @@ class FaultSchedule:
         import jax.numpy as jnp
 
         return (t >= jnp.asarray(t0)) & (t < jnp.asarray(t1))
+
+
+def remap_schedule(
+    sched: FaultSchedule, index_map: np.ndarray, n_phys: int
+) -> FaultSchedule:
+    """Re-target a schedule lowered over the EXACT (virtual) layout onto
+    a padded physical instance axis (shape bucketing, sim/buckets.py):
+    every per-lane mask scatters through ``index_map`` (virtual lane →
+    physical lane), so chaos selectors — declared against the
+    composition the operator wrote — keep hitting the same instances,
+    and dead pad lanes are never selected. Ticks and window parameters
+    are layout-free and pass through unchanged."""
+    from .buckets import remap_lane_masks
+
+    index_map = np.asarray(index_map, np.int32)
+    if sched.n != index_map.size:
+        raise ValueError(
+            f"fault schedule lowered for {sched.n} instance(s) but the "
+            f"bucket plan maps {index_map.size} — remap must run on the "
+            "virtual-layout schedule"
+        )
+
+    def remap(masks: np.ndarray) -> np.ndarray:
+        return remap_lane_masks(masks, index_map, n_phys)
+
+    return dataclasses.replace(
+        sched,
+        n=n_phys,
+        crash_masks=remap(sched.crash_masks),
+        restart_masks=remap(sched.restart_masks),
+        drop_a=remap(sched.drop_a),
+        drop_b=remap(sched.drop_b),
+        lat_masks=remap(sched.lat_masks),
+        loss_masks=remap(sched.loss_masks),
+    )
 
 
 def _ticks(ms: float, tick_ms: float) -> int:

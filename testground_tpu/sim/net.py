@@ -506,6 +506,7 @@ def enqueue(
     dead: jax.Array | None = None,
     want_fate: bool = False,
     transport: str = "xla",
+    dice_idx: jax.Array | None = None,
 ) -> tuple[Calendar, NetFeedback]:
     """Shape + schedule this tick's sends (inputs in plane layout, message
     m = o·N + src). Returns (cal', NetFeedback).
@@ -631,7 +632,16 @@ def enqueue(
     shr = jax.lax.shift_right_logical
     kd = jax.random.key_data(key).astype(jnp.int32).reshape(-1)
     salt = kd[0] ^ (kd[-1] * np.int32(-1640531527))  # 0x9E3779B9
-    iota_m = jnp.arange(m, dtype=jnp.int32)
+    # ``dice_idx`` (shape bucketing, sim/buckets.py): the caller may
+    # substitute VIRTUAL message indices for the hash inputs so a padded
+    # run's shaping draws bit-match the unpadded run's — the flat index
+    # over a padded plane would re-deal every die. Default: the flat
+    # index, the pre-bucket program unchanged.
+    iota_m = (
+        jnp.arange(m, dtype=jnp.int32)
+        if dice_idx is None
+        else dice_idx.reshape(-1).astype(jnp.int32)
+    )
 
     def uhash_id(fid: int):
         # fid·0x9E3779B9 folded on the host (int32 wraparound). Feature

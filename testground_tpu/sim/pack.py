@@ -1,0 +1,302 @@
+"""Run packing: many small concurrent compositions batched into ONE
+device program via a leading run axis (PERF.md "Serving: buckets +
+packing", ROADMAP item 2).
+
+The engine queue used to serialize small runs one dispatch at a time
+while the device sat mostly idle — a 8-instance composition costs the
+same dispatch latency as a 100k one. This module lifts the jitted tick
+over a RUN axis with ``jax.vmap`` (the same lift the engine already
+uses for instances) so R compatible runs execute as one program and one
+dispatch per chunk:
+
+- **PackRunner** owns the device half: a vmapped ``init_carry`` over
+  per-run ``(seed, live_counts)`` inputs and a vmapped ``_chunk_step``
+  loop. The run-axis width is padded to a power of two (bounded by
+  ``pack_max``) with DEAD dummy runs — status CRASH from tick 0, the
+  same masking the shape-bucket plane uses for lanes — so every pack
+  width in a ladder compiles (and caches) one program, with live
+  membership as runtime data.
+- **Straggler rule**: a run whose instances all terminated no-ops its
+  ticks inside the vmapped ``lax.cond`` (select) instead of blocking
+  the pack; its carry freezes, so its end-of-pack slice IS its
+  result at its own finish tick. A canceled member (operator kill, SLO
+  fail) is snapshotted at the chunk boundary it stopped caring at.
+- **Host demux**: per-run telemetry blocks / latency-histogram deltas /
+  SLO evaluation / perf rows split off the ``[R, ...]`` device blocks
+  each chunk; each member's results are ``SimProgram.results`` over its
+  run slice — bit-equal per run to an isolated run of the same seed
+  (pinned by tests/test_sim_pack.py).
+
+Compatibility (what may share a pack) is decided by the engine-side
+admission key (``engine/pack.py``): same plan/case/params, same padded
+bucket layout, same program gates (transport/telemetry/validate/chunk/
+max_ticks), no faults/trace/hosts/cohort/checkpoint. Seeds and exact
+live sizes are per-run runtime inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+__all__ = [
+    "PACK_MIN_MEMBERS",
+    "PackMember",
+    "PackRunner",
+    "pack_width",
+]
+
+# a pack of one is just a run — the admission layer never builds one
+PACK_MIN_MEMBERS = 2
+
+
+def pack_width(members: int, pack_max: int) -> int:
+    """Canonical vmapped run-axis width: the smallest power of two
+    holding ``members``, clamped to ``pack_max`` — a small ladder of
+    widths, so the packed program compiles (and caches) once per width
+    rather than once per membership count."""
+    members = max(1, int(members))
+    w = 1
+    while w < members:
+        w *= 2
+    return max(PACK_MIN_MEMBERS, min(w, max(int(pack_max), members)))
+
+
+@dataclasses.dataclass
+class PackMember:
+    """One run riding the pack: its runtime inputs and host-side hooks.
+    Callbacks mirror ``SimProgram.run``'s, already demuxed to this
+    member's slice."""
+
+    seed: int
+    live_counts: tuple | None = None  # exact per-group counts (bucketed)
+    max_ticks: int = 10_000
+    telemetry_cb: Callable | None = None
+    lat_hist_cb: Callable | None = None
+    on_chunk: Callable | None = None  # on_chunk(ticks)
+    # polled each chunk: True stops THIS member (operator cancel or an
+    # SLO fail) — the pack continues for everyone else
+    cancel_check: Callable[[], bool] | None = None
+    perf: Any = None  # PerfLedger hook (on_chunk only — no AOT in packs)
+
+    # --- filled by PackRunner.run
+    ticks: int = 0
+    canceled: bool = False
+    done: bool = False
+
+
+class PackRunner:
+    """Vmapped executor for N compatible runs over ONE SimProgram.
+
+    The program must be single-device, trace-free, fault-free (the
+    admission key guarantees it). ``prog.live_counts`` decides whether
+    members carry per-run exact counts (shape bucketing) — when set,
+    every member's ``live_counts`` must be provided."""
+
+    def __init__(self, prog, width: int):
+        self.prog = prog
+        self.width = int(width)
+        if prog.trace is not None or prog.faults is not None:
+            raise ValueError(
+                "run packing requires a trace-free, fault-free program "
+                "(pack admission must refuse these compositions)"
+            )
+        if prog.mesh is not None:
+            raise ValueError(
+                "run packing is single-device (the run axis would "
+                "compete with the instance axis for the mesh)"
+            )
+        self._init_fn = None
+        self._chunk_fn = None
+
+    # ------------------------------------------------------------- device
+
+    def _packed_init(self, seeds, lcs, live_run):
+        """Traced: per-run carries stacked on the leading run axis, dead
+        dummy runs (live_run False) forced all-CRASH so they are done
+        from tick 0 and never contribute a message or a counter."""
+        import jax
+        import jax.numpy as jnp
+
+        from .api import CRASH
+
+        if self.prog.live_counts is not None:
+            carry = jax.vmap(
+                lambda s, lc: self.prog.init_carry(s, lc)
+            )(seeds, lcs)
+        else:
+            carry = jax.vmap(lambda s: self.prog.init_carry(s))(seeds)
+        status = jnp.where(
+            live_run[:, None], carry.status, jnp.int32(CRASH)
+        )
+        return dataclasses.replace(carry, status=status)
+
+    def packed_init(self):
+        if self._init_fn is None:
+            import jax
+
+            self._init_fn = jax.jit(self._packed_init)
+        return self._init_fn
+
+    def packed_chunk(self):
+        if self._chunk_fn is None:
+            import jax
+
+            self._chunk_fn = jax.jit(
+                jax.vmap(self.prog._chunk_step), donate_argnums=0
+            )
+        return self._chunk_fn
+
+    # --------------------------------------------------------------- run
+
+    def run(self, members: list[PackMember]) -> list[dict]:
+        """Step every member to completion (or cancel/budget) in one
+        vmapped loop — ONE dispatch per chunk for the whole pack — and
+        return per-member results dicts (the ``SimProgram.run`` shape).
+        """
+        import jax
+
+        from .engine import _poll_done
+
+        if not (0 < len(members) <= self.width):
+            raise ValueError(
+                f"{len(members)} member(s) for a width-{self.width} pack"
+            )
+        prog = self.prog
+        chunk = prog.chunk
+        n_live = len(members)
+        width = self.width
+
+        t0 = time.perf_counter()
+        seeds = np.asarray(
+            [m.seed for m in members] + [0] * (width - n_live), np.int32
+        )
+        live_run = np.asarray(
+            [True] * n_live + [False] * (width - n_live), bool
+        )
+        if prog.live_counts is not None:
+            for m in members:
+                if m.live_counts is None:
+                    raise ValueError(
+                        "bucketed pack members must carry live_counts"
+                    )
+            fill = members[0].live_counts
+            lcs = np.asarray(
+                [m.live_counts for m in members]
+                + [fill] * (width - n_live),
+                np.int32,
+            )
+        else:
+            lcs = np.zeros((width, 1), np.int32)  # unused traced input
+        carry = self.packed_init()(seeds, lcs, live_run)
+        fn = self.packed_chunk()
+
+        max_ticks = max(m.max_ticks for m in members)
+        ticks = 0
+        compile_secs = 0.0
+        # host-side latency accumulators (python ints — no wrap)
+        lat_acc = None
+        if prog.telemetry:
+            from .telemetry import LATENCY_BINS
+
+            lat_acc = np.zeros(
+                (width, len(prog.groups), LATENCY_BINS), np.int64
+            )
+        active = [True] * n_live  # still watching (not done/canceled)
+        stashes: list[Any] = [None] * n_live
+
+        def _stash(i: int, carry_now) -> None:
+            """Freeze member i's observable state at THIS chunk
+            boundary: its lanes keep ticking on device after a cancel,
+            and results must reflect the boundary it stopped at. The
+            slice materializes NEW device buffers (a gather), so the
+            next dispatch's donation cannot invalidate it; PRNG-key
+            leaves slice typed, never through numpy."""
+            stashes[i] = jax.tree.map(lambda x: x[i], carry_now)
+
+        while ticks < max_ticks and any(active):
+            t_chunk = time.perf_counter()
+            out = fn(carry)
+            carry, done = out[0], out[1]
+            ticks += chunk
+            done_host = np.asarray(done)  # the one device sync per chunk
+            _poll_done(done_host[0])  # same barrier discipline as run()
+            wall = time.perf_counter() - t_chunk
+            if compile_secs == 0.0:
+                compile_secs = time.perf_counter() - t0
+            tele_host = None
+            if prog.telemetry:
+                tele_host = np.asarray(out[2])  # [R, chunk, K]
+                lat_delta = np.asarray(out[3], dtype=np.int64)
+                # accumulate ONLY members still being watched: a
+                # canceled/budget-stashed member's lanes keep ticking
+                # (and delivering) on device, and its journaled
+                # histogram must stop at the boundary its snapshot
+                # froze at — exactly where an isolated run stopped.
+                # (A DONE member's deltas are zero anyway.)
+                for i in range(n_live):
+                    if active[i]:
+                        lat_acc[i] += lat_delta[i]
+            for i, m in enumerate(members):
+                if not active[i]:
+                    continue
+                if m.perf is not None:
+                    m.perf.on_chunk(
+                        ticks // chunk - 1, ticks, chunk, wall
+                    )
+                if prog.telemetry:
+                    if m.telemetry_cb is not None:
+                        m.telemetry_cb(tele_host[i])
+                    if m.lat_hist_cb is not None:
+                        m.lat_hist_cb(lat_delta[i])
+                if m.on_chunk is not None:
+                    m.on_chunk(ticks)
+                if bool(done_host[i]):
+                    # finished: the member's carry freezes from here
+                    # (every lane terminal → the vmapped cond no-ops),
+                    # so its end-of-pack slice is its result — record
+                    # its OWN finish tick and stop demuxing
+                    m.done = True
+                    m.ticks = ticks
+                    active[i] = False
+                elif ticks >= m.max_ticks:
+                    # this member's own budget is spent (another member
+                    # may run longer): snapshot — its lanes would keep
+                    # evolving past the budget an isolated run enforces
+                    m.ticks = ticks
+                    active[i] = False
+                    _stash(i, carry)
+                elif m.cancel_check is not None and m.cancel_check():
+                    m.canceled = True
+                    m.ticks = ticks
+                    active[i] = False
+                    _stash(i, carry)
+
+        for i, m in enumerate(members):
+            if active[i]:  # pack budget exhausted while still running
+                m.ticks = ticks
+                active[i] = False
+
+        results: list[dict] = []
+        for i, m in enumerate(members):
+            src = (
+                stashes[i]
+                if stashes[i] is not None
+                else jax.tree.map(
+                    lambda x, _i=i: x[_i]
+                    if hasattr(x, "__getitem__")
+                    else x,
+                    carry,
+                )
+            )
+            res = prog.results(
+                src, m.ticks, live_counts=m.live_counts
+            )
+            res["compile_secs"] = compile_secs
+            if lat_acc is not None:
+                res["lat_hist"] = lat_acc[i].tolist()
+            results.append(res)
+        return results

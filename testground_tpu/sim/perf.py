@@ -209,8 +209,15 @@ class PerfLedger:
         aot: bool = True,
         warmup: int = 1,
         transport: str = "xla",
+        bucket: int | None = None,
     ):
+        # ``instances`` is the EXACT live count — never the padded
+        # bucket size: every ticks/s → peer·ticks/s normalization below
+        # divides real work done for real tenants, so a padded or
+        # packed run can never report inflated throughput (the bucket
+        # size rides beside it as an annotation).
         self.instances = int(instances)
+        self.bucket = int(bucket) if bucket else None
         self.chunk = int(chunk)
         # per-backend tag (ISSUE 5): every jsonl row and the summary
         # name the transport backend the measured program compiled with,
@@ -265,6 +272,8 @@ class PerfLedger:
                 self.instances * ticks_delta / wall, 3
             ),
         }
+        if self.bucket:
+            row["bucket"] = self.bucket
         flops = self._compile.get("flops")
         if flops:
             # achieved rate of the ESTIMATED per-chunk work — how fast
@@ -317,6 +326,8 @@ class PerfLedger:
             "chunk": self.chunk,
             "transport": self.transport,
         }
+        if self.bucket:
+            out["bucket"] = self.bucket
         if self._compile:
             out["compile"] = dict(self._compile)
         if self._chunk_walls:

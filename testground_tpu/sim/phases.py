@@ -84,14 +84,30 @@ def phase_specs(prog, concrete: bool = False, seed: int = 0) -> list:
     from .net import deliver, latency_histogram
     from .sync_kernel import update_sync
 
+    # bucketed programs (sim/buckets.py) init against runtime live
+    # counts — the phase specs then attribute the runtime-N program the
+    # run actually compiled, translation included
+    if getattr(prog, "live_counts", None) is not None:
+        import numpy as _np
+
+        _lc = _np.asarray(prog.live_counts, _np.int32)
+
+        def _init():
+            return prog.init_carry(seed, _lc)
+
+    else:
+
+        def _init():
+            return prog.init_carry(seed)
+
     if concrete:
-        carry = jax.jit(lambda: prog.init_carry(seed))()
+        carry = jax.jit(_init)()
 
         def derive(f, *args):
             return jax.jit(f)(*args)
 
     else:
-        carry = jax.eval_shape(lambda: prog.init_carry(seed))
+        carry = jax.eval_shape(_init)
         derive = jax.eval_shape
 
     t = carry.t
@@ -123,8 +139,10 @@ def phase_specs(prog, concrete: bool = False, seed: int = 0) -> list:
     def f_faults(carry_, t_):
         return prog._fault_phase(carry_, t_)
 
-    def f_net_commit(cal, link, step, t_, k_msg, dead):
-        return prog._net_commit_phase(cal, link, step, t_, k_msg, dead)
+    def f_net_commit(cal, link, step, t_, k_msg, dead, lc=None):
+        return prog._net_commit_phase(
+            cal, link, step, t_, k_msg, dead, virt=prog._virt(lc)
+        )
 
     def f_telemetry(t_, status, sync, scalars):
         return prog._telemetry_phase(t_, status, sync, *scalars)
@@ -163,7 +181,19 @@ def phase_specs(prog, concrete: bool = False, seed: int = 0) -> list:
         )
     )
     specs.append(
-        ("net_commit", f_net_commit, (carry.cal, carry.link, step, t, k_msg, dead))
+        (
+            "net_commit",
+            f_net_commit,
+            (
+                carry.cal,
+                carry.link,
+                step,
+                t,
+                k_msg,
+                dead,
+                carry.live_counts,
+            ),
+        )
     )
     if prog.telemetry:
         specs.append(
@@ -260,7 +290,13 @@ def build_phase_ledger(
     if not isinstance(whole, dict) or not any(
         num(whole.get(k)) for k in _COST_KEYS
     ):
-        carry = jax.eval_shape(lambda: prog.init_carry(seed))
+        if getattr(prog, "live_counts", None) is not None:
+            import numpy as _np
+
+            _lc = _np.asarray(prog.live_counts, _np.int32)
+            carry = jax.eval_shape(lambda: prog.init_carry(seed, _lc))
+        else:
+            carry = jax.eval_shape(lambda: prog.init_carry(seed))
         try:
             # same donation as the run's chunk program, so a warm
             # persistent cache serves this instead of a second compile

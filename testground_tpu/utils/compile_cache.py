@@ -23,7 +23,11 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["enable_compile_cache", "compile_cache_dir"]
+__all__ = [
+    "cache_event_counts",
+    "compile_cache_dir",
+    "enable_compile_cache",
+]
 
 _DISABLE = ("off", "0", "none", "false")
 
@@ -66,16 +70,61 @@ def enable_compile_cache(home: str | None = None) -> str | None:
         # small programs the test suite compiles
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        if _enabled_dir is not None:
-            # jax binds its cache object to the directory at first use;
-            # a later config change alone is ignored — rebind explicitly
-            # (one daemon can serve runs under different homes)
-            from jax.experimental.compilation_cache import (
-                compilation_cache as _cc,
-            )
+        # jax binds its cache object at the FIRST compile after backend
+        # init; a config change alone is ignored past that point. Any
+        # jit may have run before this call — the runner healthcheck's
+        # mesh probe compiles before the executor enables the cache, so
+        # without an explicit rebind a daemon-served run never touched
+        # the persistent cache at all (observed: zero cache events
+        # through the CLI path while the direct path hit). Rebind
+        # unconditionally — reset_cache() is cheap and next use binds
+        # to the directory just configured.
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
 
-            _cc.reset_cache()
+        _cc.reset_cache()
     except Exception:  # noqa: BLE001 — caching is an optimization, never fatal
         return None
     _enabled_dir = d
+    _register_cache_listener()
     return d
+
+
+# ------------------------------------------------- cache observability
+# jax emits monitoring events for persistent-cache traffic; counting
+# them is the reliable hit/miss signal (wall-clock ratios are flaky —
+# the compile-cache tests learned this in PR 3). The executor reads the
+# deltas around a run's first dispatch to journal whether a bucketed
+# program was served warm (``sim.bucket.compile_cache``) — the signal
+# behind the tg_compile_bucket_hit/_miss Prometheus counters.
+
+_cache_events = {"hits": 0, "misses": 0}
+_listener_on = False
+
+
+def _on_cache_event(event: str, **kw) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        _cache_events["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        _cache_events["misses"] += 1
+
+
+def _register_cache_listener() -> None:
+    global _listener_on
+    if _listener_on:
+        return
+    try:
+        import jax
+
+        jax.monitoring.register_event_listener(_on_cache_event)
+        _listener_on = True
+    except Exception:  # noqa: BLE001 — observability only
+        pass
+
+
+def cache_event_counts() -> dict:
+    """Cumulative persistent-cache hit/miss event counts for this
+    process (zeros until :func:`enable_compile_cache` registered the
+    listener). Read a delta around a compile to classify it."""
+    return dict(_cache_events)

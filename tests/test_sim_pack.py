@@ -1,0 +1,579 @@
+"""Run packing (PERF.md "Serving: buckets + packing"): many compatible
+runs batched into one vmapped device program.
+
+Contracts pinned here:
+
+1. **Per-member bit-equality**: every member of a pack — different
+   seeds, different live sizes within one bucket — produces results,
+   telemetry streams, and latency histograms bit-identical to an
+   isolated run of the same (seed, size).
+2. **Straggler rule**: a member finishing early freezes (the vmapped
+   cond no-ops its lanes) and reports its OWN finish tick while the
+   pack continues; a canceled member snapshots at its boundary.
+3. **Admission**: the pack signature packs only what may share a
+   program (same plan/case/params/counts-or-bucket/gates; seeds free),
+   and refuses faults/trace/multi-runs/non-packed tasks; the queue
+   claim respects priority order and marks tasks processing.
+4. **Engine end-to-end**: queued pack-opted tasks execute as one pack
+   through the real worker loop, each with its own journal carrying
+   ``sim.pack``; an SLO-failing member fails ALONE.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from testground_tpu.api import RunGroup
+from testground_tpu.sim.api import RUNNING, SUCCESS, SimTestcase
+from testground_tpu.sim.buckets import plan_buckets
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import (
+    instantiate_testcase,
+    load_sim_testcases,
+)
+from testground_tpu.sim.pack import (
+    PackMember,
+    PackRunner,
+    pack_width,
+)
+
+LADDER = (32, 64)
+PP_PARAMS = {"latency_ms": "4", "latency2_ms": "2", "tolerance_ms": "15"}
+
+
+def _pingpong(n_padded, live=None, telemetry=True, chunk=8):
+    factory = load_sim_testcases("plans/network")["ping-pong"]
+    groups = build_groups(
+        [RunGroup(id="all", instances=n_padded, parameters=PP_PARAMS)]
+    )
+    tc = instantiate_testcase(factory, groups, tick_ms=1.0)
+    return SimProgram(
+        tc,
+        groups,
+        test_plan="network",
+        test_case="ping-pong",
+        tick_ms=1.0,
+        chunk=chunk,
+        telemetry=telemetry,
+        live_counts=live,
+    )
+
+
+class _SeedClock(SimTestcase):
+    """Finish tick depends on the per-instance PRNG key — members of a
+    pack then finish at genuinely different chunks (the straggler
+    case). Every instance of a run draws the same bound from the run's
+    seed chain, so a run completes as a unit."""
+
+    SHAPING = ("latency",)
+    MSG_WIDTH = 1
+    OUT_MSGS = 1
+    IN_MSGS = 2
+    MAX_LINK_TICKS = 4
+
+    def init(self, env):
+        until = 8 + jax.random.randint(env.key, (), 0, 40)
+        return {"until": until.astype(jnp.int32)}
+
+    def step(self, env, state, inbox, sync, t):
+        return self.out(
+            state,
+            status=jnp.where(t >= state["until"], SUCCESS, RUNNING),
+        )
+
+
+class TestPackWidth:
+    def test_pack_width(self):
+        assert pack_width(2, 8) == 2
+        assert pack_width(3, 8) == 4
+        assert pack_width(5, 8) == 8
+        assert pack_width(8, 8) == 8
+        assert pack_width(1, 8) == 2  # a pack is ≥ 2 by construction
+        assert pack_width(9, 8) == 9  # never below the member count
+
+
+class TestPackBitEquality:
+    def test_bucketed_members_bit_equal_isolated(self):
+        """Three members, three live sizes, three seeds, one width-4
+        program: each bit-equals its isolated run — results, telemetry
+        stream, latency histograms."""
+        sizes, seeds = (6, 8, 12), (0, 7, 42)
+        bps = [plan_buckets([n], "auto", LADDER) for n in sizes]
+        prog = _pingpong(32, live=bps[0].live_counts)
+        runner = PackRunner(prog, pack_width(3, 8))
+        tele = [[] for _ in sizes]
+        members = [
+            PackMember(
+                seed=s,
+                live_counts=bp.live_counts,
+                max_ticks=512,
+                telemetry_cb=(
+                    lambda b, i=i: tele[i].append(np.asarray(b).copy())
+                ),
+            )
+            for i, (s, bp) in enumerate(zip(seeds, bps))
+        ]
+        packed = runner.run(members)
+        for i, (n, s, bp) in enumerate(zip(sizes, seeds, bps)):
+            iso_blocks = []
+            iso = _pingpong(32, live=bp.live_counts).run(
+                seed=s,
+                max_ticks=512,
+                telemetry_cb=lambda b: iso_blocks.append(
+                    np.asarray(b).copy()
+                ),
+            )
+            assert int((np.asarray(iso["status"]) == 1).sum()) == n
+            for key in (
+                "status",
+                "finished_at",
+                "ticks",
+                "sync_counts",
+                "msgs_delivered",
+                "msgs_sent",
+                "msgs_enqueued",
+                "msgs_dropped",
+                "msgs_rejected",
+                "cal_depth",
+            ):
+                assert np.array_equal(
+                    np.asarray(iso[key]), np.asarray(packed[i][key])
+                ), f"member {i} {key} diverged"
+            for a, b in zip(
+                jax.tree.leaves(iso["states"]),
+                jax.tree.leaves(packed[i]["states"]),
+            ):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+            assert np.array_equal(
+                np.concatenate(iso_blocks), np.concatenate(tele[i])
+            ), f"member {i} telemetry stream diverged"
+            assert np.array_equal(
+                np.asarray(iso["lat_hist"]),
+                np.asarray(packed[i]["lat_hist"]),
+            )
+
+    def test_unbucketed_members_bit_equal_isolated(self):
+        prog = _pingpong(8, telemetry=False)
+        runner = PackRunner(prog, 2)
+        packed = runner.run(
+            [
+                PackMember(seed=1, max_ticks=512),
+                PackMember(seed=2, max_ticks=512),
+            ]
+        )
+        for i, seed in enumerate((1, 2)):
+            iso = _pingpong(8, telemetry=False).run(
+                seed=seed, max_ticks=512
+            )
+            assert np.array_equal(
+                np.asarray(iso["status"]), np.asarray(packed[i]["status"])
+            )
+            assert iso["msgs_delivered"] == packed[i]["msgs_delivered"]
+            assert iso["ticks"] == packed[i]["ticks"]
+
+
+class TestStragglersAndCancel:
+    def _clock_prog(self):
+        groups = build_groups(
+            [RunGroup(id="all", instances=6, parameters={})]
+        )
+        return SimProgram(
+            _SeedClock(),
+            groups,
+            test_plan="t",
+            test_case="clock",
+            tick_ms=1.0,
+            chunk=8,
+        )
+
+    def test_early_finisher_freezes_and_reports_own_tick(self):
+        """Members whose seeds finish at different chunks: each reports
+        its OWN finish tick and its isolated results — the early
+        finisher's lanes no-op while the pack runs on."""
+        seeds = (3, 11, 29, 5)
+        prog = self._clock_prog()
+        runner = PackRunner(prog, pack_width(len(seeds), 8))
+        packed = runner.run(
+            [PackMember(seed=s, max_ticks=512) for s in seeds]
+        )
+        ticks = set()
+        for i, s in enumerate(seeds):
+            iso = self._clock_prog().run(seed=s, max_ticks=512)
+            assert iso["ticks"] == packed[i]["ticks"], f"member {i}"
+            assert np.array_equal(
+                np.asarray(iso["finished_at"]),
+                np.asarray(packed[i]["finished_at"]),
+            )
+            ticks.add(iso["ticks"])
+        # the case only exercises stragglers if durations truly differ
+        assert len(ticks) > 1, f"seed clock degenerate: {ticks}"
+
+    def test_member_cancel_snapshots_at_boundary(self):
+        """A canceled member's results freeze at the chunk boundary it
+        stopped at (its device lanes keep ticking); the other member
+        completes bit-equal to an isolated run."""
+        prog = _pingpong(8, telemetry=False)
+        runner = PackRunner(prog, 2)
+        stop = {"flag": False}
+        seen = []
+
+        def on_chunk(ticks):
+            seen.append(ticks)
+            stop["flag"] = True  # cancel after the first chunk
+
+        packed = runner.run(
+            [
+                PackMember(
+                    seed=1,
+                    max_ticks=512,
+                    on_chunk=on_chunk,
+                    cancel_check=lambda: stop["flag"],
+                ),
+                PackMember(seed=2, max_ticks=512),
+            ]
+        )
+        # member 0 stopped at the first boundary: RUNNING instances
+        # remain (ping-pong needs ≥ latency ticks), tick = chunk
+        assert packed[0]["ticks"] == prog.chunk
+        iso = _pingpong(8, telemetry=False).run(seed=2, max_ticks=512)
+        assert np.array_equal(
+            np.asarray(iso["status"]), np.asarray(packed[1]["status"])
+        )
+        assert iso["ticks"] == packed[1]["ticks"]
+
+    def test_runner_refuses_unpackable_programs(self):
+        from testground_tpu.sim.faults import build_fault_schedule
+
+        groups = build_groups(
+            [RunGroup(id="all", instances=4, parameters={})]
+        )
+        faults = build_fault_schedule(
+            groups, {"all": [{"kind": "crash", "start_ms": 1.0}]}, 1.0
+        )
+        prog = SimProgram(
+            _SeedClock(),
+            groups,
+            test_plan="t",
+            test_case="c",
+            faults=faults,
+        )
+        with pytest.raises(ValueError, match="fault-free"):
+            PackRunner(prog, 2)
+
+
+# ---------------------------------------------------------------- admission
+
+
+def _run_task(run_config, n=5, plan="network", case="ping-pong", typ=None):
+    from testground_tpu.api import (
+        Composition,
+        Global,
+        Group,
+        Instances,
+        generate_default_run,
+    )
+    from testground_tpu.engine.task import (
+        DatedState,
+        State,
+        Task,
+        TaskType,
+    )
+
+    comp = generate_default_run(
+        Composition(
+            global_=Global(
+                plan=plan,
+                case=case,
+                builder="sim:plan",
+                runner="sim:jax",
+                run_config=dict(run_config),
+            ),
+            groups=[Group(id="all", instances=Instances(count=n))],
+        )
+    )
+    return Task(
+        id=f"tk-{time.monotonic_ns()}",
+        type=typ or TaskType.RUN,
+        plan=plan,
+        case=case,
+        runner="sim:jax",
+        composition=comp.to_dict(),
+        input={"manifest": {}, "sources_dir": "/plans/network"},
+        states=[DatedState(state=State.SCHEDULED, created=time.time())],
+    )
+
+
+PACK_CFG = {
+    "pack": True,
+    "bucket": "auto",
+    "bucket_ladder": "32,64",
+    "telemetry": True,
+    "max_ticks": 512,
+}
+
+
+class TestPackSignature:
+    def test_same_bucket_different_sizes_and_seeds_pack(self):
+        from testground_tpu.engine.pack import pack_signature
+
+        a = pack_signature(_run_task({**PACK_CFG, "seed": 1}, n=5))
+        b = pack_signature(_run_task({**PACK_CFG, "seed": 9}, n=29))
+        assert a is not None and a == b
+
+    def test_unbucketed_requires_equal_counts(self):
+        from testground_tpu.engine.pack import pack_signature
+
+        cfg = {k: v for k, v in PACK_CFG.items() if k != "bucket"}
+        assert pack_signature(_run_task(cfg, n=5)) == pack_signature(
+            _run_task(cfg, n=5)
+        )
+        assert pack_signature(_run_task(cfg, n=5)) != pack_signature(
+            _run_task(cfg, n=6)
+        )
+
+    def test_refusals(self):
+        from testground_tpu.engine.pack import pack_signature
+        from testground_tpu.engine.task import TaskType
+
+        # not opted in
+        assert pack_signature(_run_task({"bucket": "auto"})) is None
+        # program-shaping exclusions
+        for bad in (
+            {"coordinator_address": "h:1"},
+            {"resume_from": "t1"},
+            {"checkpoint_chunks": 2},
+            {"profile": True},
+            {"additional_hosts": ["echo"]},
+        ):
+            assert (
+                pack_signature(_run_task({**PACK_CFG, **bad})) is None
+            ), bad
+        # builds never pack
+        assert (
+            pack_signature(
+                _run_task(PACK_CFG, typ=TaskType.BUILD)
+            )
+            is None
+        )
+        # declared faults run solo
+        t = _run_task(PACK_CFG)
+        t.composition["runs"][0]["groups"][0]["faults"] = [
+            {"kind": "crash", "start_ms": 1.0}
+        ]
+        assert pack_signature(t) is None
+        # ...including BACKING-group [groups.run.faults], which only
+        # merge into the run groups at prepare time (pre-preparation
+        # admission must still see them)
+        t = _run_task(PACK_CFG)
+        t.composition["groups"][0]["run"]["faults"] = [
+            {"kind": "crash", "start_ms": 1.0}
+        ]
+        assert pack_signature(t) is None
+        # backing-group run params key the signature too (they merge
+        # into the effective params at prepare time)
+        a = _run_task(PACK_CFG)
+        b = _run_task(PACK_CFG)
+        b.composition["groups"][0]["run"]["test_params"] = {
+            "latency_ms": "9"
+        }
+        assert pack_signature(a) != pack_signature(b)
+        # different gates split packs
+        assert pack_signature(
+            _run_task({**PACK_CFG, "transport": "pallas"})
+        ) != pack_signature(_run_task(PACK_CFG))
+        assert pack_signature(
+            _run_task({**PACK_CFG, "max_ticks": 2048})
+        ) != pack_signature(_run_task(PACK_CFG))
+
+
+class TestQueueClaim:
+    def test_claim_matching_pops_in_priority_order(self, tg_home):
+        from testground_tpu.engine.queue import TaskQueue
+        from testground_tpu.engine.storage import TaskStorage
+        from testground_tpu.engine.task import State
+
+        q = TaskQueue(TaskStorage(":memory:"), 16)
+        lo = _run_task({**PACK_CFG, "seed": 1})
+        hi = _run_task({**PACK_CFG, "seed": 2})
+        hi.priority = 5
+        other = _run_task({**PACK_CFG, "seed": 3}, case="traffic-shaped")
+        for t in (lo, hi, other):
+            q.push(t)
+        from testground_tpu.engine.pack import pack_signature
+
+        sig = pack_signature(lo)
+        claimed = q.claim_matching(
+            lambda t: pack_signature(t) == sig, limit=8
+        )
+        # hi priority first, then lo; 'other' (different case) stays
+        assert [t.id for t in claimed] == [hi.id, lo.id]
+        assert all(
+            t.state().state == State.PROCESSING for t in claimed
+        )
+        assert len(q) == 1
+        assert q.pop().id == other.id
+
+    def test_claim_matching_respects_limit(self, tg_home):
+        from testground_tpu.engine.queue import TaskQueue
+        from testground_tpu.engine.storage import TaskStorage
+
+        q = TaskQueue(TaskStorage(":memory:"), 16)
+        tasks = [_run_task({**PACK_CFG, "seed": i}) for i in range(4)]
+        for t in tasks:
+            q.push(t)
+        claimed = q.claim_matching(lambda t: True, limit=2)
+        assert len(claimed) == 2
+        assert len(q) == 2
+
+
+# ------------------------------------------------------------- engine e2e
+
+
+@pytest.fixture()
+def pack_engine(tg_home):
+    import os
+    import shutil
+
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = EnvConfig.load()
+    plans = env.dirs.plans()
+    os.makedirs(plans, exist_ok=True)
+    if not os.path.isdir(os.path.join(plans, "network")):
+        shutil.copytree(
+            os.path.join(repo, "plans", "network"),
+            os.path.join(plans, "network"),
+        )
+    e = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    # one worker so the claim is deterministic: queue first, start after
+    e.env.daemon.scheduler.workers = 1
+    yield e
+    e.stop()
+
+
+def _queue_pack_run(engine, n, seed, extra_cfg=None, slo=None):
+    import os
+
+    from testground_tpu.api import (
+        Composition,
+        Global,
+        Group,
+        Instances,
+        RunParams,
+        TestPlanManifest,
+        generate_default_run,
+    )
+
+    comp = generate_default_run(
+        Composition(
+            global_=Global(
+                plan="network",
+                case="ping-pong",
+                builder="sim:plan",
+                runner="sim:jax",
+                run_config={
+                    **PACK_CFG,
+                    "seed": seed,
+                    "chunk": 16,
+                    **(extra_cfg or {}),
+                },
+            ),
+            groups=[Group(id="all", instances=Instances(count=n))],
+        )
+    )
+    if slo is not None:
+        comp.global_.run = comp.global_.run or RunParams()
+        comp.global_.run.slo = slo
+    plans = engine.env.dirs.plans()
+    manifest = TestPlanManifest.load_file(
+        os.path.join(plans, "network", "manifest.toml")
+    )
+    return engine.queue_run(
+        comp, manifest, sources_dir=os.path.join(plans, "network")
+    )
+
+
+def _wait_all(engine, tids, budget=240):
+    from testground_tpu.engine import State
+
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        if all(
+            engine.get_task(t).state().state
+            in (State.COMPLETE, State.CANCELED)
+            for t in tids
+        ):
+            return [engine.get_task(t) for t in tids]
+        time.sleep(0.2)
+    raise TimeoutError(f"tasks not done in {budget}s")
+
+
+class TestEnginePackE2E:
+    def test_queued_runs_execute_as_one_pack(self, pack_engine):
+        from testground_tpu.engine.task import Outcome
+
+        sizes = (5, 9, 13)
+        tids = [
+            _queue_pack_run(pack_engine, n, i)
+            for i, n in enumerate(sizes)
+        ]
+        pack_engine.start_workers()
+        tasks = _wait_all(pack_engine, tids)
+        for tsk, n in zip(tasks, sizes):
+            assert tsk.outcome() == Outcome.SUCCESS, tsk.error
+            sim = (tsk.result.get("journal") or {}).get("sim") or {}
+            pack = sim.get("pack") or {}
+            assert pack.get("members") == len(sizes)
+            assert pack.get("width") == 4
+            events = (tsk.result["journal"].get("events") or {}).get(
+                "all"
+            ) or {}
+            assert events.get("success") == n, (n, events)
+            # perf rows normalize by the exact live count
+            perf = sim.get("perf") or {}
+            assert perf.get("instances") == n
+            assert perf.get("bucket") == 32
+
+    def test_slo_fail_member_fails_alone(self, pack_engine):
+        from testground_tpu.engine.task import Outcome
+
+        bad = _queue_pack_run(
+            pack_engine,
+            5,
+            0,
+            slo=[
+                {
+                    "name": "impossible",
+                    "metric": "delivered_per_tick",
+                    "op": ">",
+                    "threshold": 1e9,
+                    "severity": "fail",
+                }
+            ],
+        )
+        good = _queue_pack_run(pack_engine, 9, 1)
+        pack_engine.start_workers()
+        tasks = _wait_all(pack_engine, [bad, good])
+        sims = [
+            ((t.result or {}).get("journal") or {}).get("sim") or {}
+            for t in tasks
+        ]
+        # both rode one pack...
+        assert all((s.get("pack") or {}).get("members") == 2 for s in sims)
+        # ...but only the SLO-failing member failed
+        assert tasks[0].outcome() == Outcome.FAILURE
+        assert "impossible" in (tasks[0].error or "") or (
+            (tasks[0].result.get("journal") or {}).get("slo") or {}
+        ).get("error")
+        assert tasks[1].outcome() == Outcome.SUCCESS, tasks[1].error

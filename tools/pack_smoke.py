@@ -1,0 +1,245 @@
+"""``make pack-smoke``: the multi-tenant serving fast path's end-to-end
+contract (PERF.md "Serving: buckets + packing") on the CPU backend — the
+ROADMAP item-2 soak proof at test scale:
+
+1. **Warm the bucket ladder** — one ``sim:plan`` precompile with
+   ``build_buckets = true`` compiles the canonical bucket programs into
+   the persistent cache (per-bucket compile_secs in the build marker).
+2. **Isolated baseline** — one small bucketed run alone (``pack=false``)
+   for the single-run wall-clock rate.
+3. **The soak** — N=8 concurrent small ``tg run``s at DIFFERENT
+   instance counts, all ``bucket=auto pack=true``, queued against one
+   engine. Asserts:
+   - **zero cold compiles**: every run journals
+     ``sim.bucket.compile_cache == "hit"`` (jax's own cache_hits
+     monitoring events — the `tg_compile_bucket_hit` counter's source);
+   - **packed execution**: at least 7 of the 8 runs share one vmapped
+     device program (``sim.pack.members >= 7`` — one worker claims the
+     queue; the other worker may grab one run solo);
+   - **exact-N results**: every run reports its own instance count's
+     outcomes (all-success at its exact N, not the bucket size);
+   - **amortization**: aggregate peer·ticks/s across the batch
+     > N/2 × the isolated single-run rate (one dispatch per chunk for
+     the whole pack vs one per run).
+
+Exits non-zero with a readable message on any violation. Self-contained:
+temporary $TESTGROUND_HOME, CPU backend — safe in CI (mirrors
+``tools/slo_smoke.py``).
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+LADDER = "32,64"
+RUN_CFG = {
+    "bucket": "auto",
+    "bucket_ladder": LADDER,
+    "telemetry": True,
+    "max_ticks": 2048,
+    "chunk": 32,
+}
+# eight tenants, eight different sizes, one bucket (32)
+TENANT_SIZES = (5, 9, 13, 17, 21, 25, 29, 24)
+
+
+def fail(msg: str) -> None:
+    print(f"pack-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _comp(n: int, seed: int, pack: bool):
+    from testground_tpu.api import (
+        Composition,
+        Global,
+        Group,
+        Instances,
+        generate_default_run,
+    )
+
+    return generate_default_run(
+        Composition(
+            global_=Global(
+                plan="network",
+                case="ping-pong",
+                builder="sim:plan",
+                runner="sim:jax",
+                run_config={**RUN_CFG, "pack": pack, "seed": seed},
+            ),
+            groups=[Group(id="all", instances=Instances(count=n))],
+        )
+    )
+
+
+def _wait(engine, tids, budget=600):
+    from testground_tpu.engine import State
+
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        done = [
+            engine.get_task(t).state().state
+            in (State.COMPLETE, State.CANCELED)
+            for t in tids
+        ]
+        if all(done):
+            return [engine.get_task(t) for t in tids]
+        time.sleep(0.2)
+    fail(f"tasks did not finish within {budget}s")
+
+
+def main() -> int:
+    home = tempfile.mkdtemp(prefix="tg-pack-smoke-")
+    os.environ["TESTGROUND_HOME"] = home
+    os.makedirs(os.path.join(home, "plans"), exist_ok=True)
+    shutil.copytree(
+        os.path.join(REPO_ROOT, "plans", "network"),
+        os.path.join(home, "plans", "network"),
+    )
+    sources = os.path.join(home, "plans", "network")
+
+    from testground_tpu.api import TestPlanManifest
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    manifest = TestPlanManifest.load_file(
+        os.path.join(sources, "manifest.toml")
+    )
+
+    # ---- 1. warm the ladder (tg build --buckets) — pack=true also
+    # warms the vmapped pack-width programs per rung
+    comp = _comp(TENANT_SIZES[0], 0, pack=True)
+    comp.global_.run_config["build_buckets"] = True
+    t0 = time.time()
+    tid = engine.queue_build(comp, manifest, sources_dir=sources)
+    engine.start_workers()
+    (build,) = _wait(engine, [tid])
+    if build.outcome() != Outcome.SUCCESS:
+        fail(f"bucket warmup build failed: {build.error}")
+    print(
+        f"pack-smoke: bucket ladder {LADDER} warmed in "
+        f"{time.time() - t0:.1f}s"
+    )
+
+    # ---- 2. isolated baseline (bucketed, unpacked, alone)
+    iso_n = TENANT_SIZES[0]
+    t0 = time.time()
+    tid = engine.queue_run(
+        _comp(iso_n, 0, pack=False), manifest, sources_dir=sources
+    )
+    (iso,) = _wait(engine, [tid])
+    iso_wall = time.time() - t0
+    if iso.outcome() != Outcome.SUCCESS:
+        fail(f"isolated baseline failed: {iso.error}")
+    iso_sim = (iso.result.get("journal") or {}).get("sim") or {}
+    iso_ticks = iso_sim.get("ticks") or 0
+    iso_rate = iso_n * iso_ticks / max(iso_wall, 1e-9)
+    print(
+        f"pack-smoke: isolated run — {iso_ticks} ticks at n={iso_n} in "
+        f"{iso_wall:.2f}s ({iso_rate:.0f} peer·ticks/s)"
+    )
+
+    # ---- 3. the soak: 8 concurrent tenants, one device. A fresh
+    # single-worker engine, with every tenant queued BEFORE the worker
+    # starts — the claim is then deterministic (one worker pops the
+    # first tenant and claims the other seven in priority order).
+    engine.stop()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.env.daemon.scheduler.workers = 1
+    t0 = time.time()
+    tids = [
+        engine.queue_run(
+            _comp(n, i, pack=True), manifest, sources_dir=sources
+        )
+        for i, n in enumerate(TENANT_SIZES)
+    ]
+    engine.start_workers()
+    tasks = _wait(engine, tids)
+    batch_wall = time.time() - t0
+
+    agg_peer_ticks = 0
+    packed_members = 0
+    journal_rows = []
+    for tsk, n in zip(tasks, TENANT_SIZES):
+        if tsk.outcome() != Outcome.SUCCESS:
+            fail(f"tenant run {tsk.id} (n={n}) failed: {tsk.error}")
+        j = (tsk.result.get("journal") or {})
+        sim = j.get("sim") or {}
+        bucket = sim.get("bucket") or {}
+        pack = sim.get("pack") or {}
+        events = (j.get("events") or {}).get("all") or {}
+        if bucket.get("compile_cache") != "hit":
+            fail(
+                f"tenant {tsk.id} (n={n}) paid a COLD compile after the "
+                f"bucket warmup: sim.bucket={bucket!r}"
+            )
+        if bucket.get("instances") != n:
+            fail(
+                f"tenant {tsk.id}: bucket block reports "
+                f"{bucket.get('instances')} live instances, expected {n}"
+            )
+        if events.get("success") != n:
+            fail(
+                f"tenant {tsk.id} (n={n}): {events!r} — results are not "
+                "exact-N all-success"
+            )
+        packed_members = max(packed_members, int(pack.get("members") or 1))
+        agg_peer_ticks += n * (sim.get("ticks") or 0)
+        journal_rows.append(
+            {
+                "task": tsk.id,
+                "n": n,
+                "ticks": sim.get("ticks"),
+                "pack": pack,
+                "compile_cache": bucket.get("compile_cache"),
+            }
+        )
+    if packed_members != len(TENANT_SIZES):
+        fail(
+            f"expected all {len(TENANT_SIZES)} runs in one pack, saw "
+            f"max members={packed_members} (pack admission regressed?)"
+        )
+    agg_rate = agg_peer_ticks / max(batch_wall, 1e-9)
+    need = (len(TENANT_SIZES) / 2) * iso_rate
+    print(
+        f"pack-smoke: {len(TENANT_SIZES)} tenants in {batch_wall:.2f}s — "
+        f"aggregate {agg_rate:.0f} peer·ticks/s vs isolated "
+        f"{iso_rate:.0f} (x{agg_rate / max(iso_rate, 1e-9):.1f}, "
+        f"max pack members {packed_members})"
+    )
+    if agg_rate <= need:
+        fail(
+            f"aggregate throughput {agg_rate:.0f} ≤ N/2 × isolated "
+            f"({need:.0f}) — packing is not amortizing the dispatch"
+        )
+
+    import json
+
+    for row in journal_rows:
+        print("pack-smoke:", json.dumps(row))
+    engine.stop()
+    shutil.rmtree(home, ignore_errors=True)
+    print("pack-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
