@@ -5,7 +5,8 @@ PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
 	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke \
-	phases-smoke checkpoint-smoke crosshost-smoke pack-smoke
+	phases-smoke checkpoint-smoke crosshost-smoke pack-smoke \
+	sync-fanin-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -116,6 +117,17 @@ crosshost-smoke:
 # N/2 × the isolated single-run throughput in aggregate
 pack-smoke:
 	$(PY) tools/pack_smoke.py
+
+# sync-plane stats contract check (docs/OBSERVABILITY.md "Sync plane"):
+# ~200 concurrent clients against BOTH sync backends must conserve
+# stats exactly (Σ server op counters == client-side op count), answer
+# the wire-versioned sync_stats v2 shape, reconcile a live
+# `tg sync-service --metrics-port` scrape with a `tg sync-stats`
+# snapshot, log the heartbeat line, and keep the always-on
+# instrumentation overhead sane; the full 1k-10k fan-in ramp stays
+# manual (tools/bench_sync_fanin.py, PERF.md "Sync fan-in")
+sync-fanin-smoke:
+	$(PY) tools/sync_fanin_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

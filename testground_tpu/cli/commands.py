@@ -1567,12 +1567,34 @@ def register_sync_service(sub) -> None:
         help="window an abnormally-disconnected instance has to "
         "reconnect before its eviction event is published",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=-1,
+        help="also serve a Prometheus text exposition of the tg_sync_* "
+        "family at http://127.0.0.1:<port>/metrics (0 = ephemeral, "
+        "printed; default off) — docs/OBSERVABILITY.md 'Sync plane'",
+    )
+    p.add_argument(
+        "--stats-interval",
+        type=float,
+        default=60.0,
+        help="log a one-line stats heartbeat (conns/waiters/subs/ops-"
+        "per-sec) to stderr every N seconds so a detached service is "
+        "debuggable from its log alone (0 disables; default 60)",
+    )
     p.set_defaults(func=sync_service_cmd)
 
 
 def sync_service_cmd(args) -> int:
+    import threading
+
     from testground_tpu.sync.boot import boot_sync_service
     from testground_tpu.sync.server import serve_until_signal
+    from testground_tpu.sync.stats import (
+        SyncMetricsExporter,
+        run_stats_heartbeat,
+    )
 
     try:
         svc = boot_sync_service(
@@ -1587,7 +1609,95 @@ def sync_service_cmd(args) -> int:
     except Exception as e:  # noqa: BLE001 — boot failures exit readably
         print(f"sync-service: {e}", file=sys.stderr)
         return 1
-    return serve_until_signal(svc)
+    # the service binds args.host, but the sidecars dial it locally —
+    # a wildcard bind is reachable on loopback
+    local = ("127.0.0.1" if args.host in ("0.0.0.0", "") else args.host,
+             svc.address[1])
+    exporter = None
+    if args.metrics_port >= 0:
+        try:
+            exporter = SyncMetricsExporter(
+                local, port=args.metrics_port
+            ).start()
+            print(
+                f"METRICS http://127.0.0.1:{exporter.port}/metrics",
+                flush=True,
+            )
+        except OSError as e:
+            print(f"sync-service: metrics port: {e}", file=sys.stderr)
+            svc.stop()
+            return 1
+    hb_stop = threading.Event()
+    if args.stats_interval > 0:
+        threading.Thread(
+            target=run_stats_heartbeat,
+            args=(local, args.stats_interval, hb_stop),
+            daemon=True,
+            name="tg-sync-heartbeat",
+        ).start()
+    try:
+        return serve_until_signal(svc)
+    finally:
+        hb_stop.set()
+        if exporter is not None:
+            exporter.stop()
+
+
+def register_sync_stats(sub) -> None:
+    p = sub.add_parser(
+        "sync-stats",
+        help="query a live sync service's stats plane: op counters + "
+        "service-time percentiles, barrier fan-in timelines, pubsub "
+        "depth, connection churn (docs/OBSERVABILITY.md 'Sync plane'); "
+        "works against either backend, v1 or v2",
+    )
+    p.add_argument(
+        "address",
+        help="host:port of a running sync service (`tg sync-service` "
+        "prints it as LISTENING; a local:exec run's service address is "
+        "in the instances' SYNC_SERVICE_HOST/PORT env)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the raw sync_stats reply as JSON (machine-readable; "
+        "the wire payload minus the correlation id)",
+    )
+    p.add_argument(
+        "--timeout",
+        type=float,
+        default=5.0,
+        help="connect + reply timeout in seconds",
+    )
+    p.set_defaults(func=sync_stats_cmd)
+
+
+def sync_stats_cmd(args) -> int:
+    import json
+
+    from testground_tpu.runners.pretty import render_sync_stats
+    from testground_tpu.sync.stats import fetch_sync_stats
+
+    host, _, port = args.address.rpartition(":")
+    if not host or not port.isdigit():
+        print(
+            f"sync-stats: expected <host>:<port>, got {args.address!r}",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        stats = fetch_sync_stats(host, int(port), timeout=args.timeout)
+    except (OSError, ValueError) as e:
+        print(
+            f"sync-stats: sync service at {args.address} unreachable: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    if getattr(args, "json", False):
+        print(json.dumps(stats, indent=2, sort_keys=True))
+    else:
+        print(render_sync_stats(stats))
+    return 0
 
 
 def register_sim_worker(sub) -> None:

@@ -50,6 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     commands.register_terminate(sub)
     commands.register_daemon(sub)
     commands.register_sync_service(sub)
+    commands.register_sync_stats(sub)
     commands.register_sim_worker(sub)
     commands.register_version(sub)
     return p

@@ -15,9 +15,12 @@
 // liveness/identity plane (docs/CROSSHOST.md, spec'd by server.py):
 // ping (pong + boot id), hello (instance identity; abnormal disconnect
 // publishes an eviction event to its events_topic), bye (clean close),
-// sync_stats (conns/waiters/subs occupancy). `token` is an idempotency
-// key: re-sent mutations from a reconnecting client answer with the
-// original seq instead of mutating twice.
+// sync_stats (the wire-versioned stats plane, v2: v1 occupancy fields
+// conns/waiters/subs/boot plus counter-level per-op/conn-churn/barrier-
+// lifecycle/pubsub/dedup blocks — docs/INSTANCE_PROTOCOL.md §4.2; this
+// server stays at counter level, histograms are python-server-only).
+// `token` is an idempotency key: re-sent mutations from a reconnecting
+// client answer with the original seq instead of mutating twice.
 //
 // Design notes:
 // - publish payloads are NEVER parsed: the raw JSON value text is stored
@@ -289,6 +292,87 @@ std::string boot_id;       // changes every server start (restart detector)
 double idle_timeout = 0.0;  // seconds; 0 = sweep disabled
 double evict_grace = 2.0;   // reconnect window before eviction publishes
 
+// ------------------------------------------------ sync-stats plane (v2)
+// Counter-level mirror of the Python server's stats plane
+// (testground_tpu/sync/stats.py; wire parity pinned by
+// tests/test_sync_stats.py). Histograms and barrier-episode timing are
+// python-server-only richness — this server stays at counters, which
+// cost one increment on already-dispatched paths. --stats 0 disables
+// the plane (sync_stats answers the v1 occupancy shape), which exists
+// for the fan-in bench's instrumented-vs-uninstrumented A/B.
+bool stats_on = true;
+double stats_start = 0.0;
+struct SyncStatsCounters {
+  // per-op dispatch counters (counted BEFORE the reply is built, so a
+  // sync_stats reply includes itself — the conservation contract)
+  long signal_entry = 0, counter = 0, barrier = 0, signal_and_wait = 0,
+       publish = 0, subscribe = 0, ping = 0, hello = 0, bye = 0,
+       sync_stats = 0;
+  // connection churn
+  long accepts = 0, closes = 0, evictions = 0;
+  size_t conns_hwm = 0;
+  // barrier lifecycle (per-waiter)
+  long bar_parked = 0, bar_released = 0, bar_timed_out = 0,
+       bar_canceled = 0;
+  // pubsub
+  long published = 0;
+  size_t depth_hwm = 0, subs_open = 0, subs_hwm = 0;
+  // idempotency dedup
+  long dedup_signal = 0, dedup_publish = 0;
+};
+SyncStatsCounters g_stats;
+
+std::string sync_stats_v2_tail() {
+  // the v2 extension blocks appended after the v1 fields; pubsub
+  // topic/entry gauges count NON-EMPTY topics so both backends agree
+  // (this map grows an empty record on subscribe, the Python dict
+  // does not)
+  size_t nonempty = 0, entries = 0;
+  for (const auto& kv : topics)
+    if (!kv.second.entries.empty()) {
+      nonempty++;
+      entries += kv.second.entries.size();
+    }
+  const SyncStatsCounters& g = g_stats;
+  char buf[1536];
+  snprintf(
+      buf, sizeof buf,
+      ", \"v\": 2, \"uptime_secs\": %.3f"
+      ", \"ops\": {\"signal_entry\": %ld, \"counter\": %ld, \"barrier\": "
+      "%ld, \"signal_and_wait\": %ld, \"publish\": %ld, \"subscribe\": "
+      "%ld, \"ping\": %ld, \"hello\": %ld, \"bye\": %ld, \"sync_stats\": "
+      "%ld}"
+      ", \"conn\": {\"accepts\": %ld, \"closes\": %ld, \"evictions\": "
+      "%ld, \"hwm\": %zu}"
+      ", \"barriers\": {\"parked\": %ld, \"released\": %ld, "
+      "\"timed_out\": %ld, \"canceled\": %ld}"
+      ", \"pubsub\": {\"published\": %ld, \"topics\": %zu, \"entries\": "
+      "%zu, \"depth_hwm\": %zu, \"subs_hwm\": %zu}"
+      ", \"dedup\": {\"signal_hits\": %ld, \"publish_hits\": %ld}",
+      now_secs() - stats_start, g.signal_entry, g.counter, g.barrier,
+      g.signal_and_wait, g.publish, g.subscribe, g.ping, g.hello, g.bye,
+      g.sync_stats, g.accepts, g.closes, g.evictions, g.conns_hwm,
+      g.bar_parked, g.bar_released, g.bar_timed_out, g.bar_canceled,
+      g.published, nonempty, entries, g.depth_hwm, g.subs_hwm,
+      g.dedup_signal, g.dedup_publish);
+  return std::string(buf);
+}
+
+void count_op(const std::string& op) {
+  if (!stats_on) return;
+  SyncStatsCounters& g = g_stats;
+  if (op == "signal_entry") g.signal_entry++;
+  else if (op == "counter") g.counter++;
+  else if (op == "barrier") g.barrier++;
+  else if (op == "signal_and_wait") g.signal_and_wait++;
+  else if (op == "publish") g.publish++;
+  else if (op == "subscribe") g.subscribe++;
+  else if (op == "ping") g.ping++;
+  else if (op == "hello") g.hello++;
+  else if (op == "bye") g.bye++;
+  else if (op == "sync_stats") g.sync_stats++;
+}
+
 // live connection count per hello'd identity, plus evictions waiting out
 // their grace window (canceled when the identity reconnects in time)
 std::unordered_map<std::string, int> live_ids;
@@ -348,6 +432,7 @@ void flush_waiters(const std::string& state) {
                  w.id, w.seq);
       else
         snprintf(buf, sizeof buf, "{\"id\": %ld, \"ok\": true}", w.id);
+      if (stats_on) g_stats.bar_released++;
       send_line(w.fd, buf);
       waiters[i] = waiters.back();
       waiters.pop_back();
@@ -378,7 +463,10 @@ void expire_waiters();  // defined below; used for zero-timeout barriers
 long signal_with_token(const std::string& state, const std::string& token) {
   if (!token.empty()) {
     std::string key = state + '\x1f' + token;
-    if (long* prev = sig_tokens.find(key)) return *prev;
+    if (long* prev = sig_tokens.find(key)) {
+      if (stats_on) g_stats.dedup_signal++;
+      return *prev;
+    }
     long seq = ++counters[state];
     sig_tokens.put(key, seq);
     return seq;
@@ -388,7 +476,13 @@ long signal_with_token(const std::string& state, const std::string& token) {
 
 // Append a server-generated entry (eviction events) to a topic.
 void publish_entry(const std::string& topic, const std::string& payload) {
-  topics[topic].entries.push_back(payload);
+  Topic& t = topics[topic];
+  t.entries.push_back(payload);
+  if (stats_on) {
+    g_stats.published++;
+    if (t.entries.size() > g_stats.depth_hwm)
+      g_stats.depth_hwm = t.entries.size();
+  }
   flush_subs(topic);
 }
 
@@ -404,6 +498,7 @@ void handle_line(int fd, const std::string& line) {
     reply_err(fd, -1, "malformed request");
     return;
   }
+  count_op(op);
   char buf[160];
   if (op == "signal_entry") {
     std::string state = json_unescape(find_field(line, "state"));
@@ -441,9 +536,11 @@ void handle_line(int fd, const std::string& line) {
     for (const auto& kv : topics) nsubs += kv.second.subs.size();
     snprintf(buf, sizeof buf,
              "{\"id\": %ld, \"conns\": %zu, \"waiters\": %zu, \"subs\": %zu, "
-             "\"boot\": \"%s\"}",
+             "\"boot\": \"%s\"",
              id, conns.size(), waiters.size(), nsubs, boot_id.c_str());
-    send_line(fd, buf);
+    std::string r(buf);
+    if (stats_on) r += sync_stats_v2_tail();
+    send_line(fd, r + "}");
   } else if (op == "counter") {
     std::string state = json_unescape(find_field(line, "state"));
     snprintf(buf, sizeof buf, "{\"id\": %ld, \"count\": %ld}", id,
@@ -460,6 +557,7 @@ void handle_line(int fd, const std::string& line) {
       seq = signal_with_token(state, json_unescape(find_field(line, "token")));
     Waiter w{fd, id, state, target, seq,
              timeout >= 0 ? now_secs() + timeout : 0.0};
+    if (stats_on) g_stats.bar_parked++;
     waiters.push_back(w);
     flush_waiters(state);  // may satisfy immediately (incl. this one)
     if (timeout == 0.0) expire_waiters();  // unmet zero-timeout fails now
@@ -472,12 +570,18 @@ void handle_line(int fd, const std::string& line) {
     long* prev =
         token.empty() ? nullptr : pub_tokens.find(topic + '\x1f' + token);
     if (prev) {  // replayed publish
+      if (stats_on) g_stats.dedup_publish++;
       seq = *prev;
     } else {
       Topic& t = topics[topic];
       t.entries.push_back(payload);
       seq = (long)t.entries.size();
       if (!token.empty()) pub_tokens.put(topic + '\x1f' + token, seq);
+      if (stats_on) {
+        g_stats.published++;
+        if (t.entries.size() > g_stats.depth_hwm)
+          g_stats.depth_hwm = t.entries.size();
+      }
     }
     snprintf(buf, sizeof buf, "{\"id\": %ld, \"seq\": %ld}", id, seq);
     send_line(fd, buf);
@@ -485,6 +589,8 @@ void handle_line(int fd, const std::string& line) {
   } else if (op == "subscribe") {
     std::string topic = json_unescape(find_field(line, "topic"));
     topics[topic].subs.push_back(Sub{fd, id, 0});
+    if (stats_on && ++g_stats.subs_open > g_stats.subs_hwm)
+      g_stats.subs_hwm = g_stats.subs_open;
     flush_subs(topic);
   } else {
     reply_err(fd, id, "unknown op '" + op + "'");
@@ -524,9 +630,11 @@ void drop_conn(int fd) {
     }
   }
   close(fd);
+  if (stats_on && conns.count(fd)) g_stats.closes++;
   conns.erase(fd);
   for (size_t i = 0; i < waiters.size();) {
     if (waiters[i].fd == fd) {
+      if (stats_on) g_stats.bar_canceled++;  // conn lost mid-barrier
       waiters[i] = waiters.back();
       waiters.pop_back();
     } else {
@@ -537,6 +645,7 @@ void drop_conn(int fd) {
     auto& subs = kv.second.subs;
     for (size_t i = 0; i < subs.size();) {
       if (subs[i].fd == fd) {
+        if (stats_on && g_stats.subs_open > 0) g_stats.subs_open--;
         subs[i] = subs.back();
         subs.pop_back();
       } else {
@@ -575,14 +684,17 @@ void sweep_idle() {
   if (idle_timeout <= 0) return;
   double now = now_secs();
   for (const auto& kv : conns)
-    if (now - kv.second.last_active > idle_timeout)
+    if (now - kv.second.last_active > idle_timeout) {
+      if (stats_on) g_stats.evictions++;
       dead_conns.push_back(kv.first);
+    }
 }
 
 void expire_waiters() {
   double now = now_secs();
   for (size_t i = 0; i < waiters.size();) {
     if (waiters[i].deadline > 0 && now >= waiters[i].deadline) {
+      if (stats_on) g_stats.bar_timed_out++;
       reply_err(waiters[i].fd, waiters[i].id,
                 "barrier timed out: " + waiters[i].state);
       waiters[i] = waiters.back();
@@ -608,7 +720,11 @@ int main(int argc, char** argv) {
       idle_timeout = atof(argv[i + 1]);
     if (strcmp(argv[i], "--evict-grace") == 0)
       evict_grace = atof(argv[i + 1]);
+    // --stats 0 answers sync_stats with the v1 occupancy shape and
+    // skips the counters (the fan-in bench's A/B knob)
+    if (strcmp(argv[i], "--stats") == 0) stats_on = atoi(argv[i + 1]) != 0;
   }
+  stats_start = now_secs();
 
   {  // boot id: distinguishes restarts for reconnecting clients
     struct timespec ts;
@@ -635,7 +751,8 @@ int main(int argc, char** argv) {
     return 1;
   }
   addr.sin_port = htons((uint16_t)port);
-  if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0 || listen(lfd, 512) != 0) {
+  if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0 ||
+      listen(lfd, 1024) != 0) {
     perror("tg-syncsvc: bind/listen");
     return 1;
   }
@@ -691,6 +808,11 @@ int main(int argc, char** argv) {
           c.fd = cfd;
           c.last_active = now_secs();
           conns[cfd] = std::move(c);
+          if (stats_on) {
+            g_stats.accepts++;
+            if (conns.size() > g_stats.conns_hwm)
+              g_stats.conns_hwm = conns.size();
+          }
         }
         continue;
       }

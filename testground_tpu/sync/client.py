@@ -520,7 +520,18 @@ class SyncClient:
         return self._call_one("ping", timeout=timeout).get("boot", "")
 
     def sync_stats(self, timeout: float | None = None) -> dict:
-        """Server occupancy: ``{"conns", "waiters", "subs"}``."""
+        """The server's stats plane (docs/INSTANCE_PROTOCOL.md §4.2).
+
+        Version negotiation is by reply shape, so this client tolerates
+        old servers: a reply carrying ``"v": 2`` has the full stats
+        blocks — per-op counters (``ops``), connection churn (``conn``),
+        barrier lifecycle (``barriers``, incl. armed→release episode
+        timing by fan-in target on the python server), pubsub depth
+        (``pubsub``), idempotency-dedup hits (``dedup``) and per-op
+        service-time histograms (``op_time_us``, python server only) —
+        while a reply without ``v`` is a pre-stats v1 server and only
+        the live-occupancy fields ``{"conns", "waiters", "subs",
+        "boot"}`` (present in both versions) exist."""
         msg = self._call_one("sync_stats", timeout=timeout)
         return {k: v for k, v in msg.items() if k != "id"}
 

@@ -36,6 +36,11 @@ class InMemSyncService:
 
     def __init__(self):
         self._lock = threading.Condition()
+        # optional sync-plane stats sink (sync/stats.py SyncStats): the
+        # TCP server wires it so dedup hits, pubsub depth and barrier
+        # lifecycle are accounted at the layer that owns the semantics;
+        # None (the default) keeps this class dependency- and cost-free
+        self.stats = None
         self._counters: dict[str, int] = {}
         self._topics: dict[str, list[Any]] = {}
         # idempotency tokens: a reconnecting client re-sends unacked
@@ -64,6 +69,8 @@ class InMemSyncService:
             if token is not None:
                 prev = self._sig_tokens.get((state, token))
                 if prev is not None:
+                    if self.stats is not None:
+                        self.stats.dedup_hit("signal")
                     return prev
             self._counters[state] = self._counters.get(state, 0) + 1
             seq = self._counters[state]
@@ -86,6 +93,9 @@ class InMemSyncService:
         cancel: threading.Event | None = None,
     ) -> None:
         """Block until ``counter(state) >= target``."""
+        st = self.stats
+        if st is not None:
+            st.barrier_parked(state, target)
         with self._lock:
             ok = self._lock.wait_for(
                 lambda: self._counters.get(state, 0) >= target
@@ -93,9 +103,15 @@ class InMemSyncService:
                 timeout=timeout,
             )
         if cancel is not None and cancel.is_set():
+            if st is not None:
+                st.barrier_canceled(state, target)
             raise InterruptedError(f"barrier {state} canceled")
         if not ok:
+            if st is not None:
+                st.barrier_timed_out(state, target)
             raise TimeoutError(f"barrier {state} (target {target}) timed out")
+        if st is not None:
+            st.barrier_released(state, target)
 
     def signal_and_wait(
         self,
@@ -116,9 +132,13 @@ class InMemSyncService:
             if token is not None:
                 prev = self._pub_tokens.get((topic, token))
                 if prev is not None:
+                    if self.stats is not None:
+                        self.stats.dedup_hit("publish")
                     return prev
             entries = self._topics.setdefault(topic, [])
             entries.append(payload)
+            if self.stats is not None:
+                self.stats.pubsub_published(len(entries))
             if token is not None:
                 self._remember(
                     self._pub_tokens,
@@ -132,6 +152,15 @@ class InMemSyncService:
     def topic_len(self, topic: str) -> int:
         with self._lock:
             return len(self._topics.get(topic, []))
+
+    def pubsub_gauges(self) -> tuple[int, int]:
+        """Live (non-empty topics, total entries) for ``sync_stats`` v2.
+        Non-empty so both backends agree: the C++ server's topic map
+        grows an empty record on subscribe, this one does not."""
+        with self._lock:
+            nonempty = sum(1 for v in self._topics.values() if v)
+            entries = sum(len(v) for v in self._topics.values())
+        return nonempty, entries
 
     def get_entries(self, topic: str, start: int = 0) -> list[Any]:
         with self._lock:

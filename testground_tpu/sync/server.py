@@ -25,9 +25,13 @@ identity plane (docs/CROSSHOST.md):
   publishes ``{"type": "evicted", ...}`` to ``events_topic`` so runners
   and surviving instances observe the death;
 - ``bye`` — clean-shutdown marker (no eviction event);
-- ``sync_stats`` → ``{"conns", "waiters", "subs"}`` — live occupancy,
-  the observable that pins "a dead client's barrier occupancy is
-  released".
+- ``sync_stats`` → the wire-versioned stats plane (v2, ``"v": 2``):
+  the v1 live-occupancy fields ``{"conns", "waiters", "subs"}`` (the
+  observable that pins "a dead client's barrier occupancy is
+  released") plus per-op counters, service-time log2 histograms,
+  barrier lifecycle timing, pubsub depth/high-water marks, connection
+  churn and idempotency-dedup hit counts (``sync/stats.py``,
+  docs/INSTANCE_PROTOCOL.md §4.2).
 
 ``token`` is an idempotency key: reconnecting clients re-send unacked
 mutations with the original token and the service replies with the
@@ -68,6 +72,7 @@ import uuid
 from testground_tpu.logging_ import S
 
 from .inmem import InMemSyncService
+from .stats import SyncStats
 
 __all__ = ["SyncServiceServer"]
 
@@ -94,15 +99,24 @@ class _Handler(socketserver.StreamRequestHandler):
         self.clean = False
         with self.server.conns_lock:  # type: ignore[attr-defined]
             self.server.conns.add(self)  # type: ignore[attr-defined]
+        st: SyncStats | None = self.server.stats  # type: ignore[attr-defined]
+        if st is not None:
+            st.conn_open()
 
     def finish(self) -> None:
         with self.server.conns_lock:  # type: ignore[attr-defined]
             self.server.conns.discard(self)  # type: ignore[attr-defined]
+        st: SyncStats | None = self.server.stats  # type: ignore[attr-defined]
+        if st is not None:
+            st.conn_close()
         super().finish()
 
     def evict(self) -> None:
         """Server-side eviction (idle sweep / stop): release parked
         waiters and unblock the read loop."""
+        st: SyncStats | None = self.server.stats  # type: ignore[attr-defined]
+        if st is not None:
+            st.conn_evicted()
         self.conn_cancel.set()
         svc: InMemSyncService = self.server.service  # type: ignore[attr-defined]
         with svc._lock:
@@ -116,6 +130,7 @@ class _Handler(socketserver.StreamRequestHandler):
         svc: InMemSyncService = self.server.service  # type: ignore[attr-defined]
         stop: threading.Event = self.server.stop_event  # type: ignore[attr-defined]
         occupancy = self.server.occupancy  # type: ignore[attr-defined]
+        stats: SyncStats | None = self.server.stats  # type: ignore[attr-defined]
         cancel = _AnyEvent(stop, self.conn_cancel)
         write_lock = threading.Lock()
         pending: list[threading.Thread] = []
@@ -129,11 +144,22 @@ class _Handler(socketserver.StreamRequestHandler):
             except (BrokenPipeError, OSError):
                 pass
 
-        def run_async(fn, req_id: int, kind: str) -> None:
+        def run_async(fn, req_id: int, kind: str, op: str) -> None:
+            # service time for parked ops is measured around fn() — for
+            # barrier/signal_and_wait that is the full fan-in wait, the
+            # latency a client actually observes (subscribe streams
+            # until disconnect, so only its registration is timed, at
+            # the dispatch site)
+            timed = stats is not None and op in ("barrier", "signal_and_wait")
             def runner():
+                t0 = time.perf_counter()
                 with occupancy.held(kind):
                     try:
                         fn()
+                        if timed:
+                            stats.time_op(
+                                op, (time.perf_counter() - t0) * 1e6
+                            )
                     except TimeoutError as e:
                         reply({"id": req_id, "error": str(e)})
                     except InterruptedError:
@@ -146,6 +172,10 @@ class _Handler(socketserver.StreamRequestHandler):
             pending.append(t)
 
         boot = self.server.boot_id  # type: ignore[attr-defined]
+        # hot-path hoists: one bound-method lookup per CONNECTION, not
+        # per op (the instrumented-vs-uninstrumented A/B budget is <5%)
+        perf = time.perf_counter
+        op_done = stats.op_done if stats is not None else None
         try:
             for raw in self.rfile:
                 self.last_activity = time.monotonic()
@@ -156,31 +186,29 @@ class _Handler(socketserver.StreamRequestHandler):
                     continue
                 rid = req.get("id", -1)
                 op = req.get("op")
+                t_op = perf()
+                out: dict | None = None
                 try:
                     if op == "signal_entry":
-                        reply(
-                            {
-                                "id": rid,
-                                "seq": svc.signal_entry(
-                                    req["state"], token=req.get("token")
-                                ),
-                            }
-                        )
+                        out = {
+                            "id": rid,
+                            "seq": svc.signal_entry(
+                                req["state"], token=req.get("token")
+                            ),
+                        }
                     elif op == "counter":
-                        reply({"id": rid, "count": svc.counter(req["state"])})
+                        out = {"id": rid, "count": svc.counter(req["state"])}
                     elif op == "publish":
-                        reply(
-                            {
-                                "id": rid,
-                                "seq": svc.publish(
-                                    req["topic"],
-                                    req["payload"],
-                                    token=req.get("token"),
-                                ),
-                            }
-                        )
+                        out = {
+                            "id": rid,
+                            "seq": svc.publish(
+                                req["topic"],
+                                req["payload"],
+                                token=req.get("token"),
+                            ),
+                        }
                     elif op == "ping":
-                        reply({"id": rid, "pong": True, "boot": boot})
+                        out = {"id": rid, "pong": True, "boot": boot}
                     elif op == "hello":
                         hello = {
                             "events_topic": req.get("events_topic", ""),
@@ -189,22 +217,34 @@ class _Handler(socketserver.StreamRequestHandler):
                         }
                         _ident_retag(self.server, self.hello, hello)
                         self.hello = hello
-                        reply({"id": rid, "ok": True, "boot": boot})
+                        out = {"id": rid, "ok": True, "boot": boot}
                     elif op == "bye":
                         self.clean = True
-                        reply({"id": rid, "ok": True})
+                        out = {"id": rid, "ok": True}
                     elif op == "sync_stats":
                         with self.server.conns_lock:  # type: ignore[attr-defined]
                             n_conns = len(self.server.conns)  # type: ignore[attr-defined]
-                        reply(
-                            {
-                                "id": rid,
-                                "conns": n_conns,
-                                "waiters": occupancy.waiters,
-                                "subs": occupancy.subs,
-                                "boot": boot,
-                            }
-                        )
+                        payload = {
+                            "id": rid,
+                            "conns": n_conns,
+                            "waiters": occupancy.waiters,
+                            "subs": occupancy.subs,
+                            "boot": boot,
+                        }
+                        if stats is not None:  # v2: v1 fields preserved
+                            # count itself BEFORE snapshotting so the
+                            # reply includes this very query — the
+                            # conservation accounting the smoke pins
+                            stats.op_done(
+                                op, (time.perf_counter() - t_op) * 1e6
+                            )
+                            topics, entries = svc.pubsub_gauges()
+                            payload.update(
+                                stats.snapshot(
+                                    topics=topics, entries=entries
+                                )
+                            )
+                        reply(payload)
                     elif op == "barrier":
 
                         def do_barrier(rid=rid, req=req):
@@ -216,7 +256,9 @@ class _Handler(socketserver.StreamRequestHandler):
                             )
                             reply({"id": rid, "ok": True})
 
-                        run_async(do_barrier, rid, "waiters")
+                        if stats is not None:  # parked ops count at dispatch
+                            stats.count_op(op)
+                        run_async(do_barrier, rid, "waiters", "barrier")
                     elif op == "signal_and_wait":
 
                         def do_sw(rid=rid, req=req):
@@ -231,7 +273,9 @@ class _Handler(socketserver.StreamRequestHandler):
                             )
                             reply({"id": rid, "seq": seq, "ok": True})
 
-                        run_async(do_sw, rid, "waiters")
+                        if stats is not None:
+                            stats.count_op(op)
+                        run_async(do_sw, rid, "waiters", "signal_and_wait")
                     elif op == "subscribe":
 
                         def do_sub(rid=rid, req=req):
@@ -240,10 +284,24 @@ class _Handler(socketserver.StreamRequestHandler):
                             ):
                                 reply({"id": rid, "entry": entry, "seq": i + 1})
 
-                        run_async(do_sub, rid, "subs")
+                        if stats is not None:
+                            stats.op_done(
+                                "subscribe",
+                                (time.perf_counter() - t_op) * 1e6,
+                            )
+                        run_async(do_sub, rid, "subs", "subscribe")
                     else:
                         reply({"id": rid, "error": f"unknown op {op!r}"})
+                    if out is not None:
+                        if op_done is not None:
+                            op_done(op, (perf() - t_op) * 1e6)
+                        reply(out)
                 except KeyError as e:
+                    # the op still counts: the native server counts at
+                    # dispatch before field extraction, so a malformed
+                    # request must not diverge the backends' op counters
+                    if stats is not None and out is None:
+                        stats.count_op(op)
                     reply({"id": rid, "error": f"missing field {e}"})
         except (ConnectionResetError, OSError):
             pass
@@ -330,8 +388,9 @@ def _note_disconnect(server, hello: dict, clean: bool) -> None:
 class _Occupancy:
     """Live waiter/subscriber accounting exposed via ``sync_stats``."""
 
-    def __init__(self):
+    def __init__(self, stats: SyncStats | None = None):
         self._lock = threading.Lock()
+        self.stats = stats
         self.waiters = 0
         self.subs = 0
 
@@ -342,6 +401,9 @@ class _Occupancy:
             def __enter__(self):
                 with occ._lock:
                     setattr(occ, kind, getattr(occ, kind) + 1)
+                    w, s = occ.waiters, occ.subs
+                if occ.stats is not None:  # high-water marks
+                    occ.stats.note_occupancy(w, s)
 
             def __exit__(self, *exc):
                 with occ._lock:
@@ -354,6 +416,11 @@ class _Occupancy:
 class _Server(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+    # socketserver's default listen backlog is 5 — a fan-in connect
+    # storm (tools/bench_sync_fanin.py drives 1k-10k concurrent
+    # clients) overflows that instantly and turns into SYN retransmit
+    # stalls; match the native server's listen(1024) depth
+    request_queue_size = 1024
 
 
 class SyncServiceServer:
@@ -373,15 +440,24 @@ class SyncServiceServer:
         host: str = "127.0.0.1",
         idle_timeout: float = 0.0,
         evict_grace: float = 2.0,
+        stats: bool = True,
     ):
         self.service = service or InMemSyncService()
         self.idle_timeout = float(idle_timeout)
+        # the sync-plane stats recorder (always on by default — it is
+        # python-int adds; stats=False exists for the fan-in bench's
+        # instrumented-vs-uninstrumented A/B and doubles as the old-
+        # server emulation for client version-tolerance tests: with it
+        # off, sync_stats answers the v1 shape, no "v" field)
+        self.stats: SyncStats | None = SyncStats() if stats else None
+        self.service.stats = self.stats
         self._server = _Server((host, port), _Handler)
         self._server.service = self.service  # type: ignore[attr-defined]
+        self._server.stats = self.stats  # type: ignore[attr-defined]
         self._server.stop_event = threading.Event()  # type: ignore[attr-defined]
         self._server.conns = set()  # type: ignore[attr-defined]
         self._server.conns_lock = threading.Lock()  # type: ignore[attr-defined]
-        self._server.occupancy = _Occupancy()  # type: ignore[attr-defined]
+        self._server.occupancy = _Occupancy(self.stats)  # type: ignore[attr-defined]
         self._server.boot_id = uuid.uuid4().hex  # type: ignore[attr-defined]
         # hello'd-identity → live connection count; disconnects below a
         # count of zero arm the evict_grace timer (see _note_disconnect)
@@ -467,6 +543,13 @@ def _main(argv: list[str] | None = None) -> int:
         help="window an abnormally-disconnected instance has to "
         "reconnect before its eviction is published (0=immediate)",
     )
+    ap.add_argument(
+        "--no-stats",
+        action="store_true",
+        help="disable the sync-stats plane (sync_stats answers the v1 "
+        "occupancy shape) — exists for the fan-in bench's "
+        "instrumented-vs-uninstrumented A/B, not for production",
+    )
     args = ap.parse_args(argv)
 
     srv = SyncServiceServer(
@@ -474,6 +557,7 @@ def _main(argv: list[str] | None = None) -> int:
         host=args.host,
         idle_timeout=args.idle_timeout,
         evict_grace=args.evict_grace,
+        stats=not args.no_stats,
     ).start()
     return serve_until_signal(srv)
 

@@ -1,0 +1,637 @@
+"""Sync-plane stats tests (docs/OBSERVABILITY.md "Sync plane").
+
+The observability tier PR 12 gave the coordination plane: histogram bin
+math, barrier lifecycle timing units, the wire-versioned ``sync_stats``
+v2 schema, python↔native counter-level wire parity (field-for-field on
+identical traffic), the ``tg_sync_*`` Prometheus rendering, the
+``tg sync-stats`` CLI verb, the metrics exporter, and the version
+negotiation rule (clients tolerate v1 servers)."""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import threading
+import time
+
+import pytest
+
+from testground_tpu.sync import SyncClient, SyncServiceServer
+from testground_tpu.sync.stats import (
+    PARITY_FIELDS,
+    SYNC_OPS,
+    TIME_BINS,
+    SyncStats,
+    bin_edge_us,
+    fetch_sync_stats,
+    heartbeat_line,
+    hist_quantile_us,
+    target_bucket,
+    time_bin,
+)
+
+# ------------------------------------------------------------- bin math
+
+
+class TestBinMath:
+    def test_time_bin_edges(self):
+        # bin i covers [2^i, 2^(i+1)) µs; sub-µs lands in bin 0
+        assert time_bin(0) == 0
+        assert time_bin(0.4) == 0
+        assert time_bin(1) == 0
+        assert time_bin(1.9) == 0
+        assert time_bin(2) == 1
+        assert time_bin(3) == 1
+        assert time_bin(4) == 2
+        assert time_bin((1 << 10) - 1) == 9
+        assert time_bin(1 << 10) == 10
+
+    def test_time_bin_clamps_open_bin(self):
+        assert time_bin(1 << (TIME_BINS - 1)) == TIME_BINS - 1
+        assert time_bin(1 << 40) == TIME_BINS - 1  # way past: clamped
+
+    def test_bin_edges_double(self):
+        assert bin_edge_us(0) == 2.0
+        assert bin_edge_us(3) == 16.0
+        assert bin_edge_us(TIME_BINS - 2) == float(1 << (TIME_BINS - 1))
+        assert bin_edge_us(TIME_BINS - 1) == float("inf")
+
+    def test_quantile_empty_and_single(self):
+        assert hist_quantile_us([0] * TIME_BINS, 0.5) == 0.0
+        bins = [0] * TIME_BINS
+        bins[4] = 1  # one sample in [16, 32)µs
+        q = hist_quantile_us(bins, 0.5)
+        assert 16.0 <= q <= 32.0
+
+    def test_quantile_orders_and_interpolates(self):
+        bins = [0] * TIME_BINS
+        bins[2] = 50  # [4, 8)
+        bins[8] = 50  # [256, 512)
+        p25 = hist_quantile_us(bins, 0.25)
+        p75 = hist_quantile_us(bins, 0.75)
+        assert 4.0 <= p25 < 8.0
+        assert 256.0 <= p75 < 512.0
+        assert p25 < p75
+
+    def test_quantile_open_bin_clamps_to_lower_edge(self):
+        bins = [0] * TIME_BINS
+        bins[-1] = 10
+        assert hist_quantile_us(bins, 0.99) == float(1 << (TIME_BINS - 1))
+
+    def test_target_bucket_pow2_ceiling(self):
+        assert target_bucket(1) == 1
+        assert target_bucket(2) == 2
+        assert target_bucket(3) == 4
+        assert target_bucket(100) == 128
+        assert target_bucket(1024) == 1024
+        assert target_bucket(10_000) == 16384
+
+    def test_target_bucket_bounded_label_space(self):
+        assert target_bucket(50_000_000) == 1 << 20  # capped
+
+
+# ------------------------------------------------------- recorder units
+
+
+class TestSyncStatsRecorder:
+    def test_op_done_counts_and_bins(self):
+        st = SyncStats()
+        st.op_done("signal_entry", 5.0)  # bin 2
+        st.op_done("signal_entry", 300.0)  # bin 8
+        snap = st.snapshot()
+        assert snap["ops"]["signal_entry"] == 2
+        rec = snap["op_time_us"]["signal_entry"]
+        assert rec["count"] == 2
+        assert rec["total_us"] == 305
+        assert rec["max_us"] == 300
+        assert rec["bins"][2] == 1 and rec["bins"][8] == 1
+        assert sum(rec["bins"]) == 2
+
+    def test_count_and_time_split_paths_agree(self):
+        # the parked-op path counts at dispatch and times at completion
+        st = SyncStats()
+        st.count_op("barrier")
+        st.time_op("barrier", 1000.0)
+        snap = st.snapshot()
+        assert snap["ops"]["barrier"] == 1
+        assert snap["op_time_us"]["barrier"]["count"] == 1
+
+    def test_unknown_ops_ignored(self):
+        st = SyncStats()
+        st.count_op("nonsense")
+        st.op_done("nonsense", 1.0)
+        assert "nonsense" not in st.snapshot()["ops"]
+
+    def test_barrier_episode_wall_keyed_by_target(self):
+        # deterministic injected clock: armed at first parked waiter,
+        # released wall recorded by the FIRST releaser, pow2-bucketed
+        now = [100.0]
+        st = SyncStats(clock=lambda: now[0])
+        st.barrier_parked("s", 3)
+        now[0] += 0.5
+        st.barrier_parked("s", 3)  # same episode: no re-arm
+        now[0] += 1.0
+        st.barrier_released("s", 3)
+        st.barrier_released("s", 3)
+        st.barrier_released("s", 3)
+        snap = st.snapshot()["barriers"]
+        assert snap["parked"] == 2
+        assert snap["released"] == 3
+        ep = snap["episodes"]
+        assert ep["armed"] == 1 and ep["released"] == 1
+        rec = ep["by_target"]["4"]  # target 3 → pow2 bucket 4
+        assert rec["count"] == 1
+        assert rec["total_ms"] == pytest.approx(1500.0)
+        assert rec["max_ms"] == pytest.approx(1500.0)
+
+    def test_barrier_timeout_and_cancel_counters(self):
+        st = SyncStats()
+        st.barrier_parked("t", 2)
+        st.barrier_timed_out("t", 2)
+        st.barrier_parked("c", 2)
+        st.barrier_canceled("c", 2)
+        snap = st.snapshot()["barriers"]
+        assert snap["timed_out"] == 1 and snap["canceled"] == 1
+        # neither outcome records a release episode
+        assert snap["episodes"]["released"] == 0
+
+    def test_failed_episode_closes_and_rearms(self):
+        # a timed-out/canceled episode must not pin its arm record: the
+        # NEXT barrier on the same (state, target) re-arms and records
+        # release timing normally (regression: leaked _armed entries
+        # blocked re-arming and crept toward the _MAX_ARMED cap)
+        now = [0.0]
+        st = SyncStats(clock=lambda: now[0])
+        st.barrier_parked("s", 2)
+        st.barrier_timed_out("s", 2)
+        now[0] += 5.0
+        st.barrier_parked("s", 2)  # fresh episode: re-armed
+        now[0] += 0.25
+        st.barrier_released("s", 2)
+        ep = st.snapshot()["barriers"]["episodes"]
+        assert ep["armed"] == 2 and ep["released"] == 1
+        # the recorded wall is the SECOND episode's 250ms, not 5.25s
+        assert ep["by_target"]["2"]["max_ms"] == pytest.approx(250.0)
+        assert len(st._armed) == 0  # nothing leaked
+
+    def test_conn_churn_and_hwm(self):
+        st = SyncStats()
+        for _ in range(3):
+            st.conn_open()
+        st.conn_close()
+        st.conn_open()
+        st.conn_evicted()
+        snap = st.snapshot()["conn"]
+        assert snap["accepts"] == 4
+        assert snap["closes"] == 1
+        assert snap["evictions"] == 1
+        assert snap["hwm"] == 3
+
+    def test_snapshot_carries_every_parity_block(self):
+        snap = SyncStats().snapshot()
+        assert snap["v"] == 2
+        for block, fields in PARITY_FIELDS.items():
+            assert block in snap, block
+            for f in fields:
+                assert f in snap[block], (block, f)
+        assert set(snap["ops"]) == set(SYNC_OPS)
+
+
+# -------------------------------------------------- raw-wire test driver
+
+
+def _mk(addr):
+    s = socket.create_connection(addr, timeout=10)
+    s.settimeout(15)
+    return s, s.makefile("r", encoding="utf-8")
+
+
+def _call(s, rf, req):
+    s.sendall((json.dumps(req) + "\n").encode())
+    return json.loads(rf.readline())
+
+
+def _drive_script(addr):
+    """The scripted identical-traffic workload the wire-parity contract
+    compares: signals (+token replay), counter, publishes (+replay),
+    ping, a 2-party signal_and_wait, a satisfied barrier, a subscribe,
+    a barrier timeout — then the sync_stats snapshot."""
+    a, arf = _mk(addr)
+    assert _call(a, arf, {"id": 1, "op": "signal_entry", "state": "x",
+                          "token": "t1"})["seq"] == 1
+    assert _call(a, arf, {"id": 2, "op": "signal_entry", "state": "x",
+                          "token": "t1"})["seq"] == 1  # dedup replay
+    assert _call(a, arf, {"id": 3, "op": "counter", "state": "x"})["count"] == 1
+    assert _call(a, arf, {"id": 4, "op": "publish", "topic": "T",
+                          "payload": {"k": 1}, "token": "p1"})["seq"] == 1
+    assert _call(a, arf, {"id": 5, "op": "publish", "topic": "T",
+                          "payload": {"k": 1}, "token": "p1"})["seq"] == 1
+    assert _call(a, arf, {"id": 6, "op": "ping"})["pong"] is True
+    b, brf = _mk(addr)
+    got = {}
+
+    def sw():
+        got["b"] = _call(b, brf, {"id": 7, "op": "signal_and_wait",
+                                  "state": "bar", "target": 2,
+                                  "timeout": 15})
+
+    t = threading.Thread(target=sw, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert _call(a, arf, {"id": 8, "op": "signal_and_wait", "state": "bar",
+                          "target": 2, "timeout": 15})["ok"] is True
+    t.join(timeout=15)
+    assert got["b"]["ok"] is True
+    # satisfied-immediately barrier
+    assert _call(a, arf, {"id": 9, "op": "barrier", "state": "bar",
+                          "target": 2, "timeout": 15})["ok"] is True
+    # subscribe: first frame replays the published entry
+    frame = _call(a, arf, {"id": 10, "op": "subscribe", "topic": "T"})
+    assert frame["entry"] == {"k": 1} and frame["seq"] == 1
+    # barrier timeout
+    err = _call(a, arf, {"id": 11, "op": "barrier", "state": "never",
+                         "target": 9, "timeout": 0.2})
+    assert "error" in err
+    stats = _call(a, arf, {"id": 12, "op": "sync_stats"})
+    a.close()
+    b.close()
+    return stats
+
+
+EXPECTED_OPS = {
+    "signal_entry": 2,
+    "counter": 1,
+    "publish": 2,
+    "ping": 1,
+    "signal_and_wait": 2,
+    "barrier": 2,
+    "subscribe": 1,
+    "sync_stats": 1,
+    "hello": 0,
+    "bye": 0,
+}
+
+
+@pytest.fixture(scope="session")
+def native_bin(tmp_path_factory):
+    from testground_tpu.native import build_syncsvc, native_available
+
+    if not native_available():
+        pytest.skip("no C++ toolchain for the native sync service")
+    return build_syncsvc(str(tmp_path_factory.mktemp("syncsvc-bin")))
+
+
+# ------------------------------------------------------------ v2 server
+
+
+class TestServerV2:
+    def test_python_server_counts_the_script(self):
+        srv = SyncServiceServer().start()
+        try:
+            stats = _drive_script(srv.address)
+        finally:
+            srv.stop()
+        assert stats["v"] == 2
+        for op, want in EXPECTED_OPS.items():
+            assert stats["ops"][op] == want, op
+        assert stats["dedup"] == {"signal_hits": 1, "publish_hits": 1}
+        bar = stats["barriers"]
+        # parked: 2 signal_and_wait + satisfied barrier + timeout barrier
+        assert bar["parked"] == 4
+        assert bar["released"] == 3
+        assert bar["timed_out"] == 1
+        ps = stats["pubsub"]
+        assert ps["published"] == 1  # the replay deduped
+        assert ps["topics"] == 1 and ps["entries"] == 1
+        assert ps["depth_hwm"] == 1
+        # per-op histograms exist for everything the script exercised
+        assert stats["op_time_us"]["signal_entry"]["count"] == 2
+        assert stats["op_time_us"]["signal_and_wait"]["count"] == 2
+
+    def test_barrier_episode_timing_on_the_wire(self):
+        srv = SyncServiceServer().start()
+        try:
+            stats = _drive_script(srv.address)
+        finally:
+            srv.stop()
+        by_target = stats["barriers"]["episodes"]["by_target"]
+        # the 2-party signal_and_wait episode landed in bucket 2 with a
+        # positive armed→release wall (the thread parks ~0.2s)
+        rec = by_target["2"]
+        assert rec["count"] >= 1
+        assert rec["total_ms"] > 100.0
+        assert rec["max_ms"] >= rec["total_ms"] / rec["count"] - 1e-6
+
+    def test_stats_off_answers_v1_shape(self):
+        # the old-server emulation: no "v", occupancy fields only —
+        # what the version negotiation rule keys on
+        srv = SyncServiceServer(stats=False).start()
+        try:
+            host, port = srv.address
+            stats = fetch_sync_stats(host, port)
+        finally:
+            srv.stop()
+        assert "v" not in stats
+        assert set(stats) == {"conns", "waiters", "subs", "boot"}
+
+    def test_client_tolerates_v1_server(self):
+        # Client.sync_stats against a pre-stats server still returns
+        # the occupancy dict (docstring contract, client.py)
+        srv = SyncServiceServer(stats=False).start()
+        try:
+            c = SyncClient(*srv.address)
+            stats = c.sync_stats()
+            assert stats["conns"] >= 1 and "waiters" in stats
+            assert "v" not in stats
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_client_sync_stats_v2_passthrough(self):
+        srv = SyncServiceServer().start()
+        try:
+            c = SyncClient(*srv.address)
+            stats = c.sync_stats()
+            assert stats["v"] == 2
+            assert stats["ops"]["ping"] >= 1  # its own handshake
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_eviction_counted(self):
+        srv = SyncServiceServer(idle_timeout=0.3, evict_grace=0.0).start()
+        try:
+            host, port = srv.address
+            s = socket.create_connection((host, port), timeout=5)
+            deadline = time.monotonic() + 10
+            evicted = 0
+            while time.monotonic() < deadline and not evicted:
+                time.sleep(0.2)
+                evicted = (fetch_sync_stats(host, port).get("conn") or {}).get(
+                    "evictions", 0
+                )
+            assert evicted >= 1
+            s.close()
+        finally:
+            srv.stop()
+
+
+# ----------------------------------------------------------- wire parity
+
+
+class TestWireParity:
+    """The native server mirrors the counter-level v2 schema
+    field-for-field: identical scripted traffic must produce identical
+    counters (PARITY_FIELDS is THE contract both servers implement)."""
+
+    def test_counter_level_parity(self, native_bin):
+        from testground_tpu.native import NativeSyncService
+
+        srv_py = SyncServiceServer().start()
+        try:
+            py = _drive_script(srv_py.address)
+        finally:
+            srv_py.stop()
+        srv_nat = NativeSyncService(native_bin)
+        try:
+            nat = _drive_script(srv_nat.address)
+        finally:
+            srv_nat.stop()
+        assert py["v"] == 2 and nat["v"] == 2
+        for block, fields in PARITY_FIELDS.items():
+            for f in fields:
+                assert py[block][f] == nat[block][f], (
+                    f"{block}.{f}: python={py[block][f]} "
+                    f"native={nat[block][f]}"
+                )
+        # the v1 occupancy fields stay present and equal too
+        for k in ("conns", "waiters", "subs"):
+            assert py[k] == nat[k], k
+
+    def test_native_stats_off_answers_v1(self, native_bin):
+        import subprocess
+
+        proc = subprocess.Popen(
+            [native_bin, "--port", "0", "--stats", "0"],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            port = int(proc.stdout.readline().split()[1])
+            stats = fetch_sync_stats("127.0.0.1", port)
+            assert "v" not in stats
+            assert set(stats) == {"conns", "waiters", "subs", "boot"}
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+# ------------------------------------------------------------ prometheus
+
+
+class TestSyncPrometheus:
+    def _snapshot(self):
+        srv = SyncServiceServer().start()
+        try:
+            return _drive_script(srv.address)
+        finally:
+            srv.stop()
+
+    def test_valid_exposition(self):
+        from testground_tpu.metrics.prometheus import render_sync_prometheus
+
+        text = render_sync_prometheus(self._snapshot())
+        line_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+            r"-?[0-9.e+-]+(\.[0-9]+)?$|"
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*\{[^{}]*le=\"\+Inf\"[^{}]*\} "
+            r"[0-9]+$"
+        )
+        families = set()
+        for line in text.strip().splitlines():
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                continue
+            assert line_re.match(line), line
+            families.add(line.split("{")[0].split(" ")[0])
+        for family in (
+            "tg_sync_conns",
+            "tg_sync_waiters",
+            "tg_sync_subs",
+            "tg_sync_ops_total",
+            "tg_sync_conn_accepts_total",
+            "tg_sync_barrier_parked_total",
+            "tg_sync_barrier_released_total",
+            "tg_sync_barrier_episodes_total",
+            "tg_sync_barrier_release_ms_total",
+            "tg_sync_pubsub_published_total",
+            "tg_sync_dedup_hits_total",
+            "tg_sync_op_duration_seconds_bucket",
+            "tg_sync_op_duration_seconds_sum",
+            "tg_sync_op_duration_seconds_count",
+        ):
+            assert family in families, family
+        # one TYPE header per family, histogram typed as histogram
+        assert text.count("# TYPE tg_sync_ops_total") == 1
+        assert "# TYPE tg_sync_op_duration_seconds histogram" in text
+
+    def test_histogram_buckets_cumulative_and_reconcile(self):
+        from testground_tpu.metrics.prometheus import render_sync_prometheus
+
+        snap = self._snapshot()
+        text = render_sync_prometheus(snap)
+        buckets = [
+            int(m.group(2))
+            for m in re.finditer(
+                r'tg_sync_op_duration_seconds_bucket\{op="signal_entry"'
+                r',le="([^"]+)"\} (\d+)',
+                text,
+            )
+        ]
+        assert len(buckets) == TIME_BINS
+        assert buckets == sorted(buckets)  # cumulative
+        count = int(
+            re.search(
+                r'tg_sync_op_duration_seconds_count\{op="signal_entry"\} '
+                r"(\d+)",
+                text,
+            ).group(1)
+        )
+        assert buckets[-1] == count
+        assert count == snap["op_time_us"]["signal_entry"]["count"]
+        # ops counter reconciles with the snapshot
+        m = re.search(r'tg_sync_ops_total\{op="signal_entry"\} (\d+)', text)
+        assert int(m.group(1)) == snap["ops"]["signal_entry"]
+
+    def test_barrier_target_labels_bounded_pow2(self):
+        from testground_tpu.metrics.prometheus import render_sync_prometheus
+
+        text = render_sync_prometheus(self._snapshot())
+        targets = set(
+            re.findall(
+                r'tg_sync_barrier_episodes_total\{target="(\d+)"\}', text
+            )
+        )
+        assert targets  # the script released episodes
+        for t in targets:
+            n = int(t)
+            assert n & (n - 1) == 0  # pow2 bucket
+
+    def test_v1_snapshot_renders_occupancy_only(self):
+        from testground_tpu.metrics.prometheus import render_sync_prometheus
+
+        text = render_sync_prometheus(
+            {"conns": 3, "waiters": 1, "subs": 0, "boot": "abc"}
+        )
+        assert "tg_sync_conns 3" in text
+        assert "tg_sync_ops_total" not in text
+        assert "tg_sync_op_duration_seconds" not in text
+
+
+# ------------------------------------------------- surfaces (CLI + HTTP)
+
+
+class TestSurfaces:
+    def test_heartbeat_line_rates_over_interval(self):
+        prev = {"ops": {"ping": 10, "signal_entry": 0}}
+        cur = {
+            "conns": 5,
+            "waiters": 2,
+            "subs": 1,
+            "ops": {"ping": 20, "signal_entry": 90},
+            "barriers": {"parked": 4, "released": 3},
+            "conn": {"evictions": 1},
+        }
+        line = heartbeat_line(prev, cur, 10.0)
+        assert "conns=5" in line and "waiters=2" in line and "subs=1" in line
+        assert "ops/s=10.0" in line  # (110-10)/10
+        assert "barriers=3/4" in line and "evictions=1" in line
+
+    def test_heartbeat_line_first_sample(self):
+        line = heartbeat_line(None, {"conns": 1, "ops": {"ping": 5}}, 5.0)
+        assert "ops/s=1.0" in line
+
+    def test_cli_sync_stats_table_and_json(self, capsys):
+        from testground_tpu.cli.main import main
+
+        srv = SyncServiceServer().start()
+        try:
+            _drive_script(srv.address)
+            addr = f"{srv.address[0]}:{srv.address[1]}"
+            assert main(["sync-stats", addr]) == 0
+            out = capsys.readouterr().out
+            assert "stats v2" in out
+            assert "signal_entry" in out and "barriers" in out
+            assert "barrier release vs fan-in width" in out
+            assert main(["sync-stats", addr, "--json"]) == 0
+            data = json.loads(capsys.readouterr().out)
+            assert data["v"] == 2 and "ops" in data
+        finally:
+            srv.stop()
+
+    def test_cli_sync_stats_bad_address_and_unreachable(self, capsys):
+        from testground_tpu.cli.main import main
+
+        assert main(["sync-stats", "nonsense"]) == 2
+        # a port nothing listens on: readable failure, exit 1
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        assert (
+            main(["sync-stats", f"127.0.0.1:{port}", "--timeout", "2"]) == 1
+        )
+        err = capsys.readouterr().err
+        assert "unreachable" in err
+
+    def test_render_sync_stats_v1_hint(self):
+        from testground_tpu.runners.pretty import render_sync_stats
+
+        out = render_sync_stats(
+            {"conns": 2, "waiters": 0, "subs": 0, "boot": "old"}
+        )
+        assert "v1 server" in out and "occupancy only" in out
+
+    def test_metrics_exporter_scrape(self):
+        import urllib.error
+        import urllib.request
+
+        from testground_tpu.sync.stats import SyncMetricsExporter
+
+        srv = SyncServiceServer().start()
+        exporter = SyncMetricsExporter(srv.address).start()
+        try:
+            _drive_script(srv.address)
+            url = f"http://127.0.0.1:{exporter.port}/metrics"
+            resp = urllib.request.urlopen(url, timeout=10)
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+            assert re.search(r"^tg_sync_conns \d+$", text, re.M)
+            assert 'tg_sync_ops_total{op="signal_entry"} 2' in text
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{exporter.port}/nope", timeout=10
+                )
+        finally:
+            exporter.stop()
+            srv.stop()
+
+    def test_metrics_exporter_unreachable_service_503(self):
+        import urllib.error
+        import urllib.request
+
+        from testground_tpu.sync.stats import SyncMetricsExporter
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        exporter = SyncMetricsExporter(("127.0.0.1", dead_port)).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{exporter.port}/metrics", timeout=10
+                )
+            assert ei.value.code == 503
+        finally:
+            exporter.stop()

@@ -1,0 +1,719 @@
+"""Sync-plane fan-in bench: 100 → 1k → 10k concurrent clients.
+
+The before-picture ROADMAP item 3(a)'s server rewrite will be judged
+against: drives a multi-process client ramp against BOTH sync backends
+(python ``sync/server.py`` and native ``native/syncsvc.cc``) and banks,
+per rung and backend:
+
+- **connect storm**: wall + connects/s to stand up W concurrent
+  heartbeat-less clients;
+- **signal flood**: W clients each doing K serial ``signal_entry``
+  round-trips — per-op p50/p95/p99/max client-observed latency and
+  aggregate ops/s;
+- **barrier storm**: all W clients ``signal_and_wait`` on one state with
+  ``target=W`` — client-observed fan-in latency percentiles, plus the
+  server's own armed→release episode wall from the stats plane
+  (``barriers.episodes.by_target``, python backend) — the
+  "barrier-release latency vs fan-in width" series;
+- **pubsub fanout**: S subscribers on one topic, one publisher, M
+  entries — delivered frames/s;
+- **server-side deltas**: per-op counters + service-time histograms
+  from ``sync_stats`` v2 snapshots taken at phase boundaries.
+
+Plus the honesty check the always-on instrumentation owes: an
+**instrumented-vs-uninstrumented A/B** at smoke scale (``--no-stats`` /
+``--stats 0`` server modes), reported as overhead_pct.
+
+Clients are deliberately NOT the SDK ``SyncClient`` (which spawns
+reader+heartbeat threads per connection — 3 × 10k threads of harness
+would drown the measurement): each worker process runs a selector-based
+event loop multiplexing its client share, one outstanding request per
+client, latency stamped send→reply.
+
+A rung that dies (thread exhaustion, timeouts, refused connects) is a
+RESULT, not a crash: the failure mode is recorded in the rung's JSON
+and the ramp continues.
+
+Usage::
+
+    python tools/bench_sync_fanin.py                      # full ramp
+    python tools/bench_sync_fanin.py --rungs 100,1000 --backends python
+    python tools/bench_sync_fanin.py --out BENCH_SYNC_r01.json
+
+Results land as one pretty-printed JSON document (PERF.md "Sync
+fan-in" holds the banked round).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import platform
+import selectors
+import socket
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_RUNGS = (100, 1000, 10000)
+SIGNAL_OPS = 20  # serial signal_entry round-trips per client
+PUB_SUBS = 200  # fanout subscribers (capped to worker 0's share)
+PUB_ENTRIES = 50  # entries the publisher appends
+CONNECT_BATCH = 200  # in-flight nonblocking connects per worker
+
+
+# --------------------------------------------------------------- backends
+
+
+def spawn_backend(backend: str, stats: bool = True):
+    """Start a fresh sync server subprocess; returns (proc, (host, port)).
+    A fresh server per rung keeps stats deltas and topic state clean."""
+    if backend == "python":
+        argv = [
+            sys.executable,
+            "-m",
+            "testground_tpu.sync.server",
+            "--port",
+            "0",
+        ]
+        if not stats:
+            argv.append("--no-stats")
+        proc = subprocess.Popen(
+            argv,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            cwd=_REPO,
+        )
+        line = proc.stdout.readline().split()
+        # "LISTENING <host> <port>"
+        return proc, (line[1], int(line[2]))
+    if backend == "native":
+        from testground_tpu.native import build_syncsvc, native_available
+
+        if not native_available():
+            raise RuntimeError("no C++ toolchain (g++) for the native backend")
+        bin_path = build_syncsvc(os.path.join("/tmp", "tg-syncsvc-bench"))
+        argv = [bin_path, "--port", "0"]
+        if not stats:
+            argv += ["--stats", "0"]
+        proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+        )
+        line = proc.stdout.readline().split()
+        # "LISTENING <port>"
+        return proc, ("127.0.0.1", int(line[1]))
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def raise_nofile() -> int:
+    import resource
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    return resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+
+
+# ----------------------------------------------------------- mini client
+
+
+def _send_line(sock: socket.socket, obj: dict) -> None:
+    """Small-request send; requests are <200B so a transient full buffer
+    is drained with a bounded blocking fallback."""
+    data = (json.dumps(obj) + "\n").encode()
+    try:
+        sock.sendall(data)
+    except BlockingIOError:
+        sock.setblocking(True)
+        sock.settimeout(30)
+        sock.sendall(data)
+        sock.setblocking(False)
+
+
+def connect_clients(host, port, n, deadline, errors):
+    """Nonblocking batched connect storm; returns connected sockets."""
+    sel = selectors.DefaultSelector()
+    done: list[socket.socket] = []
+    started = 0
+    inflight = 0
+    while len(done) + len(errors) < n:
+        if time.monotonic() > deadline:
+            errors.append(f"connect deadline with {len(done)}/{n} up")
+            break
+        while started < n and inflight < CONNECT_BATCH:
+            s = socket.socket()
+            s.setblocking(False)
+            rc = s.connect_ex((host, port))
+            if rc not in (0, 115, 36):  # EINPROGRESS linux/mac
+                errors.append(f"connect_ex errno {rc}")
+                s.close()
+            else:
+                sel.register(s, selectors.EVENT_WRITE)
+                inflight += 1
+            started += 1
+        if inflight == 0:
+            continue
+        for key, _ in sel.select(timeout=1.0):
+            s = key.fileobj
+            sel.unregister(s)
+            inflight -= 1
+            err = s.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+            if err:
+                errors.append(f"connect SO_ERROR {err}")
+                s.close()
+            else:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                done.append(s)
+    sel.close()
+    return done
+
+
+def rr_phase(clients, reqs_per_client, build_req, deadline):
+    """Serial request/response per client, all clients multiplexed in
+    one selector loop. Returns (latencies_ms, errors). ``build_req(i,
+    k)`` makes client i's k-th request. A reply line containing
+    ``"error"`` counts as an error, not a latency."""
+    sel = selectors.DefaultSelector()
+    lats: list[float] = []
+    errors: list[str] = []
+    state = {}  # sock -> [sent_count, t_sent, rbuf, index]
+    active = 0
+    for i, s in enumerate(clients):
+        if reqs_per_client <= 0:
+            break
+        _send_line(s, build_req(i, 0))
+        state[s] = [1, time.perf_counter(), b"", i]
+        sel.register(s, selectors.EVENT_READ)
+        active += 1
+    while active > 0:
+        if time.monotonic() > deadline:
+            errors.append(f"phase deadline with {active} clients pending")
+            break
+        for key, _ in sel.select(timeout=1.0):
+            s = key.fileobj
+            st = state[s]
+            try:
+                data = s.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError as e:
+                errors.append(f"recv: {e}")
+                sel.unregister(s)
+                active -= 1
+                continue
+            if not data:
+                errors.append("server closed connection")
+                sel.unregister(s)
+                active -= 1
+                continue
+            st[2] += data
+            while b"\n" in st[2]:
+                line, st[2] = st[2].split(b"\n", 1)
+                now = time.perf_counter()
+                if b'"error"' in line:
+                    errors.append(line.decode(errors="replace")[:200])
+                else:
+                    lats.append((now - st[1]) * 1e3)
+                if st[0] < reqs_per_client:
+                    _send_line(s, build_req(st[3], st[0]))
+                    st[0] += 1
+                    st[1] = time.perf_counter()
+                else:
+                    sel.unregister(s)
+                    active -= 1
+                    break
+    sel.close()
+    return lats, errors
+
+
+def pubsub_phase(clients, n_subs, n_entries, topic, deadline):
+    """S subscribers + 1 publisher on ``topic``; returns (wall_secs,
+    delivered_frames, errors). Delivery wall runs from the first publish
+    to the last subscriber frame."""
+    errors: list[str] = []
+    if len(clients) < n_subs + 1:
+        n_subs = max(0, len(clients) - 1)
+    subs = clients[:n_subs]
+    if not subs:
+        return 0.0, 0, ["no clients left for pubsub"]
+    pub = clients[n_subs]
+    sel = selectors.DefaultSelector()
+    counts = {}  # sock -> [frames, rbuf]
+    for i, s in enumerate(subs):
+        _send_line(s, {"id": 1, "op": "subscribe", "topic": topic})
+        counts[s] = [0, b""]
+        sel.register(s, selectors.EVENT_READ)
+    # publisher: serial publishes (blocking round-trips on its own sock)
+    pub.setblocking(True)
+    pub.settimeout(max(1.0, deadline - time.monotonic()))
+    prf = pub.makefile("rb")
+    t0 = time.perf_counter()
+    for m in range(n_entries):
+        _send_line(pub, {"id": 2, "op": "publish", "topic": topic,
+                         "payload": {"m": m}})
+        if not prf.readline():
+            errors.append("publisher connection closed")
+            break
+    want = n_entries
+    delivered = 0
+    while delivered < want * len(subs):
+        if time.monotonic() > deadline:
+            errors.append(
+                f"pubsub deadline: {delivered}/{want * len(subs)} frames"
+            )
+            break
+        for key, _ in sel.select(timeout=1.0):
+            s = key.fileobj
+            st = counts[s]
+            try:
+                data = s.recv(262144)
+            except OSError as e:
+                errors.append(f"sub recv: {e}")
+                sel.unregister(s)
+                del counts[s]
+                continue
+            if not data:
+                errors.append("sub closed")
+                sel.unregister(s)
+                del counts[s]
+                continue
+            st[1] += data
+            n = st[1].count(b"\n")
+            if n:
+                frames = st[1].split(b"\n")
+                st[1] = frames[-1]
+                got = sum(1 for f in frames[:-1] if b'"entry"' in f)
+                st[0] += got
+                delivered += got
+    wall = time.perf_counter() - t0
+    prf.close()
+    sel.close()
+    return wall, delivered, errors
+
+
+# --------------------------------------------------------------- workers
+
+
+def run_worker(wid, host, port, n_clients, total, cfg, barrier, outq):
+    """One worker process: its client share through all phases, phase
+    starts synchronized with the parent via the shared barrier."""
+    res = {"wid": wid, "errors": []}
+    clients = []
+    try:
+        barrier.wait(timeout=cfg["timeout"])
+        t0 = time.perf_counter()
+        clients = connect_clients(
+            host, port, n_clients,
+            time.monotonic() + cfg["timeout"], res["errors"],
+        )
+        res["connect_wall"] = time.perf_counter() - t0
+        res["connected"] = len(clients)
+        barrier.wait(timeout=cfg["timeout"])  # connect done
+
+        barrier.wait(timeout=cfg["timeout"])  # flood go
+        t0 = time.perf_counter()
+        lats, errs = rr_phase(
+            clients,
+            cfg["signal_ops"],
+            lambda i, k: {
+                "id": k + 1,
+                "op": "signal_entry",
+                "state": f"flood-{wid}-{i % 16}",
+            },
+            time.monotonic() + cfg["timeout"],
+        )
+        res["flood_wall"] = time.perf_counter() - t0
+        res["flood_lats"] = lats
+        res["errors"] += errs
+        barrier.wait(timeout=cfg["timeout"])  # flood done
+
+        barrier.wait(timeout=cfg["timeout"])  # storm go
+        lats, errs = rr_phase(
+            clients,
+            1,
+            lambda i, k: {
+                "id": 1,
+                "op": "signal_and_wait",
+                "state": "storm",
+                "target": total,
+                "timeout": cfg["timeout"],
+            },
+            time.monotonic() + cfg["timeout"],
+        )
+        res["storm_lats"] = lats
+        res["errors"] += errs
+        barrier.wait(timeout=cfg["timeout"])  # storm done
+
+        barrier.wait(timeout=cfg["timeout"])  # pubsub go (worker 0 only)
+        if wid == 0 and clients:
+            wall, delivered, errs = pubsub_phase(
+                clients,
+                min(cfg["pub_subs"], max(1, len(clients) - 1)),
+                cfg["pub_entries"],
+                "fanout",
+                time.monotonic() + cfg["timeout"],
+            )
+            res["pubsub"] = {"wall_secs": wall, "delivered": delivered}
+            res["errors"] += errs
+        barrier.wait(timeout=cfg["timeout"])  # pubsub done
+    except Exception as e:  # noqa: BLE001 — a dead worker is a result
+        res["errors"].append(f"worker died: {type(e).__name__}: {e}")
+    finally:
+        for s in clients:
+            try:
+                s.close()
+            except OSError:
+                pass
+        outq.put(res)
+
+
+def percentiles(lats, qs=(0.50, 0.95, 0.99)):
+    if not lats:
+        return {f"p{int(q * 100)}_ms": None for q in qs} | {"max_ms": None}
+    xs = sorted(lats)
+    out = {}
+    for q in qs:
+        idx = min(len(xs) - 1, int(q * len(xs)))
+        out[f"p{int(q * 100)}_ms"] = round(xs[idx], 3)
+    out["max_ms"] = round(xs[-1], 3)
+    return out
+
+
+def _stats_snap(host, port):
+    from testground_tpu.sync.stats import fetch_sync_stats
+
+    try:
+        return fetch_sync_stats(host, port, timeout=10.0)
+    except (OSError, ValueError) as e:
+        return {"error": str(e)}
+
+
+def _ops_delta(a: dict, b: dict) -> dict:
+    ao, bo = a.get("ops") or {}, b.get("ops") or {}
+    return {op: bo.get(op, 0) - ao.get(op, 0) for op in bo}
+
+
+# ------------------------------------------------------------------ rungs
+
+
+def run_rung(backend, width, procs, cfg, log=print):
+    """One (backend, width) cell of the ramp. Returns the rung record;
+    a failed rung records its failure mode instead of raising."""
+    rec = {"clients": width, "procs": procs, "errors": []}
+    proc = None
+    workers = []
+    at = {"phase": "startup"}  # bound before try: spawn can raise
+    try:
+        proc, (host, port) = spawn_backend(backend)
+        ctx = mp.get_context("fork")
+        barrier = ctx.Barrier(procs + 1)
+        outq = ctx.Queue()
+        share = [width // procs] * procs
+        for i in range(width % procs):
+            share[i] += 1
+        workers = [
+            ctx.Process(
+                target=run_worker,
+                args=(i, host, port, share[i], width, cfg, barrier, outq),
+                daemon=True,
+            )
+            for i in range(procs)
+        ]
+        for w in workers:
+            w.start()
+        tmo = cfg["timeout"]
+
+        def phase(name):
+            at["phase"] = name
+            barrier.wait(timeout=tmo)
+
+        t_conn = time.perf_counter()
+        phase("connect go")
+        phase("connect done")
+        conn_wall = time.perf_counter() - t_conn
+        snap0 = _stats_snap(host, port)
+        t_flood = time.perf_counter()
+        phase("flood go")
+        phase("flood done")
+        flood_wall = time.perf_counter() - t_flood
+        snap1 = _stats_snap(host, port)
+        t_storm = time.perf_counter()
+        phase("storm go")
+        phase("storm done")
+        storm_wall = time.perf_counter() - t_storm
+        snap2 = _stats_snap(host, port)
+        phase("pubsub go")
+        phase("pubsub done")
+        snap3 = _stats_snap(host, port)
+
+        results = [outq.get(timeout=tmo) for _ in workers]
+        for w in workers:
+            w.join(timeout=10)
+
+        connected = sum(r.get("connected", 0) for r in results)
+        flood_lats = [x for r in results for x in r.get("flood_lats", ())]
+        storm_lats = [x for r in results for x in r.get("storm_lats", ())]
+        rec["errors"] = [e for r in results for e in r.get("errors", ())][:20]
+        rec["connect"] = {
+            "connected": connected,
+            "wall_secs": round(conn_wall, 3),
+            "connects_per_sec": round(connected / conn_wall, 1)
+            if conn_wall > 0
+            else None,
+        }
+        rec["signal"] = {
+            "ops": len(flood_lats),
+            "wall_secs": round(flood_wall, 3),
+            "ops_per_sec": round(len(flood_lats) / flood_wall, 1)
+            if flood_wall > 0
+            else None,
+            **percentiles(flood_lats),
+        }
+        rec["barrier"] = {
+            "width": width,
+            "completed": len(storm_lats),
+            "wall_secs": round(storm_wall, 3),
+            **percentiles(storm_lats),
+        }
+        # the server's own armed→release wall for this storm (python
+        # backend richness; the by_target delta between snap1 and snap2)
+        ep2 = (
+            ((snap2.get("barriers") or {}).get("episodes") or {}).get(
+                "by_target"
+            )
+            or {}
+        )
+        ep1 = (
+            ((snap1.get("barriers") or {}).get("episodes") or {}).get(
+                "by_target"
+            )
+            or {}
+        )
+        release = {}
+        for bucket, r2 in ep2.items():
+            n = r2.get("count", 0) - (ep1.get(bucket) or {}).get("count", 0)
+            if n > 0:
+                release[bucket] = {
+                    "episodes": n,
+                    "total_ms": round(
+                        r2.get("total_ms", 0.0)
+                        - (ep1.get(bucket) or {}).get("total_ms", 0.0),
+                        3,
+                    ),
+                    "max_ms": r2.get("max_ms"),
+                }
+        if release:
+            rec["barrier"]["server_release_ms"] = release
+        pubsub = next(
+            (r["pubsub"] for r in results if "pubsub" in r), None
+        )
+        if pubsub:
+            rec["pubsub"] = {
+                "subs": min(cfg["pub_subs"], share[0] - 1),
+                "entries": cfg["pub_entries"],
+                **pubsub,
+                "delivered_per_sec": round(
+                    pubsub["delivered"] / pubsub["wall_secs"], 1
+                )
+                if pubsub["wall_secs"] > 0
+                else None,
+            }
+        rec["server"] = {
+            "v": snap3.get("v", 1),
+            "ops_total": _ops_delta(snap0, snap3),
+            "conns_hwm": (snap3.get("conn") or {}).get("hwm"),
+            "waiters_hwm": (snap3.get("hwm") or {}).get("waiters"),
+        }
+        ok = connected >= int(0.99 * width) and len(storm_lats) >= int(
+            0.99 * width
+        )
+        rec["outcome"] = "pass" if ok and not rec["errors"] else (
+            "pass-with-errors" if ok else "fail"
+        )
+    except Exception as e:  # noqa: BLE001 — the rung's failure IS the data
+        rec["outcome"] = "fail"
+        rec["failure_mode"] = (
+            f"{type(e).__name__}: {e} (waiting for phase "
+            f"{at['phase']!r}, deadline {cfg['timeout']}s)"
+        ).strip()
+        # salvage the post-mortem: what the server had absorbed when the
+        # rung wedged, and whatever the dying workers managed to report
+        if proc is not None and proc.poll() is None:
+            snap = _stats_snap(host, port)
+            rec["server_at_failure"] = {
+                "conns": snap.get("conns"),
+                "waiters": snap.get("waiters"),
+                "conn": snap.get("conn"),
+                "ops": snap.get("ops"),
+                "barriers": {
+                    k: v
+                    for k, v in (snap.get("barriers") or {}).items()
+                    if k != "episodes"
+                },
+                "error": snap.get("error"),
+            }
+        else:
+            rec["server_at_failure"] = {
+                "error": f"server process exited rc={proc.returncode}"
+                if proc is not None
+                else "never started"
+            }
+        time.sleep(2)  # broken-barrier workers are writing their res now
+        try:
+            while True:
+                r = outq.get_nowait()
+                rec["errors"] += [
+                    f"w{r.get('wid')}: {e}" for e in r.get("errors", ())
+                ][:5]
+                if "connected" in r:
+                    rec.setdefault("connected_at_failure", 0)
+                    rec["connected_at_failure"] += r["connected"]
+        except Exception:  # noqa: BLE001 — queue drained (or unusable)
+            pass
+        rec["errors"] = rec["errors"][:20]
+    finally:
+        for w in workers:
+            if w.is_alive():
+                w.terminate()
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    log(
+        f"  {backend} @ {width}: {rec.get('outcome')} "
+        f"(connect {rec.get('connect', {}).get('connects_per_sec')}/s, "
+        f"signal {rec.get('signal', {}).get('ops_per_sec')}/s, "
+        f"barrier p99 {rec.get('barrier', {}).get('p99_ms')}ms)"
+    )
+    return rec
+
+
+# --------------------------------------------------------------------- A/B
+
+
+def run_ab(backend="python", clients=200, reps=3, cfg=None, log=print):
+    """Instrumented-vs-uninstrumented A/B at smoke scale: same signal
+    flood against a stats-on and a stats-off server, alternating reps,
+    best-of each arm (the A/B contract: always-on instrumentation must
+    cost < 5% — PERF.md 'Sync fan-in')."""
+    cfg = cfg or {"signal_ops": 50, "timeout": 60}
+    best = {True: 0.0, False: 0.0}
+    for _ in range(reps):
+        for stats in (True, False):
+            proc, (host, port) = spawn_backend(backend, stats=stats)
+            try:
+                errs: list[str] = []
+                conns = connect_clients(
+                    host, port, clients, time.monotonic() + 30, errs
+                )
+                t0 = time.perf_counter()
+                lats, errs2 = rr_phase(
+                    conns,
+                    cfg["signal_ops"],
+                    lambda i, k: {
+                        "id": k + 1,
+                        "op": "signal_entry",
+                        "state": f"ab-{i % 16}",
+                    },
+                    time.monotonic() + cfg["timeout"],
+                )
+                wall = time.perf_counter() - t0
+                rate = len(lats) / wall if wall > 0 else 0.0
+                best[stats] = max(best[stats], rate)
+                for s in conns:
+                    s.close()
+            finally:
+                proc.terminate()
+                proc.wait(timeout=10)
+    on, off = best[True], best[False]
+    overhead = (off - on) / off * 100 if off > 0 else None
+    rec = {
+        "backend": backend,
+        "clients": clients,
+        "signal_ops": cfg["signal_ops"],
+        "reps": reps,
+        "instrumented_ops_per_sec": round(on, 1),
+        "uninstrumented_ops_per_sec": round(off, 1),
+        "overhead_pct": round(overhead, 2) if overhead is not None else None,
+    }
+    log(
+        f"  A/B ({backend}, {clients} clients): instrumented {on:.0f}/s "
+        f"vs uninstrumented {off:.0f}/s → overhead "
+        f"{rec['overhead_pct']}%"
+    )
+    return rec
+
+
+# --------------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--rungs", default=",".join(map(str, DEFAULT_RUNGS)),
+        help="comma-separated concurrent-client widths",
+    )
+    ap.add_argument("--backends", default="python,native")
+    ap.add_argument("--procs", type=int, default=0,
+                    help="worker processes (0 = auto)")
+    ap.add_argument("--signal-ops", type=int, default=SIGNAL_OPS)
+    ap.add_argument("--pub-subs", type=int, default=PUB_SUBS)
+    ap.add_argument("--pub-entries", type=int, default=PUB_ENTRIES)
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="per-phase deadline seconds")
+    ap.add_argument("--no-ab", action="store_true",
+                    help="skip the instrumentation A/B")
+    ap.add_argument("--out", default="", help="write the JSON document here")
+    args = ap.parse_args(argv)
+
+    nofile = raise_nofile()
+    rungs = [int(x) for x in args.rungs.split(",") if x]
+    backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    cfg = {
+        "signal_ops": args.signal_ops,
+        "pub_subs": args.pub_subs,
+        "pub_entries": args.pub_entries,
+        "timeout": args.timeout,
+    }
+    doc = {
+        "bench": "sync_fanin",
+        "rungs": rungs,
+        "config": {**cfg, "nofile": nofile},
+        "host": {
+            "cpus": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+        },
+        "backends": {},
+    }
+    for backend in backends:
+        doc["backends"][backend] = {}
+        print(f"backend {backend}:")
+        for width in rungs:
+            procs = args.procs or max(1, min(8, width // 250 or 1))
+            doc["backends"][backend][str(width)] = run_rung(
+                backend, width, procs, cfg
+            )
+    if not args.no_ab:
+        print("instrumentation A/B:")
+        doc["ab"] = run_ab()
+    out = json.dumps(doc, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+        print(f"wrote {args.out}")
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
