@@ -121,11 +121,12 @@ pack-smoke:
 # sync-plane stats contract check (docs/OBSERVABILITY.md "Sync plane"):
 # ~200 concurrent clients against BOTH sync backends must conserve
 # stats exactly (Σ server op counters == client-side op count), answer
-# the wire-versioned sync_stats v2 shape, reconcile a live
-# `tg sync-service --metrics-port` scrape with a `tg sync-stats`
-# snapshot, log the heartbeat line, and keep the always-on
-# instrumentation overhead sane; the full 1k-10k fan-in ramp stays
-# manual (tools/bench_sync_fanin.py, PERF.md "Sync fan-in")
+# the wire-versioned sync_stats v2 shape, pass a 1k-client fan-in rung
+# through the real bench machinery (the event-loop rewrite's mid-scale
+# tripwire), reconcile a live `tg sync-service --metrics-port` scrape
+# with a `tg sync-stats` snapshot, log the heartbeat line, and keep the
+# always-on instrumentation overhead sane; the full 1k-10k fan-in ramp
+# stays manual (tools/bench_sync_fanin.py, PERF.md "Sync fan-in (r2)")
 sync-fanin-smoke:
 	$(PY) tools/sync_fanin_smoke.py
 

@@ -1568,6 +1568,14 @@ def register_sync_service(sub) -> None:
         "reconnect before its eviction event is published",
     )
     p.add_argument(
+        "--shards",
+        type=int,
+        default=0,
+        help="event-loop shards (0 = backend auto: native picks "
+        "min(4, cores), python runs one loop — docs/CROSSHOST.md "
+        "'Server architecture')",
+    )
+    p.add_argument(
         "--metrics-port",
         type=int,
         default=-1,
@@ -1605,6 +1613,7 @@ def sync_service_cmd(args) -> int:
             evict_grace=args.evict_grace,
             bin_dir=os.path.join(EnvConfig.load().dirs.work(), "bin"),
             log=lambda msg: print(msg, file=sys.stderr),
+            shards=args.shards,
         )
     except Exception as e:  # noqa: BLE001 — boot failures exit readably
         print(f"sync-service: {e}", file=sys.stderr)
@@ -1669,11 +1678,29 @@ def register_sync_stats(sub) -> None:
         default=5.0,
         help="connect + reply timeout in seconds",
     )
+    p.add_argument(
+        "--watch",
+        type=float,
+        default=0.0,
+        metavar="N",
+        help="refresh every N seconds (an operator's live view of a "
+        "ramp without Prometheus; each refresh is the exporter's same "
+        "one-shot fetch; Ctrl-C exits; under --json one payload line "
+        "per refresh)",
+    )
+    p.add_argument(
+        "--watch-count",
+        type=int,
+        default=0,
+        help="stop after this many --watch refreshes (0 = until "
+        "Ctrl-C; for scripting)",
+    )
     p.set_defaults(func=sync_stats_cmd)
 
 
 def sync_stats_cmd(args) -> int:
     import json
+    import time
 
     from testground_tpu.runners.pretty import render_sync_stats
     from testground_tpu.sync.stats import fetch_sync_stats
@@ -1685,19 +1712,51 @@ def sync_stats_cmd(args) -> int:
             file=sys.stderr,
         )
         return 2
-    try:
-        stats = fetch_sync_stats(host, int(port), timeout=args.timeout)
-    except (OSError, ValueError) as e:
-        print(
-            f"sync-stats: sync service at {args.address} unreachable: {e}",
-            file=sys.stderr,
-        )
-        return 1
-    if getattr(args, "json", False):
-        print(json.dumps(stats, indent=2, sort_keys=True))
-    else:
-        print(render_sync_stats(stats))
-    return 0
+    watch = max(0.0, getattr(args, "watch", 0.0) or 0.0)
+    as_json = getattr(args, "json", False)
+    shown = 0
+    while True:
+        try:
+            stats = fetch_sync_stats(host, int(port), timeout=args.timeout)
+        except (OSError, ValueError) as e:
+            print(
+                f"sync-stats: sync service at {args.address} "
+                f"unreachable: {e}",
+                file=sys.stderr,
+            )
+            # one-shot: unreachable is an error; watching: a live ramp's
+            # service may restart — keep watching unless it never answered
+            if not watch or shown == 0:
+                return 1
+        else:
+            if as_json:
+                print(
+                    json.dumps(
+                        stats,
+                        indent=None if watch else 2,
+                        sort_keys=True,
+                    ),
+                    flush=True,
+                )
+            else:
+                if watch and shown and sys.stdout.isatty():
+                    print("\x1b[2J\x1b[H", end="")  # clear between frames
+                header = (
+                    f"--- {args.address} @ {time.strftime('%H:%M:%S')} "
+                    f"(refresh {watch:g}s, Ctrl-C to exit) ---"
+                )
+                if watch:
+                    print(header)
+                print(render_sync_stats(stats), flush=True)
+            shown += 1
+        if not watch:
+            return 0
+        if args.watch_count and shown >= args.watch_count:
+            return 0
+        try:
+            time.sleep(watch)
+        except KeyboardInterrupt:
+            return 0
 
 
 def register_sim_worker(sub) -> None:

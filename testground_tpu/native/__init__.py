@@ -1,6 +1,16 @@
 """Native runtime components (C++), built on demand with the system
 toolchain and cached under ``$TESTGROUND_HOME/work/bin``."""
 
-from .syncsvc import NativeSyncService, build_syncsvc, native_available
+from .syncsvc import (
+    NativeSyncService,
+    build_fanin_driver,
+    build_syncsvc,
+    native_available,
+)
 
-__all__ = ["NativeSyncService", "build_syncsvc", "native_available"]
+__all__ = [
+    "NativeSyncService",
+    "build_fanin_driver",
+    "build_syncsvc",
+    "native_available",
+]

@@ -20,37 +20,56 @@ import uuid
 
 from testground_tpu.logging_ import S
 
-__all__ = ["NativeSyncService", "build_syncsvc", "native_available"]
+__all__ = [
+    "NativeSyncService",
+    "build_syncsvc",
+    "build_fanin_driver",
+    "native_available",
+]
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "syncsvc.cc")
+_DRIVER_SRC = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fanin_driver.cc"
+)
 
 
 def native_available() -> bool:
     return shutil.which("g++") is not None and os.path.isfile(_SRC)
 
 
-def build_syncsvc(bin_dir: str) -> str:
-    """Compile (or reuse) the server binary; returns its path. The binary
+def _build_native(src: str, name: str, bin_dir: str) -> str:
+    """Compile (or reuse) a native binary; returns its path. The binary
     name embeds the source hash, so edits rebuild and stale caches never
     serve."""
-    with open(_SRC, "rb") as f:
+    with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:12]
     os.makedirs(bin_dir, exist_ok=True)
-    out = os.path.join(bin_dir, f"tg-syncsvc-{digest}")
+    out = os.path.join(bin_dir, f"{name}-{digest}")
     if os.path.isfile(out):
         return out
     # unique per builder — including threads within one engine process
     # (DEFAULT_WORKERS=2 can race here on a cold cache)
     tmp = f"{out}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
     subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-o", tmp, _SRC],
+        ["g++", "-O2", "-std=c++17", "-pthread", "-o", tmp, src],
         check=True,
         capture_output=True,
         text=True,
     )
     os.replace(tmp, out)  # atomic install; last writer wins with same bits
-    S().debug("built native sync service: %s", out)
+    S().debug("built native binary: %s", out)
     return out
+
+
+def build_syncsvc(bin_dir: str) -> str:
+    """Compile (or reuse) the sync-server binary; returns its path."""
+    return _build_native(_SRC, "tg-syncsvc", bin_dir)
+
+
+def build_fanin_driver(bin_dir: str) -> str:
+    """Compile (or reuse) the fan-in bench's mini-client fleet driver
+    (``fanin_driver.cc``, used by ``tools/bench_sync_fanin.py``)."""
+    return _build_native(_DRIVER_SRC, "tg-fanin-driver", bin_dir)
 
 
 class NativeSyncService:
@@ -68,6 +87,8 @@ class NativeSyncService:
         port: int = 0,
         idle_timeout: float = 0.0,
         evict_grace: float = 2.0,
+        shards: int = 0,
+        max_wbuf: int = 0,
     ):
         argv = [
             bin_path,
@@ -80,6 +101,10 @@ class NativeSyncService:
         ]
         if idle_timeout > 0:
             argv += ["--idle-timeout", str(float(idle_timeout))]
+        if shards > 0:  # 0 = server-side auto (docs/CROSSHOST.md)
+            argv += ["--shards", str(int(shards))]
+        if max_wbuf > 0:  # slow-reader outbound-queue bound, bytes
+            argv += ["--max-wbuf", str(int(max_wbuf))]
         self._proc = subprocess.Popen(
             argv,
             stdout=subprocess.PIPE,

@@ -89,6 +89,10 @@ class LocalExecConfig:
     # window an abnormally-disconnected instance has to reconnect before
     # its eviction event is published (reconnects are not deaths)
     sync_evict_grace_secs: float = 2.0
+    # event-loop shards for the per-run sync server (0 = backend auto:
+    # native picks min(4, cores), python runs one loop — see
+    # docs/CROSSHOST.md "Server architecture")
+    sync_shards: int = 0
 
 
 class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
@@ -209,6 +213,7 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
             evict_grace=float(getattr(cfg, "sync_evict_grace_secs", 2.0)),
             bin_dir=os.path.join(job.env.dirs.work(), "bin"),
             log=lambda msg: ow.infof("%s", msg),
+            shards=int(getattr(cfg, "sync_shards", 0) or 0),
         )
 
     @staticmethod

@@ -21,6 +21,7 @@ def boot_sync_service(
     evict_grace: float,
     bin_dir: str,
     log: Callable[[str], None] | None = None,
+    shards: int = 0,
 ):
     """Start a sync service and return it (``.address`` / ``.stop()``).
 
@@ -28,7 +29,8 @@ def boot_sync_service(
     into ``bin_dir``), ``"python"`` = the in-process server, ``"auto"``
     = native when a toolchain is available, falling back to python with
     a ``log`` notice. A forced native mode raises instead of falling
-    back."""
+    back. ``shards`` is the event-loop count (0 = backend auto:
+    native picks min(4, cores), python runs one loop)."""
     if mode not in ("auto", "python", "native"):
         raise ValueError(f"unknown sync_service mode {mode!r}")
     if mode in ("auto", "native"):
@@ -47,6 +49,7 @@ def boot_sync_service(
                     port=port,
                     idle_timeout=idle_timeout,
                     evict_grace=evict_grace,
+                    shards=shards,
                 )
                 if log:
                     log(f"sync service: native ({path})")
@@ -66,5 +69,9 @@ def boot_sync_service(
     from .server import SyncServiceServer
 
     return SyncServiceServer(
-        host=host, port=port, idle_timeout=idle_timeout, evict_grace=evict_grace
+        host=host,
+        port=port,
+        idle_timeout=idle_timeout,
+        evict_grace=evict_grace,
+        shards=max(1, shards),
     ).start()

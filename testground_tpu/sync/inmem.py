@@ -85,6 +85,15 @@ class InMemSyncService:
         with self._lock:
             return self._counters.get(state, 0)
 
+    def counters_snapshot(self, states) -> dict[str, int]:
+        """Batched counter read for the event-loop server's coalesced
+        release pass: after a drain touches many states, ONE lock
+        acquisition answers all of them (the release decision then fans
+        out every satisfiable waiter in one sweep)."""
+        with self._lock:
+            get = self._counters.get
+            return {s: get(s, 0) for s in states}
+
     def barrier(
         self,
         state: str,
@@ -165,6 +174,16 @@ class InMemSyncService:
     def get_entries(self, topic: str, start: int = 0) -> list[Any]:
         with self._lock:
             return list(self._topics.get(topic, [])[start:])
+
+    def entries_since(self, topic: str, start: int) -> tuple[int, list[Any]]:
+        """(topic length, entries[start:]) in one lock acquisition — the
+        event-loop server's fanout pass reads each touched topic once
+        per drain and distributes to every subscriber cursor from it."""
+        with self._lock:
+            entries = self._topics.get(topic)
+            if not entries:
+                return 0, []
+            return len(entries), list(entries[start:])
 
     def subscribe(
         self,

@@ -194,46 +194,62 @@ class SyncStats:
         with self._lock:
             self.ops[op] += 1
 
+    def _record_time_locked(self, op: str, us: float) -> None:
+        """THE one histogram write (lock held): clamp, bin, total, max."""
+        n = max(0, int(us))
+        bins = self._op_bins.get(op)
+        if bins is None:
+            bins = self._op_bins[op] = [0] * TIME_BINS
+            self._op_total_us[op] = 0
+            self._op_max_us[op] = 0
+        bins[time_bin(n)] += 1
+        self._op_total_us[op] += n
+        if n > self._op_max_us[op]:
+            self._op_max_us[op] = n
+
     def op_done(self, op: str, us: float) -> None:
         """Count + service-time in ONE lock acquisition — the hot path
         for inline-answered ops (the server calls this just before the
         reply hits the socket, so a reply a client has seen is always
-        already counted; the bin math is precomputed outside the lock).
-        """
+        already counted)."""
         if op not in self.ops:
             return
-        n = int(us)
-        if n < 0:
-            n = 0
-        b = n.bit_length() - 1 if n >= 1 else 0
-        if b > TIME_BINS - 1:
-            b = TIME_BINS - 1
         with self._lock:
             self.ops[op] += 1
-            bins = self._op_bins.get(op)
-            if bins is None:
-                bins = self._op_bins[op] = [0] * TIME_BINS
-                self._op_total_us[op] = 0
-                self._op_max_us[op] = 0
-            bins[b] += 1
-            self._op_total_us[op] += n
-            if n > self._op_max_us[op]:
-                self._op_max_us[op] = n
+            self._record_time_locked(op, us)
 
     def time_op(self, op: str, us: float) -> None:
         if op not in self.ops:
             return
-        n = max(0, int(us))
         with self._lock:
-            bins = self._op_bins.get(op)
-            if bins is None:
-                bins = self._op_bins[op] = [0] * TIME_BINS
-                self._op_total_us[op] = 0
-                self._op_max_us[op] = 0
-            bins[time_bin(n)] += 1
-            self._op_total_us[op] += n
-            if n > self._op_max_us[op]:
-                self._op_max_us[op] = n
+            self._record_time_locked(op, us)
+
+    # ------------------------------------------------------ batched hooks
+    # The event-loop servers drain MANY ready ops per wake; these flush
+    # a whole drain's accounting under ONE lock acquisition instead of
+    # one per op (the hot-path half of the <5% instrumentation budget).
+
+    def op_done_batch(self, items: list) -> None:
+        """Count + time a batch of completed inline ops in one lock
+        acquisition; ``items`` is ``[(op, us), ...]``."""
+        if not items:
+            return
+        with self._lock:
+            for op, us in items:
+                if op not in self.ops:
+                    continue
+                self.ops[op] += 1
+                self._record_time_locked(op, us)
+
+    def time_op_batch(self, items: list) -> None:
+        """Service-time-only batch (ops already counted at dispatch —
+        the parked barrier/signal_and_wait path); ``[(op, us), ...]``."""
+        if not items:
+            return
+        with self._lock:
+            for op, us in items:
+                if op in self.ops:
+                    self._record_time_locked(op, us)
 
     # ----------------------------------------------------- connections
 
@@ -269,29 +285,45 @@ class SyncStats:
                 self._armed[key] = self._clock()
                 self.episodes_armed += 1
 
+    def _close_episode_locked(
+        self, state: str, target: int, released: bool
+    ) -> None:
+        """ANY terminal outcome closes the episode's arm record (lock
+        held) — a timed-out/canceled episode must not pin (state,
+        target) armed forever (it would block re-arming AND leak toward
+        _MAX_ARMED); only a release records armed→release timing."""
+        t0 = self._armed.pop((state, int(target)), None)
+        if not released or t0 is None:
+            return  # non-release outcome, or a later waiter of an
+            # already-closed episode
+        wall_ms = max(0.0, (self._clock() - t0) * 1e3)
+        self.episodes_released += 1
+        rec = self._by_target.setdefault(target_bucket(target), [0, 0.0, 0.0])
+        rec[0] += 1
+        rec[1] += wall_ms
+        if wall_ms > rec[2]:
+            rec[2] = wall_ms
+
     def _barrier_done(self, counter: str, state: str, target: int) -> None:
         with self._lock:
             setattr(self, counter, getattr(self, counter) + 1)
-            # ANY terminal outcome closes the episode's arm record — a
-            # timed-out/canceled episode must not pin (state, target)
-            # armed forever (it would block re-arming AND leak toward
-            # _MAX_ARMED); only a release records timing
-            t0 = self._armed.pop((state, int(target)), None)
-            if counter != "bar_released" or t0 is None:
-                return  # non-release outcome, or a later waiter of an
-                # already-closed episode
-            wall_ms = max(0.0, (self._clock() - t0) * 1e3)
-            self.episodes_released += 1
-            rec = self._by_target.setdefault(
-                target_bucket(target), [0, 0.0, 0.0]
+            self._close_episode_locked(
+                state, target, counter == "bar_released"
             )
-            rec[0] += 1
-            rec[1] += wall_ms
-            if wall_ms > rec[2]:
-                rec[2] = wall_ms
 
     def barrier_released(self, state: str, target: int) -> None:
         self._barrier_done("bar_released", state, target)
+
+    def barrier_released_batch(self, state: str, target: int, n: int) -> None:
+        """Coalesced barrier release: ``n`` waiters of one (state,
+        target) episode released in one fan-out pass — one lock, one
+        episode close (the wall recorded once, as the first releaser
+        would have)."""
+        if n <= 0:
+            return
+        with self._lock:
+            self.bar_released += n
+            self._close_episode_locked(state, target, True)
 
     def barrier_timed_out(self, state: str, target: int) -> None:
         self._barrier_done("bar_timed_out", state, target)

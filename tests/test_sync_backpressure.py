@@ -1,0 +1,351 @@
+"""Slow-reader backpressure + event-loop sharding (the 10k fan-in
+server rewrite, docs/CROSSHOST.md "Server architecture").
+
+The regression this pins: a subscribed client that STOPS READING while
+a hot topic floods must not delay other peers' barrier releases past a
+bound — on BOTH backends. In an event-loop server a stalled reader's
+backlog is the one thing that can wedge everyone (the old thread-per-
+connection design isolated it by accident); the bounded per-peer
+outbound queues exist to kill exactly this shape: once the backlog
+trips the bound the peer is shed (dropped + counted as an eviction) and
+every other connection stays live and fast.
+
+Plus a sharded-loop parity check: with connections spread across
+multiple event loops, cross-shard barrier releases and pubsub fanout
+must behave exactly like the single-loop default.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from testground_tpu.sync import SyncClient, SyncRetry, SyncServiceServer
+from testground_tpu.sync.stats import fetch_sync_stats
+
+# small outbound bound so the shed trips fast in a test (production
+# default is 16 MiB; see SyncServiceServer.outq_limit / --max-wbuf)
+OUTQ_BOUND = 65536
+
+
+def _fast_retry():
+    return SyncRetry(
+        connect_timeout=2.0,
+        attempts=2,
+        deadline_secs=3.0,
+        heartbeat_secs=0.0,
+    )
+
+
+@pytest.fixture(scope="session")
+def native_bin(tmp_path_factory):
+    from testground_tpu.native import build_syncsvc, native_available
+
+    if not native_available():
+        pytest.skip("no C++ toolchain for the native sync service")
+    return build_syncsvc(str(tmp_path_factory.mktemp("syncsvc-bin")))
+
+
+@pytest.fixture(params=["python", "native"])
+def bounded_server(request):
+    """A server of either backend with a tiny per-peer outbound bound;
+    yields (address, backend)."""
+    if request.param == "python":
+        srv = SyncServiceServer(outq_limit=OUTQ_BOUND).start()
+        yield srv.address, "python"
+        srv.stop()
+    else:
+        from testground_tpu.native import NativeSyncService
+
+        srv = NativeSyncService(
+            request.getfixturevalue("native_bin"), max_wbuf=OUTQ_BOUND
+        )
+        yield srv.address, "native"
+        srv.stop()
+
+
+def _stalled_subscriber(host, port, topic):
+    """A raw socket that subscribes and then never reads again — the
+    SIGSTOPped/wedged-consumer shape. A tiny SO_RCVBUF keeps the kernel
+    from absorbing the flood on the server's behalf."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+    s.connect((host, port))
+    s.sendall(
+        (json.dumps({"id": 1, "op": "subscribe", "topic": topic}) + "\n")
+        .encode()
+    )
+    return s
+
+
+class TestSlowReaderBackpressure:
+    def test_stalled_subscriber_never_delays_barriers(self, bounded_server):
+        (host, port), backend = bounded_server
+        evict0 = (
+            (fetch_sync_stats(host, port).get("conn") or {}).get(
+                "evictions", 0
+            )
+        )
+        stalled = _stalled_subscriber(host, port, "hot")
+        publisher = SyncClient(host, port, retry=_fast_retry())
+        a = SyncClient(host, port, namespace="bp:", retry=_fast_retry())
+        b = SyncClient(host, port, namespace="bp:", retry=_fast_retry())
+        # the kernel absorbs ~4 MiB (tcp_wmem autotuning) before the
+        # server-side queue starts growing at all — the flood must
+        # overrun that AND the 64 KiB bound to prove the shed
+        payload = {"blob": "x" * 8192}
+        worst_barrier = 0.0
+        try:
+            # flood the hot topic while measuring unrelated 2-party
+            # barriers; each round must release promptly even while the
+            # stalled reader's backlog grows toward the bound
+            for round_ in range(10):
+                for _ in range(60):
+                    publisher.publish("hot", payload)
+                got = {}
+
+                def other(i=round_):
+                    got["b"] = b.signal_and_wait(f"gate-{i}", 2, timeout=10)
+
+                t = threading.Thread(target=other, daemon=True)
+                t0 = time.monotonic()
+                t.start()
+                a.signal_and_wait(f"gate-{round_}", 2, timeout=10)
+                t.join(timeout=10)
+                wall = time.monotonic() - t0
+                worst_barrier = max(worst_barrier, wall)
+                assert got.get("b") in (1, 2)
+                assert wall < 5.0, (
+                    f"{backend}: barrier round {round_} took {wall:.1f}s "
+                    "behind a stalled subscriber"
+                )
+            # the flood replicated ~5 MiB into a reader with an 8 KiB
+            # receive window: past the kernel's autotuned send buffer
+            # the server-side backlog trips the 64 KiB bound — the
+            # server must have shed it, counted as an eviction
+            deadline = time.monotonic() + 10
+            evictions = 0
+            while time.monotonic() < deadline:
+                snap = fetch_sync_stats(host, port)
+                evictions = (snap.get("conn") or {}).get("evictions", 0)
+                if evictions > evict0:
+                    break
+                time.sleep(0.2)
+            assert evictions > evict0, (
+                f"{backend}: stalled subscriber was never shed "
+                f"(evictions {evict0} -> {evictions})"
+            )
+            # healthy clients are untouched
+            assert publisher.counter("nothing") == 0
+            assert a.signal_entry("still-alive") == 1
+        finally:
+            stalled.close()
+            publisher.close()
+            a.close()
+            b.close()
+
+    def test_fast_subscriber_still_sees_the_flood(self, bounded_server):
+        """The bound sheds READERS THAT STOPPED, not slow-but-live
+        ones: a subscriber that keeps draining receives every entry."""
+        (host, port), backend = bounded_server
+        sub_client = SyncClient(host, port, retry=_fast_retry())
+        publisher = SyncClient(host, port, retry=_fast_retry())
+        try:
+            entries = sub_client.subscribe("steady", timeout=15)
+            for i in range(100):
+                publisher.publish("steady", {"i": i})
+            got = [next(entries)["i"] for _ in range(100)]
+            assert got == list(range(100)), f"{backend}: lost entries"
+        finally:
+            sub_client.close()
+            publisher.close()
+
+
+class TestHostileLines:
+    """Wire robustness of the event loops: a hostile or odd line must
+    cost at most its own connection, never the loop."""
+
+    @pytest.fixture(params=["python", "native"])
+    def any_server(self, request):
+        if request.param == "python":
+            srv = SyncServiceServer().start()
+            yield srv.address
+            srv.stop()
+        else:
+            from testground_tpu.native import NativeSyncService
+
+            srv = NativeSyncService(request.getfixturevalue("native_bin"))
+            yield srv.address
+            srv.stop()
+
+    def test_non_dict_json_line_does_not_kill_the_loop(self, any_server):
+        # regression: `5\n` parses as an int; the dispatch must answer
+        # "malformed request" — an uncaught AttributeError here killed
+        # the whole event loop (every connection on the shard)
+        host, port = any_server
+        s = socket.create_connection((host, port), timeout=5)
+        for hostile in (b"5\n", b"null\n", b'"str"\n', b"[1,2]\n"):
+            s.sendall(hostile)
+            assert b'"error"' in s.recv(4096)
+        s2 = socket.create_connection((host, port), timeout=5)
+        s2.sendall(b'{"id": 1, "op": "ping"}\n')
+        assert b"pong" in s2.recv(4096)  # the loop is still serving
+        s.close()
+        s2.close()
+
+    def test_escaped_op_and_state_signal_and_wait(self, any_server):
+        # regression (native): the op name parsed into a scratch buffer
+        # that state-parsing then reused — an escape-containing
+        # signal_and_wait was silently parked as a plain barrier (its
+        # signal never applied; a cohort would deadlock)
+        host, port = any_server
+        s = socket.create_connection((host, port), timeout=5)
+        f = s.makefile("rw", encoding="utf-8")
+        f.write(
+            '{"op": "signal\\u005fand\\u005fwait", "id": 9, '
+            '"state": "s\\u0074", "target": 1, "timeout": 5}\n'
+        )
+        f.flush()
+        reply = json.loads(f.readline())
+        assert reply.get("seq") == 1 and reply.get("ok") is True, reply
+        f.write('{"op": "counter", "id": 10, "state": "st"}\n')
+        f.flush()
+        assert json.loads(f.readline())["count"] == 1
+        s.close()
+
+
+class TestWatchCLI:
+    """``tg sync-stats --watch N``: the operator's live-ramp view —
+    periodic refreshes of the same one-shot fetch the exporter uses."""
+
+    def test_watch_emits_periodic_frames(self, capsys):
+        from testground_tpu.cli.main import main
+
+        srv = SyncServiceServer().start()
+        try:
+            c = SyncClient(*srv.address, retry=_fast_retry())
+            c.signal_entry("w")
+            addr = f"{srv.address[0]}:{srv.address[1]}"
+            rc = main(
+                ["sync-stats", addr, "--watch", "0.1", "--watch-count", "3"]
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert out.count("stats v2") == 3  # three rendered frames
+            assert out.count("refresh 0.1s") == 3
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_watch_json_emits_one_payload_per_refresh(self, capsys):
+        from testground_tpu.cli.main import main
+
+        srv = SyncServiceServer().start()
+        try:
+            addr = f"{srv.address[0]}:{srv.address[1]}"
+            rc = main(
+                [
+                    "sync-stats", addr, "--json",
+                    "--watch", "0.05", "--watch-count", "2",
+                ]
+            )
+            assert rc == 0
+            lines = [
+                ln
+                for ln in capsys.readouterr().out.splitlines()
+                if ln.strip()
+            ]
+            assert len(lines) == 2
+            for ln in lines:
+                assert json.loads(ln)["v"] == 2
+        finally:
+            srv.stop()
+
+    def test_watch_unreachable_first_fetch_fails(self, capsys):
+        from testground_tpu.cli.main import main
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        rc = main(
+            [
+                "sync-stats", f"127.0.0.1:{port}",
+                "--timeout", "1", "--watch", "0.1",
+            ]
+        )
+        assert rc == 1
+        assert "unreachable" in capsys.readouterr().err
+
+
+class TestShardedLoops:
+    """Cross-shard correctness: with connections spread over N event
+    loops, releases and fanout must cross loops exactly like the
+    single-loop default (the knob: SyncServiceServer(shards=N) /
+    tg-syncsvc --shards N)."""
+
+    @pytest.fixture(params=["python", "native"])
+    def sharded_server(self, request):
+        if request.param == "python":
+            srv = SyncServiceServer(shards=2).start()
+            yield srv.address
+            srv.stop()
+        else:
+            from testground_tpu.native import NativeSyncService
+
+            srv = NativeSyncService(
+                request.getfixturevalue("native_bin"), shards=2
+            )
+            yield srv.address
+            srv.stop()
+
+    def test_cross_shard_barrier_and_fanout(self, sharded_server):
+        host, port = sharded_server
+        clients = [
+            SyncClient(host, port, namespace="sh:", retry=_fast_retry())
+            for _ in range(4)
+        ]
+        try:
+            # barrier across all 4 (round-robin sharding puts them on
+            # different loops; the release must fan out across shards)
+            results = []
+            threads = [
+                threading.Thread(
+                    target=lambda c=c: results.append(
+                        c.signal_and_wait("all", 4, timeout=10)
+                    ),
+                    daemon=True,
+                )
+                for c in clients
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert sorted(results) == [1, 2, 3, 4]
+            # pubsub fanout across shards: every client sees every entry
+            subs = [c.subscribe("bcast", timeout=10) for c in clients]
+            clients[0].publish("bcast", "a")
+            clients[3].publish("bcast", "b")
+            for sub in subs:
+                assert next(sub) == "a"
+                assert next(sub) == "b"
+            # occupancy accounting survives the spread
+            stats = clients[0].sync_stats()
+            assert stats["conns"] >= 4
+            # regression: a touched state forwarded between loops must
+            # be terminal — re-broadcasting it ping-pongs forever and
+            # the loops busy-spin at full CPU while completely idle
+            cpu0, wall0 = time.process_time(), time.monotonic()
+            time.sleep(0.6)
+            cpu = time.process_time() - cpu0
+            wall = time.monotonic() - wall0
+            assert cpu < 0.5 * wall, (
+                f"sharded loops burned {cpu:.2f}s CPU over {wall:.2f}s "
+                "idle — cross-shard touch ping-pong"
+            )
+        finally:
+            for c in clients:
+                c.close()

@@ -26,9 +26,19 @@ Plus the honesty check the always-on instrumentation owes: an
 
 Clients are deliberately NOT the SDK ``SyncClient`` (which spawns
 reader+heartbeat threads per connection — 3 × 10k threads of harness
-would drown the measurement): each worker process runs a selector-based
-event loop multiplexing its client share, one outstanding request per
-client, latency stamped send→reply.
+would drown the measurement): each worker multiplexes its client share
+in one event loop, one outstanding request per client, latency stamped
+send→reply. Since r2 the default worker is the NATIVE mini-client
+driver (``native/fanin_driver.cc``, ~1-2 µs/op) — r1 measured the
+Python selector workers as the pipeline ceiling on a small box (one
+worker alone tops out near 50k round-trips/s, so at 10k clients the
+harness, not the server, set flood p50). ``--driver python`` keeps the
+old workers for toolchain-less hosts; the bench JSON records which
+drove. Per rung the server's own footprint is sampled too (``/proc``):
+RSS and open-fd count at every phase boundary, peaks banked in the
+JSON — a collapse post-mortem needs resource context, not just
+latencies. ``--rungs`` doubles as the laptop escape hatch
+(``--rungs 100,1000`` stops the ramp at 1k).
 
 A rung that dies (thread exhaustion, timeouts, refused connects) is a
 RESULT, not a crash: the failure mode is recorded in the rung's JSON
@@ -373,6 +383,219 @@ def run_worker(wid, host, port, n_clients, total, cfg, barrier, outq):
         outq.put(res)
 
 
+def _split_share(width: int, procs: int) -> list[int]:
+    share = [width // procs] * procs
+    for i in range(width % procs):
+        share[i] += 1
+    return share
+
+
+class _PyFleet:
+    """The fork()ed selector-worker fleet (the r1 harness, kept as the
+    toolchain-less fallback): phase starts synchronized with the parent
+    via a shared barrier, results gathered once at the end."""
+
+    def __init__(self, host, port, width, procs, cfg):
+        ctx = mp.get_context("fork")
+        self._tmo = cfg["timeout"]
+        self._barrier = ctx.Barrier(procs + 1)
+        self._outq = ctx.Queue()
+        self.share = _split_share(width, procs)
+        self._workers = [
+            ctx.Process(
+                target=run_worker,
+                args=(
+                    i, host, port, self.share[i], width, cfg,
+                    self._barrier, self._outq,
+                ),
+                daemon=True,
+            )
+            for i in range(procs)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def phase(self, name):
+        self._barrier.wait(timeout=self._tmo)
+
+    def results(self):
+        res = [self._outq.get(timeout=self._tmo) for _ in self._workers]
+        for w in self._workers:
+            w.join(timeout=10)
+        return res
+
+    def salvage(self, rec):
+        """Failure path: whatever the dying workers managed to report
+        (they write their res on BrokenBarrierError)."""
+        time.sleep(2)
+        try:
+            while True:
+                r = self._outq.get_nowait()
+                rec["errors"] += [
+                    f"w{r.get('wid')}: {e}" for e in r.get("errors", ())
+                ][:5]
+                if "connected" in r:
+                    rec.setdefault("connected_at_failure", 0)
+                    rec["connected_at_failure"] += r["connected"]
+        except Exception:  # noqa: BLE001 — queue drained (or unusable)
+            pass
+
+    def terminate(self):
+        for w in self._workers:
+            if w.is_alive():
+                w.terminate()
+
+
+class _DriverFleet:
+    """The native mini-client fleet (default when a toolchain exists):
+    one ``tg-fanin-driver`` process per worker, "go" per phase on stdin,
+    one JSON record per phase on stdout (native/fanin_driver.cc)."""
+
+    def __init__(self, host, port, width, procs, cfg, driver_bin):
+        import queue as _queue
+        import threading
+
+        self._queue_mod = _queue
+        self._tmo = cfg["timeout"]
+        self.share = _split_share(width, procs)
+        self._records = {i: {} for i in range(procs)}
+        self._q: _queue.Queue = _queue.Queue()
+        self._procs = []
+        for wid in range(procs):
+            pub_subs = (
+                min(cfg["pub_subs"], max(1, self.share[0] - 1))
+                if wid == 0
+                else 0
+            )
+            argv = [
+                driver_bin,
+                "--host", host, "--port", str(port),
+                "--wid", str(wid),
+                "--clients", str(self.share[wid]),
+                "--total", str(width),
+                "--signal-ops", str(cfg["signal_ops"]),
+                "--pub-subs", str(pub_subs),
+                "--pub-entries", str(cfg["pub_entries"]),
+                "--timeout", str(cfg["timeout"]),
+            ]
+            p = subprocess.Popen(
+                argv,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            self._procs.append(p)
+            threading.Thread(
+                target=self._read_loop, args=(wid, p), daemon=True
+            ).start()
+
+    def _read_loop(self, wid, p):
+        for line in p.stdout:
+            try:
+                self._q.put((wid, json.loads(line)))
+            except json.JSONDecodeError:
+                pass
+        self._q.put((wid, None))  # EOF marker
+
+    def phase(self, name):
+        if name.endswith("go"):
+            for p in self._procs:
+                try:
+                    p.stdin.write("go\n")
+                    p.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass  # a dead driver surfaces at the "done" collect
+            return
+        # "<phase> done": collect one record per driver within deadline
+        want = name.split()[0]
+        deadline = time.monotonic() + self._tmo
+        got = 0
+        while got < len(self._procs):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"{len(self._procs) - got} driver(s) never finished "
+                    f"phase {want!r}"
+                )
+            try:
+                wid, msg = self._q.get(timeout=min(remaining, 1.0))
+            except self._queue_mod.Empty:
+                continue
+            if msg is None:
+                if want in self._records[wid]:
+                    continue  # clean exit after its final record
+                raise RuntimeError(f"driver w{wid} died in phase {want!r}")
+            self._records[wid][msg.get("phase", want)] = msg
+            got += 1
+
+    def results(self):
+        out = []
+        for wid, recs in self._records.items():
+            res = {"wid": wid, "errors": []}
+            for r in recs.values():
+                res["errors"] += list(r.get("errors", ()))
+            if "connect" in recs:
+                res["connected"] = recs["connect"].get("connected", 0)
+                res["connect_wall"] = recs["connect"].get("wall", 0.0)
+            if "flood" in recs:
+                res["flood_wall"] = recs["flood"].get("wall", 0.0)
+                res["flood_lats"] = recs["flood"].get("lats_ms", [])
+            if "storm" in recs:
+                res["storm_lats"] = recs["storm"].get("lats_ms", [])
+            ps = recs.get("pubsub")
+            if ps and not ps.get("skipped"):
+                res["pubsub"] = {
+                    "wall_secs": ps.get("wall", 0.0),
+                    "delivered": ps.get("delivered", 0),
+                }
+            out.append(res)
+        for p in self._procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        return out
+
+    def salvage(self, rec):
+        for wid, recs in self._records.items():
+            for r in recs.values():
+                rec["errors"] += [
+                    f"w{wid}: {e}" for e in r.get("errors", ())
+                ][:5]
+            if "connect" in recs:
+                rec.setdefault("connected_at_failure", 0)
+                rec["connected_at_failure"] += recs["connect"].get(
+                    "connected", 0
+                )
+
+    def terminate(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.kill()
+
+
+# --------------------------------------------------- server-side sampling
+
+
+def _server_resources(pid):
+    """One RSS + open-fd sample of the server process (``/proc``); None
+    off-Linux or once the process is gone."""
+    try:
+        rss_kb = 0
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss_kb = int(line.split()[1])
+                    break
+        return {
+            "rss_mb": round(rss_kb / 1024.0, 1),
+            "open_fds": len(os.listdir(f"/proc/{pid}/fd")),
+        }
+    except OSError:
+        return None
+
+
 def percentiles(lats, qs=(0.50, 0.95, 0.99)):
     if not lats:
         return {f"p{int(q * 100)}_ms": None for q in qs} | {"max_ms": None}
@@ -406,55 +629,55 @@ def run_rung(backend, width, procs, cfg, log=print):
     """One (backend, width) cell of the ramp. Returns the rung record;
     a failed rung records its failure mode instead of raising."""
     rec = {"clients": width, "procs": procs, "errors": []}
+    rec["driver"] = cfg.get("driver", "python")
     proc = None
-    workers = []
+    fleet = None
+    res_samples = {}
     at = {"phase": "startup"}  # bound before try: spawn can raise
     try:
         proc, (host, port) = spawn_backend(backend)
-        ctx = mp.get_context("fork")
-        barrier = ctx.Barrier(procs + 1)
-        outq = ctx.Queue()
-        share = [width // procs] * procs
-        for i in range(width % procs):
-            share[i] += 1
-        workers = [
-            ctx.Process(
-                target=run_worker,
-                args=(i, host, port, share[i], width, cfg, barrier, outq),
-                daemon=True,
+        if rec["driver"] == "native":
+            fleet = _DriverFleet(
+                host, port, width, procs, cfg, cfg["driver_bin"]
             )
-            for i in range(procs)
-        ]
-        for w in workers:
-            w.start()
-        tmo = cfg["timeout"]
+        else:
+            fleet = _PyFleet(host, port, width, procs, cfg)
+        share = fleet.share
 
         def phase(name):
             at["phase"] = name
-            barrier.wait(timeout=tmo)
+            fleet.phase(name)
 
+        def sample(point):
+            s = _server_resources(proc.pid)
+            if s is not None:
+                res_samples[point] = s
+
+        sample("startup")
         t_conn = time.perf_counter()
         phase("connect go")
         phase("connect done")
         conn_wall = time.perf_counter() - t_conn
+        sample("connect")
         snap0 = _stats_snap(host, port)
         t_flood = time.perf_counter()
         phase("flood go")
         phase("flood done")
         flood_wall = time.perf_counter() - t_flood
+        sample("flood")
         snap1 = _stats_snap(host, port)
         t_storm = time.perf_counter()
         phase("storm go")
         phase("storm done")
         storm_wall = time.perf_counter() - t_storm
+        sample("storm")
         snap2 = _stats_snap(host, port)
         phase("pubsub go")
         phase("pubsub done")
+        sample("pubsub")
         snap3 = _stats_snap(host, port)
 
-        results = [outq.get(timeout=tmo) for _ in workers]
-        for w in workers:
-            w.join(timeout=10)
+        results = fleet.results()
 
         connected = sum(r.get("connected", 0) for r in results)
         flood_lats = [x for r in results for x in r.get("flood_lats", ())]
@@ -530,6 +753,14 @@ def run_rung(backend, width, procs, cfg, log=print):
             "conns_hwm": (snap3.get("conn") or {}).get("hwm"),
             "waiters_hwm": (snap3.get("hwm") or {}).get("waiters"),
         }
+        if res_samples:
+            rec["server_resources"] = {
+                "rss_mb_peak": max(s["rss_mb"] for s in res_samples.values()),
+                "open_fds_peak": max(
+                    s["open_fds"] for s in res_samples.values()
+                ),
+                "samples": res_samples,
+            }
         ok = connected >= int(0.99 * width) and len(storm_lats) >= int(
             0.99 * width
         )
@@ -556,6 +787,7 @@ def run_rung(backend, width, procs, cfg, log=print):
                     for k, v in (snap.get("barriers") or {}).items()
                     if k != "episodes"
                 },
+                "resources": _server_resources(proc.pid),
                 "error": snap.get("error"),
             }
         else:
@@ -564,23 +796,12 @@ def run_rung(backend, width, procs, cfg, log=print):
                 if proc is not None
                 else "never started"
             }
-        time.sleep(2)  # broken-barrier workers are writing their res now
-        try:
-            while True:
-                r = outq.get_nowait()
-                rec["errors"] += [
-                    f"w{r.get('wid')}: {e}" for e in r.get("errors", ())
-                ][:5]
-                if "connected" in r:
-                    rec.setdefault("connected_at_failure", 0)
-                    rec["connected_at_failure"] += r["connected"]
-        except Exception:  # noqa: BLE001 — queue drained (or unusable)
-            pass
+        if fleet is not None:
+            fleet.salvage(rec)
         rec["errors"] = rec["errors"][:20]
     finally:
-        for w in workers:
-            if w.is_alive():
-                w.terminate()
+        if fleet is not None:
+            fleet.terminate()
         if proc is not None:
             proc.terminate()
             try:
@@ -669,6 +890,11 @@ def main(argv=None) -> int:
     ap.add_argument("--pub-entries", type=int, default=PUB_ENTRIES)
     ap.add_argument("--timeout", type=float, default=180.0,
                     help="per-phase deadline seconds")
+    ap.add_argument("--driver", choices=("auto", "native", "python"),
+                    default="auto",
+                    help="mini-client fleet: the native epoll driver "
+                    "(default when g++ exists; the harness stops being "
+                    "the bottleneck) or the r1 python selector workers")
     ap.add_argument("--no-ab", action="store_true",
                     help="skip the instrumentation A/B")
     ap.add_argument("--out", default="", help="write the JSON document here")
@@ -683,10 +909,23 @@ def main(argv=None) -> int:
         "pub_entries": args.pub_entries,
         "timeout": args.timeout,
     }
+    driver = args.driver
+    if driver == "auto":
+        from testground_tpu.native import native_available
+
+        driver = "native" if native_available() else "python"
+    cfg["driver"] = driver
+    if driver == "native":
+        from testground_tpu.native import build_fanin_driver
+
+        cfg["driver_bin"] = build_fanin_driver(
+            os.path.join("/tmp", "tg-syncsvc-bench")
+        )
     doc = {
         "bench": "sync_fanin",
         "rungs": rungs,
-        "config": {**cfg, "nofile": nofile},
+        "config": {**{k: v for k, v in cfg.items() if k != "driver_bin"},
+                   "nofile": nofile},
         "host": {
             "cpus": os.cpu_count(),
             "platform": platform.platform(),
@@ -698,7 +937,13 @@ def main(argv=None) -> int:
         doc["backends"][backend] = {}
         print(f"backend {backend}:")
         for width in rungs:
-            procs = args.procs or max(1, min(8, width // 250 or 1))
+            # ONE native driver epolls the whole fleet (measured faster
+            # than splitting: fewer context switches on small boxes);
+            # the python workers need the process spread
+            if driver == "native":
+                procs = args.procs or 1
+            else:
+                procs = args.procs or max(1, min(8, width // 250 or 1))
             doc["backends"][backend][str(width)] = run_rung(
                 backend, width, procs, cfg
             )

@@ -4,6 +4,10 @@ The CI-sized slice of the fan-in bench (~200 clients, BOTH backends,
 < 20 s — the full 1k-10k ramp stays manual: ``tools/bench_sync_fanin.py``).
 Asserts the contracts the stats plane owes:
 
+0. **mid-scale rung**: a 1k-client connect storm + flood + width-1000
+   barrier storm + pubsub fanout passes on both backends through the
+   real bench machinery (the event-loop rewrite cannot silently regress
+   between the 200-client contract check and the manual 10k ramp);
 1. **stats conservation**, per backend: Σ server-side op counters ==
    the client-side op count actually driven (signal flood + barrier
    storm + pubsub + the stats queries themselves — counted at dispatch,
@@ -250,6 +254,47 @@ def drive_cli_surfaces() -> None:
             svc.kill()
 
 
+def drive_1k_rung(backend: str) -> None:
+    """A mid-scale (1k-client) fan-in rung through the real bench
+    machinery — the regression tripwire for the event-loop rewrite (the
+    old thread-per-conn server passed 200 and collapsed at 10k; 1k is
+    the cheapest rung that exercises storm coalescing + the connect
+    backlog at scale)."""
+    from testground_tpu.native import build_fanin_driver, native_available
+
+    cfg = {
+        "signal_ops": 5,
+        "pub_subs": 50,
+        "pub_entries": 10,
+        "timeout": 60,
+        "driver": "python",
+    }
+    if native_available():
+        cfg["driver"] = "native"
+        cfg["driver_bin"] = build_fanin_driver(
+            os.path.join("/tmp", "tg-syncsvc-bench")
+        )
+    rec = B.run_rung(backend, 1000, 1 if cfg["driver"] == "native" else 4,
+                     cfg, log=lambda *_: None)
+    check(
+        rec.get("outcome") == "pass",
+        f"{backend}: 1k fan-in rung passes ({rec.get('outcome')}: "
+        f"{(rec.get('errors') or ['ok'])[:2]})",
+    )
+    bar = rec.get("barrier") or {}
+    check(
+        bar.get("completed") == 1000,
+        f"{backend}: width-1000 barrier storm fully released "
+        f"(p99 {bar.get('p99_ms')}ms)",
+    )
+    res = rec.get("server_resources") or {}
+    check(
+        (res.get("open_fds_peak") or 0) >= 1000,
+        f"{backend}: bench sampled server resources "
+        f"(rss {res.get('rss_mb_peak')}MB, fds {res.get('open_fds_peak')})",
+    )
+
+
 def main() -> int:
     t0 = time.monotonic()
     B.raise_nofile()
@@ -262,6 +307,8 @@ def main() -> int:
         print("note: no g++ — native backend skipped", file=sys.stderr)
     for backend in backends:
         drive_backend(backend)
+    for backend in backends:
+        drive_1k_rung(backend)
     drive_cli_surfaces()
     ab = B.run_ab(clients=100, reps=2, cfg={"signal_ops": 20, "timeout": 60})
     # CI boxes are noisy neighbors: assert a loose bound here; the tight
