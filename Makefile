@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
 	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke \
 	phases-smoke checkpoint-smoke crosshost-smoke pack-smoke \
-	sync-fanin-smoke
+	sync-fanin-smoke transport-smoke
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -129,6 +129,15 @@ pack-smoke:
 # stays manual (tools/bench_sync_fanin.py, PERF.md "Sync fan-in (r2)")
 sync-fanin-smoke:
 	$(PY) tools/sync_fanin_smoke.py
+
+# the transport=auto cost model + segmented pallas commit kernel
+# (PERF.md "Pallas transport kernels"): contrasting shapes must pick
+# BOTH backends in interpret scoring, an auto run must journal
+# sim.transport (stats line + tg_transport_resolved gauge), and the
+# two backends must agree bit-for-bit on a tile-spanning stream —
+# part of the observability-smoke CI set
+transport-smoke:
+	$(PY) tools/transport_smoke.py
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -99,21 +99,45 @@ def _bench_shape(name, n, ticks):
     raise KeyError(f"unknown bench workload {name!r}")
 
 
+# what a real TPU core's VMEM affords the commit kernel's row blocks —
+# the REMAINING envelope bound after the segmented kernel removed the
+# whole-stream term (PERF.md "Pallas transport kernels"); overridable
+# for parts with more on-chip memory
+try:
+    _PALLAS_VMEM_BUDGET = int(
+        os.environ.get("TG_PALLAS_VMEM_BUDGET", "") or 0
+    ) or 16 * 2**20
+except ValueError:  # malformed override must not kill xla-only benches
+    _PALLAS_VMEM_BUDGET = 16 * 2**20
+
+
 def _workloads_for(transport, n, only=None):
     """The bench workloads a (transport, n) pair can actually compile.
-    Storm's fan-out shape exceeds the pallas VMEM envelope at bench
-    scale (the WHOLE sorted stream must sit in VMEM — see
-    sim/pallas_transport.py) — measuring it would Mosaic-fail on chip
-    mid-bench, losing the run's result JSON."""
+    The segmented commit kernel (ISSUE 14) removed the whole-stream
+    VMEM cap that used to exclude storm under pallas outright; what
+    remains is the per-bucket ROW footprint (N·SLOTS-scaled), checked
+    here against the real-chip VMEM budget so an over-envelope rung is
+    skipped loudly instead of Mosaic-failing mid-bench and losing the
+    run's result JSON. Interpret mode (no real TPU) has no envelope —
+    nothing is skipped there."""
     names = [w for w in BENCH_WORKLOADS if only is None or w in only]
     if transport == "pallas" and "storm" in names:
-        names.remove("storm")
-        print(
-            f"# storm: skipped under transport=pallas @ {n} instances "
-            "(sorted stream exceeds the kernel VMEM envelope; see "
-            "sim/pallas_transport.py)",
-            file=sys.stderr,
-        )
+        import jax
+
+        from testground_tpu.sim.pallas_transport import commit_vmem_bytes
+
+        # storm statics: SLOTS = IN_MSGS = 16, W = 1, bool occupancy
+        # (TRACK_SRC = False), no etick in the bench programs
+        need = commit_vmem_bytes(n, 16, 1, occ_bool=True)
+        if jax.default_backend() == "tpu" and need > _PALLAS_VMEM_BUDGET:
+            names.remove("storm")
+            print(
+                f"# storm: skipped under transport=pallas @ {n} "
+                f"instances (row blocks need ~{need / 2**20:.0f} MiB "
+                f"of the {_PALLAS_VMEM_BUDGET / 2**20:.0f} MiB VMEM "
+                "budget; see PERF.md 'Pallas transport kernels')",
+                file=sys.stderr,
+            )
     return names
 
 

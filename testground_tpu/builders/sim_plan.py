@@ -152,10 +152,21 @@ class SimPlanBuilder(Builder, Precompiler):
         # the variant the run will actually trace. A cohort resolves
         # against the GLOBAL mesh at run time (always multi-device), so
         # coordinator_address forces xla here too — like the telemetry
-        # gate above, or the build warms a program the run never traces
-        transport = resolve_transport(cfg, _make_mesh(cfg.shard))
-        if getattr(cfg, "coordinator_address", ""):
-            transport = "xla"
+        # gate above, or the build warms a program the run never traces.
+        # transport=auto needs each run's SPECIALIZED shapes to score,
+        # so single-device auto resolves per run inside the loop below
+        # (same cost model, same decision cache — the executor then
+        # reuses the cached decision verbatim).
+        transport_auto = (
+            str(getattr(cfg, "transport", "xla") or "xla").lower() == "auto"
+            and not getattr(cfg, "coordinator_address", "")
+            and _make_mesh(cfg.shard) is None
+        )
+        transport = None
+        if not transport_auto:
+            transport = resolve_transport(cfg, _make_mesh(cfg.shard))
+            if getattr(cfg, "coordinator_address", ""):
+                transport = "xla"
         digests = {
             path: _source_digest(path) for path in set(artifacts.values())
         }
@@ -167,6 +178,10 @@ class SimPlanBuilder(Builder, Precompiler):
         # layout/params, every program-shaping option, backend + topology +
         # jax version); an edited plan re-keys via the source digest
         seen: set[str] = set()
+        # transport=auto load memo: (artifact, layout) → specialized
+        # (testcase, groups), shared across [[runs]] so the pre-key
+        # resolution never re-imports a plan it already specialized
+        load_memo: dict = {}
         for run in comp.runs:
             # fault schedules are program-shaping (the event tensors bake
             # into the traced tick), so they join the BuildKey and the
@@ -222,14 +237,116 @@ class SimPlanBuilder(Builder, Precompiler):
                 ),
                 warn=ow.warn,
             )
+            from testground_tpu.api import RunGroup
+
+            first = comp.get_group(run.groups[0].effective_group_id())
+            run_groups_in = [
+                RunGroup(
+                    id=rg.id,
+                    instances=rg.calculated_instance_count,
+                    parameters=dict(rg.test_params),
+                )
+                for rg in run.groups
+            ]
+            if bucket_plan is not None:
+                padded_in = [
+                    RunGroup(
+                        id=rg.id,
+                        instances=p,
+                        parameters=dict(rg.parameters),
+                    )
+                    for rg, p in zip(
+                        run_groups_in, bucket_plan.padded_counts
+                    )
+                ]
+            else:
+                padded_in = run_groups_in
+            loaded = None
+            run_transport = transport
+            if transport_auto:
+                # auto scores the SPECIALIZED shapes, so the load moves
+                # ahead of the BuildKey — the resolved backend is part
+                # of the key (a different backend is a different
+                # program), and the loaded testcase is reused below.
+                # This runs before the marker cache-hit check (the key
+                # needs the resolved backend), so: honor cancellation
+                # first, and memoize the load per layout — a warm
+                # many-[[runs]] build must not pay a plan import per
+                # cache hit (the decision cache already dedups scoring).
+                if cancel.is_set():
+                    return
+                from testground_tpu.sim.transport_model import (
+                    TransportContext,
+                )
+
+                load_key = (
+                    artifacts[first.id],
+                    tuple(
+                        (g.id, g.instances, json.dumps(
+                            dict(g.parameters), sort_keys=True
+                        ))
+                        for g in padded_in
+                    ),
+                )
+                if load_key in load_memo:
+                    testcase, groups = load_memo[load_key]
+                else:
+                    testcase, groups = load_and_specialize(
+                        artifacts[first.id],
+                        comp.global_.case,
+                        padded_in,
+                        cfg.tick_ms,
+                    )
+                    load_memo[load_key] = (testcase, groups)
+                if (
+                    bucket_plan is not None
+                    and "filter_rules" in type(testcase).SHAPING
+                    and len(groups) > 1
+                ):
+                    # executor fallback mirrored: this combination runs
+                    # exact shapes, so warm (and score) the exact program
+                    bucket_plan = None
+                    exact_key = (
+                        artifacts[first.id],
+                        tuple(
+                            (g.id, g.instances, json.dumps(
+                                dict(g.parameters), sort_keys=True
+                            ))
+                            for g in run_groups_in
+                        ),
+                    )
+                    if exact_key in load_memo:
+                        testcase, groups = load_memo[exact_key]
+                    else:
+                        testcase, groups = load_and_specialize(
+                            artifacts[first.id],
+                            comp.global_.case,
+                            run_groups_in,
+                            cfg.tick_ms,
+                        )
+                        load_memo[exact_key] = (testcase, groups)
+                loaded = (testcase, groups)
+                run_transport = resolve_transport(
+                    cfg,
+                    None,
+                    warn=ow.warn,
+                    context=TransportContext(
+                        testcase=testcase,
+                        groups=tuple(groups),
+                        test_plan=comp.global_.plan,
+                        test_case=comp.global_.case,
+                        tick_ms=cfg.tick_ms,
+                        chunk=cfg.chunk,
+                        telemetry=telemetry,
+                        validate=bool(getattr(cfg, "validate", False)),
+                        hosts=tuple(hosts),
+                        probe_reps=int(
+                            getattr(cfg, "transport_probe", 0) or 0
+                        ),
+                    ),
+                )
             spec = {
-                "sources": digests[
-                    artifacts[
-                        comp.get_group(
-                            run.groups[0].effective_group_id()
-                        ).id
-                    ]
-                ],
+                "sources": digests[artifacts[first.id]],
                 "plan": comp.global_.plan,
                 "case": comp.global_.case,
                 "groups": [
@@ -246,7 +363,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 "shard": cfg.shard,
                 "validate": bool(getattr(cfg, "validate", False)),
                 "telemetry": telemetry,
-                "transport": transport,
+                "transport": run_transport,
                 "faults": run_fault_specs,
                 "trace": run_trace_specs,
                 "slo": run_slo_specs,
@@ -279,57 +396,38 @@ class SimPlanBuilder(Builder, Precompiler):
             if cancel.is_set():
                 return
             t0 = time.perf_counter()
-            first = comp.get_group(run.groups[0].effective_group_id())
-            from testground_tpu.api import RunGroup
-
             # same load/specialize/construct helpers as the executor and
             # the sim-worker — the single-code-path guarantee behind the
             # "identical HLO" claim above. Under bucketing the testcase
             # specializes against the PADDED layout (executor rule),
             # fault selectors lower over the exact layout and remap,
             # and the flight recorder is off (the executor's gate).
-            run_groups_in = [
-                RunGroup(
-                    id=rg.id,
-                    instances=rg.calculated_instance_count,
-                    parameters=dict(rg.test_params),
-                )
-                for rg in run.groups
-            ]
-            if bucket_plan is not None:
-                padded_in = [
-                    RunGroup(
-                        id=rg.id,
-                        instances=p,
-                        parameters=dict(rg.parameters),
-                    )
-                    for rg, p in zip(
-                        run_groups_in, bucket_plan.padded_counts
-                    )
-                ]
+            # transport=auto already loaded (and fallback-checked) the
+            # testcase above to score it — reuse it here.
+            if loaded is not None:
+                testcase, groups = loaded
             else:
-                padded_in = run_groups_in
-            testcase, groups = load_and_specialize(
-                artifacts[first.id],
-                comp.global_.case,
-                padded_in,
-                cfg.tick_ms,
-            )
-            if (
-                bucket_plan is not None
-                and "filter_rules" in type(testcase).SHAPING
-                and len(groups) > 1
-            ):
-                # executor fallback mirrored: this combination runs
-                # exact shapes, so warm the exact program
-                bucket_plan = None
-                spec.pop("bucket", None)
                 testcase, groups = load_and_specialize(
                     artifacts[first.id],
                     comp.global_.case,
-                    run_groups_in,
+                    padded_in,
                     cfg.tick_ms,
                 )
+                if (
+                    bucket_plan is not None
+                    and "filter_rules" in type(testcase).SHAPING
+                    and len(groups) > 1
+                ):
+                    # executor fallback mirrored: this combination runs
+                    # exact shapes, so warm the exact program
+                    bucket_plan = None
+                    spec.pop("bucket", None)
+                    testcase, groups = load_and_specialize(
+                        artifacts[first.id],
+                        comp.global_.case,
+                        run_groups_in,
+                        cfg.tick_ms,
+                    )
             from testground_tpu.sim.engine import build_groups as _bg
 
             vgroups = (
@@ -365,7 +463,7 @@ class SimPlanBuilder(Builder, Precompiler):
                     if bucket_plan is None
                     else None
                 ),
-                transport=transport,
+                transport=run_transport,
                 live_counts=(
                     bucket_plan.live_counts
                     if bucket_plan is not None
@@ -477,7 +575,17 @@ class SimPlanBuilder(Builder, Precompiler):
                 int(mesh.devices.size),
             )
             return
-        transport = resolve_transport(cfg, mesh)
+        # transport=auto scores PER RUNG (the decision is shape-
+        # dependent: a 4k bucket and a 1M bucket may pick different
+        # backends) — resolved inside the loop with each rung's
+        # specialized context; explicit knobs resolve once here
+        transport_auto = (
+            str(getattr(cfg, "transport", "xla") or "xla").lower()
+            == "auto"
+        )
+        transport = (
+            None if transport_auto else resolve_transport(cfg, mesh)
+        )
         ladder = parse_ladder(getattr(cfg, "bucket_ladder", "") or None)
         run = comp.runs[0]
         first = comp.get_group(run.groups[0].effective_group_id())
@@ -503,6 +611,37 @@ class SimPlanBuilder(Builder, Precompiler):
                     ],
                     cfg.tick_ms,
                 )
+                if transport_auto:
+                    from testground_tpu.sim.transport_model import (
+                        TransportContext,
+                    )
+
+                    rung_transport = resolve_transport(
+                        cfg,
+                        None,
+                        warn=ow.warn,
+                        context=TransportContext(
+                            testcase=testcase,
+                            groups=tuple(groups),
+                            test_plan=comp.global_.plan,
+                            test_case=comp.global_.case,
+                            tick_ms=cfg.tick_ms,
+                            chunk=cfg.chunk,
+                            telemetry=telemetry,
+                            validate=bool(
+                                getattr(cfg, "validate", False)
+                            ),
+                            hosts=tuple(hosts),
+                            # same decision-cache key as the run's gate
+                            # — a probe-vs-static split between warming
+                            # and running would warm the wrong backend
+                            probe_reps=int(
+                                getattr(cfg, "transport_probe", 0) or 0
+                            ),
+                        ),
+                    )
+                else:
+                    rung_transport = transport
                 prog = make_sim_program(
                     testcase,
                     groups,
@@ -517,7 +656,7 @@ class SimPlanBuilder(Builder, Precompiler):
                     telemetry=telemetry,
                     faults=None,
                     trace=None,
-                    transport=transport,
+                    transport=rung_transport,
                     live_counts=tuple(counts),
                 )
                 _precheck_device_memory(prog, cfg, None, ow)

@@ -565,6 +565,32 @@ def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
                 ident,
                 pk.get("members"),
             )
+        # transport resolution (journal["sim"]["transport"]): an info
+        # gauge — constant 1, the record rides the labels. Cardinality
+        # is bounded: requested/resolved come from the 3-value knob and
+        # source from the model's fixed evidence kinds
+        tr = (
+            sim.get("transport")
+            if isinstance(sim.get("transport"), dict)
+            else {}
+        )
+        if tr.get("resolved"):
+            exp.add(
+                "tg_transport_resolved",
+                "gauge",
+                "Transport gate resolution for this run (info gauge, "
+                "value always 1): requested knob, resolved backend, and "
+                "the cost model's evidence source under transport=auto.",
+                {
+                    **ident,
+                    "requested": str(tr.get("requested", "?")),
+                    "resolved": str(tr.get("resolved", "?")),
+                    "source": str(
+                        (tr.get("scores") or {}).get("source", "explicit")
+                    ),
+                },
+                1,
+            )
         # phase attribution plane (journal["sim"]["phases"],
         # docs/OBSERVABILITY.md "Phase attribution"): per-phase cost
         # gauges plus the synthesized residual/total rows — the phase

@@ -47,6 +47,18 @@ def _fmt_count(v, missing: str = "?") -> str:
     return str(int(n)) if float(n).is_integer() else str(n)
 
 
+def _fmt_transport(tr: dict) -> str:
+    """One-line render of a ``sim.transport`` resolution block: the
+    resolved backend, the requested→resolved arrow when they differ (or
+    when the cost model decided), and the human-readable reason."""
+    req = tr.get("requested", "?")
+    res = tr.get("resolved", "?")
+    shown = res if req == res else f"{req} → {res}"
+    if tr.get("reason") and (req == "auto" or req != res):
+        shown += f" ({tr['reason']})"
+    return shown
+
+
 def render_telemetry_summary(stats: dict) -> str:
     """Render a completed task's telemetry summary as an aligned table —
     the console surface of the sim telemetry plane (``tg stats <task>``
@@ -93,6 +105,12 @@ def render_telemetry_summary(stats: dict) -> str:
             rows.append(
                 ("carry", f"{carry / 2**20:.2f} MiB device-resident")
             )
+        # transport resolution (journal["sim"]["transport"]): requested
+        # vs resolved plus the cost model's reason — e.g. "auto → pallas
+        # (commit+deliver bytes 2.1x the single-pass kernel estimate)"
+        tr = sim.get("transport") or {}
+        if tr.get("resolved"):
+            rows.append(("transport", _fmt_transport(tr)))
         # one-line performance-ledger teaser (full view: `tg perf`)
         perf_ex = (sim.get("perf") or {}).get("execute") or {}
         rate = _num(perf_ex.get("steady_peer_ticks_per_sec")) or _num(
@@ -411,6 +429,11 @@ def render_perf_summary(payload: dict) -> str:
                 f"{_fmt(sim.get('compile_secs'))}s first dispatch{split}",
             )
         )
+        # transport resolution — the backend this ledger measured, and
+        # why the gate picked it (the cost model's reason under auto)
+        tr = sim.get("transport") or {}
+        if tr.get("resolved"):
+            rows.append(("transport", _fmt_transport(tr)))
     # ``instances`` in the ledger is the EXACT live count — padded or
     # packed runs must never render inflated peer·ticks/s (the bucket
     # size is a separate annotation line below)

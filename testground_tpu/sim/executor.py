@@ -116,13 +116,25 @@ class SimJaxConfig:
     phases_measure: int = 0
     # transport backend for the calendar hot path (PERF.md "Pallas
     # transport kernels"): "xla" (default — the scatter path, program
-    # unchanged) or "pallas" (hand-tiled commit + delivery kernels,
-    # sim/pallas_transport.py; interpret mode off-TPU). Single-device
-    # only: under a mesh the run falls back to xla with a warning (the
-    # cross-shard scatter is the inter-chip traffic). A program-shaping
-    # option like telemetry: broadcast to cohort followers and keyed
-    # into the precompile BuildKey. CLI: --run-cfg transport=pallas
+    # unchanged), "pallas" (segmented VMEM-streaming commit + delivery
+    # kernels, sim/pallas_transport.py; interpret mode off-TPU), or
+    # "auto" — the measured cost model (sim/transport_model.py) scores
+    # the two per workload shape (banked chip verdicts > opt-in
+    # measured probe > static phase-ledger bytes) and journals the
+    # decision under sim.transport. Single-device only: under a mesh
+    # every value resolves to xla with a warning (the cross-shard
+    # scatter is the inter-chip traffic). The RESOLVED value is a
+    # program-shaping option like telemetry: broadcast to cohort
+    # followers and keyed into the precompile BuildKey. CLI:
+    # --run-cfg transport=auto
     transport: str = "xla"
+    # opt-in measured calibration for transport=auto: > 0 times both
+    # candidate backends' transport phases (deliver + net_commit)
+    # jitted in isolation for this many reps at the run's real shapes
+    # and picks the faster — two standalone compiles + 2K dispatches,
+    # all before the run's own trace, so strictly opt-in (meant for
+    # real-chip sessions; on CPU the pallas arm times the interpreter)
+    transport_probe: int = 0
     # shape bucketing (PERF.md "Serving: buckets + packing",
     # sim/buckets.py): "off" (default — exact shapes, the pre-bucket
     # program unchanged), "auto" (pad every group's instance count up to
@@ -302,29 +314,50 @@ def make_sim_program(
     )
 
 
-def resolve_transport(cfg, mesh, warn=None) -> str:
-    """The ONE transport-gate: validate the runner-config knob and apply
-    the single-device bound. Shared by the executor, the sim-worker
-    followers, and the sim:plan precompile so all three resolve the
-    same program variant (the telemetry-gate discipline). ``warn`` is a
-    ``(fmt, *args)`` callable for the loud fallback."""
-    transport = str(getattr(cfg, "transport", "xla") or "xla").lower()
-    if transport not in ("xla", "pallas"):
-        raise ValueError(
-            f"unknown transport {transport!r} in runner config: expected "
-            "'xla' or 'pallas' (--run-cfg transport=pallas)"
-        )
-    if transport == "pallas" and mesh is not None:
-        if warn is not None:
-            warn(
-                "transport=pallas supports a single device only (the "
-                "cross-shard calendar scatter is the inter-chip traffic) "
-                "— falling back to the XLA transport on this %d-device "
-                "mesh",
-                int(mesh.devices.size),
-            )
-        return "xla"
-    return transport
+def resolve_transport(cfg, mesh, warn=None, context=None) -> str:
+    """The ONE transport-gate: validate the runner-config knob, apply
+    the single-device bound, and resolve ``transport=auto`` through the
+    measured cost model (``sim/transport_model.py``). Shared by the
+    executor, the sim-worker followers, the pack path, and the
+    sim:plan precompile so all four resolve the same program variant
+    (the telemetry-gate discipline). ``warn`` is a ``(fmt, *args)``
+    callable for the loud fallback; ``context`` (a
+    ``transport_model.TransportContext``) carries the workload shapes
+    ``auto`` scores against — callers that can resolve ``auto`` build
+    one after specialization. Returns the resolved backend string;
+    callers that journal the full decision call ``decide_transport``
+    directly."""
+    from .transport_model import decide_transport
+
+    return decide_transport(cfg, mesh, context=context, warn=warn).resolved
+
+
+def _decide_transport_for(
+    job, cfg, mesh, testcase, groups, hosts, telemetry_on, ow
+):
+    """Executor-side transport resolution with the full workload
+    context, returning the journaled ``TransportDecision`` (the
+    ``resolve_transport`` gate with the scoring inputs this call site
+    already has in hand)."""
+    from .transport_model import TransportContext, decide_transport
+
+    return decide_transport(
+        cfg,
+        mesh,
+        context=TransportContext(
+            testcase=testcase,
+            groups=tuple(groups),
+            test_plan=job.test_plan,
+            test_case=job.test_case,
+            tick_ms=cfg.tick_ms,
+            chunk=cfg.chunk,
+            telemetry=bool(telemetry_on),
+            validate=bool(getattr(cfg, "validate", False)),
+            hosts=tuple(hosts),
+            probe_reps=int(getattr(cfg, "transport_probe", 0) or 0),
+        ),
+        warn=ow.warn,
+    )
 
 
 def resolve_buckets(cfg, counts, mesh=None, warn=None):
@@ -909,8 +942,12 @@ def _execute_sim_run(
             jax.process_index(),
         )
         # transport gate precedes the broadcast: followers must compile
-        # the POST-gate variant (a cohort mesh always forces xla)
-        transport = resolve_transport(cfg, mesh, ow.warn)
+        # the POST-gate variant (a cohort mesh always forces xla, so
+        # auto resolves before it ever reaches a follower)
+        transport_decision = _decide_transport_for(
+            job, cfg, mesh, testcase, groups, hosts, telemetry_on, ow
+        )
+        transport = transport_decision.resolved
         # followers compile the identical program from this spec
         broadcast_json(
             _cohort_job_spec(
@@ -933,9 +970,18 @@ def _execute_sim_run(
             )
     else:
         mesh = _make_mesh(cfg.shard)
-        transport = resolve_transport(cfg, mesh, ow.warn)
-    if transport != "xla":
-        ow.infof("sim:jax %s: transport backend = %s", job.run_id, transport)
+        transport_decision = _decide_transport_for(
+            job, cfg, mesh, testcase, groups, hosts, telemetry_on, ow
+        )
+        transport = transport_decision.resolved
+    if transport != "xla" or transport_decision.requested != "xla":
+        ow.infof(
+            "sim:jax %s: transport %s -> %s (%s)",
+            job.run_id,
+            transport_decision.requested,
+            transport,
+            transport_decision.reason,
+        )
     ow.infof(
         "sim:jax run %s: plan=%s case=%s instances=%d groups=%d "
         "tick=%.3fms devices=%s",
@@ -1992,6 +2038,12 @@ def _execute_sim_run(
         # build precompiled this program (see builders/sim_plan.py)
         "compile_secs": round(res.get("compile_secs", 0.0), 3),
         "devices": int(mesh.devices.size) if mesh is not None else 1,
+        # transport resolution record (sim/transport_model.py): what the
+        # runner config asked, what the gate resolved, and why — the
+        # `tg stats` pretty line and the tg_transport_resolved gauge
+        # read this block. Host-side bookkeeping only: the default
+        # transport=xla program stays jaxpr-pinned unchanged.
+        "transport": transport_decision.block(),
         "pub_dropped": res["pub_dropped"].tolist(),
         "latency_clamped": res.get("latency_clamped", 0),
         "bw_queue_dropped": res.get("bw_queue_dropped", 0),
@@ -2135,10 +2187,17 @@ def execute_packed_sim_runs(
         padded_in,
         cfg.tick_ms,
     )
-    transport = resolve_transport(cfg, None, ows[0].warn)
     telemetry_on = bool(getattr(cfg, "telemetry", False)) and not any(
         j.disable_metrics for j in jobs
     )
+    # a pack is single-device by construction, so the gate sees mesh=None;
+    # auto resolves ONCE for the whole pack (admission already grouped
+    # members by the same plan/case/shape signature, so the decision is
+    # shared by construction)
+    transport_decision = _decide_transport_for(
+        job0, cfg, None, testcase, groups, (), telemetry_on, ows[0]
+    )
+    transport = transport_decision.resolved
     prog = make_sim_program(
         testcase,
         groups,
@@ -2377,7 +2436,7 @@ def execute_packed_sim_runs(
                     len(jobs),
                     wall,
                     telemetry_on,
-                    transport,
+                    transport_decision,
                     bucket_plan,
                     compile_cache_on,
                     hits_delta,
@@ -2401,7 +2460,7 @@ def _collect_pack_member(
     n_members,
     wall,
     telemetry_on,
-    transport,
+    transport_decision,
     bucket_plan,
     compile_cache_on,
     hits_delta,
@@ -2538,6 +2597,8 @@ def _collect_pack_member(
         "faults_restarted": res.get("faults_restarted", 0),
         "msgs_fault_dropped": res.get("fault_dropped", 0),
         "carry_bytes": res.get("carry_bytes", 0),
+        # the pack-shared transport resolution (one decision per pack)
+        "transport": transport_decision.block(),
         # run packing: this member's slot in the shared device program
         "pack": {
             "width": width,

@@ -10,32 +10,42 @@ kernel in … Pallas where jnp ops are insufficient"): the same work
 expressed as two hand-tiled kernels that walk the index stream ONCE.
 
 **Calendar-commit kernel** (:func:`commit_calendar`) — replaces, for the
-sorted slot path, everything downstream of the multi-operand sort:
+sorted slot path, everything downstream of the multi-operand sort.
+Since ISSUE 14 the kernel is SEGMENTED: the sorted message stream is
+never resident in VMEM as a whole — it streams through in fixed-size
+tiles, which removes both the ~500k-instance whole-stream cap and the
+storm-shape exclusion the ISSUE-5 kernel carried:
 
-- grid = one step per calendar bucket. The sort already orders messages
-  by (bucket, dst), so bucket b's messages are one contiguous segment
-  of the sorted stream; the segment bounds are a single ``searchsorted``
-  of the L+1 bucket boundaries over the sorted keys, handed to the
-  kernel as scalar prefetch (the index computation is known before the
-  grid runs, so Pallas pipelines the row DMAs against it).
-- each grid step holds bucket b's occupancy/payload/etick rows in VMEM
-  (Pallas DMAs the [1, N·SLOTS] blocks HBM→VMEM and back around the
-  step), walks the segment once, and for each message stores EVERY
-  plane's word — occupancy mark, W payload words, enqueue tick — at the
-  message's slot position in the same pass. One index decode per
-  message, versus one scalar-core loop per plane per tick under XLA.
+- the sort already orders messages by (bucket, dst), so bucket b's
+  messages are one contiguous segment of the sorted stream. The host
+  side cuts the stream at BOTH boundary families — the L+1 bucket
+  starts (one ``searchsorted``) and the fixed tile starts k·T — and
+  enumerates the resulting intervals in stream order. Each interval
+  lies inside exactly one bucket AND one tile, so the static grid is
+  one step per interval: ``K + L + 1`` steps for K tiles over L
+  buckets, with the per-step (bucket, tile, lo, hi) tables handed to
+  the kernel as scalar prefetch.
+- per grid step, Pallas DMAs tile k of the stream operands ([1, T]
+  blocks) and bucket b's occupancy/payload/etick rows ([1, N·SLOTS]
+  blocks) into VMEM. Consecutive steps that share a tile or a bucket
+  keep the block resident (no re-fetch), and the grid pipeline
+  double-buffers the block DMAs, so tile k+1's fetch overlaps tile k's
+  walk. The walk itself is unchanged: one index decode per message,
+  storing EVERY plane's word — occupancy mark, W payload words,
+  enqueue tick — at the message's slot position in one pass.
 - slot assignment happens IN the kernel: a message's slot is its rank
-  within its (bucket, dst) run — runs are contiguous in the sorted
-  segment, so a sequential counter reproduces the XLA rank exactly —
-  plus the bucket's pre-tick fill, read as SLOTS scalar loads from the
-  in-VMEM occupancy row at each run start. That replaces the derived
-  [L·N] fill table, its 200k-lane base gather (30% of the XLA tick),
-  and the rank prefix-max entirely. Within-segment stores never affect
-  the base reads: a (bucket, dst) run is visited once, and its fill is
-  read from the PRE-update input block, exactly like the XLA path
-  derives the fill table before the scatter.
-- per-message survival (slot < SLOTS) is written to a [1, m] output so
-  the flow counters and the flight recorder's fate plane stay exact.
+  within its (bucket, dst) run plus the bucket's pre-tick fill, read as
+  SLOTS scalar loads from the in-VMEM occupancy row at each run start
+  (replacing the derived [L·N] fill table, its 200k-lane base gather,
+  and the rank prefix-max). The (prev_key, next_slot) pair lives in
+  SMEM scratch and persists across grid steps, so a (bucket, dst) run
+  spanning a tile boundary keeps its rank exactly — the tile cut is
+  invisible to the slot math. Fill reads stay PRE-update by
+  construction: a bucket's input row block is fetched once, before the
+  bucket's first interval, and all its intervals are consecutive.
+- per-message survival (slot < SLOTS) is written through a tiled
+  [1, m2] output (zeroed on each tile's first visit) so the flow
+  counters and the flight recorder's fate plane stay exact.
 
 **Delivery kernel** (:func:`pop_bucket`) — the tiled row pop over the
 arriving bucket: one grid step DMAs bucket (t mod L)'s rows into VMEM,
@@ -53,11 +63,15 @@ its XLA scatter (one index per message, no sort — there is no bucket
 ordering for the kernel to exploit), and mesh-sharded programs keep the
 XLA path entirely (the cross-shard scatter IS the inter-chip traffic;
 a single-device kernel cannot express it) — ``SimProgram`` enforces the
-single-device bound. VMEM envelope: the whole sorted message stream
-((3+W) × m2 int32) plus ~2(2+W) row blocks must fit in ~16 MB VMEM —
-the flagship full path (m2 = 2N, W = 1, SLOTS = 2) fits to ~500k
-instances; storm-shaped workloads (OUT_MSGS·IN_MSGS large) exceed it
-well below 100k, which is part of what the A/B harness measures.
+single-device bound. VMEM envelope (segmented): ~2·(3+W)·T words of
+stream tiles plus ~2·2·(1+W+E) row blocks of N·SLOTS words (E = 1 with
+the etick plane) — the m2 term is GONE, so the envelope no longer
+depends on the message-stream length at all; only the per-bucket row
+footprint bounds the instance count (~1M instances for the flagship
+W=1, SLOTS=2 shape at the default T). The tile size T is the
+``TG_TRANSPORT_TILE`` env knob (default :data:`DEFAULT_COMMIT_TILE`,
+rounded to the 128-lane grain); see PERF.md "Pallas transport
+kernels" for the full formula.
 
 On non-TPU backends every kernel runs in interpret mode, so the CPU
 test tier executes the real kernel logic bit-for-bit against the XLA
@@ -70,11 +84,27 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["commit_calendar", "pop_bucket", "pallas_interpret"]
+__all__ = [
+    "DEFAULT_COMMIT_TILE",
+    "commit_calendar",
+    "commit_tile_words",
+    "pop_bucket",
+    "pallas_interpret",
+]
+
+# Default stream-tile width in int32 words (the segmented commit
+# kernel's T). 4096 keeps the double-buffered stream-side VMEM under
+# ~256 KB at W=1 while amortizing the per-step grid overhead over
+# thousands of messages; must be a multiple of the 128-lane grain.
+# Override per process with TG_TRANSPORT_TILE (rounded down to the
+# grain) — a TRACE-time knob: it changes the compiled kernel, so two
+# processes with different values compile different programs.
+DEFAULT_COMMIT_TILE = 4096
 
 
 def pallas_interpret() -> bool:
@@ -84,63 +114,136 @@ def pallas_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.lru_cache(maxsize=64)
+def commit_vmem_bytes(
+    n_lanes: int,
+    slots: int,
+    width: int,
+    occ_bool: bool = False,
+    etick: bool = False,
+    tile: int | None = None,
+) -> int:
+    """The segmented commit kernel's VMEM envelope estimate in bytes:
+    double-buffered stream tiles ((2+W) inputs + the survival output at
+    T words each) plus double-buffered row blocks in AND out ((1+W+E)
+    planes of N·SLOTS words; the occupancy plane is 1 byte when bool).
+    The m2 stream term of the ISSUE-5 kernel is gone by construction —
+    what remains scales with N·SLOTS only (PERF.md "Pallas transport
+    kernels" documents the formula and its remaining bound)."""
+    t = commit_tile_words(tile)
+    ns = n_lanes * slots
+    stream = 2 * (2 + width + 1) * t * 4
+    occ_b = 1 if occ_bool else 4
+    row = ns * (occ_b + 4 * (width + (1 if etick else 0)))
+    return stream + 4 * row  # rows: in + out, each double-buffered
+
+
+def commit_tile_words(tile: int | None = None) -> int:
+    """Resolve the commit kernel's stream-tile width: explicit arg wins,
+    then the TG_TRANSPORT_TILE env knob, then the default — always
+    rounded down to the 128-lane grain (floor 128)."""
+    if tile is None:
+        try:
+            tile = int(os.environ.get("TG_TRANSPORT_TILE", "") or 0)
+        except ValueError:
+            tile = 0
+        tile = tile or DEFAULT_COMMIT_TILE
+    return max(128, (int(tile) // 128) * 128)
+
+
+# Cache of built pallas_calls, keyed on the REDUCED static config: the
+# engine traces one enqueue per program, but eager callers (the fuzz
+# suites) hit this per tick, and the hypothesis suites sweep shapes.
+# The key deliberately excludes anything the kernel body never reads
+# (track_src rode along here until ISSUE 14 — a dead key axis: the
+# kernel only cares about the occupancy dtype, which stays keyed), and
+# the stream length enters as m2p — already padded UP to the tile
+# grain — so nearby fuzz shapes share one entry. 256 bounds the worst
+# hypothesis sweep (shape dims × {stacking, etick, occ dtype} ≈ low
+# hundreds of distinct reduced configs) while each entry is only an
+# untraced pallas_call closure.
+@functools.lru_cache(maxsize=256)
 def _commit_call(
     horizon: int,
     n: int,
     slots: int,
     width: int,
-    m2: int,
-    track_src: bool,
+    m2p: int,
+    tile: int,
     has_etick: bool,
     stacking: bool,
     occ_bool: bool,
     interpret: bool,
 ):
-    """Build the pallas_call for one static commit configuration.
+    """Build the segmented pallas_call for one static commit config.
 
-    Cached per program shape: the engine traces one enqueue per program,
-    but eager callers (the fuzz suites) hit this per tick."""
+    Grid = one step per (bucket, tile) intersection interval of the
+    sorted stream (K + L + 1 static steps), walked in stream order with
+    the per-step tables scalar-prefetched. Stream operands and the
+    survival output are blocked [1, tile]; calendar rows [1, N·SLOTS].
+    The (prev_key, next_slot) rank carry lives in SMEM scratch so runs
+    spanning tile boundaries keep their slot rank."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     ns = n * slots
     occ_dtype = jnp.bool_ if occ_bool else jnp.int32
     n_et = 1 if has_etick else 0
+    k_tiles = m2p // tile
+    n_steps = k_tiles + horizon + 1
 
     def kernel(*refs):
-        # operand order (after the 2 scalar-prefetch refs): sorted
-        # message stream, then the input rows, then outputs
-        starts_ref, t_ref = refs[0], refs[1]
-        sk_ref, occv_ref = refs[2], refs[3]
-        pay_refs = refs[4 : 4 + width]
-        occ_in = refs[4 + width]
-        pay_in = refs[5 + width : 5 + 2 * width]
-        et_in = refs[5 + 2 * width] if has_etick else None
-        base = 5 + 2 * width + n_et
+        # operand order (after the 5 scalar-prefetch refs): sorted
+        # message stream tiles, then the input rows, then outputs,
+        # then the SMEM rank-carry scratch
+        sb_ref, st_ref, lo_ref, hi_ref, t_ref = refs[:5]
+        sk_ref, occv_ref = refs[5], refs[6]
+        pay_refs = refs[7 : 7 + width]
+        occ_in = refs[7 + width]
+        pay_in = refs[8 + width : 8 + 2 * width]
+        et_in = refs[8 + 2 * width] if has_etick else None
+        base = 8 + 2 * width + n_et
         surv_ref = refs[base]
         occ_out = refs[base + 1]
         pay_out = refs[base + 2 : base + 2 + width]
         et_out = refs[base + 2 + width] if has_etick else None
+        carry_ref = refs[-1]  # the SMEM rank-carry scratch
 
-        b = pl.program_id(0)
+        s = pl.program_id(0)
+        b = sb_ref[s]
+        k = st_ref[s]
+        prev = jnp.maximum(s - 1, 0)
+        new_row = (s == 0) | (b != sb_ref[prev])
+        new_tile = (s == 0) | (k != st_ref[prev])
 
-        # the survival plane is revisited by every grid step (each step
-        # writes its own segment); zero it once before the first
-        @pl.when(b == 0)
+        @pl.when(s == 0)
+        def _():
+            # rank carry across ALL grid steps: no run is in flight yet
+            carry_ref[0] = jnp.int32(-1)
+            carry_ref[1] = jnp.int32(0)
+
+        # the survival tile is shared by every interval inside tile k;
+        # zero it once, on the tile's first (stream-ordered) visit
+        @pl.when(new_tile)
         def _():
             surv_ref[:] = jnp.zeros_like(surv_ref)
 
-        # pass the rows through: untouched cells must survive the write-
-        # back (the out block is a fresh VMEM buffer, not the input)
-        occ_out[:] = occ_in[:]
-        for w in range(width):
-            pay_out[w][:] = pay_in[w][:]
-        if has_etick:
-            et_out[:] = et_in[:]
+        # pass the rows through on the bucket's FIRST interval only:
+        # untouched cells must survive the write-back (the out block is
+        # a fresh VMEM buffer), but later intervals of the same bucket
+        # must not wipe earlier intervals' stores. The in block stays
+        # resident (and PRE-update) across all of a bucket's intervals —
+        # they are consecutive in stream order by construction.
+        @pl.when(new_row)
+        def _():
+            occ_out[:] = occ_in[:]
+            for w in range(width):
+                pay_out[w][:] = pay_in[w][:]
+            if has_etick:
+                et_out[:] = et_in[:]
 
-        lo = starts_ref[b]
-        hi = starts_ref[b + 1]
+        off = k * tile
+        lo = lo_ref[s] - off
+        hi = hi_ref[s] - off
         tick = t_ref[0]
 
         def body(j, carry):
@@ -156,8 +259,10 @@ def _commit_call(
                 if not stacking:
                     return jnp.int32(0)
                 acc = jnp.int32(0)
-                for s in range(slots):
-                    acc += (occ_in[0, s * n + dstj] != 0).astype(jnp.int32)
+                for sl in range(slots):
+                    acc += (occ_in[0, sl * n + dstj] != 0).astype(
+                        jnp.int32
+                    )
                 return acc
 
             slot = jax.lax.cond(
@@ -182,30 +287,39 @@ def _commit_call(
 
             return key, slot + 1
 
-        jax.lax.fori_loop(lo, hi, body, (jnp.int32(-1), jnp.int32(0)))
+        # resume the rank carry from scratch, walk this interval's
+        # messages (tile-local indices), persist the carry for the next
+        # interval — a run cut by the tile boundary continues exactly
+        final_key, final_slot = jax.lax.fori_loop(
+            lo, hi, body, (carry_ref[0], carry_ref[1])
+        )
+        carry_ref[0] = final_key
+        carry_ref[1] = final_slot
 
-    stream_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    def stream_spec():
+        return pl.BlockSpec((1, tile), lambda s, st_b, st_t, *_: (0, st_t[s]))
 
     def row_spec():
-        return pl.BlockSpec((1, ns), lambda b, *_: (b, 0))
+        return pl.BlockSpec((1, ns), lambda s, st_b, *_: (st_b[s], 0))
 
     n_rows = 1 + width + n_et
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(horizon,),
-        in_specs=[stream_spec] * (2 + width)
+        num_scalar_prefetch=5,
+        grid=(n_steps,),
+        in_specs=[stream_spec() for _ in range(2 + width)]
         + [row_spec() for _ in range(n_rows)],
-        out_specs=[stream_spec] + [row_spec() for _ in range(n_rows)],
+        out_specs=[stream_spec()] + [row_spec() for _ in range(n_rows)],
+        scratch_shapes=[pltpu.SMEM((2,), jnp.int32)],
     )
-    out_shape = [jax.ShapeDtypeStruct((1, m2), jnp.int32)]
+    out_shape = [jax.ShapeDtypeStruct((1, m2p), jnp.int32)]
     out_shape.append(jax.ShapeDtypeStruct((horizon, ns), occ_dtype))
     out_shape += [
         jax.ShapeDtypeStruct((horizon, ns), jnp.int32) for _ in range(width)
     ]
     if has_etick:
         out_shape.append(jax.ShapeDtypeStruct((horizon, ns), jnp.int32))
-    # operand index of the first plane input: 2 prefetch + (2 + W) stream
-    first_plane = 4 + width
+    # operand index of the first plane input: 5 prefetch + (2 + W) stream
+    first_plane = 7 + width
     aliases = {first_plane + i: 1 + i for i in range(n_rows)}
     return pl.pallas_call(
         kernel,
@@ -224,13 +338,20 @@ def commit_calendar(
     t: jax.Array,
     *,
     stacking: bool = True,
+    tile: int | None = None,
 ):
     """Commit one tick's sorted message stream into the calendar planes.
 
     Returns ``(cal', survived)`` with ``survived`` a [m2] int32 0/1 mask
     in SORTED order — 1 exactly where the XLA path's ``val_s`` (valid ∧
     rank < SLOTS) holds, so flow counters and fate mapping stay exact.
-    Requires the 2-D plane layout (``cal.flat`` False)."""
+    Requires the 2-D plane layout (``cal.flat`` False).
+
+    ``tile`` overrides the stream-tile width (tests use tiny tiles to
+    pin the tile-boundary rank carry); default per
+    :func:`commit_tile_words`. The stream is padded up to the tile
+    grain with invalid keys — padding never survives and is sliced off
+    the returned mask."""
     assert not cal.flat, "pallas transport requires 2-D calendar planes"
     slots = cal.slots
     width = cal.width
@@ -238,14 +359,61 @@ def commit_calendar(
     horizon, ns = occ.shape
     n = ns // slots
     m2 = int(sk.shape[0])
-    track_src = cal.src is not None
     has_etick = cal.etick is not None
+    if m2 == 0:  # degenerate direct call: nothing to commit
+        return cal, jnp.zeros((0,), jnp.int32)
+
+    tile_w = commit_tile_words(tile)
+    m2p = -(-m2 // tile_w) * tile_w  # ceil to the tile grain
+    k_tiles = m2p // tile_w
+    pad = m2p - m2
+    if pad:
+        big_fill = jnp.full((pad,), horizon * n, jnp.int32)
+        sk = jnp.concatenate([sk, big_fill])
+        occ_vals = jnp.concatenate(
+            [occ_vals, jnp.zeros((pad,), occ_vals.dtype)]
+        )
+        pay_sorted = [
+            jnp.concatenate([p, jnp.zeros((pad,), p.dtype)])
+            for p in pay_sorted
+        ]
 
     # bucket b's sorted segment is [starts[b], starts[b+1]); invalid
-    # messages carry key = horizon·n and fall past starts[horizon]
+    # messages carry key = horizon·n and fall past starts[horizon].
+    # The interval table cuts the stream at every bucket start AND
+    # every tile start: each interval lies in one bucket and one tile,
+    # and there are exactly K + L + 1 of them (the static grid).
     starts = jnp.searchsorted(
         sk, jnp.arange(horizon + 1, dtype=jnp.int32) * jnp.int32(n)
     ).astype(jnp.int32)
+    valid_end = starts[horizon]
+    bounds = jnp.sort(
+        jnp.concatenate(
+            [jnp.arange(k_tiles, dtype=jnp.int32) * jnp.int32(tile_w), starts]
+        )
+    )
+    lo_raw = bounds
+    hi_raw = jnp.concatenate(
+        [bounds[1:], jnp.full((1,), m2p, jnp.int32)]
+    )
+    # message walk bounds clamp at the valid prefix; the RAW interval
+    # still drives the tile index so every survival tile (the invalid
+    # tail included) is visited and zeroed
+    steps_lo = jnp.minimum(lo_raw, valid_end)
+    steps_hi = jnp.minimum(hi_raw, valid_end)
+    steps_tile = jnp.clip(lo_raw // tile_w, 0, k_tiles - 1).astype(
+        jnp.int32
+    )
+    # bucket of the interval's first message; tail intervals inherit the
+    # LAST valid message's bucket so an already-flushed row is never
+    # re-fetched (they do no row work — the clamp only parks the block
+    # index on the final real bucket)
+    pos_b = jnp.minimum(lo_raw, jnp.maximum(valid_end - 1, 0))
+    steps_b = jnp.clip(
+        jnp.searchsorted(starts, pos_b, side="right").astype(jnp.int32) - 1,
+        0,
+        horizon - 1,
+    )
     tvec = jnp.reshape(jnp.asarray(t, jnp.int32), (1,))
 
     call = _commit_call(
@@ -253,25 +421,31 @@ def commit_calendar(
         n,
         slots,
         width,
-        m2,
-        track_src,
+        m2p,
+        tile_w,
         has_etick,
         bool(stacking),
         occ.dtype == jnp.bool_,
         pallas_interpret(),
     )
-    # message-stream operands ride as [1, m2] rows (TPU-friendly 2-D)
-    args = [starts, tvec, sk[None, :], occ_vals[None, :]]
+    # message-stream operands ride as [1, m2p] rows (TPU-friendly 2-D)
+    args = [steps_b, steps_tile, steps_lo, steps_hi, tvec]
+    args += [sk[None, :], occ_vals[None, :]]
     args += [p[None, :] for p in pay_sorted]
     args.append(occ)
     args += list(cal.payload)
     if has_etick:
         args.append(cal.etick)
     out = call(*args)
-    survived = out[0][0]
+    survived = out[0][0, :m2]
     new_occ = out[1]
     new_payload = tuple(out[2 : 2 + width])
     new_etick = out[2 + width] if has_etick else None
+    # provenance tracking only steers which Calendar field the updated
+    # occupancy plane lands in — the kernel itself is identical either
+    # way, which is exactly why track_src is NOT part of the call cache
+    # key anymore
+    track_src = cal.src is not None
     cal = dataclasses.replace(
         cal,
         payload=new_payload,

@@ -1,0 +1,503 @@
+"""Measured cost model behind ``transport=auto`` (ISSUE 14 / ROADMAP
+item 1): score xla-vs-pallas per program and pick the backend from data
+instead of a hand-set knob.
+
+``resolve_transport`` (``sim/executor.py``) stays THE one shared gate —
+the executor, the sim-worker followers, the pack path, and the
+``sim:plan`` precompile all call it — but since ISSUE 14 it delegates
+here, so every consumer resolves ``auto`` identically and the decision
+is a journaled, explainable record (``sim.transport {requested,
+resolved, reason, scores}``) rather than a vibe. Evidence sources, in
+strength order:
+
+1. **banked chip verdicts** — ``tools/bench_pallas_transport.py`` JSON
+   lines (``BENCH_PALLAS*.json`` beside the repo, or the
+   ``TG_TRANSPORT_BANK`` file/dir) measured on THIS backend kind and
+   THIS workload shape (plan/case, via the bench workload mapping). A
+   real measurement of the real kernels beats any model; the nearest
+   rung by instance count decides, with pallas flipping only past
+   :data:`BANKED_RATIO_MARGIN` (one bench run carries real spread).
+2. **opt-in measured probe** (``transport_probe = K`` in the runner
+   config) — both candidate programs' transport phases (``deliver`` +
+   ``net_commit``) jitted in isolation and timed K reps at the run's
+   real shapes, the ``sim/phases.py`` calibration path. Off the hot
+   path but costs two standalone compiles + 2K dispatches, so opt-in.
+3. **static scoring** (the default) — the XLA arm's transport phases
+   lowered standalone at the run's real shapes and their
+   ``cost_analysis()`` bytes harvested (the phases-ledger machinery),
+   against the segmented kernel's closed-form single-pass traffic
+   model. Pallas wins only past :data:`PALLAS_BYTE_MARGIN` — the
+   measured XLA bytes include the sort the pallas arm also pays, and
+   the 1.08× chip margin history says a thin edge is one chip-lottery
+   run from inverting, so the static path demands a wide one.
+
+Hard gates precede all scoring: a mesh resolves to xla (the cross-shard
+scatter IS the inter-chip traffic), and direct slot mode resolves to
+xla (no sorted bucket ordering for the commit kernel to exploit).
+
+Decisions cache per build-key (the workload shape + every
+program-shaping gate + backend), so the one-per-run scoring cost is
+paid once per distinct program, like the precompile's BuildKey.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+
+__all__ = [
+    "PALLAS_BYTE_MARGIN",
+    "TRANSPORTS",
+    "TransportContext",
+    "TransportDecision",
+    "clear_decision_cache",
+    "decide_transport",
+]
+
+TRANSPORTS = ("xla", "pallas", "auto")
+
+# Static-scoring bar: pallas is chosen only when the XLA arm's measured
+# transport bytes exceed the kernel's modeled single-pass traffic by
+# this factor. The margin absorbs (a) the multi-operand sort, which the
+# measured XLA phase includes but the pallas arm pays identically, and
+# (b) model error headroom — PERF.md's 1.08× observation at 1M is the
+# cautionary tale this knob exists for.
+PALLAS_BYTE_MARGIN = 2.0
+
+# Banked-verdict bar: a measured chip ratio flips the decision to
+# pallas only past this factor — a single bench run carries the
+# documented ±3-8% run-to-run spread (PERF.md), and the whole point of
+# the data-driven gate is that a 1.0x-adjacent measurement is one
+# chip-lottery run from inverting. Looser than the static margin
+# because a real measurement of the real kernels is stronger evidence
+# than a byte model.
+BANKED_RATIO_MARGIN = 1.15
+
+# the transport phases — the ops the kernels replace; everything else
+# is identical between backends by construction
+_TRANSPORT_PHASES = ("deliver", "net_commit")
+
+# bench_pallas_transport workload name → the (plan, case) it measures:
+# a banked verdict is only evidence for the workload SHAPE it was
+# measured on (a sustained-pingpong win says nothing about storm's
+# row-heavy fan-in)
+_BENCH_WORKLOAD_PLANS = {
+    "sustained": ("network", "pingpong-sustained"),
+    "flood": ("benchmarks", "pingpong-flood"),
+    "storm": ("benchmarks", "storm"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TransportContext:
+    """Workload context the cost model scores against — built by each
+    gate call site AFTER specialization, so the statics are the run's
+    real shapes. ``probe_reps`` > 0 opts into the measured probe."""
+
+    testcase: object
+    groups: tuple
+    test_plan: str = "?"
+    test_case: str = "?"
+    tick_ms: float = 1.0
+    chunk: int = 128
+    telemetry: bool = False
+    validate: bool = False
+    hosts: tuple = ()
+    probe_reps: int = 0
+
+
+@dataclasses.dataclass
+class TransportDecision:
+    """One resolution of the transport knob: what was asked, what was
+    chosen, why (human-readable — the ``tg stats`` pretty line), and
+    the scores behind it (absent for explicit/forced choices)."""
+
+    requested: str
+    resolved: str
+    reason: str
+    scores: dict | None = None
+
+    def block(self) -> dict:
+        """The ``sim.transport`` journal block."""
+        out = {
+            "requested": self.requested,
+            "resolved": self.resolved,
+            "reason": self.reason,
+        }
+        if self.scores:
+            out["scores"] = dict(self.scores)
+        return out
+
+
+_DECISION_CACHE: dict = {}
+
+
+def clear_decision_cache() -> None:
+    """Tests (and long-lived daemons that reload a plan) reset here."""
+    _DECISION_CACHE.clear()
+
+
+def _cache_key(context: TransportContext, backend: str):
+    cls = type(context.testcase)
+    return (
+        context.test_plan,
+        context.test_case,
+        tuple((g.id, g.count) for g in context.groups),
+        cls.__name__,
+        cls.OUT_MSGS,
+        cls.IN_MSGS,
+        cls.MSG_WIDTH,
+        cls.MAX_LINK_TICKS,
+        cls.SLOT_MODE,
+        tuple(cls.SHAPING),
+        bool(cls.CROSS_TICK_STACKING),
+        int(context.chunk),
+        bool(context.telemetry),
+        bool(context.validate),
+        tuple(context.hosts),
+        int(context.probe_reps),
+        backend,
+    )
+
+
+def decide_transport(cfg, mesh, context=None, warn=None) -> TransportDecision:
+    """Resolve the runner-config ``transport`` knob into a backend.
+
+    The single decision point behind ``resolve_transport``: validates
+    the knob, applies the structural gates (mesh → xla, direct slots →
+    xla), and for ``auto`` scores the candidates per the module
+    docstring. ``warn`` is a ``(fmt, *args)`` callable for the loud
+    fallbacks; ``context`` (a :class:`TransportContext`) is required
+    for ``auto`` to score — without one the gate falls back to xla,
+    loudly, rather than guessing."""
+    requested = str(getattr(cfg, "transport", "xla") or "xla").lower()
+    if requested not in TRANSPORTS:
+        raise ValueError(
+            f"unknown transport {requested!r} in runner config: expected "
+            "'xla', 'pallas', or 'auto' (--run-cfg transport=pallas)"
+        )
+    if requested == "xla":
+        return TransportDecision(
+            requested, "xla", "explicit runner-config choice (the default)"
+        )
+    if mesh is not None:
+        n_dev = int(mesh.devices.size)
+        if warn is not None:
+            warn(
+                "transport=%s supports a single device only (the "
+                "cross-shard calendar scatter is the inter-chip traffic) "
+                "— falling back to the XLA transport on this %d-device "
+                "mesh",
+                requested,
+                n_dev,
+            )
+        return TransportDecision(
+            requested,
+            "xla",
+            f"{n_dev}-device mesh: the cross-shard scatter is the "
+            "inter-chip traffic, single-device kernels cannot express it",
+        )
+    if requested == "pallas":
+        return TransportDecision(
+            requested, "pallas", "explicit runner-config choice"
+        )
+
+    # ------------------------------------------------------ transport=auto
+    if context is None:
+        if warn is not None:
+            warn(
+                "transport=auto needs workload context to score at this "
+                "gate and none was provided — resolving to xla"
+            )
+        return TransportDecision(
+            "auto", "xla", "no workload context at this gate"
+        )
+    import jax
+
+    backend = jax.default_backend()
+    key = _cache_key(context, backend)
+    hit = _DECISION_CACHE.get(key)
+    if hit is not None:
+        return hit
+    decision = _score(context, backend)
+    _DECISION_CACHE[key] = decision
+    return decision
+
+
+# ---------------------------------------------------------------- scoring
+
+
+def _score(context: TransportContext, backend: str) -> TransportDecision:
+    cls = type(context.testcase)
+    if cls.SLOT_MODE != "sorted":
+        return TransportDecision(
+            "auto",
+            "xla",
+            "direct slot mode: no sorted bucket ordering for the commit "
+            "kernel to exploit",
+        )
+
+    banked = _banked_verdict(
+        backend,
+        _total_instances(context),
+        context.test_plan,
+        context.test_case,
+    )
+    if banked is not None:
+        ratio = float(banked["pallas_vs_xla"])
+        resolved = "pallas" if ratio >= BANKED_RATIO_MARGIN else "xla"
+        return TransportDecision(
+            "auto",
+            resolved,
+            "banked bench verdict: pallas_vs_xla "
+            f"{ratio:.2f}x at {banked.get('instances', '?')} instances "
+            f"on {backend} ({banked.get('file', '?')}; pallas needs "
+            f">={BANKED_RATIO_MARGIN:g}x)",
+            scores={
+                "source": "banked",
+                "margin": BANKED_RATIO_MARGIN,
+                **banked,
+            },
+        )
+
+    if int(context.probe_reps) > 0:
+        return _measured_decision(context, backend)
+    return _static_decision(context, backend)
+
+
+def _total_instances(context: TransportContext) -> int:
+    return sum(int(g.count) for g in context.groups)
+
+
+def _build_candidate(context: TransportContext, transport: str):
+    from .engine import SimProgram
+
+    return SimProgram(
+        context.testcase,
+        context.groups,
+        test_plan=context.test_plan,
+        test_case=context.test_case,
+        test_run="transport-auto",
+        tick_ms=context.tick_ms,
+        mesh=None,
+        chunk=context.chunk,
+        hosts=tuple(context.hosts),
+        validate=bool(context.validate),
+        telemetry=bool(context.telemetry),
+        transport=transport,
+    )
+
+
+def _xla_transport_bytes(context: TransportContext) -> float | None:
+    """Measured static cost of the ops the kernels replace: the XLA
+    arm's ``deliver`` + ``net_commit`` phases lowered STANDALONE at the
+    run's real shapes (``sim/phases.py`` machinery) and their
+    cost-analysis bytes summed. None when the harvest yields nothing
+    (backend without cost analysis) — the caller then refuses pallas
+    rather than deciding on a zero."""
+    from .phases import _phase_cost, phase_specs
+
+    prog = _build_candidate(context, "xla")
+    total = 0.0
+    seen = False
+    for name, fn, args in phase_specs(prog):
+        if name not in _TRANSPORT_PHASES:
+            continue
+        cost = _phase_cost(fn, args)
+        val = cost.get("bytes_accessed")
+        if val:
+            total += float(val)
+            seen = True
+    return total if seen else None
+
+
+def _pallas_modeled_bytes(context: TransportContext) -> float:
+    """Closed-form single-pass traffic of the segmented kernels at the
+    run's shapes, in bytes/tick — the PERF.md envelope formula priced
+    out: one streamed read of the (2+W)-plane sorted stream plus the
+    survival write (tile-padded), worst-case every calendar bucket's
+    row set read+written once by the commit, and the delivery pop's row
+    traffic. Deliberately worst-case on the bucket count (every bucket
+    touched every tick) so the model under-promises for pallas."""
+    from .pallas_transport import commit_tile_words
+
+    cls = type(context.testcase)
+    n_lanes = _total_instances(context) + len(context.hosts)
+    width = int(cls.MSG_WIDTH)
+    slots = int(cls.IN_MSGS)
+    horizon = int(cls.MAX_LINK_TICKS)
+    etick = 1 if context.telemetry else 0
+    m2 = cls.OUT_MSGS * n_lanes * (2 if "duplicate" in cls.SHAPING else 1)
+    tile = commit_tile_words()
+    m2p = -(-max(m2, 1) // tile) * tile
+    ns = n_lanes * slots
+    n_rows = 1 + width + etick
+    commit_words = (2 + width) * m2p + m2p + horizon * n_rows * ns * 2
+    pop_words = (3 + 2 * width) * ns
+    return float((commit_words + pop_words) * 4)
+
+
+def _static_decision(
+    context: TransportContext, backend: str
+) -> TransportDecision:
+    xla_bytes = _xla_transport_bytes(context)
+    if not xla_bytes:
+        return TransportDecision(
+            "auto",
+            "xla",
+            "no cost analysis available for the transport phases on "
+            f"{backend} — keeping the XLA path",
+            scores={"source": "static", "backend": backend},
+        )
+    pallas_bytes = _pallas_modeled_bytes(context)
+    ratio = xla_bytes / max(pallas_bytes, 1.0)
+    resolved = "pallas" if ratio >= PALLAS_BYTE_MARGIN else "xla"
+    reason = (
+        f"commit+deliver bytes {ratio:.1f}x the single-pass kernel "
+        f"estimate ({'clears' if resolved == 'pallas' else 'under'} the "
+        f"{PALLAS_BYTE_MARGIN:g}x margin)"
+    )
+    return TransportDecision(
+        "auto",
+        resolved,
+        reason,
+        scores={
+            "source": "static",
+            "backend": backend,
+            "xla_bytes_per_tick": round(xla_bytes, 1),
+            "pallas_modeled_bytes_per_tick": round(pallas_bytes, 1),
+            "ratio": round(ratio, 3),
+            "margin": PALLAS_BYTE_MARGIN,
+        },
+    )
+
+
+def _measured_decision(
+    context: TransportContext, backend: str
+) -> TransportDecision:
+    """The opt-in probe (``transport_probe = K``): time both arms'
+    transport phases in isolation at the run's real shapes. On a
+    non-TPU backend the pallas arm runs INTERPRETED — the measurement
+    is then a functional gate, not a kernel cost, and the reason says
+    so; the probe is meant for chip sessions. Probe decisions cache
+    per build-key within a process; across processes (a build vs the
+    run it warmed) a near-tie could time differently and resolve the
+    other way — that costs a compile-cache miss, never a wrong
+    program."""
+    from .phases import _measure_phases, phase_specs
+
+    reps = int(context.probe_reps)
+    measured: dict[str, float] = {}
+    for transport in ("xla", "pallas"):
+        prog = _build_candidate(context, transport)
+        specs = [
+            s
+            for s in phase_specs(prog, concrete=True)
+            if s[0] in _TRANSPORT_PHASES
+        ]
+        ms = _measure_phases(specs, reps)
+        if len(ms) != len(_TRANSPORT_PHASES):
+            return TransportDecision(
+                "auto",
+                "xla",
+                f"measured probe failed on the {transport} arm — "
+                "keeping the XLA path",
+                scores={"source": "measured", "backend": backend},
+            )
+        measured[transport] = sum(ms.values())
+    interpreted = backend != "tpu"
+    resolved = (
+        "pallas" if measured["pallas"] < measured["xla"] else "xla"
+    )
+    return TransportDecision(
+        "auto",
+        resolved,
+        f"measured probe: xla {measured['xla']:.3f} ms vs pallas "
+        f"{measured['pallas']:.3f} ms per tick over {reps} rep(s) on "
+        f"{backend}"
+        + (" (pallas INTERPRETED — functional timing)" if interpreted else ""),
+        scores={
+            "source": "measured",
+            "backend": backend,
+            "xla_ms_per_tick": round(measured["xla"], 6),
+            "pallas_ms_per_tick": round(measured["pallas"], 6),
+            "reps": reps,
+            "pallas_interpreted": interpreted,
+        },
+    )
+
+
+# ----------------------------------------------------------- banked bank
+
+
+def _bank_paths() -> list:
+    """Candidate verdict files: the TG_TRANSPORT_BANK file/dir when
+    set, else BENCH_PALLAS*.json beside the repo root (where the bench
+    rounds already live)."""
+    override = os.environ.get("TG_TRANSPORT_BANK", "")
+    if override:
+        if os.path.isdir(override):
+            return sorted(glob.glob(os.path.join(override, "*.json")))
+        return [override] if os.path.isfile(override) else []
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    return sorted(glob.glob(os.path.join(root, "BENCH_PALLAS*.json")))
+
+
+def _banked_verdict(
+    backend: str, instances: int, plan: str, case: str
+) -> dict | None:
+    """Nearest applicable banked A/B verdict: a
+    ``bench_pallas_transport`` JSON record measured on this backend
+    KIND, on this workload SHAPE (the record's explicit plan/case, or
+    its bench workload name mapped through
+    :data:`_BENCH_WORKLOAD_PLANS` — foreign-shape verdicts are never
+    evidence for this run), with the real kernels (interpreted rows
+    are functional gates — skipped). Returns ``{pallas_vs_xla,
+    instances, file}`` or None."""
+    best = None
+    for path in _bank_paths():
+        try:
+            with open(path) as f:
+                records = [
+                    json.loads(line)
+                    for line in f
+                    if line.strip().startswith("{")
+                ]
+        except (OSError, ValueError):
+            continue
+        for rec in records:
+            rec_shape = _BENCH_WORKLOAD_PLANS.get(
+                rec.get("workload", ""),
+                (rec.get("plan"), rec.get("case")),
+            )
+            if rec_shape != (plan, case):
+                continue
+            rungs = rec.get("rungs") or [rec]
+            for rung in rungs:
+                if not isinstance(rung, dict):
+                    continue
+                ratio = rung.get("pallas_vs_xla", rec.get("pallas_vs_xla"))
+                if ratio is None:
+                    continue
+                if rung.get("backend", rec.get("backend")) != backend:
+                    continue
+                if rung.get(
+                    "pallas_interpreted", rec.get("pallas_interpreted")
+                ):
+                    continue
+                inst = int(rung.get("instances", rec.get("instances", 0)))
+                dist = abs(inst - instances)
+                if best is None or dist < best[0]:
+                    best = (
+                        dist,
+                        {
+                            "pallas_vs_xla": float(ratio),
+                            "instances": inst,
+                            "file": os.path.basename(path),
+                        },
+                    )
+    return best[1] if best else None

@@ -1,0 +1,288 @@
+"""The ``transport=auto`` measured cost model (ISSUE 14;
+``sim/transport_model.py``) — the contracts every gate consumer relies
+on:
+
+1. **deterministic scores**: the same workload context scores to the
+   identical decision block, fresh-cache or cached.
+2. **cache per build-key**: one scoring pass per distinct program
+   shape; a changed shape re-scores, an identical context (even a
+   freshly-built equal one) does not.
+3. **auto == explicit program identity**: the program built from an
+   auto resolution traces the identical chunk jaxpr as the explicitly
+   chosen backend — the gate only picks a NAME, never a variant.
+4. **hard gates**: mesh → xla loudly, direct slot mode → xla, unknown
+   knob values refused, context-less auto falls back to xla loudly.
+5. **banked verdicts** beat static scoring when a real measurement for
+   this backend kind exists (``TG_TRANSPORT_BANK``).
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import __graft_entry__ as ge
+from testground_tpu.sim.executor import resolve_transport
+from testground_tpu.sim.transport_model import (
+    PALLAS_BYTE_MARGIN,
+    TransportContext,
+    clear_decision_cache,
+    decide_transport,
+)
+
+Cfg = dataclasses.make_dataclass("Cfg", [("transport", str)])
+
+SUSTAINED_PARAMS = {
+    "duration_ticks": "640",
+    "latency_ms": "4",
+    "latency2_ms": "2",
+    "reshape_every": "1000",
+}
+
+
+def _sorted_ctx(n=512, chunk=32, **kw):
+    prog = ge._plan_program(
+        "network", "pingpong-sustained", n, SUSTAINED_PARAMS, chunk=chunk
+    )
+    return TransportContext(
+        testcase=prog.tc,
+        groups=tuple(prog.groups),
+        test_plan="network",
+        test_case="pingpong-sustained",
+        chunk=chunk,
+        **kw,
+    )
+
+
+def _direct_ctx(n=512):
+    prog = ge._plan_program(
+        "benchmarks",
+        "pingpong-flood",
+        n,
+        {"duration_ticks": "640", "latency_ms": "4"},
+    )
+    return TransportContext(
+        testcase=prog.tc,
+        groups=tuple(prog.groups),
+        test_plan="benchmarks",
+        test_case="pingpong-flood",
+        chunk=32,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_decision_cache()
+    yield
+    clear_decision_cache()
+
+
+class TestDeterministicScores:
+    def test_same_context_same_block_across_cache_resets(self):
+        d1 = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        clear_decision_cache()
+        d2 = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        assert d1.block() == d2.block()
+        assert d1.scores["source"] == "static"
+        assert d1.scores["ratio"] > 0
+        assert d1.scores["margin"] == PALLAS_BYTE_MARGIN
+
+    def test_sorted_flagship_scores_to_pallas_with_reason(self):
+        d = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        assert d.requested == "auto"
+        assert d.resolved == "pallas"
+        assert "kernel estimate" in d.reason
+        block = d.block()
+        assert set(block) == {"requested", "resolved", "reason", "scores"}
+
+
+class TestDecisionCache:
+    def test_identical_context_hits_cache(self):
+        d1 = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        # a FRESH equal context (new objects, same shapes) must hit
+        d2 = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        assert d2 is d1
+
+    def test_shape_change_rescores(self):
+        d1 = decide_transport(Cfg("auto"), None, context=_sorted_ctx(512))
+        d2 = decide_transport(
+            Cfg("auto"), None, context=_sorted_ctx(1024)
+        )
+        assert d2 is not d1
+        assert d1.scores["xla_bytes_per_tick"] != (
+            d2.scores["xla_bytes_per_tick"]
+        )
+
+    def test_shared_gate_identity(self):
+        """The executor, the pack path, and the precompile all build
+        equivalent contexts independently — the cache key makes them
+        resolve identically by construction (the shared-gate test)."""
+        seen = {
+            resolve_transport(
+                Cfg("auto"), None, context=_sorted_ctx()
+            )
+            for _ in range(3)
+        }
+        assert seen == {"pallas"}
+
+
+class TestProgramIdentity:
+    def test_auto_program_jaxpr_identical_to_explicit(self):
+        resolved = resolve_transport(
+            Cfg("auto"), None, context=_sorted_ctx(512, chunk=8)
+        )
+        assert resolved == "pallas"
+
+        def build(transport):
+            return ge._plan_program(
+                "network",
+                "pingpong-sustained",
+                512,
+                SUSTAINED_PARAMS,
+                chunk=8,
+                transport=transport,
+            )
+
+        auto_prog = build(resolved)
+        explicit = build("pallas")
+        carry = jax.jit(lambda: auto_prog.init_carry(0))()
+        assert str(jax.make_jaxpr(auto_prog._chunk_step)(carry)) == str(
+            jax.make_jaxpr(explicit._chunk_step)(carry)
+        )
+
+
+class TestHardGates:
+    def test_mesh_resolves_to_xla_loudly(self):
+        devs = jax.devices()[:2]
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+        warned = []
+        d = decide_transport(
+            Cfg("auto"),
+            mesh,
+            context=_sorted_ctx(),
+            warn=lambda fmt, *a: warned.append(fmt % a),
+        )
+        assert d.resolved == "xla"
+        assert "mesh" in d.reason
+        assert warned and "single device" in warned[0]
+
+    def test_direct_slot_mode_resolves_to_xla(self):
+        d = decide_transport(Cfg("auto"), None, context=_direct_ctx())
+        assert d.resolved == "xla"
+        assert "direct slot mode" in d.reason
+
+    def test_unknown_transport_refused(self):
+        with pytest.raises(ValueError, match="unknown transport"):
+            decide_transport(Cfg("cuda"), None)
+
+    def test_contextless_auto_falls_back_loudly(self):
+        warned = []
+        d = decide_transport(
+            Cfg("auto"),
+            None,
+            warn=lambda fmt, *a: warned.append(fmt % a),
+        )
+        assert d.resolved == "xla"
+        assert warned and "context" in warned[0]
+
+    def test_explicit_choices_skip_scoring(self):
+        for knob, expect in (("xla", "xla"), ("pallas", "pallas")):
+            d = decide_transport(Cfg(knob), None)
+            assert (d.requested, d.resolved) == (knob, expect)
+            assert d.scores is None
+
+
+class TestBankedVerdicts:
+    def _bank(self, tmp_path, monkeypatch, **rec):
+        path = tmp_path / "BENCH_PALLAS_test.json"
+        path.write_text(json.dumps(rec) + "\n")
+        monkeypatch.setenv("TG_TRANSPORT_BANK", str(path))
+
+    def test_banked_win_overrides_static(self, tmp_path, monkeypatch):
+        self._bank(
+            tmp_path,
+            monkeypatch,
+            workload="sustained",
+            backend=jax.default_backend(),
+            pallas_interpreted=False,
+            instances=512,
+            pallas_vs_xla=1.62,
+        )
+        d = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        assert d.resolved == "pallas"
+        assert d.scores["source"] == "banked"
+        assert "banked bench verdict" in d.reason
+
+    def test_banked_loss_forces_xla(self, tmp_path, monkeypatch):
+        self._bank(
+            tmp_path,
+            monkeypatch,
+            workload="sustained",
+            backend=jax.default_backend(),
+            pallas_interpreted=False,
+            instances=512,
+            pallas_vs_xla=0.71,
+        )
+        d = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        assert d.resolved == "xla"
+        assert d.scores["source"] == "banked"
+
+    def test_thin_banked_edge_stays_xla(self, tmp_path, monkeypatch):
+        """A 1.03x measured win is inside one bench run's spread — the
+        banked path demands its own margin (the chip-lottery rule)."""
+        self._bank(
+            tmp_path,
+            monkeypatch,
+            workload="sustained",
+            backend=jax.default_backend(),
+            pallas_interpreted=False,
+            instances=512,
+            pallas_vs_xla=1.03,
+        )
+        d = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        assert d.resolved == "xla"
+        assert d.scores["source"] == "banked"
+
+    def test_foreign_workload_bank_ignored(self, tmp_path, monkeypatch):
+        """A verdict measured on a different workload shape is not
+        evidence for this run — static scoring decides instead."""
+        self._bank(
+            tmp_path,
+            monkeypatch,
+            workload="storm",  # run context is pingpong-sustained
+            backend=jax.default_backend(),
+            pallas_interpreted=False,
+            instances=512,
+            pallas_vs_xla=9.0,
+        )
+        d = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        assert d.scores["source"] == "static"
+
+    def test_interpreted_and_foreign_backend_rows_ignored(
+        self, tmp_path, monkeypatch
+    ):
+        """Functional-gate rows (interpreted) and other-backend rows
+        are never evidence — static scoring decides instead."""
+        path = tmp_path / "BENCH_PALLAS_test.json"
+        rows = [
+            {
+                "workload": "sustained",
+                "backend": jax.default_backend(),
+                "pallas_interpreted": True,  # functional gate only
+                "instances": 512,
+                "pallas_vs_xla": 0.1,
+            },
+            {
+                "workload": "sustained",
+                "backend": "tpu-v99",  # not this backend kind
+                "pallas_interpreted": False,
+                "instances": 512,
+                "pallas_vs_xla": 0.1,
+            },
+        ]
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        monkeypatch.setenv("TG_TRANSPORT_BANK", str(path))
+        d = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+        assert d.scores["source"] == "static"

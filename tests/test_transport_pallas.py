@@ -323,6 +323,343 @@ class TestZeroOverheadDefault:
         assert not carry_p.cal.flat
 
 
+def _np_commit(occ0, pay0, sk, occ_vals, pay, n, slots, horizon, stacking):
+    """Plain-python reference of the sorted-stream commit semantics:
+    rank within each (bucket, dst) run + the bucket's PRE-tick fill,
+    survival = rank < slots — the contract both the XLA scatter path
+    and the segmented kernel implement."""
+    occ = occ0.copy()
+    payp = pay0.copy()
+    surv = np.zeros(len(sk), np.int32)
+    prev = None
+    nxt = 0
+    for j, key in enumerate(int(k) for k in sk):
+        if key >= horizon * n:
+            continue
+        b, d = divmod(key, n)
+        if key != prev:
+            slot = (
+                sum(int(occ0[b, s * n + d] != 0) for s in range(slots))
+                if stacking
+                else 0
+            )
+            prev = key
+        else:
+            slot = nxt
+        if slot < slots:
+            pos = slot * n + d
+            occ[b, pos] = occ_vals[j]
+            payp[b, pos] = pay[j]
+            surv[j] = 1
+        nxt = slot + 1
+    return occ, payp, surv
+
+
+class TestSegmentedTileCarry:
+    """Tile-boundary edge cases of the segmented commit kernel (ISSUE
+    14): the SMEM rank carry across stream tiles, runs starting exactly
+    at a tile edge, and the stacking base read when a bucket's segment
+    spans tiles — each pinned against the python reference with a tile
+    small enough that the crafted streams genuinely cross boundaries."""
+
+    N, SLOTS, HORIZON, TILE = 128, 4, 4, 128
+
+    def _commit(self, sk_np, occ0=None, stacking=True, tile=None):
+        from testground_tpu.sim.net import Calendar
+        from testground_tpu.sim.pallas_transport import commit_calendar
+
+        n, slots, horizon = self.N, self.SLOTS, self.HORIZON
+        cal = Calendar.empty(horizon, n, slots, width=1, track_src=True)
+        if occ0 is not None:
+            cal = dataclasses.replace(cal, src=jnp.asarray(occ0, jnp.int32))
+        m2 = len(sk_np)
+        sk = jnp.asarray(sk_np, jnp.int32)
+        occ_vals = jnp.arange(2, m2 + 2, dtype=jnp.int32)  # distinct marks
+        pay = [jnp.arange(1000, 1000 + m2, dtype=jnp.int32)]
+        cal2, surv = commit_calendar(
+            cal,
+            sk,
+            occ_vals,
+            pay,
+            jnp.int32(0),
+            stacking=stacking,
+            tile=self.TILE if tile is None else tile,
+        )
+        occ0_np = (
+            np.zeros((horizon, n * slots), np.int32)
+            if occ0 is None
+            else np.asarray(occ0, np.int32)
+        )
+        ref_occ, ref_pay, ref_surv = _np_commit(
+            occ0_np,
+            np.zeros((horizon, n * slots), np.int32),
+            sk_np,
+            np.arange(2, m2 + 2, dtype=np.int32),
+            np.arange(1000, 1000 + m2, dtype=np.int32),
+            n,
+            slots,
+            horizon,
+            stacking,
+        )
+        np.testing.assert_array_equal(np.asarray(cal2.src), ref_occ)
+        np.testing.assert_array_equal(np.asarray(cal2.payload[0]), ref_pay)
+        np.testing.assert_array_equal(np.asarray(surv), ref_surv)
+        return np.asarray(surv)
+
+    def test_run_spanning_two_tiles_keeps_rank(self):
+        """A 5-message (bucket, dst) run crossing the tile boundary at
+        position 128: slots 0-3 survive (two before the cut, two
+        after), the 5th overflows — the rank must NOT restart at the
+        tile edge."""
+        sk = list(range(126)) + [200] * 5 + [512] * 125
+        surv = self._commit(sk)
+        assert surv[122:126].tolist() == [1, 1, 1, 1]  # singleton runs
+        assert surv[126:131].tolist() == [1, 1, 1, 1, 0]
+
+    def test_run_starting_at_tile_edge(self):
+        """A run whose FIRST message sits exactly at a tile start: the
+        fresh-run fill read happens in the new tile with the carry
+        handed over from the previous one."""
+        sk = list(range(128)) + [300, 300] + [512] * 126
+        surv = self._commit(sk)
+        assert surv[:130].tolist() == [1] * 130
+
+    def test_stacking_base_spans_tiles(self):
+        """Pre-tick occupancy shifts the rank base of a tile-spanning
+        run: 2 slots of (bucket 1, dst 72) already taken → the 3-message
+        run gets slots 2, 3 and one overflow, split across the tile
+        cut."""
+        n, slots, horizon = self.N, self.SLOTS, self.HORIZON
+        occ0 = np.zeros((horizon, n * slots), np.int32)
+        occ0[1, 0 * n + 72] = 7  # slot 0 of dst 72 in bucket 1
+        occ0[1, 1 * n + 72] = 9  # slot 1
+        key = 1 * n + 72  # = 200, sorted after the 0..126 prefix
+        # positions 127, 128, 129 hold the run — the tile cut falls
+        # between its first and second message, so the base read
+        # happens in tile 0 and the carry crosses into tile 1
+        sk = list(range(0, 127)) + [key] * 3 + [512] * 126
+        surv = self._commit(sk, occ0=occ0)
+        assert surv[127:130].tolist() == [1, 1, 0]
+
+    def test_without_stacking_rank_restarts_at_zero(self):
+        n = self.N
+        occ0 = np.zeros((self.HORIZON, n * self.SLOTS), np.int32)
+        occ0[1, 0 * n + 5] = 3
+        key = 1 * n + 5
+        sk = list(range(0, 127)) + [key] * 2 + [512] * 127
+        surv = self._commit(sk, occ0=occ0, stacking=False)
+        assert surv[127:129].tolist() == [1, 1]
+
+    def test_tile_size_invariance_on_random_stream(self):
+        """A random sorted stream commits identically at tile 128, tile
+        512, and one whole-stream tile — the segmentation is invisible
+        to the results by construction."""
+        from testground_tpu.sim.net import Calendar
+        from testground_tpu.sim.pallas_transport import commit_calendar
+
+        n, slots, horizon = self.N, self.SLOTS, self.HORIZON
+        rng = np.random.default_rng(7)
+        m2 = 700  # not a tile multiple: exercises the padded tail
+        keys = np.sort(
+            rng.integers(0, horizon * n + 40, size=m2)
+        )  # some invalid
+        keys = np.minimum(keys, horizon * n).astype(np.int32)
+        outs = []
+        for tile in (128, 512, 1024):
+            cal = Calendar.empty(horizon, n, slots, width=1, track_src=True)
+            cal2, surv = commit_calendar(
+                cal,
+                jnp.asarray(keys),
+                jnp.arange(2, m2 + 2, dtype=jnp.int32),
+                [jnp.arange(m2, dtype=jnp.int32)],
+                jnp.int32(3),
+                stacking=True,
+                tile=tile,
+            )
+            outs.append(
+                (np.asarray(cal2.src), np.asarray(cal2.payload[0]),
+                 np.asarray(surv))
+            )
+        for got in outs[1:]:
+            for a, b in zip(outs[0], got):
+                np.testing.assert_array_equal(a, b)
+
+
+class TestCommitCallCache:
+    def test_cache_key_is_reduced_config_with_headroom(self):
+        """The lru_cache bugfix (ISSUE 14): the key is the REDUCED
+        static config — track_src is gone from it (the kernel never
+        read it; it co-varies with the occupancy dtype that IS keyed),
+        eager same-shape calls hit, and the bound has headroom for the
+        segmented (m2p, tile) combinations the fuzz suites multiply."""
+        import inspect
+
+        from testground_tpu.sim.net import Calendar
+        from testground_tpu.sim.pallas_transport import (
+            _commit_call,
+            commit_calendar,
+        )
+
+        assert "track_src" not in inspect.signature(
+            _commit_call.__wrapped__
+        ).parameters
+        _commit_call.cache_clear()
+        n, slots, horizon, m2 = 64, 2, 4, 256
+        sk = jnp.full((m2,), horizon * n, jnp.int32)  # all invalid
+        occ_vals = jnp.ones((m2,), jnp.int32)
+        pay = [jnp.zeros((m2,), jnp.int32)]
+        cal = Calendar.empty(horizon, n, slots, width=1, track_src=True)
+        for _ in range(3):  # the fuzz suites hit this eagerly per tick
+            commit_calendar(cal, sk, occ_vals, pay, jnp.int32(0))
+        info = _commit_call.cache_info()
+        assert info.misses == 1 and info.hits == 2, info
+        assert info.maxsize >= 256  # the segmented configs need headroom
+
+    def test_cache_key_pads_stream_length_to_tile_grain(self):
+        """Nearby fuzz shapes share an entry: m2 enters the key padded
+        up to the tile grain, so 600- and 700-long streams at tile 1024
+        compile once."""
+        from testground_tpu.sim.net import Calendar
+        from testground_tpu.sim.pallas_transport import (
+            _commit_call,
+            commit_calendar,
+        )
+
+        _commit_call.cache_clear()
+        n, slots, horizon = 64, 2, 4
+        cal = Calendar.empty(horizon, n, slots, width=1, track_src=True)
+        for m2 in (600, 700):
+            commit_calendar(
+                cal,
+                jnp.full((m2,), horizon * n, jnp.int32),
+                jnp.ones((m2,), jnp.int32),
+                [jnp.zeros((m2,), jnp.int32)],
+                jnp.int32(0),
+                tile=1024,
+            )
+        info = _commit_call.cache_info()
+        assert info.misses == 1 and info.hits == 1, info
+
+
+@pytest.mark.slow
+class TestSegmentedEnvelope:
+    """The ISSUE-14 acceptance pins: compositions whose sorted-stream
+    footprint exceeds the ISSUE-5 kernel's ~16 MB whole-stream VMEM
+    envelope run under ``transport=pallas`` — no fallback, no cap
+    error — bit-equal to the XLA path in interpret mode. Interpret
+    mode executes the real segmented kernel logic over hundreds of
+    stream tiles, so the tile enumeration, rank carry, and survival
+    bookkeeping are all exercised at scale."""
+
+    def test_flagship_past_500k_instances_bit_equal(self):
+        """pingpong-sustained at 540k instances: m2 = 2N ≈ 1.08M
+        messages/tick, sorted-stream footprint (3+W)·m2·4B ≈ 17.3 MB —
+        past the old whole-stream envelope. Status + every state leaf +
+        every flow total identical across backends."""
+        n = 540_672
+        params = {
+            "duration_ticks": "64",
+            "latency_ms": "4",
+            "latency2_ms": "2",
+            "reshape_every": "1000",
+        }
+
+        def run(tr):
+            return ge._plan_program(
+                "network",
+                "pingpong-sustained",
+                n,
+                params,
+                chunk=4,
+                transport=tr,
+            ).run(max_ticks=8)
+
+        res_x = run("xla")
+        res_p = run("pallas")
+        assert res_x["msgs_delivered"] > 0
+        assert_runs_equal("flagship@540k", res_x, res_p)
+
+    def test_storm_at_100k_bit_equal(self):
+        """storm at 100k instances (the shape PERF.md excluded 'well
+        below 100k'): Poisson fan-in over a random graph through the
+        sorted path, multi-message (bucket, dst) runs everywhere —
+        the adversarial shape for the tile-boundary rank carry."""
+        params = {
+            "conn_outgoing": "3",
+            "conn_delay_ticks": "8",
+            "data_size_kb": "4096",
+        }
+
+        def run(tr):
+            return ge._plan_program(
+                "benchmarks", "storm", 100_000, params, chunk=4,
+                transport=tr,
+            ).run(max_ticks=16)
+
+        res_x = run("xla")
+        res_p = run("pallas")
+        assert res_x["msgs_delivered"] > 0
+        assert_runs_equal("storm@100k", res_x, res_p)
+
+    def test_fate_plane_over_envelope(self):
+        """The flight recorder's per-message fate plane at an
+        over-envelope stream (m = 544·2048 ≈ 1.11M messages in one
+        tick): ``enqueue(want_fate=True)`` through both backends
+        returns the identical fate code per original message, plus
+        identical planes and flow counters."""
+        from testground_tpu.sim import net
+        from testground_tpu.sim.net import Calendar, enqueue
+
+        n, o, slots, horizon = 2048, 544, 4, 8
+        cal_shape = dict(track_src=True, flat=False)
+        link = net.make_link_state(n, 1, [4.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+        rng = np.random.default_rng(3)
+        dst = jnp.asarray(
+            rng.integers(0, n, size=(o, n)), jnp.int32
+        )
+        payload = jnp.asarray(
+            rng.integers(0, 1 << 20, size=(o, 1, n)), jnp.int32
+        )
+        valid = jnp.asarray(rng.random((o, n)) < 0.9)
+
+        def run(tr):
+            cal = Calendar.empty(horizon, n, slots, 1, **cal_shape)
+            cal2, fb = enqueue(
+                cal,
+                link,
+                dst,
+                payload,
+                valid,
+                jnp.int32(0),
+                1.0,
+                jax.random.key(11),
+                features=("latency",),
+                want_fate=True,
+                transport=tr,
+            )
+            return cal2, fb
+
+        cal_x, fb_x = run("xla")
+        cal_p, fb_p = run("pallas")
+        assert np.asarray(fb_x.fate).shape == (o * n,)
+        np.testing.assert_array_equal(
+            np.asarray(fb_x.fate), np.asarray(fb_p.fate)
+        )
+        for name in ("sent", "enqueued", "rejected", "clamped"):
+            assert np.array_equal(
+                np.asarray(getattr(fb_x, name)),
+                np.asarray(getattr(fb_p, name)),
+            ), name
+        np.testing.assert_array_equal(
+            np.asarray(cal_x.src), np.asarray(cal_p.src)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cal_x.payload[0]), np.asarray(cal_p.payload[0])
+        )
+        # the shape genuinely exceeds the old whole-stream envelope
+        assert (3 + 1) * o * n * 4 > 16 * 2**20
+
+
 class TestTransportGating:
     def test_unknown_transport_refused(self):
         with pytest.raises(ValueError, match="unknown transport"):

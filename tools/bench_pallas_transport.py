@@ -1,24 +1,36 @@
-"""A/B harness for the hand-tiled Pallas transport kernels (PERF.md
-"Pallas transport kernels"; ISSUE 5).
+"""A/B harness for the Pallas transport kernels (PERF.md "Pallas
+transport kernels"; ISSUE 5, segmented + cost-model rungs in ISSUE 14).
 
-Runs the SAME workload once per transport backend — ``xla`` (the scatter
+Runs the SAME workload per transport backend — ``xla`` (the scatter
 path PERF.md profiles at 84% of the sustained tick) and ``pallas``
-(``sim/pallas_transport.py``) — on a single device, and reports
-steady-state per-tick wall, peer·ticks/s, and the ratio, as one JSON
-line. Compile time is excluded from the per-tick number and reported
-alongside (both backends pay their own trace + compile/cache-read).
+(``sim/pallas_transport.py``, the segmented VMEM-streaming kernels) —
+on a single device, and reports steady-state per-tick wall,
+peer·ticks/s, and the ratio, as one JSON line. Compile time is excluded
+from the per-tick number and reported alongside (both backends pay
+their own trace + compile/cache-read).
 
-On the real chip this is the measurement the PERF.md verdict (win or
-banked negative result) comes from:
+Every rung also records the ``transport=auto`` cost model's verdict for
+that shape (``transport_choice`` in the JSON — requested/resolved/
+reason/scores), so a bench round doubles as a model-vs-measurement
+audit. ``--transport auto`` measures ONLY the backend the model picks;
+``--rungs`` sweeps instance counts in one invocation — the segmented
+kernel admits the >500k and storm-shaped rungs the ISSUE-5 kernel's
+whole-stream VMEM envelope excluded:
 
     python tools/bench_pallas_transport.py --instances 100000 --ticks 2048
+    python tools/bench_pallas_transport.py --workload storm \\
+        --rungs 100000,250000 --ticks 512
+    python tools/bench_pallas_transport.py --rungs 262144,524288,786432 \\
+        --ticks 256 --transport auto
 
 On CPU the kernels run under the Pallas interpreter, so the numbers are
 FUNCTIONAL only (the interpreter emulates the kernel op by op and is
 orders of magnitude off real kernel cost) — the tool still verifies the
 two backends agree on the workload's flow totals before timing, so a
 CPU run is a correctness gate, not a perf claim. The default sizes are
-CPU-safe; pass the 100k/2048 shape above on hardware.
+CPU-safe; pass the 100k/2048 shape above on hardware. A real-chip JSON
+saved as ``BENCH_PALLAS*.json`` beside the repo becomes a BANKED
+verdict the ``transport=auto`` model reads (sim/transport_model.py).
 """
 
 from __future__ import annotations
@@ -52,6 +64,22 @@ WORKLOADS = {
         "pingpong-flood",
         lambda ticks: {"duration_ticks": str(10 * ticks), "latency_ms": "4"},
     ),
+    # storm-shaped fan-out (OUT_MSGS·IN_MSGS large, Poisson fan-in over
+    # a random graph): the shape whose sorted-stream footprint blew the
+    # ISSUE-5 whole-stream VMEM envelope well below 100k — admissible
+    # since the segmented kernel, and the adversarial rung for the
+    # tile-boundary rank carry (multi-message runs everywhere)
+    "storm": (
+        "benchmarks",
+        "storm",
+        # one 4 KiB chunk per connection per tick — size the payload so
+        # the flood phase outlasts the measurement window
+        lambda ticks: {
+            "conn_outgoing": "3",
+            "conn_delay_ticks": "8",
+            "data_size_kb": str(4 * (10 * ticks)),
+        },
+    ),
 }
 
 
@@ -75,6 +103,29 @@ def _build(plan, case, n, params, chunk, transport):
         mesh=None,  # single-device A/B: identical topology both arms
         chunk=chunk,
         transport=transport,
+    )
+
+
+def _decide(prog, plan, case, chunk):
+    """The transport=auto verdict for this rung's shape — the same
+    decision path every runtime gate takes (sim/transport_model.py)."""
+    import types
+
+    from testground_tpu.sim.transport_model import (
+        TransportContext,
+        decide_transport,
+    )
+
+    return decide_transport(
+        types.SimpleNamespace(transport="auto"),
+        None,
+        context=TransportContext(
+            testcase=prog.tc,
+            groups=tuple(prog.groups),
+            test_plan=plan,
+            test_case=case,
+            chunk=chunk,
+        ),
     )
 
 
@@ -148,13 +199,82 @@ def _print_phase_ab(out: dict) -> None:
         )
 
 
+def _run_rung(args, plan, case, params_of, n: int) -> int | dict:
+    """One instance-count rung: build, record the cost-model choice,
+    measure the requested arm(s), cross-check flow when both ran.
+    Returns the rung dict, or a nonzero exit code on divergence."""
+    rung: dict = {"instances": n}
+    params = params_of(args.ticks)
+    base = _build(plan, case, n, params, args.chunk, "xla")
+    decision = _decide(base, plan, case, args.chunk)
+    rung["transport_choice"] = decision.block()
+    print(
+        f"# rung {n}: auto -> {decision.resolved} ({decision.reason})",
+        file=sys.stderr,
+    )
+    arms = {
+        "both": ("xla", "pallas"),
+        "xla": ("xla",),
+        "pallas": ("pallas",),
+        "auto": (decision.resolved,),
+    }[args.transport]
+    for transport in arms:
+        prog = (
+            base
+            if transport == "xla"
+            else _build(plan, case, n, params, args.chunk, transport)
+        )
+        rung[transport] = _measure(prog, args.ticks)
+        if args.phases:
+            from testground_tpu.sim.phases import build_phase_ledger
+
+            rung[transport]["phases"] = build_phase_ledger(
+                prog, measure=max(0, args.phase_reps)
+            )
+        print(
+            f"# {transport}@{n}: {rung[transport]['ms_per_tick']} ms/tick "
+            f"(+{rung[transport]['compile_secs']}s compile)",
+            file=sys.stderr,
+        )
+    if "xla" in rung and "pallas" in rung:
+        if args.phases:
+            _print_phase_ab(rung)
+        if rung["xla"]["flow"] != rung["pallas"]["flow"]:
+            print(
+                "bench_pallas_transport: FAIL — flow totals diverge "
+                f"between backends at {n} instances: "
+                f"xla={rung['xla']['flow']} pallas={rung['pallas']['flow']}",
+                file=sys.stderr,
+            )
+            return 1
+        rung["pallas_vs_xla"] = round(
+            rung["xla"]["ms_per_tick"] / rung["pallas"]["ms_per_tick"], 3
+        )
+    return rung
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--instances", type=int, default=2048)
+    p.add_argument(
+        "--rungs",
+        default="",
+        help="comma-separated instance counts — sweep several rungs in "
+        "one invocation (overrides --instances); the JSON line then "
+        "carries a per-rung `rungs` list",
+    )
     p.add_argument("--ticks", type=int, default=256)
     p.add_argument("--chunk", type=int, default=64)
     p.add_argument(
         "--workload", choices=sorted(WORKLOADS), default="sustained"
+    )
+    # which arm(s) to measure: "both" is the classic A/B; "auto" runs
+    # ONLY the backend the cost model picks for each rung (the
+    # production posture) — the choice itself is recorded either way
+    p.add_argument(
+        "--transport",
+        choices=("both", "auto", "xla", "pallas"),
+        default="both",
     )
     # per-backend phase attribution (sim/phases.py): bank the chip
     # verdict WITH the per-phase split in one command (ROADMAP item 1) —
@@ -174,54 +294,38 @@ def main() -> int:
     plan, case, params_of = WORKLOADS[args.workload]
     backend = jax.default_backend()
     interpreted = backend != "tpu"
+    rungs = (
+        [int(r) for r in args.rungs.split(",") if r.strip()]
+        if args.rungs
+        else [args.instances]
+    )
     print(
-        f"# pallas-transport A/B: {args.workload} @ {args.instances} "
-        f"instances × {args.ticks} ticks on {backend}"
+        f"# pallas-transport A/B: {args.workload} @ "
+        f"{','.join(str(r) for r in rungs)} instances × {args.ticks} "
+        f"ticks on {backend} (arm: {args.transport})"
         + (" (pallas INTERPRETED — functional gate, not a perf claim)"
            if interpreted else ""),
         file=sys.stderr,
     )
     out = {
         "workload": args.workload,
-        "instances": args.instances,
         "ticks": args.ticks,
         "backend": backend,
         "pallas_interpreted": interpreted,
+        "transport_arm": args.transport,
     }
-    for transport in ("xla", "pallas"):
-        prog = _build(
-            plan,
-            case,
-            args.instances,
-            params_of(args.ticks),
-            args.chunk,
-            transport,
-        )
-        out[transport] = _measure(prog, args.ticks)
-        if args.phases:
-            from testground_tpu.sim.phases import build_phase_ledger
-
-            out[transport]["phases"] = build_phase_ledger(
-                prog, measure=max(0, args.phase_reps)
-            )
-        print(
-            f"# {transport}: {out[transport]['ms_per_tick']} ms/tick "
-            f"(+{out[transport]['compile_secs']}s compile)",
-            file=sys.stderr,
-        )
-    if args.phases:
-        _print_phase_ab(out)
-    if out["xla"]["flow"] != out["pallas"]["flow"]:
-        print(
-            "bench_pallas_transport: FAIL — flow totals diverge between "
-            f"backends: xla={out['xla']['flow']} "
-            f"pallas={out['pallas']['flow']}",
-            file=sys.stderr,
-        )
-        return 1
-    out["pallas_vs_xla"] = round(
-        out["xla"]["ms_per_tick"] / out["pallas"]["ms_per_tick"], 3
-    )
+    results = []
+    for n in rungs:
+        rung = _run_rung(args, plan, case, params_of, n)
+        if isinstance(rung, int):
+            return rung
+        results.append(rung)
+    if len(results) == 1 and not args.rungs:
+        # classic single-rung schema, unchanged for existing consumers
+        # (+ the transport_choice block every rung now carries)
+        out.update(results[0])
+    else:
+        out["rungs"] = results
     print(json.dumps(out))
     return 0
 

@@ -1,0 +1,183 @@
+"""``make transport-smoke``: the ``transport=auto`` cost model and the
+segmented pallas commit kernel, end-to-end on CPU (ISSUE 14) —
+
+- **contrasting shapes pick both backends**: interpret-mode static
+  scoring resolves the sorted flagship shape to ``pallas`` (commit
+  bytes clear the margin) and the direct-slot flood shape to ``xla``
+  (hard gate) — both backends chosen at least once, deterministically;
+- **the journal carries the decision**: a tiny composition run with
+  ``transport=auto`` journals ``sim.transport {requested, resolved,
+  reason, scores}``, the ``tg stats`` line renders it, and the
+  Prometheus exposition carries the ``tg_transport_resolved`` info
+  gauge;
+- **bit-equality spot check**: the same sorted workload through
+  ``transport=xla`` and ``transport=pallas`` (segmented kernel,
+  interpreted) agrees on status and every flow total, with a tile
+  small enough that the stream actually spans tile boundaries.
+
+Exits non-zero with a readable message on any violation; prints a
+one-line summary on success. Self-contained: runs against a temporary
+$TESTGROUND_HOME on the CPU backend, so it is safe in CI.
+"""
+
+import dataclasses
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force multi-tile streams even at smoke scale: the equality check must
+# cross tile boundaries, not fit one tile (must be set before jax/pallas
+# trace anything)
+os.environ["TG_TRANSPORT_TILE"] = "128"
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def fail(msg: str) -> "None":
+    print(f"transport-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-smoke-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import __graft_entry__ as ge
+    from testground_tpu.sim.transport_model import (
+        TransportContext,
+        decide_transport,
+    )
+
+    cfg_cls = dataclasses.make_dataclass("Cfg", [("transport", str)])
+
+    # ---------------------------------------- 1. contrasting decisions
+    def decide(prog, plan, case):
+        return decide_transport(
+            cfg_cls("auto"),
+            None,
+            context=TransportContext(
+                testcase=prog.tc,
+                groups=tuple(prog.groups),
+                test_plan=plan,
+                test_case=case,
+                chunk=prog.chunk,
+            ),
+        )
+
+    sorted_prog = ge._plan_program(
+        "network",
+        "pingpong-sustained",
+        512,
+        {
+            "duration_ticks": "640",
+            "latency_ms": "4",
+            "latency2_ms": "2",
+            "reshape_every": "1000",
+        },
+    )
+    d_sorted = decide(sorted_prog, "network", "pingpong-sustained")
+    if d_sorted.resolved != "pallas":
+        fail(
+            "sorted flagship shape resolved to "
+            f"{d_sorted.resolved!r}, expected pallas ({d_sorted.reason})"
+        )
+    if not (d_sorted.scores or {}).get("ratio"):
+        fail(f"sorted decision carries no scores: {d_sorted.block()}")
+    flood_prog = ge._plan_program(
+        "benchmarks",
+        "pingpong-flood",
+        512,
+        {"duration_ticks": "640", "latency_ms": "4"},
+    )
+    d_flood = decide(flood_prog, "benchmarks", "pingpong-flood")
+    if d_flood.resolved != "xla":
+        fail(
+            f"direct-slot flood shape resolved to {d_flood.resolved!r}, "
+            "expected xla"
+        )
+    # determinism: the same context must yield the identical decision
+    if decide(sorted_prog, "network", "pingpong-sustained") is not d_sorted:
+        fail("decision cache missed on an identical context")
+
+    # ------------------------------------- 2. journal + surfaces
+    from tests.test_sim_runner import run_sim
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome
+    from testground_tpu.metrics.prometheus import render_prometheus
+    from testground_tpu.runners.pretty import render_telemetry_summary
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.start_workers()
+    try:
+        task = run_sim(
+            engine,
+            "network",
+            "ping-pong",
+            instances=4,
+            run_params={"chunk": 16, "transport": "auto"},
+        )
+    finally:
+        engine.stop()
+    if task.outcome() != Outcome.SUCCESS:
+        fail(f"auto run outcome {task.outcome().value}: {task.error}")
+    block = task.result["journal"]["sim"].get("transport") or {}
+    if block.get("requested") != "auto":
+        fail(f"journal sim.transport requested != auto: {block}")
+    if block.get("resolved") not in ("xla", "pallas"):
+        fail(f"journal sim.transport resolved is bogus: {block}")
+    if not block.get("reason"):
+        fail(f"journal sim.transport has no reason: {block}")
+    stats = render_telemetry_summary(
+        {"plan": "network", "case": "ping-pong", **task.result["journal"]}
+    )
+    if "transport" not in stats or "auto" not in stats:
+        fail(f"tg stats render lacks the transport line:\n{stats}")
+    text = render_prometheus([task])
+    if "\ntg_transport_resolved{" not in text:
+        fail("tg_transport_resolved absent from the Prometheus exposition")
+    if 'requested="auto"' not in text:
+        fail("tg_transport_resolved lacks the requested=auto label")
+
+    # ------------------------------------- 3. bit-equality spot check
+    res_x = ge._pingpong_program(8, transport="xla").run(max_ticks=256)
+    res_p = ge._pingpong_program(8, transport="pallas").run(max_ticks=256)
+    for key in (
+        "status",
+        "msgs_delivered",
+        "msgs_sent",
+        "msgs_enqueued",
+        "msgs_dropped",
+        "msgs_rejected",
+        "cal_depth",
+    ):
+        a, b = np.asarray(res_x[key]), np.asarray(res_p[key])
+        if not np.array_equal(a, b):
+            fail(f"xla vs pallas {key} mismatch: {a} vs {b}")
+    if not res_x["msgs_delivered"] > 0:
+        fail("equality spot check moved no traffic")
+
+    print(
+        "transport-smoke: OK — sorted→pallas "
+        f"(ratio x{(d_sorted.scores or {}).get('ratio')}), "
+        "flood→xla (direct gate), journal "
+        f"auto→{block.get('resolved')}, equality over "
+        f"{res_x['msgs_delivered']} delivered msgs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
