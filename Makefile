@@ -6,7 +6,8 @@ PY ?= python
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
 	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke \
 	phases-smoke checkpoint-smoke crosshost-smoke pack-smoke \
-	sync-fanin-smoke transport-smoke
+	sync-fanin-smoke transport-smoke check-smoke check-plans \
+	test-sync-tsan
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -138,6 +139,35 @@ sync-fanin-smoke:
 # part of the observability-smoke CI set
 transport-smoke:
 	$(PY) tools/transport_smoke.py
+
+# static-analysis plane contract check (docs/CHECKING.md): a clean
+# composition checks to zero findings / exit 0; a seeded-bad one
+# combining four incompatible knobs reports EVERY violation in one
+# pass with stable rule ids / exit 1; the deliberately-broken fixture
+# plan fires the eval_shape/jaxpr lints (traced-count contract, host
+# callback); a pack-opted solo run journals sim.pack.solo_reason and
+# `tg stats` renders it
+check-smoke:
+	$(PY) tools/check_smoke.py
+
+# `tg check` over every checked-in composition: the gallery's
+# pre-lint gate (docs/CHECKING.md) — any error-severity finding in a
+# composition under plans/*/_compositions/ fails the build, plan
+# lints included
+check-plans:
+	$(PY) -m testground_tpu.cli.main check --trace-plans \
+		plans/*/_compositions/*.toml
+
+# the sync test suites against a ThreadSanitizer-instrumented native
+# server build (docs/CHECKING.md "Sanitizer builds"): any data race in
+# syncsvc.cc aborts the server (halt_on_error) and fails the suite;
+# suppressions live in testground_tpu/native/tsan.supp (checked in,
+# kept empty). `-k native` gates to the native-backend parametrizations
+# — the python server has no TSAN surface.
+test-sync-tsan:
+	TG_NATIVE_SANITIZE=thread $(PY) -m pytest tests/test_sync.py \
+		tests/test_sync_hardening.py tests/test_sync_backpressure.py \
+		-q -k native
 
 # the multi-chip compile/correctness gate on a virtual 8-device mesh
 dryrun:

@@ -42,6 +42,18 @@ class Stall(SimTestcase):
         return self.out(state, status=RUNNING)
 
 
+class Silent(SimTestcase):
+    """Never emits a terminal status — the sim twin of the exec
+    edition's silent ``os._exit(0)`` (issue-1349): the run ends at
+    max_ticks with the instance still RUNNING, judged incomplete, and
+    the run fails. Surfaced missing by ``tg check --trace-plans``
+    (rule plan.load-failed): the manifest declared the case but the sim
+    module never exposed it."""
+
+    def step(self, env, state, inbox, sync, t):
+        return self.out(state, status=RUNNING)
+
+
 class OptionalFailure(SimTestcase):
     """Per-run failure knob (the ``issue-1493-optional-failure`` analog):
     ``should_fail`` is a group parameter, so it is a trace-time constant —
@@ -83,6 +95,7 @@ sim_testcases = {
     "abort": Abort,
     "panic": Panic,
     "stall": Stall,
+    "silent": Silent,
     "optional-failure": OptionalFailure,
     "metrics": Metrics,
 }

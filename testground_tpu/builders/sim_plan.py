@@ -106,6 +106,25 @@ class SimPlanBuilder(Builder, Precompiler):
                 "sim:plan precompile skipped: no test case on this build"
             )
             return
+        # static-analysis pass (sim/check.py): the precompile evaluates
+        # the SAME rules `tg check` and the executor enforce, so every
+        # admission refusal the run would hit surfaces in the build log
+        # up front. Warn-only by design: the executor's refusal stays
+        # the authoritative failure, and the snapshot artifact above is
+        # already valid whatever the knobs say.
+        try:
+            from testground_tpu.sim.check import check_composition
+
+            for f in check_composition(
+                comp,
+                manifest,
+                env_layer=env.runners.get("sim:jax") if env else None,
+            ):
+                ow.warn(
+                    "check: [%s] %s: %s", f.severity, f.rule, f.message
+                )
+        except Exception as e:  # noqa: BLE001 — advisory pass only
+            ow.warn("sim:plan static check pass failed: %s", e)
         from testground_tpu.sim.executor import (
             SimJaxConfig,
             _make_mesh,

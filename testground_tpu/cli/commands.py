@@ -812,6 +812,152 @@ def plan_create_cmd(args) -> int:
     return 0
 
 
+# ------------------------------------------------------------------ check
+
+
+def register_check(sub) -> None:
+    p = sub.add_parser(
+        "check",
+        help="statically analyze composition file(s) against the sim:jax "
+        "admission rules — every incompatible-knob refusal the executor "
+        "would raise, reported in ONE pass before anything queues "
+        "(docs/CHECKING.md); --trace-plans additionally runs each "
+        "referenced plan under jax.eval_shape at the composition's "
+        "shapes and lints the lowered tick",
+    )
+    p.add_argument(
+        "compositions",
+        nargs="+",
+        help="composition TOML file(s); the plan resolves from "
+        "$TESTGROUND_HOME/plans, a plans/ dir beside the composition "
+        "(plans/<plan>/_compositions/x.toml layout), or ./plans/<plan>",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the machine-readable findings document (schema "
+        "version 1; exit codes unchanged)",
+    )
+    p.add_argument(
+        "--trace-plans",
+        action="store_true",
+        help="abstract plan tracing: run each referenced testcase under "
+        "jax.eval_shape at the composition's real (and padded-ladder, "
+        "when bucketed) shapes — no device allocation — and scan the "
+        "lowered tick jaxpr for invariant lints (host callbacks, while "
+        "loops, weak-typed state, traced-count contract violations)",
+    )
+    p.add_argument(
+        "--run-cfg",
+        action="append",
+        default=[],
+        help="override runner configuration k=v for the analysis "
+        "(repeatable) — check what a different knob combination would "
+        "do without editing the file",
+    )
+    p.add_argument(
+        "--devices",
+        type=int,
+        default=0,
+        help="device-context override: evaluate the mesh-bound rules as "
+        "if the run had N devices (0 = detect from this host's jax "
+        "backend; lets a laptop check what an 8-chip host refuses)",
+    )
+    p.set_defaults(func=check_cmd)
+
+
+def _resolve_plan_for_check(
+    env: EnvConfig, comp_path: str, plan: str
+) -> tuple[str, TestPlanManifest]:
+    """Plan resolution for `tg check`: the run-verb search paths plus
+    the repo layouts a checked-in composition lives in —
+    ``plans/<plan>/_compositions/x.toml`` resolves its own plan dir, and
+    ``./plans/<plan>`` covers compositions checked from a repo root."""
+    try:
+        return _resolve_plan(env, plan)
+    except FileNotFoundError:
+        pass
+    comp_dir = os.path.dirname(os.path.abspath(comp_path))
+    candidates = [
+        os.path.dirname(comp_dir),  # plans/<plan>/_compositions/x.toml
+        os.path.join(os.getcwd(), "plans", plan),
+        os.path.join(comp_dir, plan),
+    ]
+    for c in candidates:
+        manifest_path = os.path.join(c, "manifest.toml")
+        if os.path.isfile(manifest_path):
+            m = TestPlanManifest.load_file(manifest_path)
+            if m.name == plan:
+                return os.path.abspath(c), m
+    raise FileNotFoundError(
+        f"plan {plan!r} for {comp_path} not found (searched "
+        f"$TESTGROUND_HOME/plans and {candidates}); import it with "
+        "`tg plan import --from <dir>` or run check from the repo root"
+    )
+
+
+def check_cmd(args) -> int:
+    import json
+
+    from testground_tpu.sim.check import (
+        Finding,
+        check_composition,
+        findings_payload,
+        render_findings,
+        rule_by_id,
+    )
+
+    env = EnvConfig.load()
+    overrides = parse_key_values(getattr(args, "run_cfg", []) or [])
+    results = []
+    load_failures = 0
+    for path in args.compositions:
+        try:
+            comp = load_composition(path)
+            if overrides:
+                comp.global_.run_config = dict(
+                    comp.global_.run_config or {}
+                )
+                comp.global_.run_config.update(overrides)
+            plan_dir, manifest = _resolve_plan_for_check(
+                env, path, comp.global_.plan
+            )
+            findings = check_composition(
+                comp,
+                manifest,
+                env_layer=env.runners.get(comp.global_.runner or "sim:jax"),
+                devices=getattr(args, "devices", 0) or 0,
+                trace_plans=getattr(args, "trace_plans", False),
+                plan_sources=plan_dir,
+            )
+        except Exception as e:  # noqa: BLE001 — per-file isolation: one
+            # unloadable file must not hide the other files' findings,
+            # and the failure lands IN the findings document (not
+            # stderr-only) so --json consumers see it too
+            load_failures += 1
+            r = rule_by_id("composition.invalid")
+            findings = [
+                Finding(
+                    rule=r.id,
+                    severity=r.severity,
+                    layer=r.layer,
+                    message=f"cannot check: {e}",
+                )
+            ]
+        results.append((path, findings))
+    if getattr(args, "json", False):
+        print(json.dumps(findings_payload(results), indent=2, sort_keys=True))
+    else:
+        for path, findings in results:
+            print(render_findings(path, findings))
+    errors = sum(
+        1 for _, fs in results for f in fs if f.severity == "error"
+    )
+    if load_failures:
+        return 2
+    return 1 if errors else 0
+
+
 # --------------------------------------------------------------- describe
 
 

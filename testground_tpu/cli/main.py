@@ -1,8 +1,8 @@
 """``tg`` CLI entry point.
 
 Command surface mirrors the reference's ``pkg/cmd/root.go:10-24``: run,
-build, plan, describe, daemon, collect, terminate, healthcheck, tasks,
-status, stats, perf, watch, trace, logs, version. The engine runs
+build, plan, check, describe, daemon, collect, terminate, healthcheck,
+tasks, status, stats, perf, watch, trace, logs, version. The engine runs
 in-process unless ``--endpoint`` points at a daemon (the reference's
 client↔daemon hop is transport, not semantics).
 """
@@ -37,6 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
     commands.register_run(sub)
     commands.register_build(sub)
     commands.register_plan(sub)
+    commands.register_check(sub)
     commands.register_describe(sub)
     commands.register_tasks(sub)
     commands.register_status(sub)

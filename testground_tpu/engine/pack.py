@@ -32,7 +32,12 @@ import json
 
 from testground_tpu.logging_ import S
 
-__all__ = ["claim_pack", "pack_signature"]
+__all__ = [
+    "claim_pack",
+    "pack_signature",
+    "pack_solo_reason",
+    "solo_reason_for_composition",
+]
 
 
 def _cfg_get(run_config: dict, key: str, default=None):
@@ -44,6 +49,15 @@ def _truthy(v) -> bool:
     if isinstance(v, str):
         return v.strip().lower() in ("1", "true", "yes", "on")
     return bool(v)
+
+
+# tenant-facing (journal sim.pack.solo_reason + the checker's pack.solo
+# finding); the global-run and per-group chaos/trace exclusions share
+# one wording
+_CHAOS_TRACE_SOLO = (
+    "a declared chaos schedule or flight-recorder table bakes "
+    "per-program tensors a shared vmapped program cannot carry"
+)
 
 
 def pack_signature(tsk, env=None) -> str | None:
@@ -59,13 +73,67 @@ def pack_signature(tsk, env=None) -> str | None:
 
     if tsk.type != TaskType.RUN or tsk.runner != "sim:jax":
         return None
-    comp = tsk.composition or {}
+    sig, _ = _signature_or_reason(
+        tsk.composition or {}, env, tsk.input or {}
+    )
+    return sig
+
+
+def pack_solo_reason(tsk, env=None) -> str | None:
+    """Why a pack-OPTED task runs solo, or None (pack not requested, or
+    the task is packable — a packable task that still ran solo simply
+    found no queued partner at claim time; the caller words that case).
+    The journal's ``sim.pack.solo_reason`` and the checker's
+    ``pack.solo`` finding both read this classification."""
+    from testground_tpu.engine.task import TaskType
+
+    if tsk.type != TaskType.RUN or tsk.runner != "sim:jax":
+        return None
+    return solo_reason_for_composition(
+        tsk.composition or {}, env, tsk.input or {}
+    )
+
+
+def solo_reason_for_composition(
+    comp: dict, env=None, input_rec: dict | None = None
+) -> str | None:
+    """Composition-dict variant of :func:`pack_solo_reason` (the static
+    checker has a composition, not a task). Returns the human-readable
+    solo cause when ``pack=true`` was requested but admission would
+    refuse a signature; None when pack was not requested or the
+    composition is packable."""
+    sig, reason = _signature_or_reason(comp or {}, env, input_rec or {})
+    if sig is not None:
+        return None
+    return reason
+
+
+def _signature_or_reason(
+    comp: dict, env, input_rec: dict
+) -> tuple[str | None, str | None]:
+    """The ONE admission walk: returns ``(signature, None)`` for a
+    packable composition, ``(None, reason)`` when pack was requested
+    but the composition must run solo, and ``(None, None)`` when pack
+    was not requested at all."""
     runs = comp.get("runs") or []
-    if len(runs) != 1:
-        return None  # multi-[[runs]] compositions keep their own loop
-    run = runs[0]
     glob = comp.get("global") or {}
     grun = glob.get("run") or {}
+    cfgs = [dict(env or {}), dict(glob.get("run_config") or {})]
+    cfg: dict = {}
+    for layer in cfgs:
+        cfg.update(layer)
+    requested = _truthy(cfg.get("pack"))
+
+    def solo(reason: str):
+        return None, (reason if requested else None)
+
+    if len(runs) != 1:
+        # multi-[[runs]] compositions keep their own loop
+        return solo(
+            f"multi-[[runs]] composition ({len(runs)} runs — each "
+            "[[runs]] entry keeps its own run loop)"
+        )
+    run = runs[0]
     # structural exclusions: program-shaping declarations that cannot
     # share a vmapped program (or whose host planes are per-run device
     # reads the pack cannot demux). Queued compositions are
@@ -74,32 +142,34 @@ def pack_signature(tsk, env=None) -> str | None:
     # be checked here too, or a group-level chaos/trace declaration
     # would slip past admission and silently never be injected.
     if grun.get("faults") or grun.get("trace"):
-        return None
+        return solo(_CHAOS_TRACE_SOLO)
     groups_decl = {g.get("id"): g for g in comp.get("groups") or []}
     backing_runs = {}
     for rg in run.get("groups") or []:
-        if rg.get("faults") or rg.get("trace"):
-            return None
         decl = groups_decl.get(rg.get("group_id") or rg.get("id")) or {}
         brun = decl.get("run") or {}
-        if brun.get("faults") or brun.get("trace"):
-            return None
+        if (
+            rg.get("faults")
+            or rg.get("trace")
+            or brun.get("faults")
+            or brun.get("trace")
+        ):
+            return solo(_CHAOS_TRACE_SOLO)
         backing_runs[rg.get("id")] = brun
-    cfgs = [dict(env or {}), dict(glob.get("run_config") or {})]
-    cfg: dict = {}
-    for layer in cfgs:
-        cfg.update(layer)
-    if not _truthy(cfg.get("pack")):
-        return None
-    if (
-        cfg.get("coordinator_address")
-        or cfg.get("resume_from")
-        or _truthy(cfg.get("profile"))
-        or _truthy(cfg.get("phases"))
-        or cfg.get("additional_hosts")
-        or int(cfg.get("checkpoint_chunks") or 0) > 0
-    ):
-        return None
+    if not requested:
+        return None, None
+    if cfg.get("coordinator_address"):
+        return solo("a multi-host cohort config cannot join a pack")
+    if cfg.get("resume_from"):
+        return solo("resume_from seeds this run's own carry snapshot")
+    if _truthy(cfg.get("profile")):
+        return solo("profiler capture is a per-run device session")
+    if _truthy(cfg.get("phases")):
+        return solo("phase attribution lowers per-run programs")
+    if cfg.get("additional_hosts"):
+        return solo("additional_hosts adds per-program echo lanes")
+    if int(cfg.get("checkpoint_chunks") or 0) > 0:
+        return solo("checkpointing reads this run's own carry per chunk")
 
     # instance counts: the padded bucket layout when bucketing is on
     # (the shared-program identity), exact counts otherwise. Queued
@@ -121,7 +191,10 @@ def pack_signature(tsk, env=None) -> str | None:
                 else dinst
             )
         if not c:
-            return None
+            return solo(
+                "percentage-based group instances resolve only at "
+                "prepare time"
+            )
         counts.append(int(c))
     from testground_tpu.sim.buckets import (
         bucketed_counts,
@@ -133,7 +206,8 @@ def pack_signature(tsk, env=None) -> str | None:
         mode = parse_bucket_mode(cfg.get("bucket"))
         ladder = parse_ladder(cfg.get("bucket_ladder") or None)
     except ValueError:
-        return None  # a bad knob fails in the executor, readably
+        # a bad knob fails in the executor, readably
+        return solo("invalid bucket/bucket_ladder knob")
     padded = (
         bucketed_counts(counts, mode, ladder)
         if mode != "off"
@@ -146,10 +220,10 @@ def pack_signature(tsk, env=None) -> str | None:
         # manifest or sources snapshot) must not share a program
         "manifest": hashlib.sha256(
             json.dumps(
-                (tsk.input or {}).get("manifest") or {}, sort_keys=True
+                (input_rec or {}).get("manifest") or {}, sort_keys=True
             ).encode()
         ).hexdigest()[:16],
-        "sources_dir": (tsk.input or {}).get("sources_dir") or "",
+        "sources_dir": (input_rec or {}).get("sources_dir") or "",
         "groups": [
             {
                 "id": rg.get("id"),
@@ -181,9 +255,12 @@ def pack_signature(tsk, env=None) -> str | None:
         "validate": _truthy(cfg.get("validate")),
         "pack_max": int(cfg.get("pack_max") or 8),
     }
-    return hashlib.sha256(
-        json.dumps(sig, sort_keys=True).encode()
-    ).hexdigest()[:32]
+    return (
+        hashlib.sha256(
+            json.dumps(sig, sort_keys=True).encode()
+        ).hexdigest()[:32],
+        None,
+    )
 
 
 def claim_pack(engine, tsk) -> list:

@@ -34,6 +34,7 @@ from testground_tpu.sim.slo import SloBreachError
 
 from .engine import Engine
 from .notify import notify_task_finished, notify_task_started
+from .pack import _truthy
 from .queue import QueueEmptyError
 from .task import DatedState, Outcome, State, Task, TaskType
 
@@ -625,6 +626,30 @@ def do_run(
         run_results[run.id] = result_dict
         if result_dict.get("outcome") != Outcome.SUCCESS.value:
             outcome = Outcome.FAILURE
+
+    # run packing requested but executed solo: this code path IS the
+    # solo path (packed tasks run through process_task_pack), so when
+    # the composition opted in with pack=true the journal must say WHY
+    # it did not pack — `tg stats` renders sim.pack.solo_reason so a
+    # tenant sees the cause instead of guessing (the same
+    # classification `tg check` previews as rule pack.solo)
+    if runner_id == "sim:jax" and _truthy(getattr(runner_cfg, "pack", False)):
+        from .pack import pack_solo_reason
+
+        solo_reason = (
+            pack_solo_reason(tsk, engine.env.runners.get(runner_id) or {})
+            or "no compatible queued run to pack with at claim time"
+        )
+        for rres in run_results.values():
+            journal = rres.get("journal") if isinstance(rres, dict) else None
+            if isinstance(journal, dict) and isinstance(
+                journal.get("sim"), dict
+            ):
+                journal["sim"]["pack"] = {
+                    "requested": True,
+                    "packed": False,
+                    "solo_reason": solo_reason,
+                }
 
     base = (
         run_results[comp.runs[0].id]

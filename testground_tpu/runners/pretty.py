@@ -111,6 +111,29 @@ def render_telemetry_summary(stats: dict) -> str:
         tr = sim.get("transport") or {}
         if tr.get("resolved"):
             rows.append(("transport", _fmt_transport(tr)))
+        # run packing (journal["sim"]["pack"]): a packed member shows
+        # its slot; a pack-opted run that executed SOLO shows why — the
+        # supervisor journals solo_reason so the tenant never has to
+        # guess what kept their run out of a pack
+        pk = sim.get("pack") or {}
+        if pk.get("solo_reason"):
+            rows.append(("pack", f"solo — {pk['solo_reason']}"))
+        elif pk.get("width"):
+            rows.append(
+                (
+                    "pack",
+                    "member {m}/{n} of a width-{w} pack "
+                    "(leader {l})".format(
+                        # journal index is 0-based; humans count from 1
+                        m=_fmt_count(
+                            (_num(pk.get("index"), 0) or 0) + 1, "?"
+                        ),
+                        n=_fmt_count(pk.get("members")),
+                        w=_fmt_count(pk.get("width")),
+                        l=pk.get("leader_run", "?"),
+                    ),
+                )
+            )
         # one-line performance-ledger teaser (full view: `tg perf`)
         perf_ex = (sim.get("perf") or {}).get("execute") or {}
         rate = _num(perf_ex.get("steady_peer_ticks_per_sec")) or _num(
@@ -452,7 +475,9 @@ def render_perf_summary(payload: dict) -> str:
             )
         )
     pack = sim.get("pack") or {}
-    if _num(pack.get("width")):
+    if pack.get("solo_reason"):
+        rows.append(("pack", f"solo — {pack['solo_reason']}"))
+    elif _num(pack.get("width")):
         rows.append(
             (
                 "pack",

@@ -1,0 +1,1153 @@
+"""Static-analysis plane: the shared gate-rules engine behind ``tg check``
+(docs/CHECKING.md).
+
+The reference front-loads failure with ``plan``/``describe``/
+``healthcheck`` verbs so a bad composition dies before a 10k-instance
+run is scheduled. This module is the sim analog, grown past the point
+the reference reached: every composition-level admission rule the
+``sim:jax`` executor enforces — the mutually-gated transport/bucket/
+pack/trace/SLO/checkpoint/fault knobs whose refusals historically lived
+as scattered ``ValueError``s deep in ``sim/executor.py``, firing only
+after queueing — is catalogued here as a typed :class:`Rule` and
+evaluated statically against a composition + run-config + device
+context, ALL findings reported in one pass instead of dying on the
+first.
+
+Drift discipline: the checker does not re-implement the gates — it
+**calls the same functions** the executor calls (``resolve_buckets``,
+``decide_transport``, ``build_fault_schedule``, ``build_trace_plan``,
+``build_slo_plan``, ``pack`` admission, the cohort spec-size precheck),
+catching their refusals and collecting their warnings, so an error
+message here is byte-identical to the one the executor would raise. The
+few refusals the executor states inline (SLO-without-telemetry,
+resume-under-cohort) are extracted into shared message helpers the
+executor now imports back. ``tests/test_check.py`` pins the no-drift
+property over a matrix of bad configs: the executor cannot refuse a
+config the checker passes, and vice versa.
+
+Three layers share the engine:
+
+1. **config rules** — pure composition + run-cfg + device-context
+   evaluation (no jax import, milliseconds): knob validation, gate
+   exclusions, pack-admission preview, cohort bounds.
+2. **abstract plan tracing** (``trace_plans=True``) — each referenced
+   plan's testcase runs under ``jax.eval_shape`` at the composition's
+   real (and, when bucketed, padded-ladder) shapes — no device
+   allocation — catching traced-count contract violations
+   (``docs/WRITING_PLANS.md``), shape/dtype errors, and build-time
+   refusals before anything compiles.
+3. **jaxpr invariant lints** (with ``trace_plans``) — the lowered tick
+   jaxpr is scanned for host callbacks in the hot path
+   (``pure_callback``/``io_callback``/``debug_print``), unbounded
+   ``while`` loops in the tick, and weak-type state leaves (recompile
+   hazards).
+
+Import-light on purpose for layer 1 (stdlib + numpy + the sibling gate
+modules): without ``--trace-plans`` the only jax touch is device
+detection for the mesh-bound rules — and an explicit ``devices=N``
+skips even that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import types
+
+__all__ = [
+    "CheckContext",
+    "Finding",
+    "Rule",
+    "RULES",
+    "check_composition",
+    "render_findings",
+    "resume_cohort_message",
+    "rule_by_id",
+    "slo_requires_telemetry_message",
+]
+
+
+# --------------------------------------------------------------- catalog
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One catalogued admission rule: a stable id, the severity the
+    executor enforces it at (``error`` = the run is refused, ``warn`` =
+    the executor falls back loudly), the knob layer it guards, and a
+    one-line summary for the catalog table (docs/CHECKING.md)."""
+
+    id: str
+    severity: str  # "error" | "warn"
+    layer: str
+    summary: str
+
+
+RULES: tuple[Rule, ...] = (
+    # ---- composition structure
+    Rule(
+        "composition.invalid",
+        "error",
+        "composition",
+        "composition fails structural validation / preparation",
+    ),
+    Rule(
+        "run-cfg.unknown-key",
+        "warn",
+        "run-cfg",
+        "runner-config key matches no SimJaxConfig field (silently ignored)",
+    ),
+    # ---- transport
+    Rule(
+        "transport.unknown",
+        "error",
+        "transport",
+        "transport knob is not xla|pallas|auto",
+    ),
+    Rule(
+        "transport.mesh-fallback",
+        "warn",
+        "transport",
+        "pallas/auto on a multi-device mesh falls back to xla",
+    ),
+    # ---- shape buckets
+    Rule(
+        "buckets.mode-invalid",
+        "error",
+        "buckets",
+        "bucket knob is not off|auto|<n>",
+    ),
+    Rule(
+        "buckets.ladder-invalid",
+        "error",
+        "buckets",
+        "bucket_ladder is not a positive instance-count list",
+    ),
+    Rule(
+        "buckets.cohort-disabled",
+        "warn",
+        "buckets",
+        "bucketing disabled under a cohort config",
+    ),
+    Rule(
+        "buckets.mesh-disabled",
+        "warn",
+        "buckets",
+        "bucketing disabled on a multi-device mesh",
+    ),
+    Rule(
+        "buckets.over-ladder",
+        "warn",
+        "buckets",
+        "a group exceeds the ladder coverage; runs exact shapes",
+    ),
+    Rule(
+        "buckets.filter-rules",
+        "warn",
+        "buckets",
+        "filter_rules shaping with multiple groups disables bucketing",
+    ),
+    # ---- faults / flight recorder
+    Rule(
+        "faults.invalid",
+        "error",
+        "faults",
+        "a [[run.faults]] table fails validation/lowering",
+    ),
+    Rule(
+        "trace.invalid",
+        "error",
+        "trace",
+        "a [run.trace] table fails validation/lowering",
+    ),
+    Rule(
+        "trace.bucket-disabled",
+        "warn",
+        "trace",
+        "flight recorder disabled under shape bucketing",
+    ),
+    Rule(
+        "trace.cohort-disabled",
+        "warn",
+        "trace",
+        "flight recorder disabled under a cohort config",
+    ),
+    # ---- telemetry / SLO
+    Rule(
+        "telemetry.cohort-disabled",
+        "warn",
+        "telemetry",
+        "telemetry plane disabled under a cohort config",
+    ),
+    Rule(
+        "slo.invalid",
+        "error",
+        "slo",
+        "a [[run.slo]] table fails validation",
+    ),
+    Rule(
+        "slo.needs-telemetry",
+        "error",
+        "slo",
+        "SLO rules declared but the telemetry plane is off",
+    ),
+    Rule(
+        "slo.cohort-disabled",
+        "warn",
+        "slo",
+        "SLO assertions disabled under a cohort config",
+    ),
+    # ---- checkpoint / resume
+    Rule(
+        "checkpoint.cohort-disabled",
+        "warn",
+        "checkpoint",
+        "checkpointing disabled under a cohort config",
+    ),
+    Rule(
+        "checkpoint.resume-cohort",
+        "error",
+        "checkpoint",
+        "resume_from is not supported under a multi-host cohort",
+    ),
+    Rule(
+        "checkpoint.resume-multi-runs",
+        "error",
+        "checkpoint",
+        "resume_from on a multi-[[runs]] composition is ambiguous",
+    ),
+    # ---- debug knobs
+    Rule(
+        "debug.nan-guard-cohort",
+        "warn",
+        "debug",
+        "nan_guard disabled under a cohort config",
+    ),
+    # ---- cohort
+    Rule(
+        "cohort.spec-oversize",
+        "error",
+        "cohort",
+        "cohort job spec exceeds the broadcast byte bound",
+    ),
+    # ---- run packing
+    Rule(
+        "pack.solo",
+        "warn",
+        "pack",
+        "pack=true but the composition must run solo",
+    ),
+    # ---- abstract plan tracing (--trace-plans)
+    Rule(
+        "plan.load-failed",
+        "error",
+        "plan",
+        "plan sources fail to import/specialize for this composition",
+    ),
+    Rule(
+        "plan.traced-int",
+        "error",
+        "plan",
+        "python int()/len()/control flow on a traced count "
+        "(the traced-count contract, docs/WRITING_PLANS.md)",
+    ),
+    Rule(
+        "plan.trace-error",
+        "error",
+        "plan",
+        "the testcase fails to trace at the composition's shapes",
+    ),
+    Rule(
+        "plan.memory",
+        "error",
+        "plan",
+        "estimated carry footprint exceeds the device memory budget",
+    ),
+    Rule(
+        "plan.host-callback",
+        "warn",
+        "plan",
+        "host callback (pure_callback/io_callback/debug_print) in the "
+        "jitted tick",
+    ),
+    Rule(
+        "plan.while-loop",
+        "warn",
+        "plan",
+        "while loop in the jitted tick (unbounded per-tick work)",
+    ),
+    Rule(
+        "plan.weak-type",
+        "warn",
+        "plan",
+        "weak-typed leaf in the instance state (recompile hazard)",
+    ),
+)
+
+_RULE_INDEX = {r.id: r for r in RULES}
+
+
+def rule_by_id(rule_id: str) -> Rule:
+    return _RULE_INDEX[rule_id]
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule firing against one composition: the rule id, its
+    severity/layer (denormalized for the JSON surface), the
+    executor-identical message, and where it fired (``run`` = the
+    [[runs]] entry id, when attributable; ``plan_file`` for the
+    plan-tracing layer)."""
+
+    rule: str
+    severity: str
+    layer: str
+    message: str
+    run: str = ""
+    plan_file: str = ""
+
+    def to_dict(self) -> dict:
+        out = {
+            "rule": self.rule,
+            "severity": self.severity,
+            "layer": self.layer,
+            "message": self.message,
+        }
+        if self.run:
+            out["run"] = self.run
+        if self.plan_file:
+            out["plan_file"] = self.plan_file
+        return out
+
+
+# ------------------------------------------------- shared message helpers
+# The executor imports these back, so the refusal it raises and the
+# finding the checker reports are the same string by construction.
+
+
+def slo_requires_telemetry_message(count: int, disable_metrics: bool) -> str:
+    """The SLO-without-telemetry refusal (executor + checker)."""
+    return (
+        f"composition declares {count} SLO rule(s) but the telemetry "
+        "plane is off"
+        + (
+            " (disable_metrics = true wins over everything)"
+            if disable_metrics
+            else " — set telemetry = true in the runner config "
+            "(--run-cfg telemetry=true)"
+        )
+        + "; refusing to run with unenforceable SLOs"
+    )
+
+
+def resume_cohort_message() -> str:
+    """The resume-under-cohort refusal (executor + checker)."""
+    return (
+        "resume_from is not supported under a multi-host cohort "
+        "(checkpoints are leader-local reads of a cross-process "
+        "carry); run the resumed composition single-host"
+    )
+
+
+# ---------------------------------------------------------------- context
+
+
+@dataclasses.dataclass
+class CheckContext:
+    """Everything one check pass evaluates against: the prepared
+    composition, the coalesced runner config, and the device context
+    (``devices`` = how many devices the run would see; overridable so a
+    laptop can check what an 8-chip host would refuse)."""
+
+    comp: object  # api.Composition, post prepare_for_run
+    cfg: object  # SimJaxConfig
+    devices: int = 1
+    trace_plans: bool = False
+    plan_sources: str = ""  # plan source dir (for trace_plans)
+    raw_run_config: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def cohort(self) -> bool:
+        return bool(getattr(self.cfg, "coordinator_address", ""))
+
+    @property
+    def mesh_devices(self) -> int:
+        """Devices the executor's ``_make_mesh`` would mesh over: > 1
+        only when sharding is on and this is not a cohort config (a
+        cohort builds the global mesh instead — which is always
+        multi-device, so cohort gates subsume the mesh gates there)."""
+        if not getattr(self.cfg, "shard", True) or self.cohort:
+            return 1
+        return max(int(self.devices), 1)
+
+
+class _FakeMesh:
+    """Duck-typed stand-in for a ``jax.sharding.Mesh`` where the gates
+    only read ``mesh.devices.size`` — lets the config layer evaluate
+    mesh rules without importing jax."""
+
+    def __init__(self, n: int):
+        self.devices = types.SimpleNamespace(size=int(n))
+
+
+def _mesh_of(ctx: CheckContext):
+    n = ctx.mesh_devices
+    return _FakeMesh(n) if n > 1 else None
+
+
+def _group_layout(run_groups):
+    """The resolved per-run group layout the lowering gates resolve
+    selectors against — the exact construction of
+    ``sim/engine.build_groups`` without the jax import (the gates only
+    read ``id``/``index``/``offset``/``count``/``params``)."""
+    specs = []
+    off = 0
+    for i, rg in enumerate(run_groups):
+        count = int(rg.calculated_instance_count)
+        specs.append(
+            types.SimpleNamespace(
+                id=rg.id,
+                index=i,
+                offset=off,
+                count=count,
+                params=dict(rg.test_params),
+            )
+        )
+        off += count
+    return tuple(specs)
+
+
+class _WarnCollector:
+    """A ``(fmt, *args)`` warn callable (the gates' contract) that
+    collects the rendered lines; also quacks like an OutputWriter
+    (``warn``/``infof``) for the helpers that take one."""
+
+    def __init__(self):
+        self.lines: list[str] = []
+
+    def __call__(self, fmt, *args):
+        self.warn(fmt, *args)
+
+    def warn(self, fmt, *args):
+        try:
+            self.lines.append(str(fmt) % args if args else str(fmt))
+        except (TypeError, ValueError):
+            self.lines.append(str(fmt))
+
+    def infof(self, fmt, *args):  # the gates' info lines are not findings
+        pass
+
+
+# ------------------------------------------------------------ rule passes
+
+
+def _add(findings, rule_id, message, run="", plan_file=""):
+    r = rule_by_id(rule_id)
+    findings.append(
+        Finding(
+            rule=r.id,
+            severity=r.severity,
+            layer=r.layer,
+            message=message,
+            run=run,
+            plan_file=plan_file,
+        )
+    )
+
+
+def _check_run_cfg_keys(ctx, findings) -> None:
+    """Unknown runner-config keys: ``coalesce_into`` silently drops
+    them, so a typo'd knob (``trasnport=pallas``) configures nothing —
+    surfaced here instead of silently ignored at run time."""
+    from .executor import SimJaxConfig
+
+    known = {f.name for f in dataclasses.fields(SimJaxConfig)}
+    # runner-level keys that are legitimately not SimJaxConfig fields:
+    # "enabled" is the manifest's runner toggle (prepare_for_run folds
+    # manifest runner defaults into run_config), the rest are consumed
+    # by the engine/runner layer before the executor
+    known |= {"enabled", "pack", "sync_service"}
+    for key in sorted(ctx.raw_run_config or {}):
+        if key not in known:
+            _add(
+                findings,
+                "run-cfg.unknown-key",
+                f"runner config key {key!r} matches no sim:jax option and "
+                "is silently ignored — known options: "
+                f"{', '.join(sorted(known))}",
+            )
+
+
+def _check_transport(ctx, findings) -> None:
+    from .transport_model import TRANSPORTS, decide_transport
+
+    requested = str(getattr(ctx.cfg, "transport", "xla") or "xla").lower()
+    if requested not in TRANSPORTS:
+        try:
+            decide_transport(ctx.cfg, None)
+        except ValueError as e:
+            _add(findings, "transport.unknown", str(e))
+        return
+    if requested != "xla" and ctx.mesh_devices > 1:
+        warns = _WarnCollector()
+        decide_transport(ctx.cfg, _FakeMesh(ctx.mesh_devices), warn=warns)
+        for line in warns.lines:
+            _add(findings, "transport.mesh-fallback", line)
+
+
+def _check_buckets(ctx, run, findings):
+    """Returns the resolved BucketPlan (or None) for this run — the
+    trace/pack layers need it — while collecting the gate's refusals
+    and warnings as findings."""
+    from .executor import resolve_buckets
+
+    counts = [rg.calculated_instance_count for rg in run.groups]
+    warns = _WarnCollector()
+    try:
+        plan = resolve_buckets(ctx.cfg, counts, mesh=_mesh_of(ctx), warn=warns)
+    except ValueError as e:
+        msg = str(e)
+        rule = (
+            "buckets.ladder-invalid"
+            if "bucket_ladder" in msg
+            else "buckets.mode-invalid"
+        )
+        _add(findings, rule, msg, run=run.id)
+        return None
+    for line in warns.lines:
+        if "cohort" in line:
+            rule = "buckets.cohort-disabled"
+        elif "single device" in line:
+            rule = "buckets.mesh-disabled"
+        else:
+            rule = "buckets.over-ladder"
+        _add(findings, rule, line, run=run.id)
+    return plan
+
+
+def _run_specs(ctx, run):
+    """The three spec dicts the executor collects for one run — built
+    from the SAME ``*_specs_of`` helpers on the same layout."""
+    from .executor import fault_specs_of, slo_specs_of, trace_specs_of
+
+    g = ctx.comp.global_
+    run_global = g.run if g.run is not None else None
+    fault_specs = fault_specs_of(
+        run.groups, run_global.faults if run_global else None
+    )
+    trace_specs = trace_specs_of(
+        run.groups, run_global.trace if run_global else None
+    )
+    slo_specs = slo_specs_of(
+        run.groups, run_global.slo if run_global else None
+    )
+    return fault_specs, trace_specs, slo_specs
+
+
+def _check_run(ctx, run, findings) -> dict:
+    """All config-layer rules for one [[runs]] entry. Returns the
+    resolved pieces the plan-tracing layer reuses."""
+    from .faults import build_fault_schedule
+    from .slo import build_slo_plan
+    from .trace import build_trace_plan
+
+    vgroups = _group_layout(run.groups)
+    fault_specs, trace_specs, slo_specs = _run_specs(ctx, run)
+    bucket_plan = _check_buckets(ctx, run, findings)
+
+    fault_schedule = None
+    try:
+        fault_schedule = build_fault_schedule(
+            vgroups, fault_specs, ctx.cfg.tick_ms
+        )
+    except ValueError as e:
+        _add(findings, "faults.invalid", str(e), run=run.id)
+
+    trace_plan = None
+    try:
+        trace_plan = build_trace_plan(vgroups, trace_specs)
+    except ValueError as e:
+        _add(findings, "trace.invalid", str(e), run=run.id)
+    disable_metrics = bool(ctx.comp.global_.disable_metrics)
+    if trace_plan is not None and disable_metrics:
+        trace_plan = None  # silent at run time too (the opt-out wins)
+    if trace_plan is not None and bucket_plan is not None:
+        _add(
+            findings,
+            "trace.bucket-disabled",
+            "flight recorder disabled under shape bucketing (trace "
+            "lanes are exact-layout selectors baked into the program; "
+            "run with bucket=off to trace)",
+            run=run.id,
+        )
+        trace_plan = None
+    if trace_plan is not None and ctx.cohort:
+        _add(
+            findings,
+            "trace.cohort-disabled",
+            "flight recorder disabled for the cohort config (per-chunk "
+            "leader-local device reads are not symmetric across "
+            "processes)",
+            run=run.id,
+        )
+        trace_plan = None
+
+    telemetry_on = (
+        bool(getattr(ctx.cfg, "telemetry", False)) and not disable_metrics
+    )
+    if telemetry_on and ctx.cohort:
+        _add(
+            findings,
+            "telemetry.cohort-disabled",
+            "telemetry disabled for the cohort config (per-chunk "
+            "leader-local device reads are not symmetric across "
+            "processes)",
+            run=run.id,
+        )
+        telemetry_on = False
+
+    slo_plan = None
+    try:
+        slo_plan = build_slo_plan(vgroups, slo_specs)
+    except ValueError as e:
+        _add(findings, "slo.invalid", str(e), run=run.id)
+    if slo_plan is not None and ctx.cohort:
+        _add(
+            findings,
+            "slo.cohort-disabled",
+            "SLO assertions disabled for the cohort config (the "
+            "telemetry plane they evaluate is leader-local and runs "
+            "off under a cohort)",
+            run=run.id,
+        )
+        slo_plan = None
+    if slo_plan is not None and not telemetry_on:
+        _add(
+            findings,
+            "slo.needs-telemetry",
+            slo_requires_telemetry_message(slo_plan.count, disable_metrics),
+            run=run.id,
+        )
+
+    # checkpoint / resume / debug gates
+    ckpt_every = int(getattr(ctx.cfg, "checkpoint_chunks", 0) or 0)
+    resume_from = str(getattr(ctx.cfg, "resume_from", "") or "")
+    if resume_from and ctx.cohort:
+        _add(
+            findings,
+            "checkpoint.resume-cohort",
+            resume_cohort_message(),
+            run=run.id,
+        )
+    if ckpt_every > 0 and ctx.cohort:
+        _add(
+            findings,
+            "checkpoint.cohort-disabled",
+            "checkpointing disabled for the cohort config (a "
+            "leader-local read of the cross-process-sharded carry is "
+            "not symmetric)",
+            run=run.id,
+        )
+    if bool(getattr(ctx.cfg, "nan_guard", False)) and ctx.cohort:
+        _add(
+            findings,
+            "debug.nan-guard-cohort",
+            "nan_guard disabled for the cohort config (a leader-local "
+            "read of the cross-process-sharded carry is not symmetric, "
+            "and raises on non-addressable shards)",
+            run=run.id,
+        )
+
+    if ctx.cohort:
+        _check_cohort_spec_size(ctx, run, findings)
+
+    return {
+        "vgroups": vgroups,
+        "bucket_plan": bucket_plan,
+        "fault_schedule": fault_schedule,
+        "fault_specs": fault_specs,
+        "trace_plan": trace_plan,
+        "telemetry_on": telemetry_on,
+    }
+
+
+def _check_cohort_spec_size(ctx, run, findings) -> None:
+    """The broadcast-bound precheck, via the executor's OWN function on
+    a job shaped like the one ``do_run`` would build — same builder,
+    same bound, same message."""
+    try:
+        from testground_tpu.api import RunGroup
+
+        from .executor import _precheck_cohort_spec_size
+
+        job = types.SimpleNamespace(
+            test_plan=ctx.comp.global_.plan,
+            test_case=ctx.comp.global_.case,
+            run_id=run.id,
+            groups=[
+                RunGroup(
+                    id=rg.id,
+                    instances=rg.calculated_instance_count,
+                    parameters=dict(rg.test_params),
+                    faults=[dict(f) for f in getattr(rg, "faults", [])],
+                )
+                for rg in run.groups
+            ],
+            faults=[
+                dict(f)
+                for f in (
+                    ctx.comp.global_.run.faults
+                    if ctx.comp.global_.run is not None
+                    else []
+                )
+            ],
+        )
+        _precheck_cohort_spec_size(job, ctx.cfg)
+    except ValueError as e:
+        _add(findings, "cohort.spec-oversize", str(e), run=run.id)
+    except Exception:  # noqa: BLE001 — the precheck needs jax's
+        # distributed constants; a host without them skips this rule
+        pass
+
+
+def _check_pack(ctx, findings) -> None:
+    """Pack-admission preview: when the composition opts into packing
+    but would run solo, name the cause — the same classification the
+    engine journals as ``sim.pack.solo_reason``."""
+    from testground_tpu.engine.pack import solo_reason_for_composition
+
+    env_layer = dict(ctx.raw_env_layer) if hasattr(ctx, "raw_env_layer") else {}
+    reason = solo_reason_for_composition(ctx.comp.to_dict(), env_layer)
+    if reason is not None:
+        _add(
+            findings,
+            "pack.solo",
+            f"pack=true but this composition runs solo: {reason}",
+        )
+
+
+def _check_resume_multi_runs(ctx, findings) -> None:
+    """Composition-level checkpoint rule: ``resume_from`` with multiple
+    ``[[runs]]`` entries is ambiguous (the per-run rules live in
+    :func:`_check_run` beside ``checkpoint.resume-cohort``)."""
+    if str(getattr(ctx.cfg, "resume_from", "") or "") and (
+        len(ctx.comp.runs) > 1
+    ):
+        _add(
+            findings,
+            "checkpoint.resume-multi-runs",
+            f"resume_from is set on a multi-[[runs]] composition "
+            f"({len(ctx.comp.runs)} runs) — every run would resume from "
+            "the same snapshot dir; resume one run at a time "
+            "(--run-ids <id>)",
+        )
+
+
+# ------------------------------------------------- abstract plan tracing
+
+
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback"}
+
+
+def _iter_eqns(jaxpr):
+    """Every equation in a jaxpr, recursing into call/control-flow
+    sub-jaxprs (pjit, scan, while, cond, custom_* …)."""
+    try:  # jax ≥ 0.4.34 exports these from jax.extend.core; the
+        # jax.core aliases are deprecated and removed in newer releases
+        from jax.extend.core import ClosedJaxpr, Jaxpr
+    except ImportError:
+        from jax.core import ClosedJaxpr, Jaxpr
+
+    def subs(v):
+        if isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                yield from subs(item)
+
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for param in eqn.params.values():
+            for sub in subs(param):
+                yield from _iter_eqns(sub)
+
+
+def _classify_trace_error(e) -> str:
+    """Map a trace-time exception to a rule id: the traced-count
+    contract violations get their own id (the actionable one), the rest
+    report as generic trace errors."""
+    try:
+        import jax
+
+        traced_types = tuple(
+            t
+            for t in (
+                getattr(jax.errors, "TracerIntegerConversionError", None),
+                getattr(jax.errors, "TracerBoolConversionError", None),
+                getattr(jax.errors, "TracerArrayConversionError", None),
+                getattr(jax.errors, "ConcretizationTypeError", None),
+            )
+            if t is not None
+        )
+    except Exception:  # noqa: BLE001
+        traced_types = ()
+    return (
+        "plan.traced-int"
+        if isinstance(e, traced_types)
+        else "plan.trace-error"
+    )
+
+
+def _trace_one_program(ctx, run, resolved, findings, *, bucketed) -> None:
+    """Build one SimProgram variant for this run and lint it: eval_shape
+    the carry (allocates nothing), lower the tick to a jaxpr, and scan
+    for the invariant lints. ``bucketed`` traces the padded-ladder
+    variant (runtime live counts — the traced-count contract's teeth)."""
+    import jax
+
+    from testground_tpu.api import RunGroup
+
+    from .executor import (
+        _parse_hosts,
+        _precheck_device_memory,
+        load_and_specialize,
+        make_sim_program,
+    )
+
+    plan_file = ctx.plan_sources or ctx.comp.global_.plan
+    label = f"{ctx.comp.global_.plan}:{ctx.comp.global_.case}"
+    bucket_plan = resolved["bucket_plan"] if bucketed else None
+    counts = [
+        (
+            p
+            if bucket_plan is not None
+            else rg.calculated_instance_count
+        )
+        for rg, p in zip(
+            run.groups,
+            (
+                bucket_plan.padded_counts
+                if bucket_plan is not None
+                else [0] * len(run.groups)
+            ),
+        )
+    ]
+    run_groups_in = [
+        RunGroup(
+            id=rg.id,
+            instances=c,
+            parameters=dict(rg.test_params),
+        )
+        for rg, c in zip(run.groups, counts)
+    ]
+    shape_note = (
+        f"padded shapes {tuple(counts)}" if bucketed else "exact shapes"
+    )
+    try:
+        testcase, groups = load_and_specialize(
+            ctx.plan_sources,
+            ctx.comp.global_.case,
+            run_groups_in,
+            ctx.cfg.tick_ms,
+        )
+    except Exception as e:  # noqa: BLE001 — import/specialize failures
+        _add(
+            findings,
+            "plan.load-failed",
+            f"{label}: plan failed to load/specialize at {shape_note}: {e}",
+            run=run.id,
+            plan_file=plan_file,
+        )
+        return
+    if (
+        bucket_plan is not None
+        and "filter_rules" in type(testcase).SHAPING
+        and len(groups) > 1
+    ):
+        _add(
+            findings,
+            "buckets.filter-rules",
+            "shape bucketing disabled — 'filter_rules' shaping with "
+            "multiple groups addresses the exact layout (rule ranges "
+            "cannot survive per-group padding); running exact shapes",
+            run=run.id,
+            plan_file=plan_file,
+        )
+        return
+
+    hosts = _parse_hosts(getattr(ctx.cfg, "additional_hosts", None))
+    try:
+        prog = make_sim_program(
+            testcase,
+            groups,
+            test_plan=ctx.comp.global_.plan,
+            test_case=ctx.comp.global_.case,
+            test_run="check",
+            tick_ms=ctx.cfg.tick_ms,
+            mesh=None,
+            chunk=ctx.cfg.chunk,
+            hosts=hosts,
+            validate=bool(getattr(ctx.cfg, "validate", False)),
+            telemetry=resolved["telemetry_on"],
+            faults=resolved["fault_schedule"] if not bucketed else None,
+            trace=resolved["trace_plan"] if not bucketed else None,
+            transport=(
+                str(getattr(ctx.cfg, "transport", "xla") or "xla").lower()
+                if str(getattr(ctx.cfg, "transport", "xla")).lower()
+                in ("xla", "pallas")
+                else "xla"
+            ),
+            live_counts=(
+                bucket_plan.live_counts if bucket_plan is not None else None
+            ),
+        )
+    except Exception as e:  # noqa: BLE001 — build-time refusals
+        _add(
+            findings,
+            _classify_trace_error(e),
+            f"{label}: program build failed at {shape_note}: {e}",
+            run=run.id,
+            plan_file=plan_file,
+        )
+        return
+
+    # the executor's capacity precheck, verbatim (same function)
+    try:
+        _precheck_device_memory(prog, ctx.cfg, None, _WarnCollector())
+    except RuntimeError as e:
+        _add(
+            findings,
+            "plan.memory",
+            f"{label}: {e}",
+            run=run.id,
+            plan_file=plan_file,
+        )
+
+    if bucket_plan is not None:
+        import numpy as np
+
+        lc = np.asarray(bucket_plan.live_counts, np.int32)
+
+        def _init():
+            return prog.init_carry(int(ctx.cfg.seed), lc)
+
+    else:
+
+        def _init():
+            return prog.init_carry(int(ctx.cfg.seed))
+
+    try:
+        carry = jax.eval_shape(_init)
+    except Exception as e:  # noqa: BLE001 — abstract init failures
+        _add(
+            findings,
+            _classify_trace_error(e),
+            f"{label}: init failed under eval_shape at {shape_note} "
+            f"({type(e).__name__}): {e}",
+            run=run.id,
+            plan_file=plan_file,
+        )
+        return
+
+    # weak-type lint: a weakly-typed state leaf re-promotes against the
+    # first strongly-typed operand it meets — two plans differing only
+    # in a python literal then trace different programs (a recompile
+    # hazard the persistent cache cannot dedup)
+    weak = []
+    try:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            carry.states
+        )[0]:
+            if getattr(leaf, "weak_type", False):
+                weak.append(jax.tree_util.keystr(path))
+    except Exception:  # noqa: BLE001 — lint is best-effort
+        pass
+    if weak:
+        shown = ", ".join(weak[:4]) + ("…" if len(weak) > 4 else "")
+        _add(
+            findings,
+            "plan.weak-type",
+            f"{label}: {len(weak)} weak-typed state leaf/leaves "
+            f"({shown}) — give literals an explicit dtype "
+            "(jnp.float32(0.0), jnp.zeros((), jnp.int32)) so retraces "
+            "and the compile cache see one stable program",
+            run=run.id,
+            plan_file=plan_file,
+        )
+
+    try:
+        jaxpr = jax.make_jaxpr(prog._chunk_step)(carry)
+    except Exception as e:  # noqa: BLE001 — tick trace failures
+        _add(
+            findings,
+            _classify_trace_error(e),
+            f"{label}: tick failed to trace at {shape_note} "
+            f"({type(e).__name__}): {e}",
+            run=run.id,
+            plan_file=plan_file,
+        )
+        return
+
+    callbacks = set()
+    whiles = 0
+    for eqn in _iter_eqns(jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name in _CALLBACK_PRIMS:
+            callbacks.add(name)
+        elif name == "while":
+            whiles += 1
+    if callbacks:
+        _add(
+            findings,
+            "plan.host-callback",
+            f"{label}: host callback(s) {sorted(callbacks)} inside the "
+            "jitted tick — each dispatch synchronizes device→host; "
+            "debug prints and python callbacks do not belong in the hot "
+            "path (gate them out of production steps)",
+            run=run.id,
+            plan_file=plan_file,
+        )
+    if whiles:
+        _add(
+            findings,
+            "plan.while-loop",
+            f"{label}: {whiles} while loop(s) inside the jitted tick — "
+            "per-tick work must be bounded (the chunk scan is the only "
+            "sanctioned loop); unroll with lax.fori_loop over a static "
+            "bound or restructure as multi-tick state",
+            run=run.id,
+            plan_file=plan_file,
+        )
+
+
+def _check_plans(ctx, run, resolved, findings) -> None:
+    """Layer 2+3 for one run: trace the program variant the run would
+    actually compile — the padded-ladder shapes with runtime live
+    counts when the bucket gate resolved a plan (ONLY that variant
+    gives the traced-count contract teeth: exact-shape programs see
+    static python counts), the exact shapes otherwise."""
+    _trace_one_program(
+        ctx,
+        run,
+        resolved,
+        findings,
+        bucketed=resolved["bucket_plan"] is not None,
+    )
+
+
+# ------------------------------------------------------------ entry point
+
+
+def check_composition(
+    comp,
+    manifest,
+    *,
+    env_layer: dict | None = None,
+    devices: int = 0,
+    trace_plans: bool = False,
+    plan_sources: str = "",
+) -> list[Finding]:
+    """Evaluate every catalogued rule against one composition.
+
+    ``comp`` is an ``api.Composition`` (pre-preparation — this function
+    prepares its own clone, like ``do_run``); ``manifest`` its plan
+    manifest; ``env_layer`` the daemon's ``[runners."sim:jax"]`` config
+    layer (coalesced under the composition's run_config, the executor's
+    precedence); ``devices`` the device-context override (0 = detect
+    via jax when available, else 1); ``trace_plans`` enables the
+    abstract-tracing + jaxpr-lint layers against ``plan_sources``.
+
+    Returns ALL findings, error and warn, in evaluation order — the
+    caller decides presentation and exit codes."""
+    from testground_tpu.api import prepare_for_run, validate_for_run
+    from testground_tpu.config import CoalescedConfig
+
+    from .executor import SimJaxConfig
+
+    findings: list[Finding] = []
+    try:
+        validate_for_run(comp)
+        prepared = prepare_for_run(comp, manifest)
+    except Exception as e:  # noqa: BLE001 — structural refusals
+        _add(findings, "composition.invalid", str(e))
+        return findings
+
+    if (prepared.global_.runner or "") != "sim:jax":
+        # the rules catalog guards the sim:jax admission surface; other
+        # runners only get the structural validation above
+        return findings
+
+    raw_cfg = dict(prepared.global_.run_config or {})
+    cfg = (
+        CoalescedConfig()
+        .append(env_layer)
+        .append(raw_cfg)
+        .coalesce_into(SimJaxConfig)
+    )
+    if devices <= 0:
+        try:
+            import jax
+
+            devices = len(jax.devices())
+        except Exception:  # noqa: BLE001 — jax-free hosts check at n=1
+            devices = 1
+    ctx = CheckContext(
+        comp=prepared,
+        cfg=cfg,
+        devices=devices,
+        trace_plans=trace_plans,
+        plan_sources=plan_sources,
+        raw_run_config=raw_cfg,
+    )
+    ctx.raw_env_layer = dict(env_layer or {})
+
+    _check_run_cfg_keys(ctx, findings)
+    _check_transport(ctx, findings)
+    _check_pack(ctx, findings)
+    _check_resume_multi_runs(ctx, findings)
+    for run in prepared.runs:
+        resolved = _check_run(ctx, run, findings)
+        if trace_plans and plan_sources:
+            _check_plans(ctx, run, resolved, findings)
+    return findings
+
+
+# ------------------------------------------------------------- rendering
+
+
+def render_findings(path: str, findings: list[Finding]) -> str:
+    """Human-readable report for one composition file — one line per
+    finding, errors first (stable within severity)."""
+    errors = [f for f in findings if f.severity == "error"]
+    warns = [f for f in findings if f.severity != "error"]
+    if not findings:
+        return f"{path}: ok (no findings)"
+    head = (
+        f"{path}: {len(errors)} error(s), {len(warns)} warning(s)"
+    )
+    lines = [head]
+    for f in errors + warns:
+        where = f" (run {f.run})" if f.run else ""
+        lines.append(f"  [{f.severity:5}] {f.rule}{where}: {f.message}")
+    return "\n".join(lines)
+
+
+def findings_payload(results: list[tuple[str, list[Finding]]]) -> dict:
+    """The ``tg check --json`` document — schema pinned by
+    tests/test_check.py (version bumps on shape changes)."""
+    comps = [
+        {
+            "file": path,
+            "findings": [f.to_dict() for f in fs],
+            "errors": sum(1 for f in fs if f.severity == "error"),
+            "warnings": sum(1 for f in fs if f.severity != "error"),
+        }
+        for path, fs in results
+    ]
+    return {
+        "version": 1,
+        "compositions": comps,
+        "errors": sum(c["errors"] for c in comps),
+        "warnings": sum(c["warnings"] for c in comps),
+    }

@@ -896,16 +896,15 @@ def _execute_sim_run(
         )
         slo_plan = None
     if slo_plan is not None and not telemetry_on:
+        # shared with the static checker (sim/check.py rule
+        # slo.needs-telemetry) so `tg check` reports the byte-identical
+        # refusal before anything queues
+        from .check import slo_requires_telemetry_message
+
         raise ValueError(
-            f"composition declares {slo_plan.count} SLO rule(s) but the "
-            "telemetry plane is off"
-            + (
-                " (disable_metrics = true wins over everything)"
-                if job.disable_metrics
-                else " — set telemetry = true in the runner config "
-                "(--run-cfg telemetry=true)"
+            slo_requires_telemetry_message(
+                slo_plan.count, job.disable_metrics
             )
-            + "; refusing to run with unenforceable SLOs"
         )
     if slo_plan is not None:
         ow.infof(
@@ -1038,11 +1037,11 @@ def _execute_sim_run(
     ckpt_every = int(getattr(cfg, "checkpoint_chunks", 0) or 0)
     resume_from = str(getattr(cfg, "resume_from", "") or "")
     if resume_from and getattr(cfg, "coordinator_address", ""):
-        raise ValueError(
-            "resume_from is not supported under a multi-host cohort "
-            "(checkpoints are leader-local reads of a cross-process "
-            "carry); run the resumed composition single-host"
-        )
+        # shared with the static checker (sim/check.py rule
+        # checkpoint.resume-cohort)
+        from .check import resume_cohort_message
+
+        raise ValueError(resume_cohort_message())
     if ckpt_every > 0 and getattr(cfg, "coordinator_address", ""):
         ow.warn(
             "sim:jax %s: checkpointing disabled for the cohort config "

@@ -1,0 +1,100 @@
+"""badplan: deliberately-broken sim testcases for the static-analysis
+plane (``tg check --trace-plans``; tests/test_check.py).
+
+Each testcase violates exactly ONE invariant the checker lints for, so
+a test can assert the precise rule id that fires — and that the clean
+control case fires none.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from testground_tpu.sim.api import SUCCESS, SimTestcase
+
+
+class IntOnCount(SimTestcase):
+    """Calls python ``int()`` on ``env.test_instance_count``. Fine at
+    exact shapes (the count is a static python int), but under shape
+    bucketing the count is a TRACED runtime scalar — the traced-count
+    contract violation ``plan.traced-int`` exists to catch."""
+
+    def init(self, env):
+        return {"n": jnp.int32(0)}
+
+    def step(self, env, state, inbox, sync, t):
+        # the contract violation: python arithmetic on a traced count
+        peers = int(env.test_instance_count) - 1
+        return self.out(
+            {"n": state["n"] + peers},
+            status=jnp.where(t >= 2, SUCCESS, 0),
+        )
+
+
+class DebugPrint(SimTestcase):
+    """``jax.debug.print`` in the hot path: a host callback compiled
+    into every tick (``plan.host-callback``)."""
+
+    def init(self, env):
+        return {"n": jnp.int32(0)}
+
+    def step(self, env, state, inbox, sync, t):
+        jax.debug.print("tick {t}", t=t)
+        return self.out(
+            {"n": state["n"] + 1},
+            status=jnp.where(t >= 2, SUCCESS, 0),
+        )
+
+
+class WhileTick(SimTestcase):
+    """``lax.while_loop`` in step: per-tick work without a static bound
+    (``plan.while-loop``)."""
+
+    def init(self, env):
+        return {"n": jnp.int32(0)}
+
+    def step(self, env, state, inbox, sync, t):
+        n = lax.while_loop(
+            lambda c: c < state["n"] + 3, lambda c: c + 1, jnp.int32(0)
+        )
+        return self.out(
+            {"n": n}, status=jnp.where(t >= 2, SUCCESS, 0)
+        )
+
+
+class WeakState(SimTestcase):
+    """State leaves built from bare python literals: weak-typed arrays
+    whose dtype re-promotes against the first strong operand — a
+    retrace/compile-cache hazard (``plan.weak-type``)."""
+
+    def init(self, env):
+        return {"x": jnp.asarray(0.0), "k": jnp.asarray(1)}
+
+    def step(self, env, state, inbox, sync, t):
+        return self.out(
+            {"x": state["x"] + 0.5, "k": state["k"]},
+            status=jnp.where(t >= 2, SUCCESS, 0),
+        )
+
+
+class Clean(SimTestcase):
+    """The control: explicit dtypes, no callbacks, no loops — zero
+    findings expected."""
+
+    def init(self, env):
+        return {"n": jnp.zeros((), jnp.int32)}
+
+    def step(self, env, state, inbox, sync, t):
+        n = state["n"] + jnp.int32(1)
+        return self.out(
+            {"n": n}, status=jnp.where(t >= 2, SUCCESS, 0)
+        )
+
+
+sim_testcases = {
+    "int-on-count": IntOnCount,
+    "debug-print": DebugPrint,
+    "while-tick": WhileTick,
+    "weak-state": WeakState,
+    "clean": Clean,
+}

@@ -1,0 +1,737 @@
+"""Static-analysis plane (docs/CHECKING.md): the rule catalog, the
+executor/checker NO-DRIFT pin (the checker must refuse exactly the
+configs the executor refuses, with the identical message, over a matrix
+of bad configs — and pass exactly the configs the executor runs), the
+eval_shape/jaxpr plan lints against the deliberately-broken fixture
+plan, the ``--json`` schema, CLI exit codes, and the pack
+``solo_reason`` classification."""
+
+import json
+import threading
+import os
+import types
+
+import pytest
+
+from testground_tpu.api import (
+    Composition,
+    Global,
+    Group,
+    Instances,
+    RunGroup,
+    RunInput,
+    TestPlanManifest,
+    generate_default_run,
+    prepare_for_run,
+)
+from testground_tpu.config import CoalescedConfig
+from testground_tpu.sim.check import (
+    RULES,
+    check_composition,
+    findings_payload,
+    rule_by_id,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+BADPLAN = os.path.join(REPO_ROOT, "tests", "fixtures", "badplan")
+
+
+def manifest_of(plan: str) -> TestPlanManifest:
+    return TestPlanManifest.load_file(
+        os.path.join(PLANS, plan, "manifest.toml")
+    )
+
+
+def make_comp(
+    plan="placebo",
+    case="ok",
+    count=2,
+    run_cfg=None,
+    slo=None,
+    faults=None,
+    trace=None,
+    params=None,
+    disable_metrics=False,
+) -> Composition:
+    comp = Composition(
+        global_=Global(
+            plan=plan,
+            case=case,
+            builder="sim:plan",
+            runner="sim:jax",
+            run_config=dict(run_cfg or {}),
+            disable_metrics=disable_metrics,
+        ),
+        groups=[Group(id="all", instances=Instances(count=count))],
+    )
+    if slo:
+        # run-GLOBAL tables ([[global.run.slo]]): the run-global metrics
+        # (drop_rate/crashed_fraction) refuse a group scope outright
+        from testground_tpu.api.composition import RunParams
+
+        comp.global_.run = RunParams(slo=[dict(s) for s in slo])
+    if faults:
+        comp.groups[0].run.faults = [dict(f) for f in faults]
+    if trace:
+        comp.groups[0].run.trace = dict(trace)
+    if params:
+        comp.groups[0].run.test_params = dict(params)
+    return generate_default_run(comp)
+
+
+class _WarnRecorder:
+    """OutputWriter stand-in that records rendered warn lines."""
+
+    def __init__(self):
+        self.warns: list[str] = []
+
+    def warn(self, fmt, *args):
+        self.warns.append(str(fmt) % args if args else str(fmt))
+
+    def infof(self, fmt, *args):
+        pass
+
+    def write_error(self, msg):
+        pass
+
+
+def drive_executor(comp: Composition):
+    """Run the composition through the REAL executor the way do_run
+    does (prepare → coalesce → RunInput → execute_sim_run). Returns
+    ``(exception_or_None, warn_lines)``."""
+    from testground_tpu.sim.executor import SimJaxConfig, execute_sim_run
+
+    plan = comp.global_.plan
+    prepared = prepare_for_run(comp, manifest_of(plan))
+    cfg = (
+        CoalescedConfig()
+        .append(prepared.global_.run_config)
+        .coalesce_into(SimJaxConfig)
+    )
+    run = prepared.runs[0]
+    src = os.path.join(PLANS, plan)
+    job = RunInput(
+        # the [[runs]] id, so the cohort spec-size estimate — which
+        # embeds the run id — is byte-identical to the checker's (at
+        # engine runtime the id is the task id; the estimate is within
+        # len(task_id) bytes of exact, negligible vs the 64 KiB bound)
+        run_id=run.id,
+        test_plan=prepared.global_.plan,
+        test_case=prepared.global_.case,
+        total_instances=run.total_instances,
+        groups=[
+            RunGroup(
+                id=rg.id,
+                instances=rg.calculated_instance_count,
+                artifact_path=src,
+                parameters=dict(rg.test_params),
+                faults=[dict(f) for f in rg.faults],
+                trace=dict(rg.trace),
+                slo=[dict(s) for s in rg.slo],
+            )
+            for rg in run.groups
+        ],
+        runner_config=cfg,
+        disable_metrics=prepared.global_.disable_metrics,
+        faults=[
+            dict(f)
+            for f in (
+                prepared.global_.run.faults
+                if prepared.global_.run is not None
+                else []
+            )
+        ],
+        trace=dict(
+            prepared.global_.run.trace
+            if prepared.global_.run is not None
+            else {}
+        ),
+        slo=[
+            dict(s)
+            for s in (
+                prepared.global_.run.slo
+                if prepared.global_.run is not None
+                else []
+            )
+        ],
+    )
+    ow = _WarnRecorder()
+    try:
+        execute_sim_run(job, ow, threading.Event())
+    except Exception as e:  # noqa: BLE001 — the refusal under test
+        return e, ow.warns
+    return None, ow.warns
+
+
+def checker(comp: Composition, **kw):
+    plan = comp.global_.plan
+    return check_composition(comp, manifest_of(plan), **kw)
+
+
+def errors_of(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+# -------------------------------------------------------------- catalog
+
+
+class TestCatalog:
+    def test_rule_ids_unique_and_valid(self):
+        ids = [r.id for r in RULES]
+        assert len(ids) == len(set(ids))
+        for r in RULES:
+            assert r.severity in ("error", "warn"), r
+            assert r.layer and r.summary, r
+            assert rule_by_id(r.id) is r
+
+    def test_findings_reference_catalogued_rules(self):
+        fs = checker(make_comp(run_cfg={"transport": "bogus"}))
+        assert fs
+        for f in fs:
+            r = rule_by_id(f.rule)  # KeyError = uncatalogued finding
+            assert f.severity == r.severity
+            assert f.layer == r.layer
+
+
+# ---------------------------------------------------- executor no-drift
+
+# The bad-config matrix: (label, composition kwargs, expected rule id).
+# Every entry must (a) make the checker report exactly this error rule
+# and (b) make the executor raise — with the IDENTICAL message.
+BAD_MATRIX = [
+    (
+        "transport-unknown",
+        dict(run_cfg={"transport": "warp", "max_ticks": 32}),
+        "transport.unknown",
+    ),
+    (
+        "bucket-mode",
+        dict(run_cfg={"bucket": "sideways", "shard": False, "max_ticks": 32}),
+        "buckets.mode-invalid",
+    ),
+    (
+        "bucket-ladder",
+        dict(
+            run_cfg={
+                "bucket": "auto",
+                "bucket_ladder": "x,y",
+                "shard": False,
+                "max_ticks": 32,
+            }
+        ),
+        "buckets.ladder-invalid",
+    ),
+    (
+        "fault-kind",
+        dict(
+            faults=[{"kind": "meteor", "start_ms": 1.0}],
+            run_cfg={"max_ticks": 32},
+        ),
+        "faults.invalid",
+    ),
+    (
+        "fault-range",
+        dict(
+            faults=[{"kind": "crash", "instances": "0:99", "start_ms": 1.0}],
+            run_cfg={"max_ticks": 32},
+        ),
+        "faults.invalid",
+    ),
+    (
+        "trace-fraction",
+        dict(trace={"fraction": 7.0}, run_cfg={"max_ticks": 32}),
+        "trace.invalid",
+    ),
+    (
+        "slo-metric",
+        dict(
+            slo=[{"metric": "vibes", "op": "<", "threshold": 1}],
+            run_cfg={"telemetry": True, "max_ticks": 32},
+        ),
+        "slo.invalid",
+    ),
+    (
+        "slo-no-telemetry",
+        dict(
+            slo=[{"metric": "drop_rate", "op": "<", "threshold": 0.5}],
+            run_cfg={"max_ticks": 32},
+        ),
+        "slo.needs-telemetry",
+    ),
+    (
+        "slo-disable-metrics",
+        dict(
+            slo=[{"metric": "drop_rate", "op": "<", "threshold": 0.5}],
+            run_cfg={"telemetry": True, "max_ticks": 32},
+            disable_metrics=True,
+        ),
+        "slo.needs-telemetry",
+    ),
+    (
+        "cohort-spec-oversize",
+        dict(
+            run_cfg={"coordinator_address": "127.0.0.1:1", "max_ticks": 32},
+            params={"blob": "x" * 70000},
+        ),
+        "cohort.spec-oversize",
+    ),
+]
+
+
+class TestNoDrift:
+    """The acceptance pin: the executor cannot refuse a config the
+    checker passes, and the checker cannot flag an error the executor
+    would run — with IDENTICAL refusal text."""
+
+    @pytest.mark.parametrize(
+        "label,kwargs,rule", BAD_MATRIX, ids=[m[0] for m in BAD_MATRIX]
+    )
+    def test_bad_config_refused_identically(self, label, kwargs, rule):
+        comp = make_comp(**kwargs)
+        findings = errors_of(checker(make_comp(**kwargs)))
+        assert findings, f"checker passed a config the executor refuses"
+        assert [f.rule for f in findings] == [rule]
+        exc, _ = drive_executor(comp)
+        assert exc is not None, (
+            f"executor ran a config the checker refuses ({rule})"
+        )
+        assert str(exc) == findings[0].message
+
+    def test_clean_config_passes_both(self):
+        kwargs = dict(run_cfg={"max_ticks": 32})
+        assert errors_of(checker(make_comp(**kwargs))) == []
+        exc, _ = drive_executor(make_comp(**kwargs))
+        assert exc is None
+
+    def test_clean_kitchen_sink_passes_both(self):
+        """Faults + trace + telemetry + SLO, all compatible: zero
+        findings and a clean run — the checker must not over-refuse."""
+        kwargs = dict(
+            case="stall",
+            count=4,
+            run_cfg={"telemetry": True, "max_ticks": 48, "chunk": 16},
+            faults=[{"kind": "crash", "instances": "0:1", "start_ms": 4.0}],
+            trace={"instances": "0:2"},
+            slo=[
+                {
+                    "metric": "crashed_fraction",
+                    "op": "<=",
+                    "threshold": 1.0,
+                }
+            ],
+        )
+        fs = checker(make_comp(**kwargs))
+        assert fs == []
+        exc, _ = drive_executor(make_comp(**kwargs))
+        assert exc is None
+
+
+class TestWarnParity:
+    """Warn-severity rules: the checker's finding mirrors the warn the
+    executor emits when it falls back (matched by content — executor
+    lines carry run-id prefixes)."""
+
+    def test_transport_mesh_fallback(self):
+        # conftest pins an 8-device virtual CPU mesh, so shard=True
+        # meshes and the transport gate must fall back loudly
+        kwargs = dict(run_cfg={"transport": "pallas", "max_ticks": 32})
+        fs = checker(make_comp(**kwargs), devices=8)
+        fired = [f for f in fs if f.rule == "transport.mesh-fallback"]
+        assert len(fired) == 1
+        exc, warns = drive_executor(make_comp(**kwargs))
+        assert exc is None
+        assert any(fired[0].message == w for w in warns), (
+            fired[0].message,
+            warns,
+        )
+
+    def test_bucket_mesh_disabled(self):
+        kwargs = dict(run_cfg={"bucket": "auto", "max_ticks": 32})
+        fs = checker(make_comp(**kwargs), devices=8)
+        fired = [f for f in fs if f.rule == "buckets.mesh-disabled"]
+        assert len(fired) == 1
+        exc, warns = drive_executor(make_comp(**kwargs))
+        assert exc is None
+        assert any(fired[0].message == w for w in warns)
+
+    def test_trace_disabled_under_bucketing(self):
+        kwargs = dict(
+            trace={"instances": "0:1"},
+            run_cfg={
+                "bucket": "auto",
+                "bucket_ladder": "16",
+                "shard": False,
+                "max_ticks": 32,
+            },
+        )
+        fs = checker(make_comp(**kwargs), devices=1)
+        fired = [f for f in fs if f.rule == "trace.bucket-disabled"]
+        assert len(fired) == 1
+        exc, warns = drive_executor(make_comp(**kwargs))
+        assert exc is None
+        assert any(
+            "flight recorder disabled under shape bucketing" in w
+            for w in warns
+        )
+
+    def test_cohort_gates_warn_without_running(self):
+        """Cohort exclusions (telemetry/slo/trace/checkpoint/nan_guard
+        off, resume refused) — checker-side only: a real cohort join
+        would hang on the fake coordinator, so these rules are pinned
+        to the executor by the shared message constants instead."""
+        kwargs = dict(
+            run_cfg={
+                "coordinator_address": "127.0.0.1:1",
+                "telemetry": True,
+                "checkpoint_chunks": 2,
+                "nan_guard": True,
+                "resume_from": "sometask",
+            },
+            trace={"instances": "0:1"},
+            slo=[{"metric": "drop_rate", "op": "<", "threshold": 0.5}],
+        )
+        fs = checker(make_comp(**kwargs), devices=1)
+        fired = {f.rule for f in fs}
+        assert {
+            "telemetry.cohort-disabled",
+            "trace.cohort-disabled",
+            "slo.cohort-disabled",
+            "checkpoint.cohort-disabled",
+            "checkpoint.resume-cohort",
+            "debug.nan-guard-cohort",
+        } <= fired
+        # resume-under-cohort is the one ERROR in the set, and its text
+        # is the executor's own (shared constant — drift-proof)
+        from testground_tpu.sim.check import resume_cohort_message
+
+        err = [f for f in fs if f.rule == "checkpoint.resume-cohort"]
+        assert err[0].message == resume_cohort_message()
+
+    def test_unknown_run_cfg_key(self):
+        fs = checker(make_comp(run_cfg={"trasnport": "pallas"}))
+        fired = [f for f in fs if f.rule == "run-cfg.unknown-key"]
+        assert len(fired) == 1 and "trasnport" in fired[0].message
+
+
+# ------------------------------------------------------ pack solo reason
+
+
+class TestPackSoloReason:
+    def _comp_dict(self, run_cfg=None, faults=None, runs=1):
+        comp = make_comp(run_cfg=run_cfg, faults=faults)
+        d = comp.to_dict()
+        if runs > 1:
+            d["runs"] = [dict(d["runs"][0], id=f"r{i}") for i in range(runs)]
+        return d
+
+    def test_not_requested_is_none(self):
+        from testground_tpu.engine.pack import solo_reason_for_composition
+
+        assert (
+            solo_reason_for_composition(self._comp_dict(run_cfg={}))
+            is None
+        )
+
+    def test_packable_is_none(self):
+        from testground_tpu.engine.pack import solo_reason_for_composition
+
+        assert (
+            solo_reason_for_composition(
+                self._comp_dict(run_cfg={"pack": True})
+            )
+            is None
+        )
+
+    @pytest.mark.parametrize(
+        "run_cfg,needle",
+        [
+            ({"pack": True, "checkpoint_chunks": 2}, "checkpoint"),
+            ({"pack": True, "coordinator_address": "h:1"}, "cohort"),
+            ({"pack": True, "resume_from": "t"}, "resume_from"),
+            ({"pack": True, "profile": True}, "profiler"),
+            ({"pack": True, "phases": True}, "phase"),
+            ({"pack": True, "additional_hosts": ["svc"]}, "additional_hosts"),
+            ({"pack": True, "bucket": "sideways"}, "bucket"),
+        ],
+    )
+    def test_exclusion_reasons(self, run_cfg, needle):
+        from testground_tpu.engine.pack import solo_reason_for_composition
+
+        reason = solo_reason_for_composition(self._comp_dict(run_cfg=run_cfg))
+        assert reason and needle in reason, (run_cfg, reason)
+
+    def test_faults_and_multi_runs_reasons(self):
+        from testground_tpu.engine.pack import solo_reason_for_composition
+
+        reason = solo_reason_for_composition(
+            self._comp_dict(
+                run_cfg={"pack": True},
+                faults=[{"kind": "crash", "start_ms": 1.0}],
+            )
+        )
+        assert reason and "chaos schedule" in reason
+        reason = solo_reason_for_composition(
+            self._comp_dict(run_cfg={"pack": True}, runs=3)
+        )
+        assert reason and "multi-[[runs]]" in reason
+
+    def test_signature_unchanged_for_packable_tasks(self):
+        """The refactor must not move any packable task out of (or
+        into) a pack: same composition → same signature, and a solo
+        cause → None signature."""
+        from testground_tpu.engine.pack import pack_signature
+        from testground_tpu.engine.task import TaskType
+
+        def tsk(run_cfg):
+            return types.SimpleNamespace(
+                type=TaskType.RUN,
+                runner="sim:jax",
+                composition=self._comp_dict(run_cfg=run_cfg),
+                input={"manifest": {}, "sources_dir": "x"},
+            )
+
+        a = pack_signature(tsk({"pack": True}))
+        b = pack_signature(tsk({"pack": True}))
+        assert a is not None and a == b
+        assert pack_signature(tsk({"pack": True, "profile": True})) is None
+        assert pack_signature(tsk({})) is None
+
+    def test_checker_pack_solo_rule(self):
+        fs = checker(
+            make_comp(run_cfg={"pack": True, "checkpoint_chunks": 4})
+        )
+        fired = [f for f in fs if f.rule == "pack.solo"]
+        assert len(fired) == 1 and "checkpoint" in fired[0].message
+        # a packable composition fires nothing
+        fs = checker(make_comp(run_cfg={"pack": True}))
+        assert not [f for f in fs if f.rule == "pack.solo"]
+
+    def test_resume_multi_runs_rule(self):
+        comp = make_comp(run_cfg={"resume_from": "oldtask"})
+        comp.runs = [comp.runs[0], comp.runs[0].__class__.from_dict(
+            dict(comp.runs[0].to_dict(), id="second")
+        )]
+        fs = checker(comp)
+        assert any(f.rule == "checkpoint.resume-multi-runs" for f in fs)
+
+
+# --------------------------------------------------- eval_shape plan lints
+
+
+def badplan_comp(case: str) -> Composition:
+    return make_comp(
+        plan="badplan",
+        case=case,
+        count=5,
+        run_cfg={
+            "bucket": "auto",
+            "bucket_ladder": "16,64",
+            "shard": False,
+        },
+    )
+
+
+def badplan_check(case: str):
+    return check_composition(
+        badplan_comp(case),
+        TestPlanManifest.load_file(os.path.join(BADPLAN, "manifest.toml")),
+        trace_plans=True,
+        plan_sources=BADPLAN,
+    )
+
+
+class TestPlanLints:
+    def test_python_int_on_traced_count(self):
+        fs = badplan_check("int-on-count")
+        fired = [f for f in fs if f.rule == "plan.traced-int"]
+        assert len(fired) == 1
+        assert fired[0].severity == "error"
+        assert "padded shapes" in fired[0].message
+
+    def test_host_callback_in_tick(self):
+        fs = badplan_check("debug-print")
+        fired = [f for f in fs if f.rule == "plan.host-callback"]
+        assert len(fired) == 1
+        assert "debug_callback" in fired[0].message
+
+    def test_while_loop_in_tick(self):
+        fs = badplan_check("while-tick")
+        assert any(f.rule == "plan.while-loop" for f in fs)
+
+    def test_weak_type_state(self):
+        fs = badplan_check("weak-state")
+        fired = [f for f in fs if f.rule == "plan.weak-type"]
+        assert len(fired) == 1 and "dtype" in fired[0].message
+
+    def test_clean_control_is_silent(self):
+        assert badplan_check("clean") == []
+
+    def test_missing_case_is_load_failure(self):
+        fs = check_composition(
+            make_comp(plan="badplan", case="clean", count=2),
+            # manifest that declares a case the sim module lacks
+            TestPlanManifest.from_dict(
+                {
+                    "name": "badplan",
+                    "builders": {"sim:plan": {"enabled": True}},
+                    "runners": {"sim:jax": {"enabled": True}},
+                    "testcases": [
+                        {
+                            "name": "clean",
+                            "instances": {
+                                "min": 1,
+                                "max": 16,
+                                "default": 2,
+                            },
+                        }
+                    ],
+                }
+            ),
+            trace_plans=True,
+            plan_sources=os.path.join(PLANS, "placebo"),
+        )
+        fired = [f for f in fs if f.rule == "plan.load-failed"]
+        assert len(fired) == 1
+        assert "unknown sim test case" in fired[0].message
+
+    def test_repo_plans_lint_clean(self):
+        """Dogfood: the chaos smoke composition (faults + trace +
+        telemetry + SLO) must trace to zero findings."""
+        from testground_tpu.api import load_composition
+
+        comp = load_composition(
+            os.path.join(PLANS, "chaos", "_compositions", "smoke.toml")
+        )
+        fs = check_composition(
+            comp,
+            manifest_of("chaos"),
+            trace_plans=True,
+            plan_sources=os.path.join(PLANS, "chaos"),
+        )
+        assert fs == []
+
+
+# ------------------------------------------------------- json + CLI
+
+
+class TestJsonSchema:
+    def test_payload_schema_v1(self):
+        fs = checker(make_comp(run_cfg={"transport": "warp"}))
+        doc = findings_payload([("x.toml", fs)])
+        assert doc["version"] == 1
+        assert set(doc) == {"version", "compositions", "errors", "warnings"}
+        comp = doc["compositions"][0]
+        assert set(comp) == {"file", "findings", "errors", "warnings"}
+        assert comp["file"] == "x.toml"
+        assert comp["errors"] == 1
+        f = comp["findings"][0]
+        assert {"rule", "severity", "layer", "message"} <= set(f)
+        assert f["rule"] == "transport.unknown"
+        json.dumps(doc)  # must be serializable as-is
+
+    def test_run_attribution(self):
+        fs = checker(
+            make_comp(faults=[{"kind": "meteor", "start_ms": 1.0}])
+        )
+        f = [x for x in fs if x.rule == "faults.invalid"][0]
+        assert f.to_dict()["run"] == "default"
+
+
+class TestCli:
+    @pytest.fixture()
+    def chdir_repo(self, monkeypatch):
+        monkeypatch.chdir(REPO_ROOT)
+
+    def _write(self, tmp_path, body: str) -> str:
+        p = tmp_path / "comp.toml"
+        p.write_text(body)
+        return str(p)
+
+    CLEAN = """\
+[metadata]
+name = "ok"
+[global]
+plan = "placebo"
+case = "ok"
+builder = "sim:plan"
+runner = "sim:jax"
+[[groups]]
+id = "all"
+[groups.instances]
+count = 2
+"""
+
+    BAD = CLEAN + """
+[[global.run.slo]]
+metric = "drop_rate"
+op = "<"
+threshold = 0.1
+"""
+
+    def test_exit_0_on_clean(self, tg_home, chdir_repo, tmp_path, capsys):
+        from testground_tpu.cli.main import main
+
+        rc = main(["check", self._write(tmp_path, self.CLEAN)])
+        out = capsys.readouterr().out
+        assert rc == 0 and "ok (no findings)" in out
+
+    def test_exit_1_on_error_findings(
+        self, tg_home, chdir_repo, tmp_path, capsys
+    ):
+        from testground_tpu.cli.main import main
+
+        rc = main(["check", self._write(tmp_path, self.BAD), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert doc["errors"] == 1
+        assert (
+            doc["compositions"][0]["findings"][0]["rule"]
+            == "slo.needs-telemetry"
+        )
+
+    def test_exit_2_on_unloadable_file(
+        self, tg_home, chdir_repo, tmp_path, capsys
+    ):
+        from testground_tpu.cli.main import main
+
+        rc = main(["check", str(tmp_path / "missing.toml")])
+        assert rc == 2
+        assert "cannot check" in capsys.readouterr().out
+
+    def test_unloadable_file_lands_in_json_document(
+        self, tg_home, chdir_repo, tmp_path, capsys
+    ):
+        """A load failure is a finding, not a stderr aside: --json
+        consumers must see WHICH file was unloadable and why, and the
+        document's error count must disagree with a clean run."""
+        import json as _json
+
+        from testground_tpu.cli.main import main
+
+        missing = str(tmp_path / "missing.toml")
+        rc = main(["check", "--json", missing])
+        assert rc == 2
+        doc = _json.loads(capsys.readouterr().out)
+        assert doc["errors"] == 1
+        (entry,) = doc["compositions"]
+        assert entry["file"] == missing
+        (f,) = entry["findings"]
+        assert f["rule"] == "composition.invalid"
+        assert "cannot check" in f["message"]
+
+    def test_run_cfg_override(self, tg_home, chdir_repo, tmp_path, capsys):
+        """--run-cfg lets the operator probe a knob combination without
+        editing the file: the clean composition + a bad transport."""
+        from testground_tpu.cli.main import main
+
+        rc = main(
+            [
+                "check",
+                self._write(tmp_path, self.CLEAN),
+                "--run-cfg",
+                "transport=warp",
+            ]
+        )
+        assert rc == 1
+        assert "transport.unknown" in capsys.readouterr().out

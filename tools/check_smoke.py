@@ -1,0 +1,296 @@
+"""``make check-smoke``: the static-analysis plane's end-to-end contract
+(docs/CHECKING.md) on the CPU backend:
+
+- **clean pass**: ``tg check`` on the repo's own chaos smoke
+  composition (faults + trace + telemetry + SLO, all compatible) exits
+  0 with ZERO findings — including under ``--trace-plans``;
+- **seeded-bad pass**: a composition combining four incompatible knobs
+  (unknown transport, unknown bucket mode, unknown fault kind, SLO
+  without telemetry) reports ALL of them in ONE pass with their stable
+  rule ids, ``--json`` schema version 1, and exit code 1;
+- **plan lints**: the deliberately-broken fixture plan
+  (tests/fixtures/badplan) fires ``plan.traced-int`` (python int on a
+  traced count under bucketing) and ``plan.host-callback``
+  (jax.debug.print in the tick) under ``--trace-plans``;
+- **solo-reason journal**: a ``pack=true`` run excluded from packing by
+  its own knobs journals ``sim.pack.solo_reason`` and ``tg stats``
+  renders it — the tenant-visible "why didn't my run pack".
+
+Exits non-zero with a readable message on any violation. Self-contained:
+temporary $TESTGROUND_HOME, CPU backend — safe in CI (mirrors the other
+observability smokes).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+BADPLAN = os.path.join(REPO_ROOT, "tests", "fixtures", "badplan")
+
+
+def fail(msg: str) -> None:
+    print(f"check-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+BAD_COMPOSITION = """\
+[metadata]
+name = "seeded-bad"
+
+[global]
+plan = "chaos"
+case = "chaos-barrier"
+builder = "sim:plan"
+runner = "sim:jax"
+
+[global.run_config]
+transport = "warp"
+bucket = "sideways"
+
+[[global.run.slo]]
+metric = "drop_rate"
+op = "<"
+threshold = 0.1
+
+[[groups]]
+id = "all"
+
+[groups.instances]
+count = 8
+
+[[groups.run.faults]]
+kind = "meteor"
+start_ms = 1.0
+"""
+
+BADPLAN_COMPOSITION = """\
+[metadata]
+name = "badplan-{case}"
+
+[global]
+plan = "badplan"
+case = "{case}"
+builder = "sim:plan"
+runner = "sim:jax"
+
+[global.run_config]
+bucket = "auto"
+bucket_ladder = "16,64"
+# bucketing is single-device; without this the smoke's virtual 8-device
+# mesh would disable it (rule buckets.mesh-disabled) and the padded
+# trace — the traced-count contract's teeth — would never run
+shard = false
+
+[[groups]]
+id = "all"
+
+[groups.instances]
+count = 5
+"""
+
+# every rule id the seeded-bad composition must name, in one pass
+EXPECTED_BAD_RULES = {
+    "transport.unknown",
+    "buckets.mode-invalid",
+    "faults.invalid",
+    "slo.needs-telemetry",
+}
+
+
+def run_check(argv) -> tuple[int, str]:
+    """Drive the REAL CLI (the exit-code contract is part of the smoke)
+    with stdout captured."""
+    import contextlib
+    import io
+
+    from testground_tpu.cli.main import main as tg_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tg_main(["check", *argv])
+    return rc, buf.getvalue()
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="tg-check-smoke-")
+    os.environ["TESTGROUND_HOME"] = os.path.join(tmp, "home")
+
+    # ------------------------------------------------- 1. clean pass
+    clean = os.path.join(REPO_ROOT, "plans", "chaos", "_compositions", "smoke.toml")
+    rc, out = run_check([clean, "--json"])
+    doc = json.loads(out)
+    if rc != 0:
+        fail(f"clean composition exited {rc}: {out}")
+    if doc.get("version") != 1:
+        fail(f"--json schema version is {doc.get('version')!r}, want 1")
+    if doc["errors"] or doc["warnings"]:
+        fail(f"clean composition has findings: {out}")
+    rc, out = run_check([clean, "--trace-plans"])
+    if rc != 0 or "ok (no findings)" not in out:
+        fail(f"clean composition under --trace-plans: rc={rc} {out!r}")
+    print("check-smoke: clean composition ok (0 findings, exit 0)")
+
+    # -------------------------------------------- 2. seeded-bad pass
+    bad_path = os.path.join(tmp, "seeded-bad.toml")
+    with open(bad_path, "w") as f:
+        f.write(BAD_COMPOSITION)
+    # the plan resolves from the repo's plans/ dir (cwd-relative)
+    os.chdir(REPO_ROOT)
+    rc, out = run_check([bad_path, "--json"])
+    if rc != 1:
+        fail(f"seeded-bad composition exited {rc}, want 1: {out}")
+    doc = json.loads(out)
+    fired = {
+        f["rule"]
+        for comp in doc["compositions"]
+        for f in comp["findings"]
+        if f["severity"] == "error"
+    }
+    missing = EXPECTED_BAD_RULES - fired
+    if missing:
+        fail(
+            f"seeded-bad composition missed rule(s) {sorted(missing)} "
+            f"(fired: {sorted(fired)})"
+        )
+    for comp in doc["compositions"]:
+        for f in comp["findings"]:
+            for key in ("rule", "severity", "layer", "message"):
+                if key not in f:
+                    fail(f"--json finding missing key {key!r}: {f}")
+    print(
+        "check-smoke: seeded-bad composition ok — all of "
+        f"{sorted(EXPECTED_BAD_RULES)} in one pass, exit 1"
+    )
+
+    # ------------------------------------------------- 3. plan lints
+    from testground_tpu.api import TestPlanManifest, load_composition
+    from testground_tpu.sim.check import check_composition
+
+    manifest = TestPlanManifest.load_file(
+        os.path.join(BADPLAN, "manifest.toml")
+    )
+
+    def check_case(case):
+        p = os.path.join(tmp, f"bp-{case}.toml")
+        with open(p, "w") as f:
+            f.write(BADPLAN_COMPOSITION.format(case=case))
+        return check_composition(
+            load_composition(p),
+            manifest,
+            trace_plans=True,
+            plan_sources=BADPLAN,
+        )
+
+    fs = check_case("int-on-count")
+    if not any(f.rule == "plan.traced-int" for f in fs):
+        fail(f"int-on-count did not fire plan.traced-int: {fs}")
+    fs = check_case("debug-print")
+    if not any(f.rule == "plan.host-callback" for f in fs):
+        fail(f"debug-print did not fire plan.host-callback: {fs}")
+    fs = check_case("clean")
+    if fs:
+        fail(f"badplan clean control fired findings: {fs}")
+    print(
+        "check-smoke: plan lints ok — traced-int + host-callback fire, "
+        "clean control silent"
+    )
+
+    # ------------------------------------- 4. solo-reason journaling
+    import time
+
+    from testground_tpu.api import (
+        Composition,
+        Global,
+        Group,
+        Instances,
+        generate_default_run,
+    )
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, State
+    from testground_tpu.runners.pretty import render_telemetry_summary
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    engine = Engine(
+        EngineConfig(
+            env=EnvConfig.load(),
+            builders=[SimPlanBuilder()],
+            runners=[SimJaxRunner()],
+        )
+    )
+    engine.start_workers()
+    try:
+        comp = generate_default_run(
+            Composition(
+                global_=Global(
+                    plan="placebo",
+                    case="ok",
+                    builder="sim:plan",
+                    runner="sim:jax",
+                ),
+                groups=[Group(id="all", instances=Instances(count=2))],
+            )
+        )
+        # pack requested, but checkpointing excludes it from admission
+        comp.global_.run_config.update(
+            {"pack": True, "checkpoint_chunks": 2, "max_ticks": 64}
+        )
+        manifest = TestPlanManifest.load_file(
+            os.path.join(REPO_ROOT, "plans", "placebo", "manifest.toml")
+        )
+        tid = engine.queue_run(
+            comp,
+            manifest,
+            sources_dir=os.path.join(REPO_ROOT, "plans", "placebo"),
+        )
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            t = engine.get_task(tid)
+            if t is not None and t.state().state in (
+                State.COMPLETE,
+                State.CANCELED,
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            fail("solo-reason run did not finish")
+        pack = (
+            (t.result or {}).get("journal", {}).get("sim", {}).get("pack")
+        )
+        if not pack or pack.get("packed") is not False:
+            fail(f"solo run journaled no sim.pack block: {pack!r}")
+        if "checkpoint" not in (pack.get("solo_reason") or ""):
+            fail(
+                "solo_reason does not name the checkpoint exclusion: "
+                f"{pack!r}"
+            )
+        stats = render_telemetry_summary(t.stats_payload())
+        if "solo" not in stats or "checkpoint" not in stats:
+            fail(f"tg stats does not render the solo reason:\n{stats}")
+    finally:
+        engine.stop()
+    print(
+        "check-smoke: solo-reason ok — journal sim.pack.solo_reason "
+        f"({pack['solo_reason']!r}) rendered by tg stats"
+    )
+
+    print(
+        "check-smoke: OK — clean pass, seeded-bad all-rules-one-pass, "
+        "plan lints, solo-reason journal"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
