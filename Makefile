@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
-	telemetry-smoke chaos-smoke trace-smoke perf-smoke slo-smoke \
+	telemetry-smoke chaos-smoke trace-smoke fleet-smoke perf-smoke slo-smoke \
 	phases-smoke checkpoint-smoke crosshost-smoke pack-smoke \
 	sync-fanin-smoke transport-smoke check-smoke check-plans \
 	test-sync-tsan
@@ -63,6 +63,14 @@ chaos-smoke:
 # percentiles, and stay deterministic across two runs
 trace-smoke:
 	$(PY) tools/trace_smoke.py
+
+# control-plane observability contract (docs/OBSERVABILITY.md "Control
+# plane"): a traced submit must export a single connected lifecycle
+# span tree (+ Perfetto mirror), journal the lifecycle in causal order
+# with trace ids, conserve Σ tg_fleet_tasks against the task store,
+# and render the tg top fleet view
+fleet-smoke:
+	$(PY) tools/fleet_smoke.py
 
 # performance-ledger contract check (docs/OBSERVABILITY.md): a tiny run
 # must journal sim.perf (AOT lower/compile split + cost analysis +

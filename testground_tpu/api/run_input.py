@@ -97,6 +97,11 @@ class RunInput:
     # run-global SLO assertions ([[global.run.slo]]): rules evaluated
     # against the whole run's metric stream
     slo: list = field(default_factory=list)
+    # lifecycle trace context (tracectx.py): {"trace_id", "parent_id",
+    # "traceparent"} threaded by the supervisor so executor spans and
+    # sync hello attribution join the task's tree. Distinct from
+    # ``trace`` above, which is the flight-recorder sampling table.
+    trace_ctx: dict = field(default_factory=dict)
     # EnvConfig equivalent is attached by the engine at dispatch time.
     env: Any = None
 
@@ -111,6 +116,7 @@ class RunInput:
             "faults": [dict(f) for f in self.faults],
             "trace": dict(self.trace),
             "slo": [dict(s) for s in self.slo],
+            "trace_ctx": dict(self.trace_ctx),
         }
 
 

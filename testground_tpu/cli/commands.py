@@ -359,17 +359,31 @@ def run_single_cmd(args) -> int:
 
 def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
     from testground_tpu.client import RemoteEngine
+    from testground_tpu.tracectx import TraceContext
 
     engine = _engine(args)
     try:
         created_by = _created_by(args, engine.env)
+        # the submit span roots the task's lifecycle trace: the CLI mints
+        # the trace id here so the causal chain starts at the submitter,
+        # and the daemon/engine parents every later span under it
+        # (engine/tracetree.py; docs/OBSERVABILITY.md)
+        submit_ctx = TraceContext.mint()
         if isinstance(engine, RemoteEngine):
             # the daemon resolves the plan from ITS $TESTGROUND_HOME/plans
-            task_id = engine.queue_run(comp, created_by=created_by)
+            task_id = engine.queue_run(
+                comp,
+                created_by=created_by,
+                trace_parent=submit_ctx.to_traceparent(),
+            )
         else:
             src_dir, manifest = _resolve_plan(engine.env, comp.global_.plan)
             task_id = engine.queue_run(
-                comp, manifest, sources_dir=src_dir, created_by=created_by
+                comp,
+                manifest,
+                sources_dir=src_dir,
+                created_by=created_by,
+                trace_parent=submit_ctx.to_traceparent(),
             )
         print(f"run is queued with ID: {task_id}")
         if getattr(args, "detach", False):
@@ -539,18 +553,28 @@ def _apply_bucket_build_flags(comp, args) -> None:
 
 def build_composition_cmd(args) -> int:
     from testground_tpu.client import RemoteEngine
+    from testground_tpu.tracectx import TraceContext
 
     comp = load_composition(args.file)
     _apply_bucket_build_flags(comp, args)
     engine = _engine(args)
     try:
         created_by = _created_by(args, engine.env)
+        submit_ctx = TraceContext.mint()
         if isinstance(engine, RemoteEngine):
-            task_id = engine.queue_build(comp, created_by=created_by)
+            task_id = engine.queue_build(
+                comp,
+                created_by=created_by,
+                trace_parent=submit_ctx.to_traceparent(),
+            )
         else:
             src_dir, manifest = _resolve_plan(engine.env, comp.global_.plan)
             task_id = engine.queue_build(
-                comp, manifest, sources_dir=src_dir, created_by=created_by
+                comp,
+                manifest,
+                sources_dir=src_dir,
+                created_by=created_by,
+                trace_parent=submit_ctx.to_traceparent(),
             )
         print(f"build is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
@@ -619,11 +643,22 @@ def build_single_cmd(args) -> int:
             )
         _apply_bucket_build_flags(comp, args)
         created_by = _created_by(args, engine.env)
+        from testground_tpu.tracectx import TraceContext
+
+        submit_ctx = TraceContext.mint()
         if isinstance(engine, RemoteEngine):
-            task_id = engine.queue_build(comp, created_by=created_by)
+            task_id = engine.queue_build(
+                comp,
+                created_by=created_by,
+                trace_parent=submit_ctx.to_traceparent(),
+            )
         else:
             task_id = engine.queue_build(
-                comp, manifest, sources_dir=src_dir, created_by=created_by
+                comp,
+                manifest,
+                sources_dir=src_dir,
+                created_by=created_by,
+                trace_parent=submit_ctx.to_traceparent(),
             )
         print(f"build is queued with ID: {task_id}")
         t = _wait_task(engine, task_id)
@@ -1026,14 +1061,17 @@ def tasks_cmd(args) -> int:
             after=after,
             limit=args.limit,
         )
-        # ID / DATE / PLAN:CASE / DURATION / STATE / TYPE + outcome — the
-        # reference's tabwriter column order (tasks.go:50-54)
+        # ID / DATE / PLAN:CASE / QUEUED / DURATION / STATE / TYPE +
+        # outcome — the reference's tabwriter column order
+        # (tasks.go:50-54) plus the queue-wait column (scheduled →
+        # processing; live for still-queued tasks)
         for t in tasks:
             created = time.strftime(
                 "%Y-%m-%d %H:%M:%S", time.localtime(t.created())
             )
             print(
-                f"{t.id}  {created}  {t.name():24}  {t.took():7.1f}s  "
+                f"{t.id}  {created}  {t.name():24}  "
+                f"{t.queued_secs():6.1f}s  {t.took():7.1f}s  "
                 f"{t.state().state.value:10}  {t.type.value:5}  "
                 f"{t.outcome().value}"
             )
@@ -1260,6 +1298,14 @@ def register_trace(sub) -> None:
         help="dump the raw events as JSON lines (the sim_trace.jsonl "
         "rows) instead of the aligned timeline",
     )
+    p.add_argument(
+        "--lifecycle",
+        action="store_true",
+        help="render the task's causal lifecycle span tree "
+        "(task_spans.jsonl: submit → queued → claim → execute → run "
+        "spans) instead of the flight-recorder timeline; the sibling "
+        "task_trace.json opens in Perfetto",
+    )
     p.set_defaults(func=trace_cmd)
 
 
@@ -1286,6 +1332,8 @@ def trace_cmd(args) -> int:
 
     engine = _engine(args)
     try:
+        if getattr(args, "lifecycle", False):
+            return _trace_lifecycle(engine, args)
         if isinstance(engine, RemoteEngine):
             data = engine.task_trace(args.task, limit=args.limit)
             summary, events = data.get("trace", {}), data.get("events", [])
@@ -1341,6 +1389,62 @@ def trace_cmd(args) -> int:
         return 0
     finally:
         engine.stop()
+
+
+def _trace_lifecycle(engine, args) -> int:
+    """``tg trace <task> --lifecycle``: load the archived lifecycle span
+    tree (task_spans.jsonl — engine/tracetree.py) and render it as an
+    indented tree; --json dumps the raw span rows. Works identically
+    in-process (outputs dir) and remote (GET /artifact)."""
+    import json
+
+    from testground_tpu.client import RemoteEngine
+    from testground_tpu.engine.tracetree import (
+        TASK_SPANS_FILE,
+        load_task_spans,
+    )
+    from testground_tpu.runners.pretty import render_lifecycle_tree
+
+    if isinstance(engine, RemoteEngine):
+        try:
+            raw = engine.task_artifact(args.task, TASK_SPANS_FILE)
+        except Exception as e:  # noqa: BLE001 — 404 → readable hint below
+            raw = b""
+            reason = f" ({e})"
+        else:
+            reason = ""
+        spans = []
+        for line in raw.decode(errors="replace").splitlines():
+            try:
+                spans.append(json.loads(line))
+            except ValueError:
+                continue
+    else:
+        t = engine.get_task(args.task)
+        if t is None:
+            raise KeyError(f"unknown task {args.task}")
+        reason = ""
+        spans = load_task_spans(
+            os.path.join(
+                engine.env.dirs.outputs(), t.plan, t.id, TASK_SPANS_FILE
+            )
+        )
+    if not spans:
+        # same message AND exit code with or without --json, like the
+        # flight-recorder branch above
+        print(
+            f"no lifecycle trace for task {args.task}{reason} — the span "
+            "tree is assembled when the task archives "
+            "(docs/OBSERVABILITY.md 'Control plane')",
+            file=sys.stderr,
+        )
+        return 1
+    if getattr(args, "json", False):
+        for s in spans:
+            print(json.dumps(s))
+        return 0
+    print(render_lifecycle_tree(spans))
+    return 0
 
 
 # ------------------------------------------------------------------ watch
@@ -1506,6 +1610,62 @@ def watch_cmd(args) -> int:
         engine.stop()
 
 
+def register_top(sub) -> None:
+    p = sub.add_parser(
+        "top",
+        help="live fleet view: worker occupancy, queue depth, per-state "
+        "task counts over the FULL store, and one row per queued/"
+        "running task (GET /fleet — docs/OBSERVABILITY.md 'Control "
+        "plane')",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the raw fleet payload as ndjson (one object per "
+        "refresh) instead of the rendered view",
+    )
+    p.add_argument(
+        "--no-follow",
+        action="store_true",
+        help="print one snapshot and exit instead of refreshing",
+    )
+    p.add_argument(
+        "-i",
+        "--interval",
+        type=float,
+        default=2.0,
+        help="refresh interval in seconds (default: 2)",
+    )
+    p.set_defaults(func=top_cmd)
+
+
+def top_cmd(args) -> int:
+    import json
+
+    from testground_tpu.runners.pretty import render_fleet
+
+    engine = _engine(args)
+    try:
+        follow = not getattr(args, "no_follow", False)
+        interval = max(0.1, getattr(args, "interval", 2.0))
+        clear = follow and sys.stdout.isatty() and not args.json
+        while True:
+            payload = engine.fleet_payload()
+            if getattr(args, "json", False):
+                print(json.dumps(payload, sort_keys=True))
+            else:
+                if clear:
+                    # home + clear-to-end, not full clear: no flicker
+                    sys.stdout.write("\033[H\033[J")
+                print(render_fleet(payload))
+            sys.stdout.flush()
+            if not follow:
+                return 0
+            time.sleep(interval)
+    finally:
+        engine.stop()
+
+
 def register_status(sub) -> None:
     p = sub.add_parser("status", help="get task status")
     p.add_argument("-t", "--task", required=True, help="task id")
@@ -1529,6 +1689,7 @@ def status_cmd(args) -> int:
         print(f"Type:    {t.type.value}")
         print(f"State:   {t.state().state.value}")
         print(f"Outcome: {t.outcome().value}")
+        print(f"Queued:  {t.queued_secs():.1f}s")
         cb = t.created_by
         if cb.user or cb.repo or cb.branch or cb.commit:
             parts = [cb.user or "-"]

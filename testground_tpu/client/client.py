@@ -139,16 +139,25 @@ class Client:
         composition: dict,
         priority: int = 0,
         created_by: dict | None = None,
+        trace_parent: str = "",
     ) -> str:
         """POST /run or /build; parse the chunked rpc response for the
-        task id (``ParseRunResponse``, ``client.go:402``)."""
+        task id (``ParseRunResponse``, ``client.go:402``). A non-empty
+        ``trace_parent`` rides the standard ``traceparent`` header so
+        the daemon roots the task's lifecycle span tree at the
+        submitter's span (tracectx.py)."""
         from testground_tpu.rpc import Chunk
 
         body = {"composition": composition, "priority": priority}
         if created_by:
             body["created_by"] = created_by
         task_id = ""
-        for line in self._post_stream(route, body):
+        conn = self._conn()
+        headers = self._headers()
+        if trace_parent:
+            headers["traceparent"] = trace_parent
+        conn.request("POST", route, json.dumps(body), headers)
+        for line in self._read_stream(conn, conn.getresponse()):
             try:
                 c = Chunk.from_json(line)
             except Exception:  # noqa: BLE001 — ignore non-chunk noise
@@ -162,14 +171,26 @@ class Client:
         return task_id
 
     def run(
-        self, composition: dict, priority: int = 0, created_by: dict | None = None
+        self,
+        composition: dict,
+        priority: int = 0,
+        created_by: dict | None = None,
+        trace_parent: str = "",
     ) -> str:
-        return self._queue("/run", composition, priority, created_by)
+        return self._queue(
+            "/run", composition, priority, created_by, trace_parent
+        )
 
     def build(
-        self, composition: dict, priority: int = 0, created_by: dict | None = None
+        self,
+        composition: dict,
+        priority: int = 0,
+        created_by: dict | None = None,
+        trace_parent: str = "",
     ) -> str:
-        return self._queue("/build", composition, priority, created_by)
+        return self._queue(
+            "/build", composition, priority, created_by, trace_parent
+        )
 
     def tasks(
         self, states=None, types=None, before=None, after=None, limit=0
@@ -213,6 +234,53 @@ class Client:
                     or f"HTTP {resp.status}"
                 )
             return data.decode(errors="replace")
+        finally:
+            conn.close()
+
+    def fleet(self) -> dict:
+        """GET /fleet — the daemon's live fleet snapshot (the ``tg top``
+        backend): per-state counts over the FULL task store, queue
+        depth by priority, worker occupancy, and live task rows."""
+        return self._get_json("/fleet", {})
+
+    def events(self, since: int = 0, follow: bool = False) -> Iterator[dict]:
+        """GET /events — tail the daemon's control-plane event journal
+        (``daemon_events.jsonl``) as ndjson dicts. One-shot by default
+        (the server appends a ``{"type": "_tail", "offset": N}`` trailer
+        for resume); ``follow=True`` keeps the stream open."""
+        params = {"since": str(since), "follow": "1" if follow else "0"}
+        for line in self._get_stream("/events", params):
+            line = line.strip()
+            if not line:
+                continue  # follow-mode heartbeat
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue  # tolerant-reader rule: skip foreign noise
+
+    def artifact(self, task_id: str, name: str, run: str = "") -> bytes:
+        """GET /artifact — fetch one whitelisted run-outputs file (e.g.
+        ``task_spans.jsonl`` for ``tg trace --lifecycle`` against a
+        remote daemon) as raw bytes."""
+        from urllib.parse import urlencode
+
+        params = {"task_id": task_id, "name": name}
+        if run:
+            params["run"] = run
+        conn = self._conn()
+        conn.request(
+            "GET", f"/artifact?{urlencode(params)}", headers=self._headers()
+        )
+        resp = conn.getresponse()
+        try:
+            data = resp.read()
+            if resp.status >= 400:
+                try:
+                    msg = json.loads(data).get("error")
+                except Exception:  # noqa: BLE001
+                    msg = data.decode(errors="replace")[:500]
+                raise DaemonError(msg or f"HTTP {resp.status}")
+            return data
         finally:
             conn.close()
 
@@ -341,20 +409,22 @@ class RemoteEngine:
     # -- queueing: manifest/sources resolve on the daemon side
     def queue_run(
         self, comp, manifest=None, sources_dir="", priority=0,
-        created_by=None, **_,
+        created_by=None, trace_parent="", **_,
     ):
         return self.client.run(
             comp.to_dict(), priority,
             created_by.to_dict() if created_by else None,
+            trace_parent=trace_parent,
         )
 
     def queue_build(
         self, comp, manifest=None, sources_dir="", priority=0,
-        created_by=None, **_,
+        created_by=None, trace_parent="", **_,
     ):
         return self.client.build(
             comp.to_dict(), priority,
             created_by.to_dict() if created_by else None,
+            trace_parent=trace_parent,
         )
 
     def get_task(self, task_id: str) -> Task | None:
@@ -380,6 +450,21 @@ class RemoteEngine:
         of ``tg trace``; in-process engines read the run outputs via
         sim.trace.read_trace_events)."""
         return self.client.trace(task_id, limit=limit)
+
+    def fleet_payload(self) -> dict:
+        """The daemon's /fleet route, shaped like Engine.fleet_payload
+        so ``tg top`` works identically in-process and remote."""
+        return self.client.fleet()
+
+    def event_rows(self, since: int = 0, follow: bool = False):
+        """The daemon's /events route (control-plane journal tail)."""
+        return self.client.events(since=since, follow=follow)
+
+    def task_artifact(self, task_id: str, name: str, run: str = "") -> bytes:
+        """One whitelisted run-outputs file as raw bytes (the remote
+        half of ``tg trace --lifecycle``; in-process engines read the
+        outputs dir directly)."""
+        return self.client.artifact(task_id, name, run=run)
 
     def stream_rows(
         self, task_id: str, follow: bool = True, cancel=None, families=None

@@ -46,6 +46,7 @@ import shutil
 import tarfile
 import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from testground_tpu.api import (
@@ -147,6 +148,10 @@ class _Handler(BaseHTTPRequestHandler):
             "/delete": lambda: self._delete(q),
             "/logs": lambda: self._get_logs(q),
             "/outputs": lambda: self._get_outputs(q),
+            # control plane (docs/OBSERVABILITY.md "Control plane"):
+            # fleet summary for `tg top`, daemon event-journal tail
+            "/fleet": lambda: self._fleet(q),
+            "/events": lambda: self._events(q),
         }
         h = handlers.get(url.path)
         if h is None:
@@ -256,6 +261,10 @@ class _Handler(BaseHTTPRequestHandler):
             sources_dir=plan_dir,
             priority=int(body.get("priority", 0)),
             created_by=created_by,
+            # lifecycle tracing (tracectx.py): adopt the submitter's
+            # traceparent so the task's span tree roots at the client's
+            # submit span; absent/malformed → the engine mints fresh
+            trace_parent=self.headers.get("traceparent", ""),
         )
         # chunked rpc response: progress line + result chunk (the wire
         # shape the reference's ParseRunResponse expects, client.go:402)
@@ -549,14 +558,75 @@ class _Handler(BaseHTTPRequestHandler):
             int(self.daemon_ref.env.daemon.metrics_task_limit or 0)
             or self._METRICS_TASKS_MAX
         )
+        fleet = (
+            self.engine.fleet_info()
+            if hasattr(self.engine, "fleet_info")
+            else None
+        )
         body = render_prometheus(
-            self.engine.tasks(), per_task_limit=limit
+            self.engine.tasks(), per_task_limit=limit, fleet=fleet
         ).encode()
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _fleet(self, q: dict) -> None:
+        """GET /fleet — the daemon-wide summary behind ``tg top``:
+        worker slots, queue depth by priority, per-state counts over
+        the FULL task store, pack occupancy, and one row per
+        queued/running task with live ticks/s and breach counts."""
+        self._send_json(self.engine.fleet_payload())
+
+    def _events(self, q: dict) -> None:
+        """GET /events?since=<byte offset>[&follow=1] — tail the daemon
+        event journal (engine/events.py) as ndjson. One-shot by
+        default: replays complete lines from ``since`` to EOF, then
+        sends a ``{"type": "_tail", "offset": N}`` marker whose offset
+        resumes the next call. With ``follow=1``, keeps tailing
+        (heartbeat blank line every 15 s of idle) until the client
+        disconnects. 404 while the journal does not exist yet."""
+        from testground_tpu.engine.stream import _Tail
+
+        path = self.engine.events.path
+        try:
+            since = int(q.get("since") or 0)
+        except (TypeError, ValueError):
+            return self._send_error_json("invalid since", 400)
+        if not os.path.exists(path):
+            return self._send_error_json("no events journal yet", 404)
+        follow = q.get("follow", "0") not in ("0", "false", "no", "")
+        tail = _Tail(path)
+        tail.offset = max(0, since)
+        self._start_stream()
+        try:
+            last_data = time.monotonic()
+            while True:
+                wrote = False
+                for row in tail.read_new():
+                    self._write_chunked(
+                        (json.dumps(row) + "\n").encode()
+                    )
+                    wrote = True
+                if wrote:
+                    last_data = time.monotonic()
+                if not follow:
+                    self._write_chunked(
+                        (
+                            json.dumps(
+                                {"type": "_tail", "offset": tail.offset}
+                            )
+                            + "\n"
+                        ).encode()
+                    )
+                    break
+                if time.monotonic() - last_data >= 15.0:
+                    self._write_chunked(b"\n")  # heartbeat
+                    last_data = time.monotonic()
+                time.sleep(0.15)
+        finally:
+            self._end_chunked()
 
     # Event cap for one /trace JSON response (sim_trace.jsonl itself is
     # unbounded; the full file streams via /artifact).
@@ -621,6 +691,10 @@ class _Handler(BaseHTTPRequestHandler):
         "run_spans.jsonl",
         "sim_trace.jsonl",
         "trace_events.json",
+        # lifecycle span tree (engine/tracetree.py): assembled at
+        # archive time; task_trace.json opens in Perfetto directly
+        "task_spans.jsonl",
+        "task_trace.json",
     )
     # Instance-side artifacts live NESTED under <group>/<instance>/ —
     # still a closed basename whitelist, with every path component

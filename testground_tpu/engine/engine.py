@@ -21,10 +21,22 @@ from testground_tpu.api import (
 )
 from testground_tpu.config import EnvConfig
 from testground_tpu.logging_ import S
+from testground_tpu.tracectx import TraceContext, new_span_id, new_trace_id
 
+from .events import EVENTS_FILE, EventJournal
 from .queue import TaskQueue
 from .storage import TaskStorage
 from .task import CreatedBy, DatedState, State, Task, TaskType, new_task_id
+
+# Fleet histograms reuse the sync plane's log2 µs binning (one binning
+# vocabulary across every tg_* histogram; sync/stats.py is import-light
+# by contract so the engine can depend on it).
+from testground_tpu.sync.stats import TIME_BINS, time_bin
+
+# Distinct solo_reason labels tracked before overflowing into "other" —
+# Prometheus label sets must stay bounded even if a future pack gate
+# invents per-task reason strings.
+_FLEET_SOLO_REASONS_MAX = 32
 
 __all__ = ["Engine", "EngineConfig"]
 
@@ -61,6 +73,25 @@ class Engine:
         self._stop = threading.Event()
         self._queue_kick = threading.Event()
         self._workers: list[threading.Thread] = []
+
+        # Control plane (docs/OBSERVABILITY.md "Control plane"): the
+        # append-only daemon event journal plus in-memory fleet
+        # counters behind tg_fleet_* and GET /fleet. Counters cover the
+        # daemon's lifetime, not the task store's — they reset on
+        # restart like every other process-local Prometheus counter.
+        self.events = EventJournal(
+            os.path.join(self.env.dirs.daemon(), EVENTS_FILE)
+        )
+        self._fleet_lock = threading.Lock()
+        self._worker_task: dict[int, str] = {}  # worker idx -> task id ("" idle)
+        self._queue_wait_bins = [0] * TIME_BINS
+        self._queue_wait_total_us = 0
+        self._claim_latency_bins = [0] * TIME_BINS
+        self._claim_latency_total_us = 0
+        self._pack_packed_total = 0  # admissions that packed >= 2 runs
+        self._pack_packed_runs_total = 0  # member runs admitted via packs
+        self._pack_solo: dict[str, int] = {}  # solo_reason -> count
+        self._running_packs: dict[str, int] = {}  # leader task id -> width
 
     # ---------------------------------------------------------------- wiring
 
@@ -152,12 +183,19 @@ class Engine:
         sources_dir: str = "",
         priority: int = 0,
         created_by: CreatedBy | None = None,
+        trace_parent: str = "",
     ) -> str:
         """Queue a run task (``engine.go:203-249`` QueueRun)."""
         validate_for_run(comp)
         self._check_run_compat(comp, manifest)
         return self._queue_task(
-            TaskType.RUN, comp, manifest, sources_dir, priority, created_by
+            TaskType.RUN,
+            comp,
+            manifest,
+            sources_dir,
+            priority,
+            created_by,
+            trace_parent,
         )
 
     def queue_build(
@@ -167,10 +205,17 @@ class Engine:
         sources_dir: str = "",
         priority: int = 0,
         created_by: CreatedBy | None = None,
+        trace_parent: str = "",
     ) -> str:
         """Queue a build task (``engine.go:162-201`` QueueBuild)."""
         return self._queue_task(
-            TaskType.BUILD, comp, manifest, sources_dir, priority, created_by
+            TaskType.BUILD,
+            comp,
+            manifest,
+            sources_dir,
+            priority,
+            created_by,
+            trace_parent,
         )
 
     def _queue_task(
@@ -181,7 +226,19 @@ class Engine:
         sources_dir: str,
         priority: int,
         created_by: CreatedBy | None,
+        trace_parent: str = "",
     ) -> str:
+        # Lifecycle trace ids (tracectx.py): adopt the submitter's
+        # traceparent when one arrived (its span becomes the task's
+        # root "submit" span), else mint a fresh trace here — every
+        # task has a complete id set from birth so the archive-time
+        # span tree always connects.
+        ctx = TraceContext.from_traceparent(trace_parent)
+        if ctx is not None:
+            trace = {"trace_id": ctx.trace_id, "root_span_id": ctx.span_id}
+        else:
+            trace = {"trace_id": new_trace_id(), "root_span_id": new_span_id()}
+        trace["queued_span_id"] = new_span_id()
         tsk = Task(
             id=new_task_id(),
             type=typ,
@@ -196,21 +253,38 @@ class Engine:
             },
             states=[DatedState(state=State.SCHEDULED, created=time.time())],
             created_by=created_by or CreatedBy(),
+            trace=trace,
         )
         if tsk.created_by_ci():
             self.queue.push_unique_by_branch(tsk)
         else:
             self.queue.push(tsk)
         self._queue_kick.set()
+        self.events.emit(
+            "task.scheduled",
+            task=tsk.id,
+            trace=tsk.trace,
+            state=State.SCHEDULED.value,
+            task_type=typ.value,
+            plan=tsk.plan,
+            case=tsk.case,
+            priority=priority,
+        )
         S().info("queued task %s (%s)", tsk.id, tsk.name())
         return tsk.id
 
     # ------------------------------------------------------------ cancel/kill
 
     def register_cancel(self, task_id: str) -> threading.Event:
-        ev = threading.Event()
+        # idempotent: the worker registers at claim time (before the
+        # claim bookkeeping) so kill() never races the pop→process
+        # window; the later process_task call must return the SAME
+        # event or an operator cancel landing in between would be lost
         with self._cancel_lock:
-            self._cancels[task_id] = ev
+            ev = self._cancels.get(task_id)
+            if ev is None:
+                ev = threading.Event()
+                self._cancels[task_id] = ev
         return ev
 
     def drop_cancel(self, task_id: str) -> None:
@@ -221,11 +295,32 @@ class Engine:
         """Cancel a queued or running task (``engine.go:419-427`` Kill)."""
         if self.queue.cancel_queued(task_id):
             S().info("canceled queued task %s", task_id)
+            tsk = self.storage.get(task_id)
+            trace = tsk.trace if tsk is not None else None
+            self.events.emit(
+                "task.cancel_requested", task=task_id, trace=trace, queued=True
+            )
+            # a queued cancel IS the terminal transition — no worker
+            # will ever touch this task, so journal it here
+            self.events.emit(
+                "task.canceled",
+                task=task_id,
+                trace=trace,
+                state=State.CANCELED.value,
+                by="operator",
+            )
             return True
         with self._cancel_lock:
             ev = self._cancels.get(task_id)
         if ev is not None:
             ev.set()
+            tsk = self.storage.get(task_id)
+            self.events.emit(
+                "task.cancel_requested",
+                task=task_id,
+                trace=tsk.trace if tsk is not None else None,
+                queued=False,
+            )
             return True
         return False
 
@@ -333,6 +428,176 @@ class Engine:
             families=families,
             heartbeat_secs=heartbeat_secs,
         )
+
+    # ----------------------------------------------------------------- fleet
+
+    def fleet_worker_state(self, idx: int, task_id: str) -> None:
+        """Supervisor hook: worker ``idx`` is now busy on ``task_id``
+        ("" = idle). Feeds tg_fleet_workers and GET /fleet."""
+        with self._fleet_lock:
+            self._worker_task[idx] = task_id
+
+    def fleet_note_claim(
+        self, queue_wait_secs: float, claim_latency_secs: float
+    ) -> None:
+        """Supervisor hook: one task left the queue. Records log2
+        histograms of how long it waited (scheduled → PROCESSING) and
+        how long the claim itself took (PROCESSING stamp → worker
+        dispatch, i.e. pack admission + prep overhead)."""
+        wait_us = max(0.0, queue_wait_secs) * 1e6
+        claim_us = max(0.0, claim_latency_secs) * 1e6
+        with self._fleet_lock:
+            self._queue_wait_bins[time_bin(wait_us)] += 1
+            self._queue_wait_total_us += int(wait_us)
+            self._claim_latency_bins[time_bin(claim_us)] += 1
+            self._claim_latency_total_us += int(claim_us)
+
+    def fleet_note_pack(self, leader_id: str, width: int) -> None:
+        """Supervisor hook: a pack claim admitted ``width`` runs."""
+        with self._fleet_lock:
+            self._pack_packed_total += 1
+            self._pack_packed_runs_total += width
+            self._running_packs[leader_id] = width
+
+    def fleet_note_solo(self, reason: str) -> None:
+        """Supervisor hook: a pack-eligible run went solo; count by
+        reason (bounded label set)."""
+        reason = reason or "none"
+        with self._fleet_lock:
+            if (
+                reason not in self._pack_solo
+                and len(self._pack_solo) >= _FLEET_SOLO_REASONS_MAX
+            ):
+                reason = "other"
+            self._pack_solo[reason] = self._pack_solo.get(reason, 0) + 1
+
+    def fleet_pack_done(self, leader_id: str) -> None:
+        with self._fleet_lock:
+            self._running_packs.pop(leader_id, None)
+
+    def fleet_info(self) -> dict:
+        """Counter snapshot for the Prometheus ``tg_fleet_*`` family
+        (metrics/prometheus.py renders it; task-store gauges are
+        computed there from the FULL task list)."""
+        with self._fleet_lock:
+            busy = sum(1 for t in self._worker_task.values() if t)
+            total = max(len(self._workers), len(self._worker_task))
+            return {
+                "workers": {"total": total, "busy": busy},
+                "queue_wait_bins": list(self._queue_wait_bins),
+                "queue_wait_total_us": self._queue_wait_total_us,
+                "claim_latency_bins": list(self._claim_latency_bins),
+                "claim_latency_total_us": self._claim_latency_total_us,
+                "pack": {
+                    "packed": self._pack_packed_total,
+                    "packed_runs": self._pack_packed_runs_total,
+                    "solo": dict(self._pack_solo),
+                },
+            }
+
+    @staticmethod
+    def _tail_last_row(path: str, tail_bytes: int = 8192) -> dict:
+        """Last parseable JSON line of a jsonl file, reading only the
+        tail — bounded no matter how long a run has been ticking."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                chunk = f.read().decode("utf-8", "replace")
+        except OSError:
+            return {}
+        import json as _json
+
+        for line in reversed(chunk.splitlines()):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = _json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict):
+                return row
+        return {}
+
+    @staticmethod
+    def _count_lines_bounded(path: str, max_bytes: int = 256 << 10) -> int:
+        """Line count of a jsonl file, reading at most ``max_bytes``
+        from the head — exact for every sane breach stream, a floor for
+        a pathological one (the fleet view needs "how bad", not an
+        audit-grade total)."""
+        try:
+            with open(path, "rb") as f:
+                return f.read(max_bytes).count(b"\n")
+        except OSError:
+            return 0
+
+    def fleet_payload(self) -> dict:
+        """The ``GET /fleet`` summary: worker slots, queue depth, pack
+        occupancy, and one row per queued/running task with live
+        ticks/s (sim_perf.jsonl tail) and SLO breach counts. Counts
+        cover the FULL task store; the per-task list is naturally
+        bounded by what is actually queued or running."""
+        now = time.time()
+        all_tasks = self.storage.filter()
+        counts: dict[str, int] = {}
+        by_priority: dict[int, int] = {}
+        rows: list[dict] = []
+        outputs = self.env.dirs.outputs()
+        with self._fleet_lock:
+            worker_task = dict(self._worker_task)
+            running_packs = dict(self._running_packs)
+            n_workers = max(len(self._workers), len(self._worker_task))
+        for tsk in all_tasks:
+            st = tsk.state().state
+            counts[st.value] = counts.get(st.value, 0) + 1
+            if st == State.SCHEDULED:
+                by_priority[tsk.priority] = by_priority.get(tsk.priority, 0) + 1
+            if st not in (State.SCHEDULED, State.PROCESSING):
+                continue
+            row = {
+                "id": tsk.id,
+                "name": tsk.name(),
+                "type": tsk.type.value,
+                "state": st.value,
+                "priority": tsk.priority,
+                "queued_secs": round(tsk.queued_secs(), 3),
+                "trace_id": tsk.trace.get("trace_id", ""),
+            }
+            if st == State.PROCESSING:
+                row["running_secs"] = round(
+                    max(0.0, now - tsk.state().created), 3
+                )
+                row["pack_width"] = running_packs.get(tsk.id, 0)
+                run_dir = os.path.join(outputs, tsk.plan, tsk.id)
+                perf = self._tail_last_row(
+                    os.path.join(run_dir, "sim_perf.jsonl")
+                )
+                if perf:
+                    row["ticks_per_sec"] = perf.get("ticks_per_sec", 0)
+                row["breaches"] = self._count_lines_bounded(
+                    os.path.join(run_dir, "sim_slo.jsonl")
+                )
+            rows.append(row)
+        rows.sort(key=lambda r: (r["state"], -r["priority"], r["id"]))
+        busy = sum(1 for t in worker_task.values() if t)
+        return {
+            "ts_wall_ns": time.time_ns(),
+            "workers": {
+                "total": n_workers,
+                "busy": busy,
+                "idle": max(0, n_workers - busy),
+            },
+            "queue": {
+                "depth": counts.get(State.SCHEDULED.value, 0),
+                "by_priority": {str(k): v for k, v in by_priority.items()},
+            },
+            "counts": counts,
+            "tasks_total": len(all_tasks),
+            "pack": {"running": running_packs},
+            "tasks": rows,
+        }
 
     # -------------------------------------------------------------- actions
 

@@ -1,0 +1,126 @@
+"""Daemon event journal: the control plane's append-only audit log.
+
+Every task state transition, claim, pack admission, SLO cancel,
+operator cancel, checkpoint and sync eviction lands here as one JSON
+line in ``daemon_events.jsonl`` (under the daemon state dir, next to
+``tasks.db``). Records carry both clocks — wall ns for cross-host
+correlation, monotonic ns for intra-daemon ordering that survives NTP
+slew — plus the task's trace ids so the journal joins the lifecycle
+span tree.
+
+This is the audit stream a future fleet controller (ROADMAP item 2)
+consumes to answer "why did the daemon do that": admission decisions,
+preemptions and migrations become replayable from the journal alone.
+Served live by ``GET /events?since=<byte offset>`` (daemon/server.py),
+which reuses the byte-offset tail machinery from ``engine/stream.py``.
+
+Bounded by size-based rotation: when the journal exceeds ``max_bytes``
+it is renamed to ``daemon_events.jsonl.1`` (replacing any previous
+rotation) and a fresh file begins — the journal is an operational
+tail, not an unbounded archive. Emission never raises: observability
+must not fail the daemon it observes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["EVENTS_FILE", "EventJournal"]
+
+EVENTS_FILE = "daemon_events.jsonl"
+
+# Rotation threshold. 4 MiB of ~250-byte records is ~16k events — hours
+# of busy-daemon history, small enough to tail over HTTP in one read.
+_MAX_BYTES_DEFAULT = 4 << 20
+
+
+class EventJournal:
+    """Thread-safe append-only jsonl journal with single-slot rotation.
+
+    Record shape (every record, extra keys per event type):
+
+    ``{"seq": n, "ts_wall_ns": ..., "ts_mono_ns": ..., "type": "...",
+    "task": "<task id>", "trace_id": "...", "span_id": "...", ...}``
+
+    ``seq`` increases monotonically for the journal's lifetime (it does
+    NOT reset on rotation), so consumers detect gaps after a rotation
+    they slept through.
+    """
+
+    def __init__(self, path: str, max_bytes: int = _MAX_BYTES_DEFAULT):
+        self.path = path
+        self.max_bytes = max(1, int(max_bytes))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._size = 0
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._size = os.path.getsize(path)
+        except OSError:
+            self._size = 0
+        # resume seq from the existing journal so a daemon restart
+        # keeps the file monotonic (consumers detect gaps, not resets)
+        if self._size:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(max(0, self._size - 8192))
+                    tail = f.read().decode("utf-8", "replace")
+                for line in reversed(tail.splitlines()):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        self._seq = int(json.loads(line).get("seq", 0))
+                        break
+                    except (ValueError, TypeError):
+                        continue
+            except OSError:
+                pass
+
+    def emit(
+        self,
+        type_: str,
+        task: str = "",
+        trace: dict | None = None,
+        **attrs,
+    ) -> None:
+        """Append one event. ``trace`` is a Task.trace-shaped dict; its
+        trace_id and the most specific span id minted so far are copied
+        onto the record. Never raises."""
+        trace = trace or {}
+        rec = {
+            "seq": 0,  # patched under the lock
+            "ts_wall_ns": time.time_ns(),
+            "ts_mono_ns": time.monotonic_ns(),
+            "type": type_,
+            "task": task,
+            "trace_id": trace.get("trace_id", ""),
+            "span_id": (
+                trace.get("claim_span_id")
+                or trace.get("queued_span_id")
+                or trace.get("root_span_id", "")
+            ),
+        }
+        rec.update(attrs)
+        try:
+            with self._lock:
+                self._seq += 1
+                rec["seq"] = self._seq
+                line = json.dumps(rec, default=str) + "\n"
+                if self._size + len(line) > self.max_bytes:
+                    self._rotate_locked()
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line)
+                self._size += len(line)
+        except (OSError, ValueError, TypeError):
+            pass
+
+    def _rotate_locked(self) -> None:
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+        self._size = 0

@@ -31,12 +31,14 @@ from testground_tpu.rpc import OutputWriter
 # import-light on purpose (numpy + stdlib — sim/slo.py's contract): the
 # typed SLO failure must be catchable here without loading jax
 from testground_tpu.sim.slo import SloBreachError
+from testground_tpu.tracectx import new_span_id, new_trace_id
 
 from .engine import Engine
 from .notify import notify_task_finished, notify_task_started
 from .pack import _truthy
 from .queue import QueueEmptyError
 from .task import DatedState, Outcome, State, Task, TaskType
+from .tracetree import export_task_trace
 
 __all__ = ["worker", "do_build", "do_run"]
 
@@ -59,10 +61,149 @@ def worker(engine: Engine, idx: int) -> None:
             engine._queue_kick.clear()
             continue
         pack = claim_pack(engine, tsk)
+        # close the kill() race before any claim bookkeeping: the tasks
+        # are already stamped PROCESSING (queue.pop), so an operator
+        # cancel arriving now must find a registered event, not fall
+        # between cancel_queued and process_task's registration
+        for member in pack:
+            engine.register_cancel(member.id)
+        _note_claim(engine, idx, pack)
+        engine.fleet_worker_state(idx, tsk.id)
+        try:
+            if len(pack) > 1:
+                process_task_pack(engine, pack)
+            else:
+                process_task(engine, tsk)
+        finally:
+            engine.fleet_worker_state(idx, "")
+            if len(pack) > 1:
+                engine.fleet_pack_done(tsk.id)
+
+
+def _note_claim(engine: Engine, idx: int, pack: list[Task]) -> None:
+    """Claim bookkeeping for a freshly-popped task (or pack): mint the
+    claim and execute span ids — the pack-claim span is minted ONCE and
+    shared by every member, so each member's tree hangs off the same
+    span — feed the fleet claim histograms, and journal the claims.
+    Tasks pushed straight into the queue (tests, future federation)
+    get trace ids filled in here so every archive still exports a
+    connected tree."""
+    now = time.time()
+    claim_sid = new_span_id()
+    leader = pack[0]
+    for tsk in pack:
+        tr = tsk.trace
+        tr.setdefault("trace_id", new_trace_id())
+        tr.setdefault("root_span_id", new_span_id())
+        tr.setdefault("queued_span_id", new_span_id())
+        tr["claim_span_id"] = claim_sid
+        tr["execute_span_id"] = new_span_id()
         if len(pack) > 1:
-            process_task_pack(engine, pack)
-        else:
-            process_task(engine, tsk)
+            tr["pack_leader"] = leader.id
+            tr["pack_width"] = len(pack)
+        queue_wait = (
+            max(0.0, tsk.states[-1].created - tsk.states[0].created)
+            if len(tsk.states) >= 2
+            else 0.0
+        )
+        claim_latency = (
+            max(0.0, now - tsk.states[-1].created) if tsk.states else 0.0
+        )
+        engine.fleet_note_claim(queue_wait, claim_latency)
+        engine.events.emit(
+            "task.claimed",
+            task=tsk.id,
+            trace=tr,
+            state=State.PROCESSING.value,
+            worker=idx,
+            queue_wait_secs=round(queue_wait, 6),
+            pack_width=len(pack),
+        )
+    if len(pack) > 1:
+        engine.fleet_note_pack(leader.id, len(pack))
+        engine.events.emit(
+            "pack.admitted",
+            task=leader.id,
+            trace=leader.trace,
+            width=len(pack),
+            members=[t.id for t in pack],
+        )
+
+
+def _run_trace_ctx(tsk: Task) -> dict:
+    """The RunInput.trace_ctx the executor and sync client carry: the
+    task's trace with the execute span as parent, plus the ready-made
+    traceparent wire form."""
+    tr = tsk.trace or {}
+    trace_id = tr.get("trace_id", "")
+    if not trace_id:
+        return {}
+    parent = (
+        tr.get("execute_span_id")
+        or tr.get("claim_span_id")
+        or tr.get("root_span_id", "")
+    )
+    return {
+        "trace_id": trace_id,
+        "parent_id": parent,
+        "task_id": tsk.id,
+        "traceparent": f"00-{trace_id}-{parent}-01",
+    }
+
+
+def _post_run_events(engine: Engine, tsk: Task) -> None:
+    """Journal the run-derived control-plane events an archived result
+    reveals: checkpoint/resume activity and sync-service evictions.
+    Best-effort — a malformed result journal must not fail the task."""
+    try:
+        result = tsk.result if isinstance(tsk.result, dict) else {}
+        journal = result.get("journal")
+        if not isinstance(journal, dict):
+            return
+        sim = journal.get("sim")
+        if isinstance(sim, dict) and isinstance(sim.get("checkpoint"), dict):
+            ck = sim["checkpoint"]
+            if ck.get("count"):
+                engine.events.emit(
+                    "task.checkpoint",
+                    task=tsk.id,
+                    trace=tsk.trace,
+                    count=int(ck.get("count", 0)),
+                    last_tick=int(ck.get("last_tick", 0) or 0),
+                )
+            if ck.get("resumed"):
+                engine.events.emit(
+                    "task.resumed",
+                    task=tsk.id,
+                    trace=tsk.trace,
+                    resumed=ck["resumed"],
+                )
+        sync = journal.get("sync")
+        if isinstance(sync, dict) and sync.get("evicted"):
+            engine.events.emit(
+                "task.sync_evicted",
+                task=tsk.id,
+                trace=tsk.trace,
+                count=int(sync["evicted"]),
+            )
+    except (TypeError, ValueError):
+        pass
+
+
+def _finish_task(engine: Engine, tsk: Task) -> None:
+    """Shared archive-time tail for solo and packed paths: journal the
+    terminal transition plus run-derived events, then export the task's
+    span tree (task_spans.jsonl + task_trace.json)."""
+    _post_run_events(engine, tsk)
+    engine.events.emit(
+        "task.finished",
+        task=tsk.id,
+        trace=tsk.trace,
+        state=tsk.states[-1].state.value,
+        outcome=tsk.outcome().value,
+        error=tsk.error[:200] if tsk.error else "",
+    )
+    export_task_trace(engine.env.dirs.outputs(), tsk)
 
 
 def process_task(engine: Engine, tsk: Task) -> None:
@@ -83,6 +224,13 @@ def process_task(engine: Engine, tsk: Task) -> None:
                 engine.storage.update_current(tsk)
                 # pending commit status for CI tasks (supervisor.go:213-215)
                 notify_task_started(engine.env, tsk)
+                engine.events.emit(
+                    "task.started",
+                    task=tsk.id,
+                    trace=tsk.trace,
+                    state=State.PROCESSING.value,
+                    task_type=tsk.type.value,
+                )
                 if tsk.type == TaskType.RUN:
                     result = do_run(engine, tsk, ow, cancel)
                 elif tsk.type == TaskType.BUILD:
@@ -109,6 +257,10 @@ def process_task(engine: Engine, tsk: Task) -> None:
         engine.drop_cancel(tsk.id)
         final = State.CANCELED if cancel.is_set() and tsk.error else State.COMPLETE
         tsk.states.append(DatedState(state=final, created=time.time()))
+        # journal + span-tree export BEFORE the archive makes the
+        # terminal state visible: a client polling for COMPLETE must
+        # find task_spans.jsonl already on disk
+        _finish_task(engine, tsk)
         engine.storage.archive(tsk)
         # status webhooks: log-and-continue, never affect the task
         # (supervisor.go:176-183)
@@ -181,6 +333,7 @@ def _prepare_pack_run_input(
                 else []
             )
         ],
+        trace_ctx=_run_trace_ctx(tsk),
         env=engine.env,
     )
 
@@ -215,6 +368,14 @@ def process_task_pack(engine: Engine, tasks: list[Task]) -> None:
         )
         engine.storage.update_current(tsk)
         notify_task_started(engine.env, tsk)
+        engine.events.emit(
+            "task.started",
+            task=tsk.id,
+            trace=tsk.trace,
+            state=State.PROCESSING.value,
+            task_type=tsk.type.value,
+            pack_width=len(tasks),
+        )
 
     try:
         # ---------------------------------------------------- preparation
@@ -335,6 +496,9 @@ def process_task_pack(engine: Engine, tasks: list[Task]) -> None:
             tsk.states.append(
                 DatedState(state=final, created=time.time())
             )
+            # same ordering contract as the solo path: spans on disk
+            # before COMPLETE is observable
+            _finish_task(engine, tsk)
             engine.storage.archive(tsk)
             notify_task_finished(engine.env, tsk)
             try:
@@ -565,6 +729,7 @@ def do_run(
                     else []
                 )
             ],
+            trace_ctx=_run_trace_ctx(tsk),
             env=engine.env,
         )
         ow.infof(
@@ -589,6 +754,15 @@ def do_run(
             # plane cancels through its own wrapper), so later [[runs]]
             # still execute, mirroring the continue-on-failure rule.
             ow.write_error(f"run {run.id} failed: {e}")
+            engine.events.emit(
+                "task.slo_canceled",
+                task=tsk.id,
+                trace=tsk.trace,
+                run=run.id,
+                rule=e.breach.get("rule", ""),
+                metric=e.breach.get("metric", ""),
+                observed=e.breach.get("observed"),
+            )
             bo = e.run_output
             result_dict = (
                 bo.result.to_dict()
@@ -639,6 +813,16 @@ def do_run(
         solo_reason = (
             pack_solo_reason(tsk, engine.env.runners.get(runner_id) or {})
             or "no compatible queued run to pack with at claim time"
+        )
+        # control plane: the solo cause rides on the claim span, the
+        # journal, and the tg_fleet_pack_solo_total counter
+        tsk.trace["solo_reason"] = solo_reason
+        engine.fleet_note_solo(solo_reason)
+        engine.events.emit(
+            "pack.solo",
+            task=tsk.id,
+            trace=tsk.trace,
+            solo_reason=solo_reason,
         )
         for rres in run_results.values():
             journal = rres.get("journal") if isinstance(rres, dict) else None

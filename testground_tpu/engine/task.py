@@ -138,6 +138,11 @@ class Task:
     result: Any = None
     error: str = ""
     created_by: CreatedBy = field(default_factory=CreatedBy)
+    # Causal lifecycle-trace ids (tracectx.py): trace_id plus the span
+    # ids of the lifecycle phases minted so far (root/queued/claim/
+    # execute). NOT the flight recorder — that lives in the result
+    # journal under "trace"; this keyspace is control-plane only.
+    trace: dict = field(default_factory=dict)
 
     def created(self) -> float:
         if not self.states:
@@ -160,6 +165,21 @@ class Task:
     def took(self) -> float:
         """Seconds from creation to last state transition (``task.go:98-100``)."""
         return self.state().created - self.created()
+
+    def queued_secs(self) -> float:
+        """Seconds the task spent (or has spent so far) in the queue:
+        scheduled → first PROCESSING transition, or scheduled → now for
+        a task still waiting. The same quantity the supervisor reports
+        in the perf payload, computable for every task in the store."""
+        if not self.states:
+            return 0.0
+        t0 = self.states[0].created
+        for ds in self.states[1:]:
+            if ds.state == State.PROCESSING:
+                return max(0.0, ds.created - t0)
+        if self.states[-1].state == State.SCHEDULED:
+            return max(0.0, time.time() - t0)
+        return 0.0
 
     def created_by_ci(self) -> bool:
         cb = self.created_by
@@ -257,6 +277,7 @@ class Task:
             "error": self.error,
             "outcome": self.outcome().value,
             "created_by": self.created_by.to_dict(),
+            "trace": dict(self.trace),
         }
 
     @classmethod
@@ -275,4 +296,5 @@ class Task:
             result=d.get("result"),
             error=d.get("error", ""),
             created_by=CreatedBy.from_dict(d.get("created_by", {})),
+            trace=dict(d.get("trace") or {}),
         )

@@ -272,12 +272,20 @@ def render_sync_prometheus(stats: dict) -> str:
     return out
 
 
-def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
+def render_prometheus(
+    tasks, per_task_limit: int | None = None, fleet: dict | None = None
+) -> str:
     """Render the daemon's metric surface from a task list (most recent
     first). The fixed-cardinality ``tg_tasks`` aggregate counts EVERY
     task given; ``per_task_limit`` bounds only the task-labeled series
     (label cardinality), so counts stay honest on daemons whose history
-    outgrows the per-task window."""
+    outgrows the per-task window. ``fleet`` is the engine's counter
+    snapshot (``Engine.fleet_info()``): worker occupancy, queue-wait /
+    claim-latency histogram bins, pack admission counters — rendered as
+    the ``tg_fleet_*`` family alongside the fleet gauges this function
+    computes over the FULL task list (never the truncated slice; the
+    conservation contract Σ tg_fleet_tasks == tg_scrape_tasks_total is
+    pinned by test)."""
     exp = _Exposition()
 
     by_state: dict[tuple[str, str], int] = {}
@@ -292,6 +300,103 @@ def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
             {"state": state, "type": ttype},
             count,
         )
+
+    # ---------------------------------------------------------- fleet
+    # Control-plane gauges over the FULL task store, computed BEFORE
+    # the per-task truncation below (the fleet-total-blindness fix):
+    # per-state depth (conservation: sums to the store count), queue
+    # depth by priority, and compile-cache totals.
+    fleet_states: dict[str, int] = {}
+    fleet_prio: dict[int, int] = {}
+    cache_totals = {"hit": 0, "miss": 0}
+    for t in tasks:
+        st = t.state().state.value
+        fleet_states[st] = fleet_states.get(st, 0) + 1
+        if st == "scheduled":
+            fleet_prio[t.priority] = fleet_prio.get(t.priority, 0) + 1
+        result = t.result if isinstance(t.result, dict) else {}
+        journal = (
+            result.get("journal")
+            if isinstance(result.get("journal"), dict)
+            else {}
+        )
+        sim = journal.get("sim") if isinstance(journal.get("sim"), dict) else {}
+        bk = sim.get("bucket") if isinstance(sim.get("bucket"), dict) else {}
+        verdict = bk.get("compile_cache")
+        if verdict in cache_totals:
+            cache_totals[verdict] += 1
+    for state in sorted(fleet_states):
+        exp.add(
+            "tg_fleet_tasks",
+            "gauge",
+            "Tasks in the daemon's store by lifecycle state, over the "
+            "FULL store (sums to tg_scrape_tasks_total).",
+            {"state": state},
+            fleet_states[state],
+        )
+    for prio in sorted(fleet_prio):
+        exp.add(
+            "tg_fleet_queue_depth",
+            "gauge",
+            "Queued (scheduled) tasks by priority, over the full store.",
+            {"priority": str(prio)},
+            fleet_prio[prio],
+        )
+    # an empty store renders only the scrape-coverage gauges (the
+    # test_empty_task_list pin) — the zero-valued cache counters would
+    # be noise on a daemon that has never run anything
+    if tasks:
+        for verdict in ("hit", "miss"):
+            exp.add(
+                "tg_fleet_compile_cache_total",
+                "counter",
+                "Bucketed runs served warm (hit) or paying a cold XLA "
+                "compile (miss), totalled over the full task store.",
+                {"verdict": verdict},
+                cache_totals[verdict],
+            )
+    if fleet:
+        workers = (
+            fleet.get("workers") if isinstance(fleet.get("workers"), dict) else {}
+        )
+        busy = int(_num(workers.get("busy")) or 0)
+        total_workers = int(_num(workers.get("total")) or 0)
+        for state, value in (
+            ("busy", busy),
+            ("idle", max(0, total_workers - busy)),
+        ):
+            exp.add(
+                "tg_fleet_workers",
+                "gauge",
+                "Supervisor worker slots by occupancy.",
+                {"state": state},
+                value,
+            )
+        pk = fleet.get("pack") if isinstance(fleet.get("pack"), dict) else {}
+        exp.add(
+            "tg_fleet_pack_admissions_total",
+            "counter",
+            "Pack claims that admitted >= 2 runs onto one device "
+            "program since daemon start.",
+            {},
+            pk.get("packed", 0),
+        )
+        exp.add(
+            "tg_fleet_pack_runs_total",
+            "counter",
+            "Member runs admitted via pack claims since daemon start.",
+            {},
+            pk.get("packed_runs", 0),
+        )
+        solo = pk.get("solo") if isinstance(pk.get("solo"), dict) else {}
+        for reason in sorted(solo):
+            exp.add(
+                "tg_fleet_pack_solo_total",
+                "counter",
+                "Pack-requesting runs that executed solo, by cause.",
+                {"reason": str(reason)[:120]},
+                solo[reason],
+            )
 
     # truncation is NEVER silent (the render_prometheus contract): a
     # scraper can alert on elided > 0 instead of trusting an invisibly
@@ -632,4 +737,48 @@ def render_prometheus(tasks, per_task_limit: int | None = None) -> str:
                     pident,
                     row.get("measured_ms"),
                 )
-    return exp.render()
+    out = exp.render()
+    # fleet latency histograms (engine claim bookkeeping): proper
+    # Prometheus histogram series over the engine's log2 µs bins,
+    # hand-assembled like tg_sync_op_duration_seconds above
+    if fleet:
+        hist_lines: list[str] = []
+        for name, bins_key, sum_key, help_ in (
+            (
+                "tg_fleet_queue_wait_seconds",
+                "queue_wait_bins",
+                "queue_wait_total_us",
+                "Time claimed tasks spent queued (scheduled -> "
+                "processing), log2 buckets.",
+            ),
+            (
+                "tg_fleet_claim_latency_seconds",
+                "claim_latency_bins",
+                "claim_latency_total_us",
+                "Claim overhead (processing stamp -> worker dispatch, "
+                "pack admission included), log2 buckets.",
+            ),
+        ):
+            bins = fleet.get(bins_key)
+            if not bins:
+                continue
+            cum = 0
+            lines = []
+            for i, c in enumerate(bins):
+                cum += int(_num(c) or 0)
+                le = (
+                    "+Inf"
+                    if i == len(bins) - 1
+                    else repr((1 << (i + 1)) / 1e6)
+                )
+                lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
+            total_us = _num(fleet.get(sum_key)) or 0
+            lines.append(f"{name}_sum {total_us / 1e6}")
+            lines.append(f"{name}_count {cum}")
+            hist_lines.extend(
+                [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+                + lines
+            )
+        if hist_lines:
+            out = out.rstrip("\n") + "\n" + "\n".join(hist_lines) + "\n"
+    return out

@@ -282,6 +282,11 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
         outcomes_lock = threading.Lock()
         expected = sum(g.instances for g in job.groups)
         all_outcomes_in = threading.Event()
+        # eviction tally for the result journal (journal.sync.evicted):
+        # counted per event, not from the final slot map — a terminal
+        # event landing after an eviction overwrites the slot but the
+        # eviction still happened and the control plane journals it
+        evicted_count = [0]
 
         from testground_tpu.sync.client import SyncClient
 
@@ -291,6 +296,8 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
                 for evt in collector_client.subscribe(topic):
                     with outcomes_lock:
                         key = (evt.get("group", ""), int(evt.get("instance", -1)))
+                        if evt.get("type") == "evicted":
+                            evicted_count[0] += 1
                         # a server-side eviction (killed / partitioned
                         # instance) fills the slot so survivors and the
                         # runner stop waiting — but never rewrites a
@@ -370,6 +377,9 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
                         sync_heartbeat=float(
                             getattr(cfg, "sync_heartbeat_secs", 5.0)
                         ),
+                        test_traceparent=(
+                            getattr(job, "trace_ctx", None) or {}
+                        ).get("traceparent", ""),
                     )
                     env = {**os.environ, **params.to_env()}
                     # Instances are plain CPU processes; drop accelerator
@@ -479,6 +489,10 @@ class LocalExecRunner(Runner, HealthcheckedRunner, Terminatable):
             for (group, _), outcome in outcomes.items():
                 if group in result.outcomes and outcome == "success":
                     result.add_outcome(group, Outcome.SUCCESS)
+            if evicted_count[0]:
+                result.journal.setdefault("sync", {})["evicted"] = (
+                    evicted_count[0]
+                )
         result.update_outcome()
         ow.infof(
             "run %s finished: %s (%s)",

@@ -18,6 +18,8 @@ from testground_tpu.sdk.events import parse_event_line
 
 __all__ = [
     "PrettyPrinter",
+    "render_fleet",
+    "render_lifecycle_tree",
     "render_perf_summary",
     "render_phase_table",
     "render_sync_stats",
@@ -628,6 +630,140 @@ def render_phase_table(payload: dict) -> str:
     if _num(cov.get("bytes_frac")) is not None:
         meta += f"  byte-coverage=x{cov['bytes_frac']:.2f}"
     return "\n".join([meta] + lines)
+
+
+def render_fleet(payload: dict) -> str:
+    """Render a ``GET /fleet`` snapshot (engine.fleet_payload) as the
+    ``tg top`` screen: one header block (workers / queue / per-state
+    counts over the FULL store) plus one row per live task.
+    Shape-tolerant like every payload renderer."""
+    workers = payload.get("workers") or {}
+    queue = payload.get("queue") or {}
+    counts = payload.get("counts") or {}
+    lines = [
+        "workers {busy}/{total} busy · queue depth {depth} · "
+        "tasks {total_tasks} ({states})".format(
+            busy=_fmt_count(workers.get("busy"), "0"),
+            total=_fmt_count(workers.get("total"), "0"),
+            depth=_fmt_count(queue.get("depth"), "0"),
+            total_tasks=_fmt_count(payload.get("tasks_total"), "0"),
+            states=" ".join(
+                f"{k}={v}" for k, v in sorted(counts.items())
+            )
+            or "none",
+        )
+    ]
+    by_prio = queue.get("by_priority") or {}
+    if by_prio:
+        lines.append(
+            "queue by priority: "
+            + "  ".join(
+                f"p{p}={n}"
+                for p, n in sorted(
+                    by_prio.items(), key=lambda kv: -int(kv[0])
+                )
+            )
+        )
+    packs = (payload.get("pack") or {}).get("running")
+    if packs:
+        lines.append(f"running packs: {_fmt_count(packs)}")
+    rows = payload.get("tasks") or []
+    if not rows:
+        lines.append("(no queued or running tasks)")
+        return "\n".join(lines)
+    head = [
+        "ID", "STATE", "PRIO", "QUEUED", "RUNNING", "TICKS/S",
+        "PACK", "BREACH", "NAME",
+    ]
+    table = [head]
+    for r in rows:
+        table.append(
+            [
+                str(r.get("id", "?")),
+                str(r.get("state", "?")),
+                _fmt_count(r.get("priority"), "0"),
+                _fmt(r.get("queued_secs"), "{:.1f}s", "?"),
+                _fmt(r.get("running_secs"), "{:.1f}s", ""),
+                _fmt_rate(r.get("ticks_per_sec"))
+                if r.get("ticks_per_sec") is not None
+                else "",
+                _fmt_count(r.get("pack_width"), ""),
+                _fmt_count(r.get("breaches"), ""),
+                str(r.get("name", "")),
+            ]
+        )
+    widths = [max(len(row[i]) for row in table) for i in range(len(head))]
+    lines += [
+        "  ".join(
+            cell.ljust(w) if i in (0, 1, 8) else cell.rjust(w)
+            for i, (cell, w) in enumerate(zip(row, widths))
+        ).rstrip()
+        for row in table
+    ]
+    return "\n".join(lines)
+
+
+def render_lifecycle_tree(spans: list) -> str:
+    """Render a task's lifecycle span tree (``task_spans.jsonl`` rows —
+    engine/tracetree.py) as an indented tree: every child under its
+    parent_id, durations in ms, and the control-plane attributes that
+    explain scheduling (pack width / solo reason / outcome). Orphan
+    spans (parent_id missing from the file) render as extra roots so a
+    broken tree is VISIBLE, not silently reshaped."""
+    spans = [s for s in spans if isinstance(s, dict) and s.get("span_id")]
+    if not spans:
+        return "no lifecycle spans"
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict[str, list] = {}
+    roots = []
+    for s in spans:
+        parent = s.get("parent_id", "")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    for kids in children.values():
+        kids.sort(key=lambda s: (s.get("start_ns", 0), s["span_id"]))
+    roots.sort(key=lambda s: (s.get("start_ns", 0), s["span_id"]))
+
+    _ATTR_SKIP = (
+        "name", "trace_id", "span_id", "parent_id", "start_ns",
+        "end_ns", "kind",
+    )
+
+    def line(s: dict, depth: int) -> str:
+        dur_ms = max(0, s.get("end_ns", 0) - s.get("start_ns", 0)) / 1e6
+        text = f"{'  ' * depth}{s.get('name', '?')}"
+        if s.get("kind") == "point":
+            text += "  ·"
+        else:
+            text += f"  {dur_ms:.1f}ms"
+        attrs = {
+            k: v
+            for k, v in s.items()
+            if k not in _ATTR_SKIP and v not in ("", None)
+        }
+        if attrs:
+            text += "  " + " ".join(
+                f"{k}={v}" for k, v in sorted(attrs.items())
+            )
+        return text
+
+    out: list[str] = []
+
+    def walk(s: dict, depth: int) -> None:
+        out.append(line(s, depth))
+        for kid in children.get(s["span_id"], []):
+            walk(kid, depth + 1)
+
+    root_trace = roots[0].get("trace_id", "")
+    if root_trace:
+        out.append(f"trace {root_trace}")
+    for i, r in enumerate(roots):
+        if i:
+            out.append("(orphan subtree — parent span missing)")
+        walk(r, 0)
+    return "\n".join(out)
 
 
 _CLASS = {

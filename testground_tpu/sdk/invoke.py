@@ -50,6 +50,10 @@ def _connect_sync(env: RunEnv):
             "events_topic": f"run:{p.test_run}:{RUN_EVENTS_TOPIC}",
             "group": p.test_group_id,
             "instance": p.test_instance_seq,
+            # hello attribution: the run id lets the sync service bucket
+            # its per-task op counters (docs/CROSSHOST.md) — old servers
+            # ignore unknown identity fields, so the wire stays compatible
+            "task": p.test_run,
         },
     )
 

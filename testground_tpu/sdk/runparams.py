@@ -61,6 +61,10 @@ class RunParams:
     sync_retry_attempts: int = 8
     sync_retry_deadline: float = 60.0
     sync_heartbeat: float = 5.0
+    # control-plane trace context (W3C traceparent) threaded from the
+    # task's lifecycle trace so instance-side telemetry can join the
+    # daemon's span tree (engine/tracetree.py); empty = untraced
+    test_traceparent: str = ""
 
     def to_env(self) -> dict[str, str]:
         return {
@@ -86,6 +90,7 @@ class RunParams:
             "SYNC_RETRY_ATTEMPTS": str(self.sync_retry_attempts),
             "SYNC_RETRY_DEADLINE": str(self.sync_retry_deadline),
             "SYNC_HEARTBEAT": str(self.sync_heartbeat),
+            "TEST_TRACEPARENT": self.test_traceparent,
         }
 
     @classmethod
@@ -116,4 +121,5 @@ class RunParams:
             sync_retry_attempts=int(e.get("SYNC_RETRY_ATTEMPTS", "8") or 8),
             sync_retry_deadline=float(e.get("SYNC_RETRY_DEADLINE", "60") or 60),
             sync_heartbeat=float(e.get("SYNC_HEARTBEAT", "5") or 5),
+            test_traceparent=e.get("TEST_TRACEPARENT", ""),
         )

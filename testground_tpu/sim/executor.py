@@ -662,7 +662,8 @@ def execute_sim_run(
     spans = SpanTracer(
         os.path.join(run_dir, SPAN_FILE)
         if run_dir is not None and not job.disable_metrics
-        else None
+        else None,
+        ctx=getattr(job, "trace_ctx", None),
     )
     spans.start(
         "run", run_id=job.run_id, plan=job.test_plan, case=job.test_case
@@ -2234,7 +2235,8 @@ def execute_packed_sim_runs(
         spans = SpanTracer(
             os.path.join(run_dir, SPAN_FILE)
             if run_dir is not None and not job.disable_metrics
-            else None
+            else None,
+            ctx=getattr(job, "trace_ctx", None),
         )
         spans.start(
             "run",

@@ -227,12 +227,28 @@ class SpanTracer:
     A ``SpanTracer(None)`` is a no-op sink so call sites need no
     conditionals; failures are swallowed (observability must never fail
     the run it observes).
+
+    Every row carries the lifecycle-trace vocabulary (tracectx.py):
+    ``trace_id`` (the task's trace when ``ctx`` is given, else a fresh
+    one), a per-span ``span_id``, ``parent_id`` (the innermost open
+    span, or the context's parent — the supervisor's execute span — at
+    top level), and ``wall_ns``, so run spans and the archive-time
+    lifecycle spans merge into one Perfetto timeline without post-hoc
+    clock alignment.
     """
 
-    def __init__(self, path: str | None):
+    def __init__(self, path: str | None, ctx: dict | None = None):
+        from testground_tpu.tracectx import new_trace_id
+
+        ctx = ctx or {}
         self._path = path
         self._f = None
-        self._open: dict[str, float] = {}
+        self._trace_id = ctx.get("trace_id") or new_trace_id()
+        self._root_parent = ctx.get("parent_id", "")
+        # span name -> (monotonic t0, span_id, parent_id); plus a stack
+        # of open span names so children parent to the innermost span
+        self._open: dict[str, tuple[float, str, str]] = {}
+        self._stack: list[str] = []
         if path is not None:
             try:
                 self._f = open(path, "a", encoding="utf-8")
@@ -254,24 +270,74 @@ class SpanTracer:
         except (OSError, ValueError):
             pass
 
+    def _parent(self) -> str:
+        if self._stack:
+            rec = self._open.get(self._stack[-1])
+            if rec is not None:
+                return rec[1]
+        return self._root_parent
+
     def start(self, span: str, **attrs) -> None:
+        from testground_tpu.tracectx import new_span_id
+
         # durations come from the monotonic clock — a wall-clock step
         # (NTP slew, operator date change) mid-span must not produce a
         # negative or wildly wrong wall_secs; the emitted line keeps the
         # wall-clock ts for cross-host correlation
-        self._open[span] = time.monotonic()
-        self._emit({"type": "span_start", "span": span, **attrs})
+        parent = self._parent()
+        sid = new_span_id()
+        self._open[span] = (time.monotonic(), sid, parent)
+        self._stack.append(span)
+        self._emit(
+            {
+                "type": "span_start",
+                "span": span,
+                "trace_id": self._trace_id,
+                "span_id": sid,
+                "parent_id": parent,
+                "wall_ns": time.time_ns(),
+                **attrs,
+            }
+        )
 
     def end(self, span: str, **attrs) -> None:
-        t0 = self._open.pop(span, None)
-        if t0 is not None:
+        rec = self._open.pop(span, None)
+        sid = parent = ""
+        if rec is not None:
+            t0, sid, parent = rec
             attrs.setdefault(
                 "wall_secs", round(time.monotonic() - t0, 6)
             )
-        self._emit({"type": "span_end", "span": span, **attrs})
+            for i in range(len(self._stack) - 1, -1, -1):
+                if self._stack[i] == span:
+                    del self._stack[i]
+                    break
+        self._emit(
+            {
+                "type": "span_end",
+                "span": span,
+                "trace_id": self._trace_id,
+                "span_id": sid,
+                "parent_id": parent,
+                "wall_ns": time.time_ns(),
+                **attrs,
+            }
+        )
 
     def point(self, name: str, **attrs) -> None:
-        self._emit({"type": "point", "span": name, **attrs})
+        from testground_tpu.tracectx import new_span_id
+
+        self._emit(
+            {
+                "type": "point",
+                "span": name,
+                "trace_id": self._trace_id,
+                "span_id": new_span_id(),
+                "parent_id": self._parent(),
+                "wall_ns": time.time_ns(),
+                **attrs,
+            }
+        )
 
     def close(self) -> None:
         if self._f is not None:

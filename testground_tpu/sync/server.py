@@ -274,6 +274,7 @@ class _EventLoop(threading.Thread):
         self._dirty: set[_Conn] = set()
         self._op_done: list = []  # (op, us) — inline ops, batch-flushed
         self._op_timed: list = []  # (op, us) — released parked ops
+        self._task_ops: dict = {}  # task → ops this drain (hello attr.)
         self._compact_states: set[str] = set()
         self._compact_topics: set[str] = set()
 
@@ -347,8 +348,11 @@ class _EventLoop(threading.Thread):
                 srv.stats.op_done_batch(self._op_done)
             if self._op_timed and srv.stats is not None:
                 srv.stats.time_op_batch(self._op_timed)
+            if self._task_ops and srv.stats is not None:
+                srv.stats.task_ops_batch(self._task_ops)
             self._op_done = []
             self._op_timed = []
+            self._task_ops = {}
             dirty, self._dirty = self._dirty, set()
             for conn in dirty:
                 if not conn.dead:
@@ -462,6 +466,14 @@ class _EventLoop(threading.Thread):
             return
         rid = req.get("id", -1)
         op = req.get("op")
+        # hello attribution (docs/CROSSHOST.md): every op from a
+        # connection that introduced itself with a task id counts toward
+        # that task — accumulated per drain, batch-flushed like _op_done
+        # so the hot path takes no stats lock
+        if stats is not None and conn.hello:
+            _task = conn.hello.get("task", "")
+            if _task:
+                self._task_ops[_task] = self._task_ops.get(_task, 0) + 1
         out: dict | None = None
         try:
             if op == "signal_entry":
@@ -489,6 +501,10 @@ class _EventLoop(threading.Thread):
                     "events_topic": req.get("events_topic", ""),
                     "group": req.get("group", ""),
                     "instance": req.get("instance", -1),
+                    # run-id attribution for per-task op counters; ""
+                    # from old clients that don't send it (wire-compat:
+                    # the field is additive in both directions)
+                    "task": req.get("task", ""),
                 }
                 _ident_retag(srv, conn.hello, hello)
                 conn.hello = hello
@@ -514,6 +530,9 @@ class _EventLoop(threading.Thread):
                     if self._op_timed:
                         stats.time_op_batch(self._op_timed)
                         self._op_timed = []
+                    if self._task_ops:
+                        stats.task_ops_batch(self._task_ops)
+                        self._task_ops = {}
                     stats.op_done(op, (perf() - t_op) * 1e6)
                     topics, entries = svc.pubsub_gauges()
                     payload.update(

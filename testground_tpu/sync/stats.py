@@ -145,6 +145,11 @@ def target_bucket(target: int) -> int:
 # barrier that never releases must not leak its arm record forever
 _MAX_ARMED = 4096
 
+# distinct task ids remembered by the hello-attribution counters; a
+# long-lived service must not grow the map with every run that ever
+# connected (overflow aggregates under the "" key so Σ still conserves)
+_MAX_TASKS = 64
+
 
 class SyncStats:
     """Thread-safe sync-plane accounting (one lock, python-int adds).
@@ -185,6 +190,9 @@ class SyncStats:
         # idempotency dedup
         self.dedup_signal = 0
         self.dedup_publish = 0
+        # hello attribution: ops per task (run) id, bounded — overflow
+        # aggregates under "" so totals still conserve
+        self._task_ops: dict[str, int] = {}
 
     # ------------------------------------------------------------- ops
 
@@ -250,6 +258,23 @@ class SyncStats:
             for op, us in items:
                 if op in self.ops:
                     self._record_time_locked(op, us)
+
+    def task_ops_batch(self, items: dict) -> None:
+        """Fold one drain's per-task op counts (``{task: n}`` — hello
+        attribution, docs/CROSSHOST.md) under one lock acquisition. The
+        map is bounded: once ``_MAX_TASKS`` distinct ids are tracked,
+        new ids aggregate under ``""`` so Σ over tasks still equals the
+        attributed-op total."""
+        if not items:
+            return
+        with self._lock:
+            for task, n in items.items():
+                key = task
+                if key not in self._task_ops and len(
+                    self._task_ops
+                ) >= _MAX_TASKS:
+                    key = ""
+                self._task_ops[key] = self._task_ops.get(key, 0) + int(n)
 
     # ----------------------------------------------------- connections
 
@@ -406,6 +431,13 @@ class SyncStats:
                     "subs": self.subs_hwm,
                 },
                 "op_time_us": op_time,
+                # additive block (NOT in PARITY_FIELDS): per-task op
+                # attribution from hello's `task` field — old clients
+                # never send it, the native server never renders it, and
+                # readers treat an absent block as "no attribution"
+                "tasks": {
+                    t: n for t, n in sorted(self._task_ops.items())
+                },
             }
 
 

@@ -1,0 +1,769 @@
+"""Control-plane tracing + fleet observability (docs/OBSERVABILITY.md
+"Control plane"): causal task-lifecycle spans, the daemon event journal,
+``GET /fleet`` / ``GET /events``, the ``tg_fleet_*`` Prometheus family,
+and ``tg top``.
+
+Pins the acceptance contracts:
+
+- one submitted task traces end-to-end as a SINGLE connected span tree
+  (every parent_id resolves; the root is the submitter's ``submit``
+  span; run spans from the executor join under ``execute``);
+- pack members share ONE claim span; a solo-despite-pack run carries
+  ``solo_reason`` on its claim span;
+- the event journal is ordered (monotonic seq, across rotation) and
+  tails over ``GET /events`` with auth + 404 semantics;
+- the fleet Prometheus gauges aggregate over the FULL task store:
+  Σ ``tg_fleet_tasks`` == store count even past the per-task-series
+  truncation limit;
+- lifecycle tracing is zero-overhead for the jitted loop: the chunk
+  jaxpr is identical and no host syncs are added.
+"""
+
+import json
+import os
+import re
+import time
+
+import pytest
+
+from testground_tpu.api import generate_default_run
+from testground_tpu.config import EnvConfig
+from testground_tpu.daemon import Daemon
+from testground_tpu.engine import Outcome, State
+from testground_tpu.engine.events import EventJournal
+from testground_tpu.engine.tracetree import (
+    TASK_SPANS_FILE,
+    TASK_TRACE_FILE,
+    lifecycle_spans,
+    load_task_spans,
+)
+from testground_tpu.tracectx import TraceContext, parse_traceparent
+from tests.test_engine import (
+    make_engine,
+    mktask,
+    simple_composition,
+    simple_manifest,
+    wait_complete,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def assert_connected(spans):
+    """Every parent_id resolves to another span in the same file and
+    exactly one root exists — the tree-connectivity contract."""
+    ids = {s["span_id"] for s in spans}
+    assert len(ids) == len(spans), "duplicate span ids"
+    roots = [s for s in spans if not s["parent_id"]]
+    assert len(roots) == 1, [s["name"] for s in roots]
+    for s in spans:
+        assert s["parent_id"] == "" or s["parent_id"] in ids, s
+    return roots[0]
+
+
+# ------------------------------------------------------------ trace ctx
+
+
+class TestTraceContext:
+    def test_traceparent_round_trip(self):
+        ctx = TraceContext.mint()
+        header = ctx.to_traceparent()
+        assert re.fullmatch(
+            r"00-[0-9a-f]{32}-[0-9a-f]{16}-01", header
+        )
+        parsed = parse_traceparent(header)
+        assert parsed == (ctx.trace_id, ctx.span_id)
+
+    def test_invalid_headers_rejected(self):
+        for bad in (
+            "",
+            "garbage",
+            "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+            "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+            "00-xyz-abc-01",
+        ):
+            assert parse_traceparent(bad) is None
+        # an invalid header restarts the trace rather than failing
+        ctx = TraceContext.from_traceparent("garbage")
+        assert ctx is None
+
+    def test_child_keeps_trace_id(self):
+        ctx = TraceContext.mint()
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.parent_id == ctx.span_id
+        assert kid.span_id != ctx.span_id
+
+
+# ----------------------------------------------------- lifecycle e2e
+
+
+class TestLifecycleTraceE2E:
+    def test_submitted_task_exports_connected_tree(self, tg_home):
+        """The tentpole pin: submit with a client-minted traceparent,
+        archive, and the exported tree is singly-rooted at the
+        submitter's span with every parent resolving."""
+        engine = make_engine(tg_home)
+        engine.start_workers()
+        try:
+            ctx = TraceContext.mint()
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()),
+                simple_manifest(),
+                trace_parent=ctx.to_traceparent(),
+            )
+            t = wait_complete(engine, tid)
+            assert t.outcome() == Outcome.SUCCESS
+            # the trace rides the task record (and survives to_dict)
+            assert t.trace["trace_id"] == ctx.trace_id
+            assert t.trace["root_span_id"] == ctx.span_id
+            assert t.to_dict()["trace"]["trace_id"] == ctx.trace_id
+
+            run_dir = os.path.join(
+                engine.env.dirs.outputs(), t.plan, t.id
+            )
+            spans = load_task_spans(
+                os.path.join(run_dir, TASK_SPANS_FILE)
+            )
+            root = assert_connected(spans)
+            assert root["name"] == "submit"
+            assert root["span_id"] == ctx.span_id
+            names = {s["name"] for s in spans}
+            assert {"submit", "queued", "claim", "execute"} <= names
+            # Perfetto sibling exists and is well-formed trace-event JSON
+            trace = json.load(
+                open(os.path.join(run_dir, TASK_TRACE_FILE))
+            )
+            assert len(trace["traceEvents"]) == len(spans)
+        finally:
+            engine.stop()
+
+    def test_invalid_traceparent_restarts_trace(self, tg_home):
+        engine = make_engine(tg_home)
+        engine.start_workers()
+        try:
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()),
+                simple_manifest(),
+                trace_parent="not-a-traceparent",
+            )
+            t = wait_complete(engine, tid)
+            # a fresh trace was minted — the tree still exports connected
+            assert re.fullmatch(r"[0-9a-f]{32}", t.trace["trace_id"])
+            spans = load_task_spans(
+                os.path.join(
+                    engine.env.dirs.outputs(),
+                    t.plan,
+                    t.id,
+                    TASK_SPANS_FILE,
+                )
+            )
+            assert_connected(spans)
+        finally:
+            engine.stop()
+
+    def test_queued_secs(self, tg_home):
+        engine = make_engine(tg_home)
+        engine.start_workers()
+        try:
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()),
+                simple_manifest(),
+            )
+            t = wait_complete(engine, tid)
+            assert t.queued_secs() >= 0.0
+            # a still-queued task reports live wait
+            q = mktask(created=time.time() - 2.0)
+            assert q.queued_secs() >= 1.5
+        finally:
+            engine.stop()
+
+    def test_sim_run_spans_join_the_tree(self, tg_home):
+        """Executor SpanTracer rows (run_spans.jsonl) carry the task's
+        trace_id and parent under the execute span — the whole
+        submit→chunk tree is one connected trace."""
+        from testground_tpu.builders.sim_plan import SimPlanBuilder
+        from testground_tpu.sim.runner import SimJaxRunner
+        from tests.test_sim_runner import run_sim
+
+        engine = make_engine(
+            tg_home, runner=SimJaxRunner(), builder=SimPlanBuilder()
+        )
+        engine.start_workers()
+        try:
+            ctx = TraceContext.mint()
+            orig = engine.queue_run
+
+            def traced_queue_run(*a, **kw):
+                kw.setdefault("trace_parent", ctx.to_traceparent())
+                return orig(*a, **kw)
+
+            engine.queue_run = traced_queue_run
+            t = run_sim(
+                engine,
+                "network",
+                "ping-pong",
+                instances=2,
+                run_params={"chunk": 16},
+            )
+            assert t.outcome() == Outcome.SUCCESS
+            spans = load_task_spans(
+                os.path.join(
+                    engine.env.dirs.outputs(),
+                    "network",
+                    t.id,
+                    TASK_SPANS_FILE,
+                )
+            )
+            root = assert_connected(spans)
+            assert root["span_id"] == ctx.span_id
+            run_rows = [s for s in spans if s["kind"] == "run"]
+            assert run_rows, "no executor spans joined the tree"
+            assert all(
+                s["trace_id"] == ctx.trace_id for s in run_rows
+            )
+            # the executor's `run` span hangs off the execute span
+            execute = next(s for s in spans if s["name"] == "execute")
+            top_run = next(s for s in run_rows if s["name"] == "run")
+            assert top_run["parent_id"] == execute["span_id"]
+            # every run row stamps a wall clock
+            assert all(s["start_ns"] > 0 for s in run_rows)
+        finally:
+            engine.stop()
+
+
+# ----------------------------------------------------------- pack spans
+
+
+class TestPackClaimSpan:
+    def test_pack_members_share_one_claim_span(self, tg_home):
+        from testground_tpu.engine.supervisor import _note_claim
+
+        engine = make_engine(tg_home)
+        try:
+            a, b = mktask(), mktask()
+            _note_claim(engine, 0, [a, b])
+            assert a.trace["claim_span_id"] == b.trace["claim_span_id"]
+            assert (
+                a.trace["execute_span_id"] != b.trace["execute_span_id"]
+            )
+            assert a.trace["pack_leader"] == a.id
+            assert b.trace["pack_leader"] == a.id
+            assert a.trace["pack_width"] == 2
+            fi = engine.fleet_info()
+            assert fi["pack"]["packed"] == 1
+            assert fi["pack"]["packed_runs"] == 2
+            # both claims landed in the histograms
+            assert sum(fi["claim_latency_bins"]) == 2
+            # the claim span renders pack attrs in each member's tree
+            a.states.append(
+                type(a.states[0])(
+                    state=State.PROCESSING, created=time.time()
+                )
+            )
+            spans = lifecycle_spans(a)
+            claim = next(s for s in spans if s["name"] == "claim")
+            assert claim["pack_width"] == 2
+            assert claim["span_id"] == b.trace["claim_span_id"]
+        finally:
+            engine.stop()
+
+    def test_solo_reason_rides_the_claim_span(self, tg_home):
+        engine = make_engine(tg_home)
+        try:
+            t = mktask()
+            from testground_tpu.engine.supervisor import _note_claim
+
+            _note_claim(engine, 0, [t])
+            t.trace["solo_reason"] = "width cap"
+            engine.fleet_note_solo("width cap")
+            t.states.append(
+                type(t.states[0])(
+                    state=State.PROCESSING, created=time.time()
+                )
+            )
+            claim = next(
+                s
+                for s in lifecycle_spans(t)
+                if s["name"] == "claim"
+            )
+            assert claim["solo_reason"] == "width cap"
+            assert "pack_leader" not in claim
+            assert engine.fleet_info()["pack"]["solo"] == {
+                "width cap": 1
+            }
+        finally:
+            engine.stop()
+
+
+# -------------------------------------------------------- event journal
+
+
+class TestEventJournal:
+    def test_ordering_and_rotation(self, tmp_path):
+        path = str(tmp_path / "daemon_events.jsonl")
+        j = EventJournal(path, max_bytes=600)
+        for i in range(20):
+            j.emit("task.scheduled", task=f"t{i}", n=i)
+        assert os.path.exists(path + ".1"), "no rotation happened"
+        rows = [json.loads(l) for l in open(path + ".1")] + [
+            json.loads(l) for l in open(path)
+        ]
+        seqs = [r["seq"] for r in rows]
+        # monotonic ACROSS the rotation boundary, no resets
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        last = rows[-1]
+        assert last["type"] == "task.scheduled"
+        assert last["ts_wall_ns"] > 0 and last["ts_mono_ns"] > 0
+
+    def test_trace_ids_ride_events(self, tmp_path):
+        j = EventJournal(str(tmp_path / "ev.jsonl"))
+        trace = {"trace_id": "a" * 32, "claim_span_id": "b" * 16}
+        j.emit("task.claimed", task="t1", trace=trace)
+        row = json.loads(open(j.path).read())
+        assert row["trace_id"] == "a" * 32
+        assert row["span_id"] == "b" * 16
+
+    def test_emit_never_raises(self, tmp_path):
+        j = EventJournal(str(tmp_path / "ev.jsonl"))
+        j.emit("x", weird=object())  # non-serializable attr → swallowed
+        j.path = str(tmp_path / "no" / "such" / "dir" / "ev.jsonl")
+        j.emit("y")  # unwritable path → swallowed
+
+    def test_engine_emits_lifecycle_events_in_order(self, tg_home):
+        engine = make_engine(tg_home)
+        engine.start_workers()
+        try:
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()),
+                simple_manifest(),
+            )
+            wait_complete(engine, tid)
+            rows = [
+                json.loads(l) for l in open(engine.events.path)
+            ]
+            types = [r["type"] for r in rows if r["task"] == tid]
+            assert types.index("task.scheduled") < types.index(
+                "task.claimed"
+            )
+            assert types.index("task.claimed") < types.index(
+                "task.started"
+            )
+            assert types[-1] == "task.finished"
+            tids = {r["trace_id"] for r in rows if r["task"] == tid}
+            assert len(tids) == 1 and "" not in tids
+        finally:
+            engine.stop()
+
+    def test_operator_kill_is_journaled(self, tg_home):
+        engine = make_engine(tg_home)  # workers NOT started: stays queued
+        try:
+            tid = engine.queue_run(
+                generate_default_run(simple_composition()),
+                simple_manifest(),
+            )
+            assert engine.kill(tid)
+            types = [
+                json.loads(l)["type"]
+                for l in open(engine.events.path)
+            ]
+            assert "task.cancel_requested" in types
+            assert "task.canceled" in types
+        finally:
+            engine.stop()
+
+
+# --------------------------------------------------- daemon HTTP routes
+
+
+@pytest.fixture()
+def daemon(tg_home):
+    d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+    d.start()
+    yield d
+    d.stop()
+
+
+@pytest.fixture()
+def client(daemon):
+    from testground_tpu.client import Client
+
+    return Client(daemon.address)
+
+
+class TestDaemonFleetRoutes:
+    def test_events_404_before_first_event(self, client):
+        from testground_tpu.client import DaemonError
+
+        with pytest.raises(DaemonError, match="no events journal"):
+            list(client.events())
+
+    def test_fleet_events_and_artifact_over_http(self, client):
+        """One placebo run through the daemon with a traceparent header:
+        /fleet reflects it, /events tails it with a resumable offset,
+        and /artifact serves the exported span tree."""
+        assert client.import_plan(
+            os.path.join(PLANS, "placebo")
+        ) == "placebo"
+        ctx = TraceContext.mint()
+        task_id = client.run(
+            {
+                "metadata": {"name": "placebo-ok"},
+                "global": {
+                    "plan": "placebo",
+                    "case": "ok",
+                    "builder": "exec:py",
+                    "runner": "local:exec",
+                    "total_instances": 1,
+                },
+                "groups": [{"id": "all", "instances": {"count": 1}}],
+            },
+            trace_parent=ctx.to_traceparent(),
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            t = client.status(task_id)
+            if t["states"][-1]["state"] in ("complete", "canceled"):
+                break
+            time.sleep(0.2)
+        assert t["outcome"] == "success"
+        # the daemon minted the tree from the HTTP traceparent header
+        assert t["trace"]["trace_id"] == ctx.trace_id
+        assert t["trace"]["root_span_id"] == ctx.span_id
+
+        fleet = client.fleet()
+        assert fleet["tasks_total"] >= 1
+        assert fleet["counts"].get("complete", 0) >= 1
+        assert set(fleet["workers"]) == {"total", "busy", "idle"}
+
+        rows = list(client.events())
+        assert rows[-1]["type"] == "_tail"
+        offset = rows[-1]["offset"]
+        types = [r["type"] for r in rows[:-1]]
+        assert "task.scheduled" in types and "task.finished" in types
+        # resume from the trailer's offset: nothing new
+        again = list(client.events(since=offset))
+        assert [r for r in again if r["type"] != "_tail"] == []
+
+        raw = client.artifact(task_id, TASK_SPANS_FILE)
+        spans = [
+            json.loads(l) for l in raw.decode().splitlines() if l
+        ]
+        root = assert_connected(spans)
+        assert root["span_id"] == ctx.span_id
+
+    def test_events_bad_since_and_auth(self, tg_home):
+        from testground_tpu.client import Client, DaemonError
+
+        env = EnvConfig.load()
+        env.daemon.tokens = ["sekrit"]
+        d = Daemon(env=env, listen="localhost:0")
+        d.start()
+        try:
+            with pytest.raises(DaemonError, match="unauthorized"):
+                Client(d.address).fleet()
+            with pytest.raises(DaemonError, match="unauthorized"):
+                list(Client(d.address).events())
+            ok = Client(d.address, token="sekrit")
+            assert ok.fleet()["tasks_total"] == 0
+            with pytest.raises(DaemonError, match="invalid since"):
+                list(ok._get_stream("/events", {"since": "xyz"}))
+        finally:
+            d.stop()
+
+
+# ----------------------------------------------------------- prometheus
+
+
+class TestFleetPrometheus:
+    LINE_RE = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+        r"-?[0-9.e+-]+(\.[0-9]+)?$|^[a-zA-Z_:][a-zA-Z0-9_:]*"
+        r"(\{[^{}]*\})? \+Inf$"
+    )
+
+    def _tasks(self, n):
+        out = []
+        for i in range(n):
+            t = mktask()
+            if i % 3 == 0:
+                t.states.append(
+                    type(t.states[0])(
+                        state=State.PROCESSING, created=time.time()
+                    )
+                )
+            out.append(t)
+        return out
+
+    def test_conservation_over_full_store(self, tg_home):
+        """The fleet-total-blindness fix: Σ tg_fleet_tasks == store
+        count even when the per-task series truncate at 200."""
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        tasks = self._tasks(250)
+        text = render_prometheus(tasks, per_task_limit=200)
+        states = dict(
+            re.findall(r'tg_fleet_tasks\{state="(\w+)"\} (\d+)', text)
+        )
+        assert sum(int(v) for v in states.values()) == 250
+        assert "tg_scrape_tasks_elided 50" in text
+        # queue depth by priority covers every scheduled task
+        prio = re.findall(
+            r'tg_fleet_queue_depth\{priority="(-?\d+)"\} (\d+)', text
+        )
+        assert sum(int(v) for _, v in prio) == int(
+            states.get("scheduled", 0)
+        )
+
+    def test_fleet_block_and_histograms_render(self, tg_home):
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        engine = make_engine(tg_home)
+        try:
+            engine.fleet_note_claim(0.001, 0.0005)
+            engine.fleet_note_claim(2.0, 0.1)
+            engine.fleet_note_pack("leader", 2)
+            engine.fleet_note_solo("width cap")
+            text = render_prometheus([], fleet=engine.fleet_info())
+            for line in text.strip().splitlines():
+                if line.startswith("#"):
+                    continue
+                assert self.LINE_RE.match(line), line
+            for family in (
+                "tg_fleet_workers",
+                "tg_fleet_pack_admissions_total",
+                "tg_fleet_pack_runs_total",
+                "tg_fleet_pack_solo_total",
+                "tg_fleet_queue_wait_seconds_bucket",
+                "tg_fleet_queue_wait_seconds_sum",
+                "tg_fleet_claim_latency_seconds_count",
+            ):
+                assert family in text, family
+            assert 'reason="width cap"' in text
+            # histogram buckets are cumulative and end at +Inf == count
+            buckets = re.findall(
+                r'tg_fleet_queue_wait_seconds_bucket\{le="([^"]+)"\} '
+                r"(\d+)",
+                text,
+            )
+            counts = [int(c) for _, c in buckets]
+            assert counts == sorted(counts)
+            assert buckets[-1][0] == "+Inf" and counts[-1] == 2
+        finally:
+            engine.stop()
+
+
+# ----------------------------------------------------------------- CLI
+
+
+class TestTgTopCLI:
+    def test_top_no_follow_json(self, tg_home, capsys):
+        from testground_tpu.cli.main import main
+
+        assert main(["top", "--no-follow", "--json"]) == 0
+        out = capsys.readouterr().out.strip()
+        payload = json.loads(out)
+        assert set(payload["workers"]) == {"total", "busy", "idle"}
+        assert payload["tasks_total"] == 0
+
+    def test_top_no_follow_rendered(self, tg_home, capsys):
+        from testground_tpu.cli.main import main
+
+        assert main(["top", "--no-follow"]) == 0
+        out = capsys.readouterr().out
+        assert "workers" in out and "queue depth" in out
+
+    def test_trace_lifecycle_renders_tree(self, tg_home, capsys):
+        """`tg trace --lifecycle` against the in-process disk engine
+        reads the archived span tree from the outputs dir."""
+        from testground_tpu.runners.pretty import render_lifecycle_tree
+
+        spans = [
+            {
+                "name": "submit",
+                "trace_id": "t" * 32,
+                "span_id": "a",
+                "parent_id": "",
+                "start_ns": 0,
+                "end_ns": 3_000_000,
+                "kind": "lifecycle",
+            },
+            {
+                "name": "queued",
+                "trace_id": "t" * 32,
+                "span_id": "b",
+                "parent_id": "a",
+                "start_ns": 0,
+                "end_ns": 1_000_000,
+                "kind": "lifecycle",
+            },
+            {
+                "name": "orphan",
+                "trace_id": "t" * 32,
+                "span_id": "c",
+                "parent_id": "missing",
+                "start_ns": 2,
+                "end_ns": 2,
+                "kind": "point",
+            },
+        ]
+        out = render_lifecycle_tree(spans)
+        assert "submit" in out and "  queued" in out
+        assert "orphan subtree" in out  # broken trees are visible
+
+
+# -------------------------------------------------------- zero overhead
+
+
+class TestZeroOverhead:
+    def test_trace_ctx_does_not_shape_the_program(self):
+        """Lifecycle tracing is host-side bookkeeping: the chunk jaxpr
+        is identical whether or not a trace context exists, and the
+        SpanTracer's id stamping adds no host syncs to the jitted
+        loop."""
+        import jax
+
+        from tests.test_sim_perf import pingpong_prog
+        from testground_tpu.sim import engine as engine_mod
+        from testground_tpu.sim.telemetry import SpanTracer
+
+        a, b = pingpong_prog(), pingpong_prog()
+        carry = jax.eval_shape(lambda: a.init_carry(0))
+        assert str(jax.make_jaxpr(a._chunk_step)(carry)) == str(
+            jax.make_jaxpr(b._chunk_step)(carry)
+        )
+
+        calls = {"n": 0}
+        real = engine_mod._poll_done
+
+        def counting(done):
+            calls["n"] += 1
+            return real(done)
+
+        def run(tmpdir, ctx):
+            calls["n"] = 0
+            tracer = SpanTracer(
+                os.path.join(tmpdir, "run_spans.jsonl"), ctx=ctx
+            )
+            tracer.start("run")
+            res = pingpong_prog().run(max_ticks=128)
+            tracer.point("chunk", ticks=int(res["ticks"]))
+            tracer.end("run", outcome="success")
+            tracer.close()
+            return calls["n"], res
+
+        import unittest.mock as mock
+
+        with mock.patch.object(engine_mod, "_poll_done", counting):
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as d1:
+                n_off, res_off = run(d1, None)
+            with tempfile.TemporaryDirectory() as d2:
+                n_on, res_on = run(
+                    d2,
+                    {
+                        "trace_id": "c" * 32,
+                        "parent_id": "d" * 16,
+                    },
+                )
+        assert n_on == n_off
+        assert res_on["ticks"] == res_off["ticks"]
+
+    def test_span_rows_carry_ids_and_wall_ns(self, tmp_path):
+        from testground_tpu.sim.telemetry import SpanTracer
+
+        path = str(tmp_path / "run_spans.jsonl")
+        ctx = {"trace_id": "e" * 32, "parent_id": "f" * 16}
+        tr = SpanTracer(path, ctx=ctx)
+        tr.start("run")
+        tr.start("build")
+        tr.point("chunk", ticks=16)
+        tr.end("build")
+        tr.end("run", outcome="success")
+        tr.close()
+        from testground_tpu.sdk.events import parse_event_line
+
+        events = [
+            parse_event_line(l)[1] for l in open(path)
+        ]
+        assert all(e["trace_id"] == "e" * 32 for e in events)
+        assert all(e["wall_ns"] > 0 for e in events)
+        run_start = next(
+            e
+            for e in events
+            if e["type"] == "span_start" and e["span"] == "run"
+        )
+        build_start = next(
+            e
+            for e in events
+            if e["type"] == "span_start" and e["span"] == "build"
+        )
+        point = next(e for e in events if e["type"] == "point")
+        # nesting: run hangs off the injected parent, build and the
+        # chunk point hang off the innermost open span
+        assert run_start["parent_id"] == "f" * 16
+        assert build_start["parent_id"] == run_start["span_id"]
+        assert point["parent_id"] == build_start["span_id"]
+        run_end = next(
+            e
+            for e in events
+            if e["type"] == "span_end" and e["span"] == "run"
+        )
+        assert run_end["span_id"] == run_start["span_id"]
+
+
+# --------------------------------------------------- sync hello add-ons
+
+
+class TestSyncHelloAttribution:
+    def test_task_ops_block_is_additive_and_bounded(self):
+        from testground_tpu.sync.stats import PARITY_FIELDS, SyncStats
+
+        st = SyncStats()
+        st.task_ops_batch({"run-a": 3, "run-b": 2})
+        st.task_ops_batch({"run-a": 1})
+        snap = st.snapshot()
+        assert snap["tasks"] == {"run-a": 4, "run-b": 2}
+        # additive: the parity contract is untouched
+        assert "tasks" not in PARITY_FIELDS
+        # bounded: overflow aggregates under "" and Σ conserves
+        st2 = SyncStats()
+        for i in range(80):
+            st2.task_ops_batch({f"r{i:03d}": 1})
+        tasks = st2.snapshot()["tasks"]
+        assert len(tasks) <= 65
+        assert sum(tasks.values()) == 80
+        assert tasks[""] == 80 - 64
+
+    def test_server_attributes_ops_to_hello_task(self):
+        from testground_tpu.sync.client import SyncClient
+        from testground_tpu.sync.server import SyncServiceServer
+
+        srv = SyncServiceServer().start()
+        try:
+            host, port = srv.address
+            c = SyncClient(
+                host,
+                port,
+                namespace="run:r1:",
+                identity={
+                    "events_topic": "run:r1:events",
+                    "group": "g",
+                    "instance": 0,
+                    "task": "r1",
+                },
+            )
+            c.signal_entry("s")
+            c.signal_entry("s")
+            deadline = time.time() + 2
+            while time.time() < deadline:
+                snap = srv.stats.snapshot()
+                if snap.get("tasks", {}).get("r1", 0) >= 2:
+                    break
+                time.sleep(0.02)
+            assert snap["tasks"]["r1"] >= 2
+            c.close()
+        finally:
+            srv.stop()

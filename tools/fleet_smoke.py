@@ -1,0 +1,256 @@
+"""``make fleet-smoke``: the control-plane observability contract
+(docs/OBSERVABILITY.md "Control plane") end-to-end on the CPU backend:
+
+- a ``network:ping-pong`` run submitted with a client-minted
+  traceparent exports ``task_spans.jsonl`` as a SINGLE connected tree —
+  the submitter's span is the root, every parent id resolves, and the
+  executor's ``run_spans.jsonl`` rows join under the ``execute`` span
+  carrying the same trace id;
+- ``task_trace.json`` is valid Chrome trace-event JSON (loads in
+  Perfetto) with one event per span;
+- the daemon event journal records the lifecycle in causal order
+  (scheduled < claimed < started < finished) with monotonic seq and the
+  task's trace ids on every record;
+- the ``tg_fleet_*`` Prometheus family renders grammatically and
+  conserves: Σ ``tg_fleet_tasks`` equals the full task-store count even
+  when per-task series are truncated, and the queue-wait histogram
+  buckets are cumulative ending at ``+Inf == count``;
+- ``tg top``'s renderer produces the fleet view from the same payload
+  ``GET /fleet`` serves.
+
+Exits non-zero with a readable message on any violation. Self-contained:
+temporary $TESTGROUND_HOME, CPU backend — safe in CI (mirrors
+``tools/trace_smoke.py``).
+"""
+
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def fail(msg: str) -> "None":
+    print(f"fleet-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _load_spans(path):
+    if not os.path.isfile(path):
+        fail(f"task_spans.jsonl was not written ({path})")
+    spans = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            try:
+                spans.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                fail(f"span line {i + 1} is not JSON: {e}")
+    if not spans:
+        fail("task_spans.jsonl is empty")
+    return spans
+
+
+def _check_tree(spans, ctx):
+    ids = {s["span_id"] for s in spans}
+    if len(ids) != len(spans):
+        fail("duplicate span ids in task_spans.jsonl")
+    roots = [s for s in spans if not s["parent_id"]]
+    if len(roots) != 1:
+        fail(f"expected one root span, got {[s['name'] for s in roots]}")
+    if roots[0]["name"] != "submit" or roots[0]["span_id"] != ctx.span_id:
+        fail("the tree is not rooted at the submitter's span")
+    for s in spans:
+        if s["parent_id"] and s["parent_id"] not in ids:
+            fail(f"orphan span {s['name']}: parent {s['parent_id']}")
+        if s["trace_id"] != ctx.trace_id:
+            fail(f"span {s['name']} left the trace ({s['trace_id']})")
+    kinds = {s["kind"] for s in spans}
+    if not {"lifecycle", "run"} <= kinds:
+        fail(f"missing span kinds: have {sorted(kinds)}")
+    execute = next(s for s in spans if s["name"] == "execute")
+    run_rows = [s for s in spans if s["kind"] == "run"]
+    if not any(s["parent_id"] == execute["span_id"] for s in run_rows):
+        fail("no executor span is parented under execute")
+
+
+def _check_journal(path, task_id, trace_id):
+    if not os.path.isfile(path):
+        fail(f"daemon_events.jsonl was not written ({path})")
+    rows = [json.loads(line) for line in open(path)]
+    seqs = [r["seq"] for r in rows]
+    if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+        fail("journal seq is not strictly monotonic")
+    types = [r["type"] for r in rows if r["task"] == task_id]
+    order = ["task.scheduled", "task.claimed", "task.started",
+             "task.finished"]
+    idx = []
+    for t in order:
+        if t not in types:
+            fail(f"journal is missing {t} for the run")
+        idx.append(types.index(t))
+    if idx != sorted(idx):
+        fail(f"journal lifecycle out of order: {types}")
+    for r in rows:
+        if r["task"] == task_id and r["trace_id"] != trace_id:
+            fail(f"journal record {r['type']} lost the trace id")
+        if not (r["ts_wall_ns"] > 0 and r["ts_mono_ns"] > 0):
+            fail(f"journal record {r['type']} is missing a clock")
+
+
+_LINE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(-?[0-9.e+-]+|\+Inf)$"
+)
+
+
+def _check_prometheus(engine):
+    from testground_tpu.metrics.prometheus import render_prometheus
+
+    tasks = engine.tasks()
+    text = render_prometheus(tasks, fleet=engine.fleet_info())
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        if not _LINE_RE.match(line):
+            fail(f"exposition grammar violation: {line!r}")
+    states = dict(
+        re.findall(r'tg_fleet_tasks\{state="(\w+)"\} (\d+)', text)
+    )
+    total = sum(int(v) for v in states.values())
+    if total != len(tasks):
+        fail(
+            f"conservation: Σ tg_fleet_tasks = {total} "
+            f"!= store count {len(tasks)}"
+        )
+    buckets = re.findall(
+        r'tg_fleet_queue_wait_seconds_bucket\{le="([^"]+)"\} (\d+)', text
+    )
+    counts = [int(c) for _, c in buckets]
+    if counts != sorted(counts):
+        fail("queue-wait histogram buckets are not cumulative")
+    if not buckets or buckets[-1][0] != "+Inf":
+        fail("queue-wait histogram does not end at +Inf")
+    m = re.search(r"tg_fleet_queue_wait_seconds_count (\d+)", text)
+    if m is None or int(m.group(1)) != counts[-1]:
+        fail("queue-wait +Inf bucket != _count")
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-fleet-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from testground_tpu.api import (
+        Composition,
+        Global,
+        Group,
+        Instances,
+        TestPlanManifest,
+        generate_default_run,
+    )
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome, State
+    from testground_tpu.engine.tracetree import (
+        TASK_SPANS_FILE,
+        TASK_TRACE_FILE,
+    )
+    from testground_tpu.runners.pretty import (
+        render_fleet,
+        render_lifecycle_tree,
+    )
+    from testground_tpu.sim.runner import SimJaxRunner
+    from testground_tpu.tracectx import TraceContext
+
+    plan_dir = os.path.join(REPO_ROOT, "plans", "network")
+    manifest = TestPlanManifest.load_file(
+        os.path.join(plan_dir, "manifest.toml")
+    )
+    comp = generate_default_run(
+        Composition(
+            global_=Global(
+                plan="network",
+                case="ping-pong",
+                builder="sim:plan",
+                runner="sim:jax",
+            ),
+            groups=[Group(id="all", instances=Instances(count=2))],
+        )
+    )
+    comp.global_.run_config.update({"chunk": 16})
+
+    env = EnvConfig.load()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.start_workers()
+    try:
+        ctx = TraceContext.mint()
+        tid = engine.queue_run(
+            comp,
+            manifest,
+            sources_dir=plan_dir,
+            trace_parent=ctx.to_traceparent(),
+        )
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            t = engine.get_task(tid)
+            if t is not None and t.state().state in (
+                State.COMPLETE,
+                State.CANCELED,
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            fail(f"task {tid} did not finish within 300s")
+        if t.outcome() != Outcome.SUCCESS:
+            fail(f"run outcome {t.outcome().value}: {t.error}")
+        if t.trace.get("trace_id") != ctx.trace_id:
+            fail("the task record did not adopt the submitted trace id")
+
+        run_dir = os.path.join(env.dirs.outputs(), "network", t.id)
+        spans = _load_spans(os.path.join(run_dir, TASK_SPANS_FILE))
+        _check_tree(spans, ctx)
+        print(f"fleet-smoke: span tree connected ({len(spans)} spans)")
+
+        trace = json.load(open(os.path.join(run_dir, TASK_TRACE_FILE)))
+        events = trace.get("traceEvents")
+        if not isinstance(events, list) or len(events) != len(spans):
+            fail("task_trace.json does not mirror the span file")
+        for e in events:
+            if not {"name", "ph", "pid", "tid"} <= set(e):
+                fail(f"malformed Perfetto event: {e}")
+        print("fleet-smoke: Perfetto export OK")
+
+        _check_journal(engine.events.path, t.id, ctx.trace_id)
+        print("fleet-smoke: event journal ordered + traced")
+
+        _check_prometheus(engine)
+        print("fleet-smoke: tg_fleet_* conserves + renders")
+
+        view = render_fleet(engine.fleet_payload())
+        if "workers" not in view or "queue depth" not in view:
+            fail("render_fleet produced no fleet header")
+        tree = render_lifecycle_tree(spans)
+        for name in ("submit", "queued", "claim", "execute"):
+            if name not in tree:
+                fail(f"lifecycle tree render is missing {name}")
+        print("fleet-smoke: tg top + tg trace --lifecycle render OK")
+    finally:
+        engine.stop()
+
+    print("fleet-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
