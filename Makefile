@@ -5,9 +5,9 @@ PY ?= python
 
 .PHONY: test test-tier1 test-kernel test-e2e bench dryrun \
 	telemetry-smoke chaos-smoke trace-smoke fleet-smoke perf-smoke slo-smoke \
-	phases-smoke checkpoint-smoke crosshost-smoke pack-smoke \
-	sync-fanin-smoke transport-smoke check-smoke check-plans \
-	test-sync-tsan
+	phases-smoke checkpoint-smoke preempt-smoke crosshost-smoke \
+	pack-smoke sync-fanin-smoke transport-smoke check-smoke \
+	check-plans test-sync-tsan
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -106,6 +106,17 @@ phases-smoke:
 # snapshot refuses loudly with the typed CheckpointError
 checkpoint-smoke:
 	$(PY) tools/checkpoint_smoke.py
+
+# fleet-controller preemption contract (docs/FLEET.md) against a real
+# daemon subprocess: POST /preempt live-migrates a running task
+# (checkpoint at the next chunk boundary, requeue, auto-resume) to a
+# bit-equal completion; a priority-5 arrival evicts the busy priority-0
+# run; a composition tg check rejects is refused at submit with the
+# rule ids; SIGTERM drains (checkpoint + requeue + daemon.drain + exit
+# 0) and a restarted daemon resumes the interrupted task bit-equal;
+# tg_fleet_preemptions/evictions/refused_total exported
+preempt-smoke:
+	$(PY) tools/preempt_smoke.py
 
 # cross-host control-plane contract check (docs/CROSSHOST.md): a
 # two-"host" ping-pong with instances split across engine-less process

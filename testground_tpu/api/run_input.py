@@ -104,6 +104,11 @@ class RunInput:
     trace_ctx: dict = field(default_factory=dict)
     # EnvConfig equivalent is attached by the engine at dispatch time.
     env: Any = None
+    # preemption signal (engine/controller.py): a threading.Event the
+    # supervisor arms so the fleet controller can stop this run at a
+    # chunk boundary for live migration. Process-local — never
+    # serialized (to_dict excludes it, like env).
+    preempt: Any = None
 
     def to_dict(self) -> dict:
         return {

@@ -161,6 +161,17 @@ def _add_metadata_flags(p) -> None:
     p.add_argument("--metadata-commit", default="", help="source commit (CI)")
 
 
+def _add_priority_flag(p) -> None:
+    p.add_argument(
+        "--priority",
+        type=int,
+        default=0,
+        help="queue priority (higher runs first; against a full fleet a "
+        "positive priority may EVICT the lowest-priority running task — "
+        "docs/FLEET.md)",
+    )
+
+
 def register_run(sub) -> None:
     p = sub.add_parser("run", help="(builds and) runs a composition or single test case")
     p.set_defaults(func=_help_func(p))
@@ -190,6 +201,7 @@ def register_run(sub) -> None:
         help="queue the task and exit without waiting (the reference's "
         "non---wait mode; follow later with `tg logs -f`)",
     )
+    _add_priority_flag(pc)
     _add_metadata_flags(pc)
     pc.set_defaults(func=run_composition_cmd)
 
@@ -228,6 +240,7 @@ def register_run(sub) -> None:
         action="store_true",
         help="queue the task and exit without waiting",
     )
+    _add_priority_flag(ps)
     _add_metadata_flags(ps)
     ps.set_defaults(func=run_single_cmd)
 
@@ -254,6 +267,7 @@ def register_run(sub) -> None:
         action="store_true",
         help="queue the resumed task and exit without waiting",
     )
+    _add_priority_flag(pr)
     _add_metadata_flags(pr)
     pr.set_defaults(func=run_resume_cmd)
 
@@ -369,10 +383,12 @@ def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
         # and the daemon/engine parents every later span under it
         # (engine/tracetree.py; docs/OBSERVABILITY.md)
         submit_ctx = TraceContext.mint()
+        priority = int(getattr(args, "priority", 0) or 0)
         if isinstance(engine, RemoteEngine):
             # the daemon resolves the plan from ITS $TESTGROUND_HOME/plans
             task_id = engine.queue_run(
                 comp,
+                priority=priority,
                 created_by=created_by,
                 trace_parent=submit_ctx.to_traceparent(),
             )
@@ -382,6 +398,7 @@ def _run(args, comp: Composition, write_artifacts_to: str = "") -> int:
                 comp,
                 manifest,
                 sources_dir=src_dir,
+                priority=priority,
                 created_by=created_by,
                 trace_parent=submit_ctx.to_traceparent(),
             )
@@ -1069,10 +1086,13 @@ def tasks_cmd(args) -> int:
             created = time.strftime(
                 "%Y-%m-%d %H:%M:%S", time.localtime(t.created())
             )
+            # PRE: times this task was preempted/migrated (docs/FLEET.md)
+            preemptions = int(t.trace.get("preemptions", 0) or 0)
             print(
                 f"{t.id}  {created}  {t.name():24}  "
                 f"{t.queued_secs():6.1f}s  {t.took():7.1f}s  "
                 f"{t.state().state.value:10}  {t.type.value:5}  "
+                f"{preemptions:3}  "
                 f"{t.outcome().value}"
             )
         return 0
@@ -1791,6 +1811,40 @@ def healthcheck_cmd(args) -> int:
         engine.stop()
 
 
+def register_preempt(sub) -> None:
+    p = sub.add_parser(
+        "preempt",
+        help="checkpoint-and-requeue a running task at its next chunk "
+        "boundary (the fleet controller's live-migration verb — "
+        "docs/FLEET.md); a checkpointed run resumes bit-identically "
+        "when re-claimed",
+    )
+    p.add_argument("task", help="task id")
+    p.set_defaults(func=preempt_cmd)
+
+
+def preempt_cmd(args) -> int:
+    engine = _engine(args)
+    try:
+        res = engine.preempt(args.task)
+        if not res.get("ok"):
+            print(
+                f"preempt refused: {res.get('error', 'unknown')}",
+                file=sys.stderr,
+            )
+            return 1
+        if res.get("queued"):
+            print(f"task {args.task} is still queued — nothing to preempt")
+        else:
+            print(
+                f"task {args.task} will checkpoint and requeue at its "
+                "next chunk boundary"
+            )
+        return 0
+    finally:
+        engine.stop()
+
+
 def register_terminate(sub) -> None:
     p = sub.add_parser(
         "terminate",
@@ -1798,10 +1852,40 @@ def register_terminate(sub) -> None:
     )
     p.add_argument("--runner", default="")
     p.add_argument("--builder", default="")
+    p.add_argument(
+        "--drain",
+        action="store_true",
+        help="gracefully drain the daemon instead: stop claiming, "
+        "checkpoint + requeue running runs (they resume on restart), "
+        "cancel builds, then shut the daemon down (docs/FLEET.md)",
+    )
     p.set_defaults(func=terminate_cmd)
 
 
 def terminate_cmd(args) -> int:
+    if getattr(args, "drain", False):
+        if args.runner or args.builder:
+            print(
+                "--drain drains the whole daemon; it takes no "
+                "--runner/--builder",
+                file=sys.stderr,
+            )
+            return 1
+        engine = _engine(args)
+        try:
+            res = engine.drain()
+            print(
+                "daemon drained: {drained} worker(s) idle, "
+                "{preempted} task(s) preempted, "
+                "{canceled} build(s) canceled".format(
+                    drained=res.get("drained"),
+                    preempted=res.get("preempted", 0),
+                    canceled=res.get("canceled", 0),
+                )
+            )
+            return 0 if res.get("drained") else 1
+        finally:
+            engine.stop()
     # one component at a time, like the reference (terminate.go:38-45)
     if bool(args.runner) == bool(args.builder):
         print(

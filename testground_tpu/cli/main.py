@@ -49,6 +49,7 @@ def build_parser() -> argparse.ArgumentParser:
     commands.register_logs(sub)
     commands.register_collect(sub)
     commands.register_healthcheck(sub)
+    commands.register_preempt(sub)
     commands.register_terminate(sub)
     commands.register_daemon(sub)
     commands.register_sync_service(sub)

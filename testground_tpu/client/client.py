@@ -352,6 +352,16 @@ class Client:
     def kill(self, task_id: str) -> bool:
         return bool(self._post_json("/kill", {"task_id": task_id})["killed"])
 
+    def preempt(self, task_id: str) -> dict:
+        """POST /preempt — checkpoint-and-requeue a running task (the
+        fleet controller's live-migration verb, docs/FLEET.md)."""
+        return self._post_json("/preempt", {"task_id": task_id})
+
+    def drain(self, timeout_secs: float = 30.0) -> dict:
+        """POST /drain — gracefully drain the daemon: stop claiming,
+        checkpoint + requeue running runs, then shut down."""
+        return self._post_json("/drain", {"timeout_secs": timeout_secs})
+
     def delete(self, task_id: str) -> bool:
         """Delete a finished task's record + log (``daemon.go:88``)."""
         return bool(
@@ -515,6 +525,12 @@ class RemoteEngine:
 
     def kill(self, task_id: str) -> bool:
         return self.client.kill(task_id)
+
+    def preempt(self, task_id: str) -> dict:
+        return self.client.preempt(task_id)
+
+    def drain(self, timeout_secs: float = 30.0) -> dict:
+        return self.client.drain(timeout_secs=timeout_secs)
 
     def delete_task(self, task_id: str) -> bool:
         return self.client.delete(task_id)

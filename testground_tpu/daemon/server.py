@@ -43,6 +43,7 @@ import io
 import json
 import os
 import shutil
+import signal
 import tarfile
 import tempfile
 import threading
@@ -181,6 +182,10 @@ class _Handler(BaseHTTPRequestHandler):
             "/terminate": self._terminate,
             "/healthcheck": self._healthcheck,
             "/kill": self._kill,
+            # fleet controller (docs/FLEET.md): checkpoint-and-requeue a
+            # running task / drain the whole daemon gracefully
+            "/preempt": self._preempt,
+            "/drain": self._drain,
             "/delete": self._delete,
             "/build/purge": self._build_purge,
         }
@@ -247,6 +252,27 @@ class _Handler(BaseHTTPRequestHandler):
         if resolved is None:
             return
         plan_dir, manifest = resolved
+        if kind == "run":
+            # admission-at-submit (docs/FLEET.md): the `tg check` rules
+            # engine runs server-side BEFORE the task takes a queue
+            # slot — a composition that would only fail at claim time
+            # is refused now, with every violation and the same rule
+            # ids `tg check` reports. Daemon-boundary only: the
+            # in-process engine keeps accepting anything, so local
+            # experiments (and tests) can still queue bad compositions
+            # deliberately.
+            findings = self.engine.admission_findings(comp, manifest)
+            if findings:
+                self.engine.note_refused(
+                    comp, [f.rule for f in findings], kind=kind
+                )
+                return self._send_error_json(
+                    "composition refused at submit (tg check): "
+                    + "; ".join(
+                        f"[{f.rule}] {f.message}" for f in findings
+                    ),
+                    422,
+                )
         queue = (
             self.engine.queue_run if kind == "run" else self.engine.queue_build
         )
@@ -413,6 +439,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_json("task_id param required", 400)
         ok = self.engine.kill(task_id)
         self._send_json({"killed": bool(ok)})
+
+    def _preempt(self, body: dict) -> None:
+        """Checkpoint-and-requeue one running task (docs/FLEET.md): the
+        live-migration verb. The engine answers with queued/refused
+        detail; actually stopping happens at the run's next chunk
+        boundary."""
+        task_id = body.get("task_id")
+        if not task_id:
+            return self._send_error_json("task_id param required", 400)
+        self._send_json(self.engine.preempt(task_id))
+
+    def _drain(self, body: dict) -> None:
+        """Graceful drain + shutdown (docs/FLEET.md): stop claiming,
+        preempt running runs (checkpointed ones requeue resumable),
+        cancel builds, then exit. The drain runs inline so the response
+        carries its result; the daemon shutdown runs on a timer thread —
+        httpd.shutdown() from a handler thread's request would otherwise
+        close the socket under this very response."""
+        timeout = float(body.get("timeout_secs", 30.0) or 30.0)
+        res = self.engine.drain(timeout_secs=timeout)
+        self._send_json(res)
+        t = threading.Timer(0.2, self.daemon_ref.stop)
+        t.daemon = True
+        t.start()
 
     def _describe(self, q: dict) -> None:
         """GET /describe?plan= — the daemon-side manifest, so a remote CLI
@@ -1086,6 +1136,8 @@ class Daemon:
             (host or "localhost", int(port)), handler
         )
         self._thread: threading.Thread | None = None
+        self._stop_lock = threading.Lock()
+        self._stopped = False
 
     @property
     def address(self) -> str:
@@ -1103,6 +1155,20 @@ class Daemon:
     def serve_forever(self) -> None:
         self.engine.start_workers()
         S().info("daemon listening on %s", self.address)
+
+        def _on_sigterm(signum, frame):  # noqa: ARG001
+            # graceful drain (docs/FLEET.md): checkpoint + requeue the
+            # running work, journal daemon.drain, exit 0. Spawns a
+            # thread because the handler runs ON the serving thread —
+            # calling httpd.shutdown() here would deadlock serve_forever
+            threading.Thread(
+                target=self._drain_and_stop, daemon=True
+            ).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass  # not the main thread (embedded use) — no SIGTERM hook
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:
@@ -1110,7 +1176,20 @@ class Daemon:
         finally:
             self.stop()
 
+    def _drain_and_stop(self) -> None:
+        try:
+            self.engine.drain()
+        except Exception as e:  # noqa: BLE001 — still shut down
+            S().warning("drain on SIGTERM failed: %s", e)
+        self.stop()
+
     def stop(self) -> None:
+        # idempotent: SIGTERM-drain, /drain's timer, and serve_forever's
+        # finally may all reach here
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
         self.httpd.shutdown()
         self.httpd.server_close()
         self.engine.stop()

@@ -1,0 +1,107 @@
+"""Fleet controller: the preemption/eviction/admission decision layer.
+
+Composes the robustness organs the repo grew separately — bit-identical
+checkpoint/resume (sim/checkpoint.py), SLO cancel-at-boundary
+(sim/slo.py), pack admission (engine/pack.py), the `tg check` rules
+engine (sim/check.py), and the daemon event journal (engine/events.py) —
+into one controller loop that keeps runs alive through preemption
+(docs/FLEET.md):
+
+- **live migration**: a preemption signal (``POST /preempt``, ``tg
+  preempt``, priority eviction, daemon drain) checkpoints the running
+  task at the next chunk boundary and requeues it to resume from its
+  own newest snapshot, completing bit-equal to an uninterrupted run;
+- **priority eviction**: a high-priority arrival that cannot be
+  admitted evicts the lowest-value running task instead of queueing
+  behind it (:func:`pick_eviction_victim`);
+- **admission-at-submit**: the daemon refuses a composition the
+  ``tg check`` rules engine rejects, at submit time, with the same
+  rule ids.
+
+Import-light on purpose (stdlib only): the executor raises
+:class:`TaskPreemptedError` from inside the jax-heavy sim module, and
+the supervisor's worker thread catches it without loading jax — the
+same contract ``sim/slo.py`` keeps for :class:`SloBreachError`.
+"""
+
+from __future__ import annotations
+
+__all__ = ["TaskPreemptedError", "pick_eviction_victim"]
+
+
+class TaskPreemptedError(RuntimeError):
+    """A run stopped at a chunk boundary because its preemption signal
+    was set — not a failure: the supervisor requeues the task to resume
+    from its newest snapshot (``resumable=True``) or to rerun from
+    scratch deterministically (``resumable=False`` — the run never
+    wrote a snapshot, e.g. checkpointing was off).
+
+    Ordering contract (executor tail, ``sim/executor.py``): an operator
+    cancel wins over preemption (the task archives CANCELED), and a
+    fail-severity SLO breach wins too (the breach IS the run's verdict;
+    resuming a run the health plane already condemned would launder the
+    failure).
+    """
+
+    def __init__(
+        self,
+        run_id: str,
+        *,
+        tick: int = 0,
+        snapshot_tick: int = 0,
+        snapshots: int = 0,
+        resumable: bool = False,
+    ):
+        self.run_id = run_id
+        self.tick = int(tick)
+        self.snapshot_tick = int(snapshot_tick)
+        self.snapshots = int(snapshots)
+        self.resumable = bool(resumable)
+        super().__init__(
+            f"run {run_id} preempted at tick {tick}"
+            + (
+                f" (snapshot at tick {snapshot_tick}, will resume)"
+                if resumable
+                else " (no snapshot — will rerun from scratch)"
+            )
+        )
+
+
+def pick_eviction_victim(
+    candidates: list[dict], arriving_priority: int
+) -> dict | None:
+    """Choose which running task a high-priority arrival evicts, or
+    None when nothing should be (every candidate is at least as
+    important as the arrival).
+
+    ``candidates`` rows: ``{"id", "priority", "started" (epoch secs),
+    "checkpointed" (bool)}`` — one per running preemptible task.
+
+    Policy (lowest value lost first):
+
+    1. only tasks with ``priority < arriving_priority`` are evictable —
+       eviction must never be a lateral move, or two equal-priority
+       tenants would evict each other forever;
+    2. among those, the LOWEST priority loses first;
+    3. tie-break: prefer a checkpointed victim (it resumes from its
+       snapshot, so eviction costs at most one checkpoint interval of
+       replay — an uncheckpointed victim reruns from scratch);
+    4. final tie-break: the most recently started (least work lost).
+    """
+    evictable = [
+        c
+        for c in candidates
+        if int(c.get("priority", 0)) < int(arriving_priority)
+    ]
+    if not evictable:
+        return None
+    return min(
+        evictable,
+        key=lambda c: (
+            int(c.get("priority", 0)),
+            # False < True: uncheckpointed sorts first at equal
+            # priority — invert so checkpointed wins the min()
+            not bool(c.get("checkpointed")),
+            -float(c.get("started", 0.0)),
+        ),
+    )

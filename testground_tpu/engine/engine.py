@@ -23,6 +23,7 @@ from testground_tpu.config import EnvConfig
 from testground_tpu.logging_ import S
 from testground_tpu.tracectx import TraceContext, new_span_id, new_trace_id
 
+from .controller import pick_eviction_victim
 from .events import EVENTS_FILE, EventJournal
 from .queue import TaskQueue
 from .storage import TaskStorage
@@ -69,6 +70,12 @@ class Engine:
         # per-task cancel signals (``engine.go:59-62``)
         self._cancel_lock = threading.Lock()
         self._cancels: dict[str, threading.Event] = {}
+        # per-task preemption signals (fleet controller, docs/FLEET.md):
+        # distinct from cancel — a preempted run checkpoints at the next
+        # chunk boundary and REQUEUES instead of archiving CANCELED
+        self._preempts: dict[str, threading.Event] = {}
+        # drain flag: workers stop claiming while set (graceful SIGTERM)
+        self._draining = threading.Event()
 
         self._stop = threading.Event()
         self._queue_kick = threading.Event()
@@ -92,6 +99,10 @@ class Engine:
         self._pack_packed_runs_total = 0  # member runs admitted via packs
         self._pack_solo: dict[str, int] = {}  # solo_reason -> count
         self._running_packs: dict[str, int] = {}  # leader task id -> width
+        # fleet controller decision counters (tg_fleet_*_total)
+        self._fleet_preemptions = 0  # preempted runs requeued to resume
+        self._fleet_evictions = 0  # preemptions caused by priority arrivals
+        self._fleet_refused = 0  # compositions refused at submit
 
     # ---------------------------------------------------------------- wiring
 
@@ -271,6 +282,15 @@ class Engine:
             priority=priority,
         )
         S().info("queued task %s (%s)", tsk.id, tsk.name())
+        # fleet controller (docs/FLEET.md): a high-priority run that
+        # cannot be admitted right now evicts the lowest-value running
+        # task instead of queueing behind it
+        if typ == TaskType.RUN and priority > 0:
+            try:
+                self._maybe_evict_for(tsk)
+            except Exception as e:  # noqa: BLE001 — eviction is an
+                # optimization; a policy failure must never fail submit
+                S().warning("eviction check failed for %s: %s", tsk.id, e)
         return tsk.id
 
     # ------------------------------------------------------------ cancel/kill
@@ -323,6 +343,208 @@ class Engine:
             )
             return True
         return False
+
+    # -------------------------------------------------- fleet controller
+    # (docs/FLEET.md) preemption, eviction, admission, drain — the
+    # composition layer over checkpoint/resume + the rules engine.
+
+    def register_preempt(self, task_id: str) -> threading.Event:
+        """Idempotent get-or-create of a task's preemption signal —
+        same contract as :meth:`register_cancel`: the supervisor arms
+        it at dispatch, and a ``preempt()`` landing between queue-pop
+        and claim must find (or pre-create) the SAME event."""
+        with self._cancel_lock:
+            ev = self._preempts.get(task_id)
+            if ev is None:
+                ev = threading.Event()
+                self._preempts[task_id] = ev
+        return ev
+
+    def drop_preempt(self, task_id: str) -> None:
+        with self._cancel_lock:
+            self._preempts.pop(task_id, None)
+
+    def preempt_requested(self, task_id: str) -> bool:
+        with self._cancel_lock:
+            ev = self._preempts.get(task_id)
+        return ev is not None and ev.is_set()
+
+    def preempt(self, task_id: str) -> dict:
+        """Request live migration of a running RUN task: checkpoint at
+        the next chunk boundary, requeue, resume from the newest
+        snapshot (docs/FLEET.md). Idempotent — a double preempt sets an
+        already-set event. A still-QUEUED task is a no-op success (it
+        is already durably parked). Returns ``{"ok", "queued", ...}``;
+        refusals carry ``"error"``."""
+        tsk = self.storage.get(task_id)
+        if tsk is None:
+            return {"ok": False, "error": f"unknown task {task_id}"}
+        st = tsk.state().state
+        if st == State.SCHEDULED:
+            return {"ok": True, "queued": True}
+        if st != State.PROCESSING:
+            return {
+                "ok": False,
+                "error": (
+                    f"task {task_id} is {st.value}; only running tasks "
+                    "can be preempted"
+                ),
+            }
+        if tsk.type != TaskType.RUN:
+            return {
+                "ok": False,
+                "error": (
+                    "build tasks are not preemptible (a build has no "
+                    "carry to checkpoint — kill it instead)"
+                ),
+            }
+        ev = self.register_preempt(task_id)
+        first = not ev.is_set()
+        ev.set()
+        if first:
+            self.events.emit(
+                "task.preempt_requested", task=task_id, trace=tsk.trace
+            )
+        return {"ok": True, "queued": False}
+
+    def _maybe_evict_for(self, tsk: Task) -> None:
+        """Priority preemption: when ``tsk`` (a just-queued RUN with
+        priority > 0) finds no idle worker, evict the lowest-value
+        running task (policy: :func:`controller.pick_eviction_victim`)
+        so the arrival is claimed next. Pack members are candidates too
+        — storage.processing() lists every claimed task, not just the
+        worker-visible pack leaders."""
+        with self._fleet_lock:
+            busy = sum(1 for t in self._worker_task.values() if t)
+            total = max(len(self._workers), len(self._worker_task))
+        if total == 0 or busy < total:
+            return  # an idle worker will claim the arrival anyway
+        candidates = []
+        for cur in self.storage.processing():
+            if cur.type != TaskType.RUN or cur.id == tsk.id:
+                continue  # builds are not preemptible
+            cfg = dict(self.env.runners.get(cur.runner) or {})
+            cfg.update(
+                (cur.composition.get("global") or {}).get("run_config")
+                or {}
+            )
+            candidates.append(
+                {
+                    "id": cur.id,
+                    "priority": cur.priority,
+                    "started": cur.state().created,
+                    "checkpointed": int(cfg.get("checkpoint_chunks") or 0)
+                    > 0,
+                }
+            )
+        victim = pick_eviction_victim(candidates, tsk.priority)
+        if victim is None:
+            return
+        res = self.preempt(victim["id"])
+        if not res.get("ok"):
+            return
+        with self._fleet_lock:
+            self._fleet_evictions += 1
+        vt = self.storage.get(victim["id"])
+        self.events.emit(
+            "task.evicted",
+            task=victim["id"],
+            trace=vt.trace if vt is not None else None,
+            by=tsk.id,
+            arriving_priority=tsk.priority,
+            victim_priority=int(victim["priority"]),
+            checkpointed=bool(victim["checkpointed"]),
+        )
+        S().info(
+            "evicted task %s (priority %d) for arrival %s (priority %d)",
+            victim["id"],
+            victim["priority"],
+            tsk.id,
+            tsk.priority,
+        )
+
+    def fleet_note_preemption(self) -> None:
+        """Supervisor hook: one preempted run was requeued to resume."""
+        with self._fleet_lock:
+            self._fleet_preemptions += 1
+
+    def admission_findings(self, comp, manifest) -> list:
+        """Server-side ``tg check``: the error-severity findings the
+        rules engine (sim/check.py) raises against a composition — the
+        daemon refuses the submit when this is non-empty, with the SAME
+        rule ids ``tg check`` reports (docs/FLEET.md "Admission")."""
+        from testground_tpu.sim.check import check_composition
+
+        findings = check_composition(
+            comp,
+            manifest,
+            env_layer=self.env.runners.get(comp.global_.runner) or {},
+        )
+        return [f for f in findings if f.severity == "error"]
+
+    def note_refused(self, comp, rules: list[str], kind: str = "run") -> None:
+        """Journal + count one refused-at-submit composition."""
+        with self._fleet_lock:
+            self._fleet_refused += 1
+        self.events.emit(
+            "task.refused",
+            task_type=kind,
+            plan=comp.global_.plan,
+            case=comp.global_.case,
+            rules=list(rules),
+        )
+
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, timeout_secs: float = 30.0) -> dict:
+        """Graceful drain (docs/FLEET.md): stop claiming new tasks,
+        preempt running RUN tasks (checkpoint-enabled ones snapshot at
+        the next boundary and requeue to resume; the rest requeue to
+        rerun deterministically), cancel running BUILD tasks (a build
+        has nothing to checkpoint and is cheap to redo), then wait —
+        bounded — for every worker to park. Idempotent; journals
+        ``daemon.drain``."""
+        already = self._draining.is_set()
+        self._draining.set()
+        self._queue_kick.set()
+        preempted: list[str] = []
+        canceled: list[str] = []
+        for tsk in self.storage.processing():
+            if tsk.type == TaskType.RUN:
+                if self.preempt(tsk.id).get("ok"):
+                    preempted.append(tsk.id)
+            elif self.kill(tsk.id):
+                canceled.append(tsk.id)
+        deadline = time.monotonic() + max(0.0, timeout_secs)
+        drained = False
+        while True:
+            with self._fleet_lock:
+                busy = any(t for t in self._worker_task.values())
+            if not busy:
+                drained = True
+                break
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        self.events.emit(
+            "daemon.drain",
+            preempted=preempted,
+            canceled=canceled,
+            drained=drained,
+            already_draining=already,
+        )
+        S().info(
+            "drain: %d run(s) preempted, %d build(s) canceled, workers %s",
+            len(preempted),
+            len(canceled),
+            "idle" if drained else "still busy at timeout",
+        )
+        return {
+            "drained": drained,
+            "preempted": preempted,
+            "canceled": canceled,
+        }
 
     def delete_task(self, task_id: str) -> bool:
         """Delete a FINISHED task's record + log file (the daemon's GET
@@ -493,6 +715,11 @@ class Engine:
                     "packed_runs": self._pack_packed_runs_total,
                     "solo": dict(self._pack_solo),
                 },
+                # fleet controller decisions (docs/FLEET.md)
+                "preemptions": self._fleet_preemptions,
+                "evictions": self._fleet_evictions,
+                "refused": self._fleet_refused,
+                "draining": self._draining.is_set(),
             }
 
     @staticmethod
@@ -564,6 +791,9 @@ class Engine:
                 "priority": tsk.priority,
                 "queued_secs": round(tsk.queued_secs(), 3),
                 "trace_id": tsk.trace.get("trace_id", ""),
+                # how many times the fleet controller migrated this
+                # task (rides Task.trace so it survives requeues)
+                "preemptions": int(tsk.trace.get("preemptions", 0) or 0),
             }
             if st == State.PROCESSING:
                 row["running_secs"] = round(
@@ -589,6 +819,7 @@ class Engine:
                 "busy": busy,
                 "idle": max(0, n_workers - busy),
             },
+            "draining": self._draining.is_set(),
             "queue": {
                 "depth": counts.get(State.SCHEDULED.value, 0),
                 "by_priority": {str(k): v for k, v in by_priority.items()},

@@ -73,6 +73,17 @@ class TaskQueue:
         self._storage.persist_scheduled(tsk)
         heapq.heappush(self._heap, _Entry(tsk))
 
+    def requeue(self, tsk: Task) -> None:
+        """Put a claimed (PROCESSING) task back on the queue — the fleet
+        controller's preempt/drain/evict path (docs/FLEET.md). Bypasses
+        the size bound: the task already held a queue slot once, and a
+        full queue must never strand a checkpointed evictee in limbo.
+        The caller appends the SCHEDULED state first; storage moves the
+        record current → queue atomically."""
+        with self._lock:
+            self._storage.persist_rescheduled(tsk)
+            heapq.heappush(self._heap, _Entry(tsk))
+
     def push_unique_by_branch(self, tsk: Task) -> None:
         """Cancel queued tasks from the same repo+branch, then push
         (``queue.go:79-96``)."""

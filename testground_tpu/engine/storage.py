@@ -79,6 +79,14 @@ class TaskStorage:
         """Task moved queue → current (``storage.go:147-151``)."""
         self._move(tsk, BUCKET_CURRENT, (BUCKET_QUEUE,))
 
+    def persist_rescheduled(self, tsk: Task) -> None:
+        """A preempted/drained task moved current → queue (the fleet
+        controller's requeue, docs/FLEET.md). Clearing the CURRENT row
+        in the same transaction matters: ``get()`` prefers CURRENT over
+        QUEUE, so a plain ``persist_scheduled`` would leave a stale
+        PROCESSING record shadowing the requeued one."""
+        self._move(tsk, BUCKET_QUEUE, (BUCKET_CURRENT,))
+
     def update_current(self, tsk: Task) -> None:
         self._move(tsk, BUCKET_CURRENT, ())
 

@@ -33,6 +33,7 @@ from testground_tpu.rpc import OutputWriter
 from testground_tpu.sim.slo import SloBreachError
 from testground_tpu.tracectx import new_span_id, new_trace_id
 
+from .controller import TaskPreemptedError
 from .engine import Engine
 from .notify import notify_task_finished, notify_task_started
 from .pack import _truthy
@@ -54,6 +55,13 @@ def worker(engine: Engine, idx: int) -> None:
 
     S().debug("supervisor worker %d started", idx)
     while not engine._stop.is_set():
+        # graceful drain (docs/FLEET.md): a draining daemon stops
+        # claiming — requeued/queued tasks stay durably parked for the
+        # restarted daemon to rehydrate
+        if engine._draining.is_set():
+            engine._queue_kick.wait(timeout=0.2)
+            engine._queue_kick.clear()
+            continue
         try:
             tsk = engine.queue.pop()
         except QueueEmptyError:
@@ -61,12 +69,14 @@ def worker(engine: Engine, idx: int) -> None:
             engine._queue_kick.clear()
             continue
         pack = claim_pack(engine, tsk)
-        # close the kill() race before any claim bookkeeping: the tasks
-        # are already stamped PROCESSING (queue.pop), so an operator
-        # cancel arriving now must find a registered event, not fall
-        # between cancel_queued and process_task's registration
+        # close the kill()/preempt() race before any claim bookkeeping:
+        # the tasks are already stamped PROCESSING (queue.pop), so an
+        # operator cancel OR a preemption arriving now must find a
+        # registered event, not fall between cancel_queued and
+        # process_task's registration
         for member in pack:
             engine.register_cancel(member.id)
+            engine.register_preempt(member.id)
         _note_claim(engine, idx, pack)
         engine.fleet_worker_state(idx, tsk.id)
         try:
@@ -96,6 +106,19 @@ def _note_claim(engine: Engine, idx: int, pack: list[Task]) -> None:
         tr.setdefault("trace_id", new_trace_id())
         tr.setdefault("root_span_id", new_span_id())
         tr.setdefault("queued_span_id", new_span_id())
+        if tr.get("claim_span_id") and tr.get("execute_span_id"):
+            # a re-claim (preemption requeue or restart rehydration):
+            # keep the prior attempt's ids so the executor spans it
+            # parented still resolve in the archived tree (bounded —
+            # a chaos soak must not grow the trace without limit)
+            prior = tr.setdefault("prior_attempts", [])
+            prior.append(
+                {
+                    "claim": tr["claim_span_id"],
+                    "execute": tr["execute_span_id"],
+                }
+            )
+            del prior[:-16]
         tr["claim_span_id"] = claim_sid
         tr["execute_span_id"] = new_span_id()
         if len(pack) > 1:
@@ -178,6 +201,19 @@ def _post_run_events(engine: Engine, tsk: Task) -> None:
                     trace=tsk.trace,
                     resumed=ck["resumed"],
                 )
+                fb = (
+                    ck["resumed"].get("fallback")
+                    if isinstance(ck["resumed"], dict)
+                    else None
+                )
+                if isinstance(fb, dict):
+                    engine.events.emit(
+                        "task.resume_fallback",
+                        task=tsk.id,
+                        trace=tsk.trace,
+                        skipped=list(fb.get("skipped", [])),
+                        error=str(fb.get("error", ""))[:200],
+                    )
         sync = journal.get("sync")
         if isinstance(sync, dict) and sync.get("evicted"):
             engine.events.emit(
@@ -206,6 +242,56 @@ def _finish_task(engine: Engine, tsk: Task) -> None:
     export_task_trace(engine.env.dirs.outputs(), tsk)
 
 
+def _requeue_preempted(
+    engine: Engine, tsk: Task, e: TaskPreemptedError
+) -> None:
+    """Live migration's requeue half (docs/FLEET.md): the executor
+    stopped the run at a chunk boundary and raised; put the task back on
+    the queue pointing at its own newest snapshot so the next claim
+    resumes instead of restarting. NO terminal state, NO archive, NO
+    webhook — the task never finished. When the preemption is not
+    resumable (no snapshots: ckpt_every=0, or a pack member — packed
+    lanes freeze on-device, never on disk) the composition is left
+    untouched and the rerun starts from scratch; determinism still
+    yields the bit-equal result."""
+    if e.resumable:
+        glob = tsk.composition.setdefault("global", {})
+        rc = glob.setdefault("run_config", {})
+        # own-snapshot preference (sim/executor.py): even if this run
+        # itself resumed from another task, its own snapshots are newer
+        rc["resume_from"] = tsk.id
+    tsk.trace["preemptions"] = int(tsk.trace.get("preemptions", 0) or 0) + 1
+    tsk.error = ""
+    tsk.result = None
+    tsk.states.append(DatedState(state=State.SCHEDULED, created=time.time()))
+    engine.queue.requeue(tsk)
+    engine.fleet_note_preemption()
+    engine.events.emit(
+        "task.preempted",
+        task=tsk.id,
+        trace=tsk.trace,
+        tick=e.tick,
+        snapshot_tick=e.snapshot_tick,
+        snapshots=e.snapshots,
+        resumable=e.resumable,
+        preemptions=int(tsk.trace["preemptions"]),
+    )
+    engine.events.emit(
+        "task.migrated",
+        task=tsk.id,
+        trace=tsk.trace,
+        resume_from=tsk.id if e.resumable else "",
+        from_tick=e.snapshot_tick if e.resumable else 0,
+    )
+    engine._queue_kick.set()
+    S().info(
+        "task %s preempted at tick %d (%s) — requeued",
+        tsk.id,
+        e.tick,
+        f"resume from tick {e.snapshot_tick}" if e.resumable else "rerun",
+    )
+
+
 def process_task(engine: Engine, tsk: Task) -> None:
     """Execute one task end-to-end, with timeout and cancellation."""
     timeout = engine.env.daemon.scheduler.task_timeout_min * 60 or (
@@ -217,6 +303,7 @@ def process_task(engine: Engine, tsk: Task) -> None:
     timer.start()
 
     log_path = engine.task_log_path(tsk.id)
+    preempted: TaskPreemptedError | None = None
     try:
         with open(log_path, "w") as log_file:
             ow = OutputWriter(sink=log_file)
@@ -238,6 +325,11 @@ def process_task(engine: Engine, tsk: Task) -> None:
                 else:
                     raise ValueError(f"unsupported task type {tsk.type}")
                 tsk.result = result
+            except TaskPreemptedError as e:
+                # not a failure: the fleet controller stopped the run at
+                # a chunk boundary — the finally branch requeues it
+                preempted = e
+                ow.infof("%s", e)
             except Exception as e:  # noqa: BLE001 — task errors become results
                 S().error("task %s failed: %s", tsk.id, e)
                 ow.write_error(str(e))
@@ -255,17 +347,25 @@ def process_task(engine: Engine, tsk: Task) -> None:
     finally:
         timer.cancel()
         engine.drop_cancel(tsk.id)
-        final = State.CANCELED if cancel.is_set() and tsk.error else State.COMPLETE
-        tsk.states.append(DatedState(state=final, created=time.time()))
-        # journal + span-tree export BEFORE the archive makes the
-        # terminal state visible: a client polling for COMPLETE must
-        # find task_spans.jsonl already on disk
-        _finish_task(engine, tsk)
-        engine.storage.archive(tsk)
-        # status webhooks: log-and-continue, never affect the task
-        # (supervisor.go:176-183)
-        notify_task_finished(engine.env, tsk)
-        S().info("task %s finished: %s", tsk.id, tsk.outcome().value)
+        engine.drop_preempt(tsk.id)
+        if preempted is not None:
+            _requeue_preempted(engine, tsk, preempted)
+        else:
+            final = (
+                State.CANCELED
+                if cancel.is_set() and tsk.error
+                else State.COMPLETE
+            )
+            tsk.states.append(DatedState(state=final, created=time.time()))
+            # journal + span-tree export BEFORE the archive makes the
+            # terminal state visible: a client polling for COMPLETE must
+            # find task_spans.jsonl already on disk
+            _finish_task(engine, tsk)
+            engine.storage.archive(tsk)
+            # status webhooks: log-and-continue, never affect the task
+            # (supervisor.go:176-183)
+            notify_task_finished(engine.env, tsk)
+            S().info("task %s finished: %s", tsk.id, tsk.outcome().value)
 
 
 def _prepare_pack_run_input(
@@ -335,6 +435,11 @@ def _prepare_pack_run_input(
         ],
         trace_ctx=_run_trace_ctx(tsk),
         env=engine.env,
+        # eviction of a pack member stops its lanes at the next chunk
+        # boundary via the same in-program freeze cancellation uses;
+        # the requeued member reruns from scratch (no disk snapshots
+        # inside a pack — engine/pack.py excludes checkpointing)
+        preempt=engine.register_preempt(tsk.id),
     )
 
 
@@ -364,6 +469,7 @@ def process_task_pack(engine: Engine, tasks: list[Task]) -> None:
                 "ow": OutputWriter(sink=log_file),
                 "result": None,
                 "error": "",
+                "preempted": None,
             }
         )
         engine.storage.update_current(tsk)
@@ -435,6 +541,12 @@ def process_task_pack(engine: Engine, tasks: list[Task]) -> None:
                             "outcome": Outcome.FAILURE.value,
                             "composition": comp_dict,
                         }
+                    elif isinstance(out, TaskPreemptedError):
+                        # evicted pack member: the finally loop requeues
+                        # it instead of archiving (never resumable — no
+                        # disk snapshots inside a pack)
+                        ctx["preempted"] = out
+                        ctx["ow"].infof("%s", out)
                     elif isinstance(out, Exception):
                         ctx["ow"].write_error(str(out))
                         ctx["error"] = str(out)
@@ -463,6 +575,9 @@ def process_task_pack(engine: Engine, tasks: list[Task]) -> None:
                 ctx["result"] = do_run(
                     engine, ctx["tsk"], ctx["ow"], ctx["cancel"]
                 )
+            except TaskPreemptedError as e:
+                ctx["preempted"] = e
+                ctx["ow"].infof("%s", e)
             except Exception as e:  # noqa: BLE001
                 ctx["ow"].write_error(str(e))
                 ctx["error"] = str(e)
@@ -476,6 +591,16 @@ def process_task_pack(engine: Engine, tasks: list[Task]) -> None:
     finally:
         for ctx in ctxs:
             tsk = ctx["tsk"]
+            if ctx["preempted"] is not None:
+                ctx["timer"].cancel()
+                engine.drop_cancel(tsk.id)
+                engine.drop_preempt(tsk.id)
+                _requeue_preempted(engine, tsk, ctx["preempted"])
+                try:
+                    ctx["log"].close()
+                except OSError:
+                    pass
+                continue
             tsk.result = ctx["result"] or {
                 "outcome": Outcome.FAILURE.value
             }
@@ -488,6 +613,7 @@ def process_task_pack(engine: Engine, tasks: list[Task]) -> None:
                     pass
             ctx["timer"].cancel()
             engine.drop_cancel(tsk.id)
+            engine.drop_preempt(tsk.id)
             final = (
                 State.CANCELED
                 if ctx["cancel"].is_set() and tsk.error
@@ -731,6 +857,13 @@ def do_run(
             ],
             trace_ctx=_run_trace_ctx(tsk),
             env=engine.env,
+            # live migration (docs/FLEET.md): single-[[runs]] tasks only —
+            # a multi-run task's partial results have no requeue story
+            preempt=(
+                engine.register_preempt(tsk.id)
+                if len(comp.runs) == 1
+                else None
+            ),
         )
         ow.infof(
             "executing run %s: plan=%s case=%s instances=%d runner=%s",
@@ -743,6 +876,10 @@ def do_run(
         t_run = time.monotonic()
         try:
             out = runner.run(rinput, ow, cancel)
+        except TaskPreemptedError:
+            # never a per-run failure: only armed for single-[[runs]]
+            # tasks, and process_task's dedicated handler requeues
+            raise
         except SloBreachError as e:
             # typed run-health failure (docs/OBSERVABILITY.md "Run health
             # plane"): the run was canceled at a chunk boundary because a

@@ -56,11 +56,22 @@ def lifecycle_spans(tsk: Task) -> list[dict]:
         return []
     t0 = int(tsk.states[0].created * _NS)
     t_final = int(tsk.states[-1].created * _NS)
-    t_proc = None
+    # PROCESSING episodes: a preempted task re-queues (SCHEDULED) and is
+    # claimed again, so one task can hold several [claim..requeue) spans
+    episodes: list[tuple[int, int]] = []
+    ep_start = None
     for ds in tsk.states[1:]:
+        ts = int(ds.created * _NS)
         if ds.state == State.PROCESSING:
-            t_proc = int(ds.created * _NS)
-            break
+            if ep_start is not None:
+                episodes.append((ep_start, ts))
+            ep_start = ts
+        elif ds.state == State.SCHEDULED and ep_start is not None:
+            episodes.append((ep_start, ts))
+            ep_start = None
+    if ep_start is not None:
+        episodes.append((ep_start, t_final))
+    t_proc = episodes[0][0] if episodes else None
 
     def span(name, sid, parent, start, end, kind="lifecycle", **attrs):
         return {
@@ -103,12 +114,34 @@ def lifecycle_spans(tsk: Task) -> list[dict]:
             attrs["pack_width"] = tr.get("pack_width", 0)
         if tr.get("solo_reason"):
             attrs["solo_reason"] = tr["solo_reason"]
-        out.append(
-            span("claim", claim, queued or root, t_proc, t_final, **attrs)
+        # one claim/execute pair per attempt — earlier (preempted)
+        # attempts kept their span ids in trace["prior_attempts"] so
+        # the executor spans they parented still join the tree
+        attempts = list(tr.get("prior_attempts") or [])
+        attempts.append(
+            {"claim": claim, "execute": tr.get("execute_span_id", "")}
         )
-        execute = tr.get("execute_span_id", "")
-        if execute:
-            out.append(span("execute", execute, claim, t_proc, t_final))
+        eps = episodes[-len(attempts):]
+        while len(eps) < len(attempts):
+            eps.insert(0, (t_proc, t_final))
+        for i, (att, (ep_s, ep_e)) in enumerate(zip(attempts, eps)):
+            last = i == len(attempts) - 1
+            a = dict(attrs) if last else {"preempted": True}
+            if len(attempts) > 1:
+                a["attempt"] = i + 1
+            out.append(
+                span(
+                    "claim", att.get("claim", ""), queued or root,
+                    ep_s, ep_e, **a,
+                )
+            )
+            if att.get("execute"):
+                out.append(
+                    span(
+                        "execute", att["execute"], att.get("claim", ""),
+                        ep_s, ep_e,
+                    )
+                )
     out.append(
         span(
             "archive",

@@ -397,6 +397,33 @@ def render_prometheus(
                 {"reason": str(reason)[:120]},
                 solo[reason],
             )
+        # fleet controller counters (docs/FLEET.md): preempt/evict/refuse
+        # decisions since daemon start
+        exp.add(
+            "tg_fleet_preemptions_total",
+            "counter",
+            "Running tasks checkpointed and requeued by the fleet "
+            "controller (operator preempt, eviction, or drain) since "
+            "daemon start.",
+            {},
+            fleet.get("preemptions", 0),
+        )
+        exp.add(
+            "tg_fleet_evictions_total",
+            "counter",
+            "Running tasks preempted to admit a higher-priority arrival "
+            "since daemon start.",
+            {},
+            fleet.get("evictions", 0),
+        )
+        exp.add(
+            "tg_fleet_refused_total",
+            "counter",
+            "Compositions refused at submit by the admission rules "
+            "engine (tg check server-side) since daemon start.",
+            {},
+            fleet.get("refused", 0),
+        )
 
     # truncation is NEVER silent (the render_prometheus contract): a
     # scraper can alert on elided > 0 instead of trusting an invisibly

@@ -653,6 +653,10 @@ def render_fleet(payload: dict) -> str:
             or "none",
         )
     ]
+    if payload.get("draining"):
+        # graceful drain in progress (docs/FLEET.md): workers park,
+        # running tasks checkpoint + requeue
+        lines.append("DRAINING — not claiming; running tasks checkpointing")
     by_prio = queue.get("by_priority") or {}
     if by_prio:
         lines.append(
@@ -673,7 +677,7 @@ def render_fleet(payload: dict) -> str:
         return "\n".join(lines)
     head = [
         "ID", "STATE", "PRIO", "QUEUED", "RUNNING", "TICKS/S",
-        "PACK", "BREACH", "NAME",
+        "PACK", "PRE", "BREACH", "NAME",
     ]
     table = [head]
     for r in rows:
@@ -688,6 +692,8 @@ def render_fleet(payload: dict) -> str:
                 if r.get("ticks_per_sec") is not None
                 else "",
                 _fmt_count(r.get("pack_width"), ""),
+                # PRE: times this task was preempted/migrated so far
+                _fmt_count(r.get("preemptions"), ""),
                 _fmt_count(r.get("breaches"), ""),
                 str(r.get("name", "")),
             ]
@@ -695,7 +701,7 @@ def render_fleet(payload: dict) -> str:
     widths = [max(len(row[i]) for row in table) for i in range(len(head))]
     lines += [
         "  ".join(
-            cell.ljust(w) if i in (0, 1, 8) else cell.rjust(w)
+            cell.ljust(w) if i in (0, 1, 9) else cell.rjust(w)
             for i, (cell, w) in enumerate(zip(row, widths))
         ).rstrip()
         for row in table

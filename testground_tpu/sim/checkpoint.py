@@ -38,7 +38,10 @@ Contract (the discipline every plane in this repo carries):
 - **Refuse loudly, never resume garbage.** A corrupt/truncated archive,
   a manifest that fails validation, or a snapshot from a different
   composition/plan-source/transport raises :class:`CheckpointError`
-  naming exactly what mismatched.
+  naming exactly what mismatched. Resume falls back LOUDLY from an
+  unloadable newest snapshot to the next retained one (warned +
+  journaled, see :func:`load_latest`); only when every retained
+  snapshot is unloadable does the resume refuse.
 """
 
 from __future__ import annotations
@@ -47,6 +50,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import random
 import time
 import zipfile
 
@@ -75,6 +79,16 @@ CHECKPOINT_DIR = "checkpoints"
 _PREFIX = "ckpt-"
 _SUFFIX = ".npz"
 _TICK_WIDTH = 12  # zero-padded so lexical order == tick order
+
+# Resume-load retry budget (the influx exporter's idiom, metrics/
+# influx.py): a snapshot being fetched or copied for a migration can
+# hit transient I/O that is indistinguishable from corruption on the
+# first read — retry with bounded exponential backoff + jitter before
+# declaring the candidate unloadable. Module-level so tests can shrink
+# the waits.
+_RETRY_ATTEMPTS = 3
+_RETRY_BASE_SECS = 0.25
+_RETRY_JITTER_SECS = 0.1
 
 # Bumped when the archive layout changes; a mismatch refuses to resume
 # (an old snapshot must never be silently reinterpreted).
@@ -458,22 +472,64 @@ def load_snapshot(path: str) -> tuple[dict, list]:
     return manifest, leaves
 
 
+def _load_snapshot_retrying(path: str) -> tuple[dict, list]:
+    """:func:`load_snapshot` under the bounded retry budget above."""
+    last: CheckpointError | None = None
+    for attempt in range(1, _RETRY_ATTEMPTS + 1):
+        try:
+            return load_snapshot(path)
+        except CheckpointError as e:
+            last = e
+            if attempt < _RETRY_ATTEMPTS:
+                time.sleep(
+                    _RETRY_BASE_SECS * 2 ** (attempt - 1)
+                    + random.uniform(0, _RETRY_JITTER_SECS)
+                )
+    raise last  # type: ignore[misc]  # loop always sets it
+
+
 def load_latest(run_dir: str) -> tuple[dict, list, str]:
-    """Load the NEWEST snapshot of a run dir → ``(manifest, leaves,
-    path)``. No snapshots → :class:`CheckpointError`. A corrupt newest
-    snapshot refuses loudly too (no silent fallback to an older tick —
-    resuming further back than the operator believes is its own kind of
-    garbage); the error names the file so the operator can delete it and
-    fall back deliberately."""
+    """Load the newest LOADABLE snapshot of a run dir → ``(manifest,
+    leaves, path)``. No snapshots → :class:`CheckpointError`.
+
+    Each candidate load gets the bounded retry budget above, so
+    transient I/O during a migration fetch does not read as corruption.
+    A newest snapshot that still fails falls back LOUDLY to the next
+    retained one: the fallback rides the returned manifest
+    (``_fallback``: skipped files + the first error) so the resume
+    warns and journals it — resuming from an older tick *silently*
+    would be its own kind of garbage, but refusing a run that holds a
+    perfectly good previous snapshot strands exactly the preempted runs
+    checkpointing exists for. Only when EVERY retained snapshot is
+    unloadable does the resume refuse."""
     snaps = list_snapshots(run_dir)
     if not snaps:
         raise CheckpointError(
             f"no snapshots under {os.path.join(run_dir, CHECKPOINT_DIR)} — "
             "was the run checkpointed (--run-cfg checkpoint_chunks=K)?"
         )
-    _, path = snaps[-1]
-    manifest, leaves = load_snapshot(path)
-    return manifest, leaves, path
+    skipped: list[str] = []
+    first_error = ""
+    for _, path in reversed(snaps):
+        try:
+            manifest, leaves = _load_snapshot_retrying(path)
+        except CheckpointError as e:
+            if not skipped:
+                first_error = str(e)
+            skipped.append(os.path.basename(path))
+            continue
+        if skipped:
+            manifest["_fallback"] = {
+                "skipped": list(skipped),
+                "error": first_error[:300],
+            }
+        return manifest, leaves, path
+    raise CheckpointError(
+        "every retained snapshot under "
+        f"{os.path.join(run_dir, CHECKPOINT_DIR)} is corrupt or "
+        f"unreadable ({', '.join(skipped)}) — refusing to resume; "
+        f"newest failed with: {first_error}"
+    )
 
 
 def validate_manifest(manifest: dict, identity: dict) -> None:

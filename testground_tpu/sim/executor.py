@@ -475,6 +475,27 @@ class _SloRunCancel:
         return self.run_local.is_set() or self._task.is_set()
 
 
+class _PreemptRunCancel:
+    """OR-composition of the fleet controller's preemption signal with
+    the run's existing cancel object (task cancel, or the SLO wrapper)
+    — live migration's stop path (engine/controller.py, docs/FLEET.md).
+    The chunk loop stops at the next boundary when either fires; the
+    preempt observer has already forced a snapshot at that same
+    boundary, so the requeued task resumes exactly where it stopped.
+    ``set()`` keeps task-level semantics (stall watchdog et al.), same
+    as :class:`_SloRunCancel`."""
+
+    def __init__(self, inner, preempt):
+        self._inner = inner
+        self._preempt = preempt
+
+    def set(self) -> None:
+        self._inner.set()
+
+    def is_set(self) -> bool:
+        return self._preempt.is_set() or self._inner.is_set()
+
+
 def _parse_hosts(raw) -> tuple[str, ...]:
     """Normalize the additional_hosts config: a TOML list, or a
     comma-separated string like the reference's ADDITIONAL_HOSTS env var
@@ -674,8 +695,15 @@ def execute_sim_run(
         )
     except BaseException as e:
         # failed runs keep their span record — those are exactly the
-        # ones an operator wants to inspect
-        spans.end("run", outcome="error", error=str(e)[:200])
+        # ones an operator wants to inspect. A preemption is not a
+        # failure: the span says so, and the requeued attempt's spans
+        # join the same lifecycle tree (engine/tracetree.py).
+        from testground_tpu.engine.controller import TaskPreemptedError
+
+        outcome = (
+            "preempted" if isinstance(e, TaskPreemptedError) else "error"
+        )
+        spans.end("run", outcome=outcome, error=str(e)[:200])
         raise
     finally:
         spans.close()
@@ -1134,6 +1162,20 @@ def _execute_sim_run(
                 "from_run": source_run,
                 "snapshot": os.path.basename(resume_state.path),
             }
+            fb = resume_state.manifest.get("_fallback")
+            if fb:
+                # loud fallback (sim/checkpoint.py load_latest): newer
+                # retained snapshot(s) were unloadable — the resume
+                # continues from an older tick, and says so everywhere
+                resume_info["fallback"] = dict(fb)
+                ow.warn(
+                    "sim:jax %s: newest snapshot(s) unloadable (%s) — "
+                    "falling back to %s: %s",
+                    job.run_id,
+                    ", ".join(fb.get("skipped", [])),
+                    resume_info["snapshot"],
+                    fb.get("error", ""),
+                )
             ow.infof(
                 "sim:jax %s: resuming from snapshot %s (tick %d, run %s)",
                 job.run_id,
@@ -1141,7 +1183,11 @@ def _execute_sim_run(
                 resume_state.tick,
                 resume_info["from_run"],
             )
-            spans.point("resume", **resume_info)
+            spans.point(
+                "resume",
+                **{k: v for k, v in resume_info.items() if k != "fallback"},
+                fallback_skipped=len((fb or {}).get("skipped", [])),
+            )
 
     # duration math runs on the monotonic clock (a wall-clock step —
     # NTP slew, operator date change — must not produce negative chunk
@@ -1376,6 +1422,18 @@ def _execute_sim_run(
     else:
         run_cancel = cancel
 
+    # Fleet-controller preemption (docs/FLEET.md): the supervisor arms
+    # job.preempt for solo single-[[runs]] RUN tasks; when it fires the
+    # loop stops at the next chunk boundary and the tail raises
+    # TaskPreemptedError so the task requeues instead of archiving.
+    # Not armed under a cohort — checkpointing is disabled there, and
+    # cancellation must stay a lockstep cohort decision (CohortCancel).
+    preempt_ev = getattr(job, "preempt", None)
+    if multi:
+        preempt_ev = None
+    if preempt_ev is not None:
+        run_cancel = _PreemptRunCancel(run_cancel, preempt_ev)
+
     def on_stall(last_tick: int, chunk_index: int) -> None:
         # the stall diagnostic must outlive the failing run: a span
         # point in run_spans.jsonl plus a task-log line, both carrying
@@ -1503,6 +1561,23 @@ def _execute_sim_run(
         )
         if o is not None
     ]
+    if preempt_ev is not None and checkpointer is not None:
+        # live migration's snapshot-at-the-stopping-boundary: the
+        # observer fires BEFORE the loop's cancel check (sim/engine.py
+        # chunk loop), so when preemption lands the forced snapshot and
+        # the stop happen at the SAME boundary — the resumed run
+        # replays nothing. Runs after the periodic checkpointer.observe
+        # above, whose write (if this boundary was a K-th one) makes
+        # last_tick == ticks and skips the duplicate.
+
+        def _preempt_observe(ticks, carry):
+            if (
+                preempt_ev.is_set()
+                and checkpointer.last_tick != int(ticks)
+            ):
+                checkpointer.snapshot(int(ticks), carry)
+
+        _observers.append(_preempt_observe)
     if not _observers:
         _observer = None
     elif len(_observers) == 1:
@@ -2105,6 +2180,41 @@ def _execute_sim_run(
         result.journal["slo"]["error"] = str(err)
         err.run_output = RunOutput(run_id=job.run_id, result=result)
         raise err
+    # fleet-controller preemption (docs/FLEET.md): the loop stopped at a
+    # chunk boundary because the preempt signal fired. Raise the typed
+    # error so the supervisor requeues the task to resume — AFTER the
+    # SLO block (a condemned run must not launder its failure into a
+    # migration) and only when the operator did not cancel (a kill
+    # stays CANCELED).
+    if (
+        preempt_ev is not None
+        and preempt_ev.is_set()
+        and not cancel.is_set()
+    ):
+        from testground_tpu.engine.controller import TaskPreemptedError
+
+        resumable = checkpointer is not None and checkpointer.count > 0
+        spans.point(
+            "preempt",
+            tick=int(res["ticks"]),
+            snapshot_tick=(
+                int(checkpointer.last_tick) if resumable else 0
+            ),
+            resumable=resumable,
+        )
+        # the run span is closed by execute_sim_run's except hook,
+        # which labels a preemption outcome="preempted", not "error"
+        raise TaskPreemptedError(
+            job.run_id,
+            tick=int(res["ticks"]),
+            snapshot_tick=(
+                int(checkpointer.last_tick) if resumable else 0
+            ),
+            snapshots=(
+                int(checkpointer.count) if checkpointer is not None else 0
+            ),
+            resumable=resumable,
+        )
     spans.end("run", outcome=result.outcome.value, ticks=res["ticks"])
     return RunOutput(run_id=job.run_id, result=result)
 
@@ -2347,9 +2457,16 @@ def execute_packed_sim_runs(
                     else "",
                 )
 
-        def _cancel_check(_c=cancel, _sc=slo_cancel):
-            return _c.is_set() or (
-                _sc is not None and _sc.run_local.is_set()
+        # eviction (engine/controller.py) rides the same in-program
+        # lane-freeze path as cancellation: the member stops at the
+        # next chunk boundary, collect raises TaskPreemptedError
+        preempt_ev = getattr(job, "preempt", None)
+
+        def _cancel_check(_c=cancel, _sc=slo_cancel, _p=preempt_ev):
+            return (
+                _c.is_set()
+                or (_sc is not None and _sc.run_local.is_set())
+                or (_p is not None and _p.is_set())
             )
 
         ow.infof(
@@ -2445,7 +2562,16 @@ def execute_packed_sim_runs(
                 )
             )
         except Exception as e:  # noqa: BLE001 — member-local failure
-            spans.end("run", outcome="error", error=str(e)[:200])
+            from testground_tpu.engine.controller import (
+                TaskPreemptedError,
+            )
+
+            outcome = (
+                "preempted"
+                if isinstance(e, TaskPreemptedError)
+                else "error"
+            )
+            spans.end("run", outcome=outcome, error=str(e)[:200])
             outs.append(e)
         finally:
             spans.close()
@@ -2628,6 +2754,29 @@ def _collect_pack_member(
         spans.end("collect")
         spans.end("run", outcome=result.outcome.value, ticks=res["ticks"])
         raise err
+    preempt_ev = getattr(job, "preempt", None)
+    if (
+        member.canceled
+        and preempt_ev is not None
+        and preempt_ev.is_set()
+        and not cancel.is_set()
+    ):
+        from testground_tpu.engine.controller import TaskPreemptedError
+
+        # evicted member: lanes froze at the chunk boundary, but a pack
+        # member never writes disk snapshots (engine/pack.py exclusion)
+        # — the supervisor requeues it to rerun from scratch. Ordered
+        # after the SLO raise: a fatal breach wins over eviction.
+        spans.point(
+            "preempt",
+            tick=int(res["ticks"]),
+            snapshot_tick=0,
+            resumable=False,
+        )
+        spans.end("collect")
+        raise TaskPreemptedError(
+            job.run_id, tick=int(res["ticks"]), resumable=False
+        )
     ow.infof(
         "sim:jax %s: packed run done — %d ticks, %s",
         job.run_id,

@@ -605,16 +605,42 @@ class TestExecutorResume:
                 seed=6,
             )
 
-    def test_corrupted_snapshot_refuses_resume(self, resumed_runs):
-        # LAST in the class: this damages cut's newest snapshot on disk
+    def test_corrupted_snapshot_fallback_then_refusal(
+        self, resumed_runs, monkeypatch
+    ):
+        # LAST in the class: this damages cut's snapshots on disk.
+        # A corrupt newest snapshot falls back loudly to the previous
+        # retained one; only when EVERY snapshot is unloadable does the
+        # resume refuse.
+        import testground_tpu.sim.checkpoint as ckpt_mod
+
+        monkeypatch.setattr(ckpt_mod, "_RETRY_BASE_SECS", 0.001)
+        monkeypatch.setattr(ckpt_mod, "_RETRY_JITTER_SECS", 0.0)
         env = resumed_runs["env"]
         ckpt_dir = os.path.join(
             env.dirs.outputs(), "network", "cut", CHECKPOINT_DIR
         )
-        newest = sorted(os.listdir(ckpt_dir))[-1]
-        path = os.path.join(ckpt_dir, newest)
-        with open(path, "r+b") as f:
-            f.truncate(os.path.getsize(path) // 3)
+        names = sorted(os.listdir(ckpt_dir))
+        assert len(names) >= 2  # keep=2: a fallback candidate exists
+        newest = os.path.join(ckpt_dir, names[-1])
+        with open(newest, "r+b") as f:
+            f.truncate(os.path.getsize(newest) // 3)
+        out = _exec("res-fb", env=env, max_ticks=512, resume_from="cut")
+        ck = out.result.journal["sim"]["checkpoint"]
+        assert ck["resumed"]["from_run"] == "cut"
+        fb = ck["resumed"]["fallback"]
+        assert fb["skipped"] == [names[-1]] and fb["error"]
+        # fell back to the older snapshot, then re-simulated to the end
+        newest_tick = int(names[-1][len("ckpt-") : -len(".npz")])
+        assert ck["resumed"]["from_tick"] < newest_tick
+        # the fallback resume still lands on the uninterrupted endpoint
+        full_ticks = resumed_runs["full"].result.journal["sim"]["ticks"]
+        assert out.result.journal["sim"]["ticks"] == full_ticks
+        # now every retained snapshot is unloadable: refuse loudly
+        for name in os.listdir(ckpt_dir):
+            path = os.path.join(ckpt_dir, name)
+            with open(path, "r+b") as f:
+                f.truncate(os.path.getsize(path) // 3)
         with pytest.raises(CheckpointError, match="refusing to resume"):
             _exec("res-bad", env=env, max_ticks=512, resume_from="cut")
 

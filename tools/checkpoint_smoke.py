@@ -14,8 +14,11 @@ SLO, telemetry) — so the snapshot must carry EVERY plane's state:
 - **provenance**: the resumed journal records what it resumed from, the
   ``tg stats`` table renders the checkpoint line, and the Prometheus
   exposition carries ``tg_checkpoint_*``;
-- **loud refusal**: a truncated newest snapshot fails the resume with
-  the typed CheckpointError — never resumes garbage.
+- **loud fallback**: a truncated newest snapshot falls back to the
+  previous retained one — the resume succeeds, journals what it
+  skipped, and still lands bit-equal with the uninterrupted run;
+- **loud refusal**: when EVERY retained snapshot is unloadable the
+  resume fails with the typed CheckpointError — never resumes garbage.
 
 Exits non-zero with a readable message on any violation. Self-contained:
 temporary $TESTGROUND_HOME, CPU backend — safe in CI (mirrors
@@ -135,20 +138,36 @@ def main() -> int:
             manifest,
             plan_dir,
         )
-        # corrupt the newest snapshot, then try to resume again: typed
-        # refusal, never garbage
+        # corrupt the newest snapshot, then resume again: loud fallback
+        # to the previous retained snapshot, not a refusal
+        import testground_tpu.sim.checkpoint as _ckpt_mod
+
+        _ckpt_mod._RETRY_BASE_SECS = 0.01  # keep the smoke fast
+        _ckpt_mod._RETRY_JITTER_SECS = 0.0
         ckpt_dir = os.path.join(
             env.dirs.outputs(), "chaos", cut.id, CHECKPOINT_DIR
         )
         names = sorted(os.listdir(ckpt_dir))
-        if not (1 <= len(names) <= 2):
+        if len(names) != 2:
             fail(
-                f"retention: expected <= 2 snapshot(s) under {ckpt_dir} "
+                f"retention: expected 2 snapshot(s) under {ckpt_dir} "
                 f"(checkpoint_keep=2), found {names}"
             )
         newest = os.path.join(ckpt_dir, names[-1])
         with open(newest, "r+b") as f:
             f.truncate(os.path.getsize(newest) // 3)
+        fellback = _run_once(
+            engine,
+            comp_with(checkpoint_chunks=1, resume_from=cut.id),
+            manifest,
+            plan_dir,
+        )
+        # corrupt EVERY retained snapshot, then try once more: typed
+        # refusal, never garbage
+        for name in names:
+            path = os.path.join(ckpt_dir, name)
+            with open(path, "r+b") as f:
+                f.truncate(os.path.getsize(path) // 3)
         refused = _run_once(
             engine,
             comp_with(checkpoint_chunks=1, resume_from=cut.id),
@@ -227,10 +246,32 @@ def main() -> int:
         if gauge not in text:
             fail(f"{gauge} missing from the Prometheus exposition")
 
-    # ---- corrupted snapshot refused loudly, typed
+    # ---- corrupt newest snapshot: loud fallback, still bit-equal
+    if fellback.outcome() != Outcome.SUCCESS:
+        fail(
+            "resume with a truncated newest snapshot must fall back to "
+            f"the previous one, got {fellback.outcome().value}: "
+            f"{fellback.error}"
+        )
+    jfb = fellback.result["journal"]
+    fb_res = (jfb["sim"].get("checkpoint") or {}).get("resumed") or {}
+    fb = fb_res.get("fallback") or {}
+    if fb.get("skipped") != [names[-1]] or not fb.get("error"):
+        fail(
+            f"fallback resume journaled no skipped-snapshot provenance: "
+            f"{fb_res}"
+        )
+    for key in ("ticks", "msgs_delivered", "faults_crashed"):
+        if jfb["sim"].get(key) != jf["sim"].get(key):
+            fail(
+                f"fallback-resumed vs uninterrupted journal sim.{key}: "
+                f"{jfb['sim'].get(key)} != {jf['sim'].get(key)}"
+            )
+
+    # ---- every snapshot corrupt: refused loudly, typed
     if refused.outcome() != Outcome.FAILURE:
         fail(
-            "resume from a truncated snapshot must FAIL, got "
+            "resume with every snapshot truncated must FAIL, got "
             f"{refused.outcome().value}"
         )
     if "refusing to resume" not in (refused.error or ""):
@@ -243,8 +284,8 @@ def main() -> int:
         "checkpoint-smoke: OK — {n} snapshot(s) (keep=2 enforced), cut at "
         "tick 32 mid-schedule, resumed run == uninterrupted run "
         "(journal + telemetry + SLO streams, {t} ticks), provenance + "
-        "tg_checkpoint_* exported, truncated snapshot refused "
-        "loudly".format(n=ck["count"], t=jr["sim"]["ticks"])
+        "tg_checkpoint_* exported, truncated newest fell back loudly, "
+        "all-corrupt refused loudly".format(n=ck["count"], t=jr["sim"]["ticks"])
     )
     return 0
 

@@ -1,0 +1,367 @@
+"""``make preempt-smoke``: the fleet controller's preemption contract
+(docs/FLEET.md) end-to-end against a REAL daemon subprocess on the CPU
+backend — live migration, SIGTERM drain chaos, priority eviction, and
+admission-at-submit, with bit-equal completions:
+
+- **live migration**: a running ``network:pingpong-sustained`` task hit
+  by ``POST /preempt`` checkpoints at its next chunk boundary, requeues
+  itself with ``resume_from`` pointing at its own newest snapshot, and
+  completes with journal totals and an ident-stripped telemetry stream
+  byte-equal to an uninterrupted baseline;
+- **SIGTERM drain**: SIGTERM to the daemon checkpoints + requeues the
+  running task, journals ``daemon.drain``, and exits 0; a restarted
+  daemon rehydrates the queue and the task resumes to the same
+  bit-equal completion;
+- **priority eviction**: with one worker busy on a priority-0 run, a
+  priority-5 arrival evicts it (``task.evicted``), runs to completion
+  first, and the evictee auto-requeues and still completes bit-equal;
+- **admission-at-submit**: a composition ``tg check`` rejects (here
+  ``transport.unknown``) is refused at ``POST /run`` with the rule id
+  in the error and a ``task.refused`` journal record — nothing queues;
+- **observability**: ``tg_fleet_preemptions_total`` /
+  ``tg_fleet_evictions_total`` / ``tg_fleet_refused_total`` count on
+  ``GET /metrics``, the controller decisions ride
+  ``daemon_events.jsonl``, and the migrated task's span tree stays
+  singly-rooted with the resume point recorded.
+
+Exits non-zero with a readable message on any violation. Self-contained:
+temporary $TESTGROUND_HOME, CPU backend, daemon subprocesses on an
+ephemeral port — safe in CI (mirrors ``tools/fleet_smoke.py``).
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def fail(msg: str) -> "None":
+    print(f"preempt-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _comp(name: str, priority_case: bool = False) -> dict:
+    """The shared deterministic workload: identical composition every
+    time so completions are comparable bit-for-bit. ``priority_case``
+    swaps in a short un-checkpointed ping-pong (the evicting arrival)."""
+    if priority_case:
+        run_config = {"chunk": 16, "seed": 5, "max_ticks": 256}
+        case, params = "pingpong-sustained", {"duration_ticks": "100"}
+    else:
+        run_config = {
+            "chunk": 16,
+            "seed": 5,
+            "max_ticks": 1400,
+            "telemetry": True,
+            "checkpoint_chunks": 1,
+            "checkpoint_keep": 3,
+        }
+        case, params = "pingpong-sustained", {"duration_ticks": "1200"}
+    return {
+        "metadata": {"name": name},
+        "global": {
+            "plan": "network",
+            "case": case,
+            "builder": "sim:plan",
+            "runner": "sim:jax",
+            "total_instances": 2,
+            "run_config": run_config,
+        },
+        "groups": [
+            {
+                "id": "all",
+                "instances": {"count": 2},
+                "run": {"test_params": params},
+            }
+        ],
+    }
+
+
+class DaemonProc:
+    """One ``tg daemon`` subprocess bound to a known port."""
+
+    def __init__(self, home: str, port: int, log_path: str):
+        self.log = open(log_path, "ab")
+        env = dict(os.environ)
+        env["TESTGROUND_HOME"] = home
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "testground_tpu.cli.main",
+                "daemon",
+                "--listen",
+                f"127.0.0.1:{port}",
+            ],
+            env=env,
+            stdout=self.log,
+            stderr=self.log,
+        )
+        self.endpoint = f"http://127.0.0.1:{port}"
+
+    def wait_ready(self, client, deadline_secs: float = 60.0) -> None:
+        deadline = time.time() + deadline_secs
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                fail(
+                    f"daemon exited rc={self.proc.returncode} before "
+                    f"serving (see {self.log.name})"
+                )
+            try:
+                client.fleet()
+                return
+            except Exception:  # noqa: BLE001 — not up yet
+                time.sleep(0.1)
+        fail("daemon did not become ready within 60s")
+
+    def sigterm_and_wait(self, deadline_secs: float = 120.0) -> int:
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            rc = self.proc.wait(timeout=deadline_secs)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            fail("daemon did not exit within 120s of SIGTERM")
+        self.log.close()
+        return rc
+
+
+def _wait_state(client, tid, states, deadline_secs=240.0, poll=0.05):
+    deadline = time.time() + deadline_secs
+    while time.time() < deadline:
+        t = client.status(tid)
+        if t["states"][-1]["state"] in states:
+            return t
+        time.sleep(poll)
+    fail(f"task {tid} never reached {states} within {deadline_secs}s")
+
+
+def _wait_done(client, tid, deadline_secs=240.0):
+    return _wait_state(
+        client, tid, ("complete", "canceled"), deadline_secs, poll=0.1
+    )
+
+
+def _journal_rows(home):
+    path = os.path.join(home, "data", "daemon", "daemon_events.jsonl")
+    if not os.path.isfile(path):
+        fail(f"daemon_events.jsonl was not written ({path})")
+    return [json.loads(line) for line in open(path)]
+
+
+def _stream_rows(home, tid, name="sim_timeseries.jsonl"):
+    path = os.path.join(home, "data", "outputs", "network", tid, name)
+    if not os.path.isfile(path):
+        fail(f"{name} missing for task {tid}")
+    return [
+        {k: v for k, v in json.loads(line).items() if k != "run"}
+        for line in open(path)
+    ]
+
+
+_COMPARE_KEYS = (
+    "ticks",
+    "msgs_delivered",
+    "msgs_sent",
+    "msgs_enqueued",
+    "msgs_dropped",
+    "msgs_rejected",
+    "msgs_in_flight",
+)
+
+
+def _assert_bit_equal(home, label, base_task, chaos_task):
+    jb = base_task["result"]["journal"]["sim"]
+    jc = chaos_task["result"]["journal"]["sim"]
+    for key in _COMPARE_KEYS:
+        if jb.get(key) != jc.get(key):
+            fail(
+                f"{label}: journal sim.{key} diverged — "
+                f"{jc.get(key)} != baseline {jb.get(key)}"
+            )
+    rows_b = _stream_rows(home, base_task["id"])
+    rows_c = _stream_rows(home, chaos_task["id"])
+    if rows_b != rows_c:
+        fail(
+            f"{label}: telemetry streams diverged "
+            f"({len(rows_c)} vs {len(rows_b)} rows)"
+        )
+
+
+def main() -> int:
+    home = tempfile.mkdtemp(prefix="tg-preempt-")
+    os.environ["TESTGROUND_HOME"] = home
+    # one worker: eviction only triggers when every slot is busy
+    with open(os.path.join(home, ".env.toml"), "w") as f:
+        f.write("[daemon.scheduler]\nworkers = 1\n")
+
+    from testground_tpu.client import Client, DaemonError
+
+    port = _free_port()
+    plan_dir = os.path.join(REPO_ROOT, "plans", "network")
+    daemon = DaemonProc(home, port, os.path.join(home, "daemon-a.log"))
+    client = Client(daemon.endpoint)
+    daemon.wait_ready(client)
+    if client.import_plan(plan_dir) != "network":
+        fail("plan import failed")
+
+    # ---- baseline: uninterrupted completion of the shared workload
+    base_id = client.run(_comp("baseline"))
+    base = _wait_done(client, base_id)
+    if base["outcome"] != "success":
+        fail(f"baseline outcome {base['outcome']}: {base.get('error')}")
+    print(f"preempt-smoke: baseline complete ({base_id})")
+
+    # ---- live migration: POST /preempt mid-run, auto-resume, bit-equal
+    mig_id = client.run(_comp("migrate"))
+    _wait_state(client, mig_id, ("processing",))
+    res = client.preempt(mig_id)
+    if not res.get("ok"):
+        fail(f"POST /preempt refused a running task: {res}")
+    mig = _wait_done(client, mig_id)
+    if mig["outcome"] != "success":
+        fail(f"migrated outcome {mig['outcome']}: {mig.get('error')}")
+    if int(mig["trace"].get("preemptions", 0)) < 1:
+        fail("migrated task records no preemption in its trace")
+    _assert_bit_equal(home, "live migration", base, mig)
+    rows = _journal_rows(home)
+    mine = [r for r in rows if r.get("task") == mig_id]
+    types = [r["type"] for r in mine]
+    for needed in ("task.preempt_requested", "task.preempted",
+                   "task.migrated", "task.resumed"):
+        if needed not in types:
+            fail(f"journal is missing {needed} for the migrated task")
+    migrated = next(r for r in mine if r["type"] == "task.migrated")
+    if migrated.get("resume_from") != mig_id:
+        fail(
+            "task.migrated does not point the resume at the task's own "
+            f"snapshots: {migrated}"
+        )
+    if any(not r.get("trace_id") for r in mine):
+        fail("a controller decision lost the task's trace id")
+    # the span tree stays singly-rooted and records the resume point
+    spans_path = os.path.join(
+        home, "data", "outputs", "network", mig_id, "task_spans.jsonl"
+    )
+    spans = [json.loads(l) for l in open(spans_path)]
+    roots = [s for s in spans if not s["parent_id"]]
+    ids = {s["span_id"] for s in spans}
+    if len(roots) != 1 or any(
+        s["parent_id"] and s["parent_id"] not in ids for s in spans
+    ):
+        fail("the migrated task's span tree is not singly-rooted/connected")
+    if "resume" not in {s["name"] for s in spans}:
+        fail("the migrated task's span tree has no resume point")
+    print("preempt-smoke: live migration bit-equal + journaled")
+
+    # ---- priority eviction: a priority-5 arrival evicts the busy worker
+    victim_id = client.run(_comp("victim"))
+    _wait_state(client, victim_id, ("processing",))
+    hi_id = client.run(_comp("hi", priority_case=True), priority=5)
+    hi = _wait_done(client, hi_id)
+    if hi["outcome"] != "success":
+        fail(f"high-priority arrival outcome {hi['outcome']}")
+    victim = _wait_done(client, victim_id)
+    if victim["outcome"] != "success":
+        fail(f"evictee outcome {victim['outcome']}: {victim.get('error')}")
+    _assert_bit_equal(home, "priority eviction", base, victim)
+    rows = _journal_rows(home)
+    evicted = [r for r in rows if r["type"] == "task.evicted"]
+    if not any(
+        r.get("task") == victim_id and r.get("by") == hi_id for r in evicted
+    ):
+        fail(f"no task.evicted record for {victim_id} by {hi_id}: {evicted}")
+    print("preempt-smoke: priority eviction bit-equal + journaled")
+
+    # ---- admission-at-submit: tg check error rules refuse at POST /run
+    bad = _comp("bad")
+    bad["global"]["run_config"]["transport"] = "bogus"
+    try:
+        client.run(bad)
+        fail("a composition tg check rejects was accepted at submit")
+    except DaemonError as e:
+        if "transport.unknown" not in str(e):
+            fail(f"refusal does not name the tg check rule id: {e}")
+    if not any(
+        r["type"] == "task.refused"
+        and "transport.unknown" in (r.get("rules") or [])
+        for r in _journal_rows(home)
+    ):
+        fail("no task.refused journal record naming the rule")
+    print("preempt-smoke: admission refused with tg check rule ids")
+
+    # ---- counters on GET /metrics (daemon-lifetime, so check pre-restart)
+    import urllib.request
+
+    text = urllib.request.urlopen(
+        daemon.endpoint + "/metrics", timeout=10
+    ).read().decode()
+    for metric, floor in (
+        ("tg_fleet_preemptions_total", 2),  # migration + eviction
+        ("tg_fleet_evictions_total", 1),
+        ("tg_fleet_refused_total", 1),
+    ):
+        m = re.search(rf"^{metric} (\d+)$", text, re.M)
+        if m is None or int(m.group(1)) < floor:
+            fail(f"{metric} missing or below {floor} on GET /metrics")
+    print("preempt-smoke: tg_fleet_* counters exported")
+
+    # ---- SIGTERM chaos: drain checkpoints + requeues, restart resumes
+    chaos_id = client.run(_comp("chaos"))
+    _wait_state(client, chaos_id, ("processing",))
+    rc = daemon.sigterm_and_wait()
+    if rc != 0:
+        fail(f"daemon exited rc={rc} on SIGTERM (graceful drain must be 0)")
+    rows = _journal_rows(home)
+    if not any(r["type"] == "daemon.drain" for r in rows):
+        fail("SIGTERM drain journaled no daemon.drain record")
+    if not any(
+        r["type"] == "task.preempted" and r.get("task") == chaos_id
+        for r in rows
+    ):
+        fail("SIGTERM drain did not checkpoint + preempt the running task")
+    daemon_b = DaemonProc(home, port, os.path.join(home, "daemon-b.log"))
+    client = Client(daemon_b.endpoint)
+    daemon_b.wait_ready(client)
+    chaos = _wait_done(client, chaos_id)
+    if chaos["outcome"] != "success":
+        fail(
+            f"post-restart resume outcome {chaos['outcome']}: "
+            f"{chaos.get('error')}"
+        )
+    if int(chaos["trace"].get("preemptions", 0)) < 1:
+        fail("chaos task records no preemption in its trace")
+    _assert_bit_equal(home, "SIGTERM chaos", base, chaos)
+    rc = daemon_b.sigterm_and_wait()
+    if rc != 0:
+        fail(f"idle daemon exited rc={rc} on SIGTERM")
+    print("preempt-smoke: SIGTERM drain + restart resume bit-equal")
+
+    print(
+        "preempt-smoke: OK — live migration, priority eviction, "
+        "admission refusal, SIGTERM drain chaos all bit-equal + journaled"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
