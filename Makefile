@@ -7,7 +7,7 @@ PY ?= python
 	telemetry-smoke chaos-smoke trace-smoke fleet-smoke perf-smoke slo-smoke \
 	phases-smoke checkpoint-smoke preempt-smoke crosshost-smoke \
 	pack-smoke sync-fanin-smoke transport-smoke check-smoke \
-	check-plans test-sync-tsan
+	netmap-smoke check-plans test-sync-tsan
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -168,6 +168,17 @@ transport-smoke:
 # `tg stats` renders it
 check-smoke:
 	$(PY) tools/check_smoke.py
+
+# network-topology plane end to end (docs/OBSERVABILITY.md "Traffic
+# matrix"): a daemon-served clustered composition (two isolated
+# ping-pong pairs) with netmatrix=true must journal an exactly-
+# reconciling sim.net_matrix block, stream/serve sim_netmatrix.jsonl,
+# render the `tg netmap` heatmap through the real CLI, have
+# `tg netmap --cut 2` recover the cluster split at zero cut bytes,
+# and keep the Prometheus tg_net_pair_* series top-K bounded —
+# part of the observability-smoke CI set
+netmap-smoke:
+	$(PY) tools/netmap_smoke.py
 
 # `tg check` over every checked-in composition: the gallery's
 # pre-lint gate (docs/CHECKING.md) — any error-severity finding in a

@@ -166,6 +166,11 @@ class SimPlanBuilder(Builder, Precompiler):
             and not comp.global_.disable_metrics
             and not getattr(cfg, "coordinator_address", "")
         )
+        # the traffic-matrix plane is program-shaping too, and its gate
+        # mirrors the executor's exactly: it requires the telemetry
+        # plane (the run refuses otherwise) and cohorts shed it — both
+        # collapse to the matrix-OFF variant here
+        netmatrix = telemetry and bool(getattr(cfg, "netmatrix", False))
         # transport gate mirrors the executor (resolve_transport is the
         # shared gate): a mesh forces xla, so the build must precompile
         # the variant the run will actually trace. A cohort resolves
@@ -397,6 +402,9 @@ class SimPlanBuilder(Builder, Precompiler):
                     if bucket_plan is not None
                     else {}
                 ),
+                # keyed only when the matrix plane is on — same
+                # backward-compatible idiom as the bucket key
+                **({"netmatrix": True} if netmatrix else {}),
             }
             key = hashlib.sha256(
                 json.dumps(spec, sort_keys=True).encode()
@@ -488,6 +496,7 @@ class SimPlanBuilder(Builder, Precompiler):
                     if bucket_plan is not None
                     else None
                 ),
+                netmatrix=netmatrix,
             )
             # same capacity precheck as the run: an oversized composition
             # must refuse readably at BUILD time too, not die as an XLA
@@ -677,6 +686,10 @@ class SimPlanBuilder(Builder, Precompiler):
                     trace=None,
                     transport=rung_transport,
                     live_counts=tuple(counts),
+                    # same gate as the per-run precompile above: the
+                    # matrix plane rides telemetry
+                    netmatrix=telemetry
+                    and bool(getattr(cfg, "netmatrix", False)),
                 )
                 _precheck_device_memory(prog, cfg, None, ow)
                 carry = jax.jit(

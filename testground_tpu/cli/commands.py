@@ -1494,14 +1494,16 @@ def _follow_stream(engine, task_id: str, families, out=None, follow=True) -> Non
     clock unless ``perf`` rows (one per chunk) are streamed; with
     ``follow=False`` (``tg watch --no-follow``) one replay sweep of
     what exists is rendered instead of waiting for the task."""
-    from testground_tpu.sim.perf import fmt_rate
+    from testground_tpu.sim.netmatrix import NM_MSG_BYTES
+    from testground_tpu.sim.perf import fmt_rate, num
 
     out = out or sys.stdout
     color = hasattr(out, "isatty") and out.isatty()
     use_spans_clock = "spans" in families
     header = (
         f"{'tick':>8}  {'wall':>8}  {'ticks/s':>9}  {'peer·t/s':>9}"
-        f"  {'delivered':>9}  {'dropped':>8}  {'in-flight':>9}  breaches"
+        f"  {'delivered':>9}  {'dropped':>8}  {'in-flight':>9}"
+        f"  {'infl-KiB':>8}  breaches"
     )
     printed_header = False
     # telemetry deltas accumulated since the last chunk line
@@ -1514,12 +1516,17 @@ def _follow_stream(engine, task_id: str, families, out=None, follow=True) -> Non
         d = acc["delivered"]
         x = acc["dropped"] + acc["fault_dropped"]
         acc.update(delivered=0, dropped=0, fault_dropped=0)
+        # in-flight wire bytes: calendar occupancy × the fixed message
+        # size (the traffic matrix's bytes accounting) — "?" when the
+        # telemetry row has no finite depth yet
+        depth = num(last_tele.get("cal_depth"))
+        infl = f"{depth * NM_MSG_BYTES / 1024:.1f}" if depth is not None else "?"
         return (
             f"{tick:>8}  {wall:>8.2f}  "
             f"{fmt_rate(last_perf.get('ticks_per_sec')):>9}  "
             f"{fmt_rate(last_perf.get('peer_ticks_per_sec')):>9}  "
             f"{d:>9}  {x:>8}  "
-            f"{last_tele.get('cal_depth', '?'):>9}  {breaches}"
+            f"{last_tele.get('cal_depth', '?'):>9}  {infl:>8}  {breaches}"
         )
 
     for row in engine.stream_rows(
@@ -1625,6 +1632,133 @@ def watch_cmd(args) -> int:
                     print(
                         f"task {args.task}: outcome {t.outcome().value}"
                     )
+        return 0
+    finally:
+        engine.stop()
+
+
+def register_netmap(sub) -> None:
+    p = sub.add_parser(
+        "netmap",
+        help="show a task's group-to-group traffic matrix (sent heatmap, "
+        "lossy pairs, link-shaping observables) and recommend a "
+        "cross-traffic-minimizing group partition with --cut — "
+        "docs/OBSERVABILITY.md 'Traffic matrix'; record with "
+        "--run-cfg telemetry=true netmatrix=true",
+    )
+    p.add_argument("task", help="task id")
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the raw sim.net_matrix journal block as JSON "
+        "(machine-readable; the same shape as in GET /stats)",
+    )
+    p.add_argument(
+        "-f",
+        "--follow",
+        action="store_true",
+        help="follow the per-chunk matrix deltas live first (the "
+        "netmatrix family of GET /stream), then print the final "
+        "heatmap",
+    )
+    p.add_argument(
+        "--cut",
+        type=int,
+        default=0,
+        metavar="N",
+        help="recommend a balanced N-shard group partition minimizing "
+        "cross-cut traffic bytes (measured, not guessed — the "
+        "instance-axis → mesh-axis placement advisor)",
+    )
+    p.set_defaults(func=netmap_cmd)
+
+
+def netmap_cmd(args) -> int:
+    import json
+
+    from testground_tpu.client import RemoteEngine
+    from testground_tpu.runners.pretty import (
+        render_netmap,
+        render_netmap_cut,
+    )
+
+    engine = _engine(args)
+    try:
+        as_json = bool(getattr(args, "json", False))
+        # under --json every human-facing line goes to stderr — stdout
+        # stays the machine-readable payload (the --json contract)
+        hout = sys.stderr if as_json else sys.stdout
+        if getattr(args, "follow", False):
+            print(
+                f"following task {args.task} traffic deltas "
+                "(ctrl-c to stop)",
+                file=hout,
+            )
+            for row in engine.stream_rows(
+                args.task, follow=True, families=("netmatrix",)
+            ):
+                if not row or row.get("stream") != "netmatrix":
+                    continue
+                cells = row.get("cells") or []
+                sent = sum(
+                    int(c[2]) for c in cells if len(c) > 2
+                )
+                lost = sum(
+                    int(c[5]) + int(c[6]) + int(c[7])
+                    for c in cells
+                    if len(c) > 7
+                )
+                line = (
+                    f"tick {row.get('tick', '?'):>8}  "
+                    f"{len(cells)} active pair(s)  sent {sent}"
+                )
+                if lost:
+                    line += f"  LOST {lost}"
+                print(line, file=hout)
+                try:
+                    hout.flush()
+                except OSError:
+                    pass
+        if isinstance(engine, RemoteEngine):
+            data = engine.task_stats(args.task)
+        else:
+            t = engine.get_task(args.task)
+            if t is None:
+                raise KeyError(f"unknown task {args.task}")
+            data = t.stats_payload()
+        block = (data.get("sim") or {}).get("net_matrix") or {}
+        if as_json:
+            print(json.dumps(block, indent=2, sort_keys=True))
+        if not block:
+            print(
+                "no traffic matrix recorded for this task — run with "
+                "--run-cfg telemetry=true netmatrix=true (cohorts and "
+                "disable_metrics run matrix-free)",
+                file=hout,
+            )
+            return 1
+        if not as_json:
+            ident = (
+                f"{data.get('plan', '?')}:{data.get('case', '?')}"
+                f"  ({args.task})"
+            )
+            print(render_netmap(block, ident))
+        if getattr(args, "cut", 0):
+            import numpy as np
+
+            from testground_tpu.sim.netmatrix import (
+                cut_advisor,
+                matrix_bytes,
+            )
+
+            mat = np.asarray(block.get("matrix") or [], np.int64)
+            rec = cut_advisor(
+                matrix_bytes(mat),
+                int(args.cut),
+                labels=block.get("labels") or None,
+            )
+            print("", file=hout)
+            print(render_netmap_cut(rec, int(args.cut)), file=hout)
         return 0
     finally:
         engine.stop()

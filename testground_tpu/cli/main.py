@@ -2,7 +2,8 @@
 
 Command surface mirrors the reference's ``pkg/cmd/root.go:10-24``: run,
 build, plan, check, describe, daemon, collect, terminate, healthcheck,
-tasks, status, stats, perf, watch, top, trace, logs, version. The engine
+tasks, status, stats, perf, watch, netmap, top, trace, logs, version. The
+engine
 runs in-process unless ``--endpoint`` points at a daemon (the reference's
 client↔daemon hop is transport, not semantics).
 """
@@ -44,6 +45,7 @@ def build_parser() -> argparse.ArgumentParser:
     commands.register_stats(sub)
     commands.register_perf(sub)
     commands.register_watch(sub)
+    commands.register_netmap(sub)
     commands.register_top(sub)
     commands.register_trace(sub)
     commands.register_logs(sub)

@@ -734,6 +734,7 @@ class _Handler(BaseHTTPRequestHandler):
     _ARTIFACT_FILES = (
         "timeseries.jsonl",
         "sim_timeseries.jsonl",
+        "sim_netmatrix.jsonl",
         "sim_latency.jsonl",
         "sim_perf.jsonl",
         "sim_phases.jsonl",

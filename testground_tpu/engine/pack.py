@@ -166,6 +166,8 @@ def _signature_or_reason(
         return solo("profiler capture is a per-run device session")
     if _truthy(cfg.get("phases")):
         return solo("phase attribution lowers per-run programs")
+    if _truthy(cfg.get("netmatrix")):
+        return solo("the traffic matrix is a per-run device carry read")
     if cfg.get("additional_hosts"):
         return solo("additional_hosts adds per-program echo lanes")
     if int(cfg.get("checkpoint_chunks") or 0) > 0:

@@ -33,6 +33,7 @@ from typing import Callable, Iterator
 
 from testground_tpu.sim.slo import SLO_FILE
 from testground_tpu.sim.telemetry import (
+    NETMATRIX_FILE,
     PERF_FILE,
     PHASES_FILE,
     SIM_SERIES_FILE,
@@ -47,6 +48,9 @@ __all__ = ["STREAM_FAMILIES", "stream_task_rows"]
 # order (the executor writes them in this order too).
 STREAM_FAMILIES = (
     ("telemetry", SIM_SERIES_FILE),
+    # traffic-matrix chunk deltas (sim/netmatrix.py) — one sparse row
+    # per chunk, the `tg netmap -f` live feed
+    ("netmatrix", NETMATRIX_FILE),
     ("perf", PERF_FILE),
     # phase attribution rows (sim/phases.py) — written once at collect
     # time, so a follow replays them right before the task closes

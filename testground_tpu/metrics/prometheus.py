@@ -606,6 +606,83 @@ def render_prometheus(
             ident,
             hbm.get("peak_bytes"),
         )
+        # network topology plane (journal["sim"]["net_matrix"],
+        # docs/OBSERVABILITY.md "Traffic matrix"): BOUNDED cardinality
+        # by construction — only the journal's top-K pairs export as
+        # tg_net_pair_* series (≤ K pairs × flow legs) plus one elision
+        # gauge saying how many nonzero pairs did NOT make the page;
+        # the raw G² matrix never reaches the scrape page (read it via
+        # `tg netmap` or the sim_netmatrix.jsonl stream).
+        nm = (
+            sim.get("net_matrix")
+            if isinstance(sim.get("net_matrix"), dict)
+            else {}
+        )
+        if nm:
+            from testground_tpu.sim.netmatrix import NM_MSG_BYTES
+
+            nm_labels = nm.get("labels") or []
+
+            def _nm_group(i) -> str:
+                try:
+                    return str(nm_labels[int(i)])
+                except (TypeError, ValueError, IndexError):
+                    return str(i)
+
+            for pr in nm.get("top_pairs") or []:
+                if not isinstance(pr, dict):
+                    continue
+                pident = {
+                    **ident,
+                    "src": _nm_group(pr.get("src")),
+                    "dst": _nm_group(pr.get("dst")),
+                }
+                for flow in (
+                    "sent",
+                    "delivered",
+                    "dropped",
+                    "rejected",
+                    "fault_dropped",
+                ):
+                    exp.add(
+                        "tg_net_pair_msgs_total",
+                        "counter",
+                        "Per-(src,dst) group-pair message counts of a "
+                        "finished run's traffic matrix — top-K pairs by "
+                        "sent volume only (bounded cardinality; see "
+                        "tg_net_pairs_elided).",
+                        {**pident, "flow": flow},
+                        pr.get(flow),
+                    )
+                enq = _num(pr.get("enqueued"))
+                exp.add(
+                    "tg_net_pair_bytes_total",
+                    "counter",
+                    "Per-(src,dst) group-pair wire bytes (enqueued "
+                    "messages x fixed message size) — top-K pairs only.",
+                    pident,
+                    None if enq is None else enq * NM_MSG_BYTES,
+                )
+            exp.add(
+                "tg_net_pairs_elided",
+                "gauge",
+                "Nonzero traffic-matrix pairs NOT exported as "
+                "tg_net_pair_* series (the bounded-cardinality "
+                "remainder; full matrix via tg netmap).",
+                ident,
+                nm.get("elided_pairs", 0),
+            )
+            exp.add(
+                "tg_net_conservation_mismatches",
+                "gauge",
+                "Traffic-matrix channels whose cell sum failed to "
+                "reconcile with the run's flow totals (0 = exact; "
+                "nonzero is an engine bug).",
+                ident,
+                len(nm.get("mismatches"))
+                if isinstance(nm.get("mismatches"), list)
+                else None,
+            )
         # checkpoint/resume plane (journal["sim"]["checkpoint"],
         # docs/CHECKPOINT.md): snapshot progress gauges so a scraper can
         # alert on a soak whose last checkpoint is falling behind

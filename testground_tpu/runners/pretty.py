@@ -20,6 +20,8 @@ __all__ = [
     "PrettyPrinter",
     "render_fleet",
     "render_lifecycle_tree",
+    "render_netmap",
+    "render_netmap_cut",
     "render_perf_summary",
     "render_phase_table",
     "render_sync_stats",
@@ -382,7 +384,14 @@ def render_sync_stats(stats: dict) -> str:
     if by_target:
         lines.append("")
         lines.append("barrier release vs fan-in width (armed → release):")
-        for bucket in sorted(by_target, key=lambda b: int(b)):
+        # bucket keys are strings in decoded JSON; a foreign
+        # non-numeric key sorts last, never raises
+        for bucket in sorted(
+            by_target,
+            key=lambda b: (
+                int(b) if str(b).lstrip("-").isdigit() else float("inf")
+            ),
+        ):
             rec = by_target[bucket] or {}
             count = _num(rec.get("count")) or 0
             mean = (
@@ -632,6 +641,173 @@ def render_phase_table(payload: dict) -> str:
     return "\n".join([meta] + lines)
 
 
+def _heat_shade(v, peak) -> str:
+    """A 4-step intensity glyph for a heatmap cell — zero-safe (a peak
+    of 0, None or NaN renders every cell cold, never divides)."""
+    n = _num(v, 0) or 0
+    p = _num(peak, 0) or 0
+    if n <= 0 or p <= 0:
+        return " "
+    return "░▒▓█"[min(3, int(3 * n / p))]
+
+
+def render_netmap(block: dict, ident: str = "") -> str:
+    """Render a ``sim.net_matrix`` journal block as the ``tg netmap``
+    screen: the src-group × dst-group sent-count heatmap, the per-pair
+    problem lines (any drops / rejections / chaos losses), link-shaping
+    observables, and the conservation verdict. Shape-tolerant like
+    every payload renderer — absent/NaN fields degrade to readable
+    placeholders, never a crash (``block`` is decoded JSON from a
+    possibly foreign writer)."""
+    from testground_tpu.sim.netmatrix import (
+        NM_CHANNEL_NAMES,
+        NM_MSG_BYTES,
+        NM_SENT,
+    )
+
+    labels = [str(g) for g in (block.get("labels") or [])]
+    mat = block.get("matrix") or []
+    gh = len(labels)
+    if not gh or len(mat) <= NM_SENT:
+        return "no traffic matrix in this block"
+
+    def cell(c, s, t) -> int:
+        try:
+            return int(_num(mat[c][s][t], 0) or 0)
+        except (IndexError, TypeError):
+            return 0
+
+    lines = []
+    head = "traffic matrix"
+    if ident:
+        head += f"  {ident}"
+    lines.append(head)
+    totals = block.get("totals") or {}
+    lines.append(
+        "totals  "
+        + " ".join(
+            f"{name}={_fmt_count(totals.get(name), '0')}"
+            for name in NM_CHANNEL_NAMES
+        )
+    )
+    if _num(block.get("bytes_total")) is not None:
+        lines.append(
+            f"bytes   {_fmt_bytes(block['bytes_total'])} enqueued on the "
+            f"wire ({NM_MSG_BYTES} B/message)"
+        )
+    mismatches = block.get("mismatches") or []
+    for m in mismatches:
+        lines.append(f"CONSERVATION FAILED: {m}")
+
+    # --- the heatmap: sent counts, shaded against the hottest pair
+    peak = max(
+        (cell(NM_SENT, s, t) for s in range(gh) for t in range(gh)),
+        default=0,
+    )
+    cells = [
+        [
+            (
+                f"{_heat_shade(cell(NM_SENT, s, t), peak)}"
+                f"{cell(NM_SENT, s, t)}"
+                if cell(NM_SENT, s, t)
+                else "·"
+            )
+            for t in range(gh)
+        ]
+        for s in range(gh)
+    ]
+    col_w = [
+        max(len(labels[t]), max(len(cells[s][t]) for s in range(gh)))
+        for t in range(gh)
+    ]
+    row_w = max(len("sent ↓src→dst"), max(len(x) for x in labels))
+    lines.append("")
+    lines.append(
+        f"{'sent ↓src→dst':<{row_w}}  "
+        + "  ".join(f"{labels[t]:>{col_w[t]}}" for t in range(gh))
+    )
+    for s in range(gh):
+        lines.append(
+            f"{labels[s]:<{row_w}}  "
+            + "  ".join(f"{cells[s][t]:>{col_w[t]}}" for t in range(gh))
+        )
+
+    # --- problem pairs: anything that did not arrive, attributed
+    problems = []
+    for s in range(gh):
+        for t in range(gh):
+            lost = [
+                (name, cell(c, s, t))
+                for c, name in enumerate(NM_CHANNEL_NAMES)
+                if name in ("dropped", "rejected", "fault_dropped")
+                and cell(c, s, t)
+            ]
+            if lost:
+                problems.append(
+                    f"  {labels[s]}→{labels[t]}: "
+                    + " ".join(f"{n}={v}" for n, v in lost)
+                )
+    if problems:
+        lines.append("")
+        lines.append("lossy pairs:")
+        lines.extend(problems)
+
+    # --- link-shaping observables
+    hi = block.get("bw_queue_hiwater") or []
+    if any((_num(v, 0) or 0) > 0 for v in hi):
+        lines.append("")
+        lines.append(
+            "bandwidth-queue depth high-water (messages, per src group): "
+            + "  ".join(
+                f"{labels[i]}={_fmt(hi[i], '{:g}')}"
+                for i in range(min(gh, len(hi)))
+                if (_num(hi[i], 0) or 0) > 0
+            )
+        )
+    fp = block.get("faulted_pairs") or []
+    faulted = [
+        f"{labels[s]}→{labels[t]} ({int(_num(fp[s][t], 0) or 0)} window(s))"
+        for s in range(min(gh, len(fp)))
+        for t in range(min(gh, len(fp[s])))
+        if (_num(fp[s][t], 0) or 0) > 0
+    ]
+    if faulted:
+        lines.append("")
+        lines.append("chaos-degraded pairs: " + ", ".join(faulted))
+    if not mismatches:
+        lines.append("")
+        lines.append("conservation: exact (Σ cells == flow totals)")
+    if block.get("file"):
+        lines.append(
+            f"stream: {block['file']} "
+            f"({_fmt_count(block.get('chunks'), '?')} chunk row(s))"
+        )
+    return "\n".join(lines)
+
+
+def render_netmap_cut(rec: dict, shards: int) -> str:
+    """Render a :func:`~testground_tpu.sim.netmatrix.cut_advisor`
+    recommendation (``tg netmap --cut N``): the group→shard assignment
+    plus the cross-cut volume it costs — zero-safe when there is no
+    cross-group traffic at all."""
+    lines = [
+        f"cut advisor — {shards} shard(s), "
+        f"{rec.get('method', '?')} search"
+    ]
+    for i, members in enumerate(rec.get("shards") or []):
+        lines.append(f"  shard {i}: {', '.join(str(m) for m in members)}")
+    cut = _num(rec.get("cut"), 0) or 0
+    total = _num(rec.get("total"), 0) or 0
+    frac = _num(rec.get("cut_fraction"), 0) or 0
+    lines.append(
+        f"cross-cut traffic: {_fmt_bytes(cut)} of {_fmt_bytes(total)} "
+        f"cross-group bytes ({frac * 100:.1f}%)"
+        if total > 0
+        else "cross-cut traffic: none (no cross-group traffic measured)"
+    )
+    return "\n".join(lines)
+
+
 def render_fleet(payload: dict) -> str:
     """Render a ``GET /fleet`` snapshot (engine.fleet_payload) as the
     ``tg top`` screen: one header block (workers / queue / per-state
@@ -664,7 +840,17 @@ def render_fleet(payload: dict) -> str:
             + "  ".join(
                 f"p{p}={n}"
                 for p, n in sorted(
-                    by_prio.items(), key=lambda kv: -int(kv[0])
+                    by_prio.items(),
+                    # priority keys are strings in decoded JSON; a
+                    # foreign non-numeric key sorts last, never raises
+                    key=lambda kv: -(
+                        _num(
+                            int(kv[0])
+                            if str(kv[0]).lstrip("-").isdigit()
+                            else None,
+                            float("-inf"),
+                        )
+                    ),
                 )
             )
         )
@@ -738,7 +924,15 @@ def render_lifecycle_tree(spans: list) -> str:
     )
 
     def line(s: dict, depth: int) -> str:
-        dur_ms = max(0, s.get("end_ns", 0) - s.get("start_ns", 0)) / 1e6
+        # explicit nulls from a foreign writer must not TypeError here
+        dur_ms = (
+            max(
+                0,
+                (_num(s.get("end_ns"), 0) or 0)
+                - (_num(s.get("start_ns"), 0) or 0),
+            )
+            / 1e6
+        )
         text = f"{'  ' * depth}{s.get('name', '?')}"
         if s.get("kind") == "point":
             text += "  ·"

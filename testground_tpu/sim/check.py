@@ -178,6 +178,19 @@ RULES: tuple[Rule, ...] = (
         "telemetry",
         "telemetry plane disabled under a cohort config",
     ),
+    # ---- traffic matrix
+    Rule(
+        "netmatrix.needs-telemetry",
+        "error",
+        "netmatrix",
+        "netmatrix = true but the telemetry plane is off",
+    ),
+    Rule(
+        "netmatrix.cohort-disabled",
+        "warn",
+        "netmatrix",
+        "traffic matrix disabled under a cohort config",
+    ),
     Rule(
         "slo.invalid",
         "error",
@@ -336,6 +349,21 @@ def slo_requires_telemetry_message(count: int, disable_metrics: bool) -> str:
             "(--run-cfg telemetry=true)"
         )
         + "; refusing to run with unenforceable SLOs"
+    )
+
+
+def netmatrix_requires_telemetry_message(disable_metrics: bool) -> str:
+    """The netmatrix-without-telemetry refusal (executor + checker)."""
+    return (
+        "netmatrix = true but the telemetry plane is off"
+        + (
+            " (disable_metrics = true wins over everything)"
+            if disable_metrics
+            else " — the traffic matrix rides the telemetry chunk "
+            "flush; set telemetry = true in the runner config "
+            "(--run-cfg telemetry=true)"
+        )
+        + "; refusing to run with an unobservable matrix plane"
     )
 
 
@@ -605,6 +633,30 @@ def _check_run(ctx, run, findings) -> dict:
         )
         telemetry_on = False
 
+    # network-topology plane: same gate ladder as the executor —
+    # cohorts shed it (per-chunk leader-local delta reads), and asking
+    # for the matrix with the telemetry plane off is a hard refusal
+    # (the executor raises the same message at run time)
+    netmatrix_on = bool(getattr(ctx.cfg, "netmatrix", False))
+    if netmatrix_on and ctx.cohort:
+        _add(
+            findings,
+            "netmatrix.cohort-disabled",
+            "traffic matrix disabled for the cohort config (per-chunk "
+            "leader-local delta reads are not symmetric across "
+            "processes)",
+            run=run.id,
+        )
+        netmatrix_on = False
+    if netmatrix_on and not telemetry_on:
+        _add(
+            findings,
+            "netmatrix.needs-telemetry",
+            netmatrix_requires_telemetry_message(disable_metrics),
+            run=run.id,
+        )
+        netmatrix_on = False
+
     slo_plan = None
     try:
         slo_plan = build_slo_plan(vgroups, slo_specs)
@@ -667,6 +719,7 @@ def _check_run(ctx, run, findings) -> dict:
         "fault_specs": fault_specs,
         "trace_plan": trace_plan,
         "telemetry_on": telemetry_on,
+        "netmatrix_on": netmatrix_on,
     }
 
 
@@ -901,6 +954,7 @@ def _trace_one_program(ctx, run, resolved, findings, *, bucketed) -> None:
             live_counts=(
                 bucket_plan.live_counts if bucket_plan is not None else None
             ),
+            netmatrix=resolved["netmatrix_on"],
         )
     except Exception as e:  # noqa: BLE001 — build-time refusals
         _add(

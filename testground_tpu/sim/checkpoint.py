@@ -97,6 +97,7 @@ FORMAT_VERSION = 1
 _MANIFEST_KEY = "__manifest__"
 _LEAF_FMT = "leaf_{:05d}"
 _AUX_LAT_KEY = "aux_lat_hist"
+_AUX_NM_KEY = "aux_net_matrix"
 
 
 class CheckpointError(RuntimeError):
@@ -120,6 +121,7 @@ def run_identity(
     trace_specs: dict,
     hosts,
     bucket=None,
+    netmatrix: bool = False,
 ) -> dict:
     """The resume-compatibility identity of a run: everything that shapes
     the compiled program or the deterministic tick stream. A snapshot
@@ -165,6 +167,11 @@ def run_identity(
         # refuses to seed a program built under another. Keyed only when
         # bucketed, so pre-bucket snapshots keep resuming unchanged.
         **({"bucket": list(bucket)} if bucket else {}),
+        # traffic-matrix plane (sim/netmatrix.py): program-shaping (the
+        # matrix rides the carry) AND aux-shaping (the host accumulator
+        # + sim_netmatrix.jsonl alignment). Keyed only when on, so
+        # pre-matrix snapshots keep resuming unchanged.
+        **({"netmatrix": True} if netmatrix else {}),
     }
 
 
@@ -351,16 +358,16 @@ def list_snapshots(run_dir: str) -> list[tuple[int, str]]:
 
 
 def save_snapshot(
-    run_dir: str, manifest: dict, leaves: list, lat_hist=None
+    run_dir: str, manifest: dict, leaves: list, lat_hist=None, net_matrix=None
 ) -> tuple[str, int, float]:
     """Write one snapshot atomically; returns ``(path, bytes, write_ms)``.
 
     The archive is a plain (uncompressed) npz: carry leaves under
-    ``leaf_NNNNN``, the optional latency accumulator under
-    ``aux_lat_hist``, and the manifest JSON as a uint8 array under
-    ``__manifest__`` — ONE file, so ``os.replace`` makes the commit
-    atomic and a crash mid-write can never leave a half-snapshot under
-    a final name."""
+    ``leaf_NNNNN``, the optional latency and traffic-matrix accumulators
+    under ``aux_lat_hist`` / ``aux_net_matrix``, and the manifest JSON
+    as a uint8 array under ``__manifest__`` — ONE file, so
+    ``os.replace`` makes the commit atomic and a crash mid-write can
+    never leave a half-snapshot under a final name."""
     t0 = time.perf_counter()
     d = os.path.join(run_dir, CHECKPOINT_DIR)
     try:
@@ -370,6 +377,8 @@ def save_snapshot(
         }
         if lat_hist is not None:
             arrays[_AUX_LAT_KEY] = np.asarray(lat_hist)
+        if net_matrix is not None:
+            arrays[_AUX_NM_KEY] = np.asarray(net_matrix)
         arrays[_MANIFEST_KEY] = np.frombuffer(
             json.dumps(manifest).encode(), dtype=np.uint8
         )
@@ -462,6 +471,14 @@ def load_snapshot(path: str) -> tuple[dict, list]:
                         "refusing to resume"
                     )
                 manifest["_lat_hist"] = z[_AUX_LAT_KEY]
+            if manifest.get("aux", {}).get("net_matrix"):
+                if _AUX_NM_KEY not in names:
+                    raise CheckpointError(
+                        f"snapshot {path} manifest promises a traffic-"
+                        "matrix accumulator but the archive has none — "
+                        "corrupt; refusing to resume"
+                    )
+                manifest["_net_matrix"] = z[_AUX_NM_KEY]
     except CheckpointError:
         raise
     except (zipfile.BadZipFile, ValueError, KeyError, OSError, EOFError) as e:
@@ -574,6 +591,11 @@ class ResumeState:
     def lat_hist(self):
         h = self.manifest.get("_lat_hist")
         return None if h is None else np.asarray(h, dtype=np.int64)
+
+    @property
+    def net_matrix(self):
+        m = self.manifest.get("_net_matrix")
+        return None if m is None else np.asarray(m, dtype=np.int64)
 
     @property
     def aux(self) -> dict:
@@ -705,6 +727,7 @@ class RunCheckpointer:
         self.total_write_ms = 0.0
         self.errors = 0
         self._lat_hist = None  # [G, LATENCY_BINS] int64 mirror
+        self._net_mat = None  # [NM_CHANNELS, GH, GH] int64 mirror
         self._warned = False
 
     # fed from the run loop's lat_hist_cb (telemetry programs only):
@@ -716,6 +739,16 @@ class RunCheckpointer:
     def seed_lat_hist(self, acc) -> None:
         if acc is not None:
             self._lat_hist = np.asarray(acc, dtype=np.int64).copy()
+
+    # same mirror discipline for the traffic-matrix plane's accumulator
+    # (netmatrix programs only; fed from the loop's netmatrix_cb)
+    def on_net_matrix_delta(self, delta) -> None:
+        d = np.asarray(delta, dtype=np.int64)
+        self._net_mat = d if self._net_mat is None else self._net_mat + d
+
+    def seed_net_matrix(self, acc) -> None:
+        if acc is not None:
+            self._net_mat = np.asarray(acc, dtype=np.int64).copy()
 
     def observe(self, ticks: int, carry) -> None:
         chunk_index = int(ticks) // self.chunk
@@ -730,6 +763,7 @@ class RunCheckpointer:
             leaves, metas = snapshot_carry(carry)
             aux = dict(self.aux_cb() if self.aux_cb is not None else {})
             aux["lat_hist"] = self._lat_hist is not None
+            aux["net_matrix"] = self._net_mat is not None
             manifest = {
                 "version": FORMAT_VERSION,
                 "tick": int(ticks),
@@ -748,7 +782,11 @@ class RunCheckpointer:
                 **self.ident,
             }
             path, size, write_ms = save_snapshot(
-                self.run_dir, manifest, leaves, lat_hist=self._lat_hist
+                self.run_dir,
+                manifest,
+                leaves,
+                lat_hist=self._lat_hist,
+                net_matrix=self._net_mat,
             )
             prune_snapshots(self.run_dir, self.keep)
         except Exception as e:  # noqa: BLE001

@@ -60,7 +60,9 @@ from .net import (
     latency_histogram,
     make_link_state,
     purge_dst,
+    purge_dst_matrix,
 )
+from .netmatrix import NM_CHANNELS, NM_DELIVERED, NM_FAULT
 from .sync_kernel import (
     SyncState,
     live_per_group,
@@ -229,6 +231,20 @@ class SimCarry:
     # ticks (threaded through unchanged); the env virtualization, the
     # dst translation, and the PRNG derivation all read it.
     live_counts: jax.Array | None = None
+    # --- traffic-matrix plane (sim/netmatrix.py; None when the plane is
+    # compiled out): [NM_CHANNELS, GH, GH] int32 src-group × dst-group
+    # flow counts (GH = groups + one hosts row when additional hosts are
+    # attached), accumulated per tick and FLUSHED (read + zeroed) once
+    # per chunk beside lat_hist — the host accumulates chunk deltas in
+    # int64, so the device counter never wraps (a cell gains at most
+    # chunk·O·N per flush, far under 2^31 at any plannable scale).
+    net_mat: jax.Array | None = None
+    # [GH] float32 per-src-group bandwidth-queue backlog high-water
+    # (peak link busy-until horizon in ticks, the queue-depth shaping
+    # observable). Monotone max — read once at results, never flushed.
+    # None unless the matrix plane is on AND the plan declares the
+    # bandwidth_queue shaping stage.
+    net_bw_hiwater: jax.Array | None = None
 
 
 def build_groups(run_groups, parameters_of=None) -> tuple[GroupSpec, ...]:
@@ -266,6 +282,7 @@ class SimProgram:
         trace=None,
         transport: str = "xla",
         live_counts: tuple | None = None,
+        netmatrix: bool = False,
     ):
         self.tc = testcase
         self.groups = groups
@@ -354,6 +371,21 @@ class SimProgram:
         self._tele_k = (
             len(TELEMETRY_FIXED_COLUMNS) + len(groups) if telemetry else 0
         )
+        # Traffic-matrix plane (sim/netmatrix.py): [NM_CHANNELS, GH, GH]
+        # src-group × dst-group flow counters in the carry, flushed once
+        # per chunk beside the telemetry block. A static program-shaping
+        # option like telemetry/faults/trace — off compiles the
+        # identical pre-matrix program (zero-overhead contract, pinned
+        # by jaxpr equality). GH appends one hosts row past the declared
+        # groups so echo traffic is attributed, not lost, and the matrix
+        # sums reconcile against the flow totals EXACTLY.
+        self.netmatrix = bool(netmatrix)
+        if self.netmatrix and not self.telemetry:
+            raise ValueError(
+                "the traffic-matrix plane rides the telemetry chunk "
+                "flush: enable telemetry or drop netmatrix"
+            )
+        self._nm_gh = len(groups) + (1 if self.hosts else 0)
         # Fault-injection plane: a lowered FaultSchedule (sim/faults.py)
         # or None. A static program-shaping option like telemetry — the
         # schedule's event tensors bake into the traced tick, and None
@@ -520,6 +552,10 @@ class SimProgram:
                 np.full((len(self.hosts),), len(groups), np.int32),
             ]
         )
+        # lane → matrix row for the traffic-matrix plane: identical map,
+        # except host lanes land IN range (row len(groups) IS the hosts
+        # row when GH = G+1) so echo traffic stays accounted
+        self._nm_group_of = jnp.asarray(self._lat_group_of)
         self._chunk_fn: Callable | None = None
 
     # ------------------------------------------------------------ sharding
@@ -818,7 +854,12 @@ class SimProgram:
                 self.n_lanes,
                 cls.IN_MSGS,
                 cls.MSG_WIDTH,
-                track_src=cls.TRACK_SRC,
+                # the matrix plane forces the provenance plane on (it
+                # attributes deliveries and crash purges to sender
+                # groups); the plan-visible inbox src is re-zeroed in
+                # _tick when the plan itself opted out, so results stay
+                # bit-equal with the plane off
+                track_src=cls.TRACK_SRC or self.netmatrix,
                 # unsharded: flat planes in the scatters' linear layout
                 # (see Calendar docstring); sharded: 2-D rows whose
                 # N·SLOTS axis carries the instance-axis sharding. The
@@ -873,6 +914,18 @@ class SimProgram:
             live_counts=(
                 jnp.asarray(live_counts, jnp.int32)
                 if virt is not None
+                else None
+            ),
+            net_mat=(
+                jnp.zeros(
+                    (NM_CHANNELS, self._nm_gh, self._nm_gh), jnp.int32
+                )
+                if self.netmatrix
+                else None
+            ),
+            net_bw_hiwater=(
+                jnp.zeros((self._nm_gh,), jnp.float32)
+                if self.netmatrix and "bandwidth_queue" in cls.SHAPING
                 else None
             ),
         )
@@ -973,15 +1026,38 @@ class SimProgram:
             kill_l = _to_lanes(kill)
             # purge the victims' in-flight calendar rows (cond-gated: the
             # O(L·N·SLOTS) sweep runs only on ticks a crash fires)
-            cal0, purged_t = jax.lax.cond(
-                jnp.any(kill),
-                lambda c: purge_dst(c, kill_l),
-                lambda c: (c, jnp.int32(0)),
-                carry.cal,
-            )
+            if self.netmatrix:
+                # matrix-attributing purge: the same sweep also charges
+                # each killed message's (sender group, crashed receiver
+                # group) cell so chaos losses land in the right cells —
+                # accumulated straight into the carry to keep this
+                # phase's return signature unchanged
+                gh = self._nm_gh
+                cal0, purged_t, pmat = jax.lax.cond(
+                    jnp.any(kill),
+                    lambda c: purge_dst_matrix(
+                        c, kill_l, self._nm_group_of, gh
+                    ),
+                    lambda c: (
+                        c,
+                        jnp.int32(0),
+                        jnp.zeros((gh, gh), jnp.int32),
+                    ),
+                    carry.cal,
+                )
+                net_mat0 = carry.net_mat.at[NM_FAULT].add(pmat)
+            else:
+                cal0, purged_t = jax.lax.cond(
+                    jnp.any(kill),
+                    lambda c: purge_dst(c, kill_l),
+                    lambda c: (c, jnp.int32(0)),
+                    carry.cal,
+                )
+                net_mat0 = carry.net_mat
             carry = dataclasses.replace(
                 carry,
                 cal=cal0,
+                net_mat=net_mat0,
                 status=jnp.where(kill_l, CRASH, carry.status),
                 finished_at=jnp.where(kill_l, t, carry.finished_at),
             )
@@ -1228,13 +1304,71 @@ class SimProgram:
             "net_region_valid": net_region_valid,
         }
 
+    def _netmatrix_send(self, flow, dst) -> jax.Array:
+        """Scatter one tick's per-message send-side fates into the
+        [NM_CHANNELS, GH, GH] matrix delta. ``flow`` is the transport's
+        [4, M] per-original-message counts (sent copies, enqueued
+        copies, rejected, fault-killed — net.enqueue ``want_flow``) and
+        ``dst`` the POST-translation [O, n_lanes] physical destination
+        plane; message m's sender lane is ``m % n_lanes`` (the
+        transport's flattening order), and an invalid destination is
+        charged to its clipped lane's group — consistent on both the
+        sent and dropped sides, so conservation closes cell-wise. The
+        delivered channel is filled receiver-side (_netmatrix_delivered)
+        and the crash-purge fault term in _fault_phase."""
+        gh = self._nm_gh
+        g = self._nm_group_of
+        dst_f = dst.reshape(-1)
+        rows = dst_f.shape[0] // self.n_lanes
+        srcg = jnp.tile(g, rows)
+        dstg = g[jnp.clip(dst_f, 0, self.n_lanes - 1)]
+        cell = srcg * gh + dstg
+        sent_m, enq_m, rej_m, fault_m = flow
+        # per-message residual: copies that rolled the shaping dice and
+        # lost (loss/partition/filter/duplicate-then-drop) — the same
+        # identity the scalar dropped_t closes in _tick
+        drop_m = sent_m - enq_m - rej_m - fault_m
+        counts = jnp.stack([sent_m, enq_m, drop_m, rej_m, fault_m])
+        chan = jnp.asarray([0, 1, 3, 4, 5], jnp.int32)  # 2 = delivered
+        idx = chan[:, None] * (gh * gh) + cell[None, :]
+        flat = (
+            jnp.zeros((NM_CHANNELS * gh * gh,), jnp.int32)
+            .at[idx.reshape(-1)]
+            .add(counts.reshape(-1))
+        )
+        return flat.reshape(NM_CHANNELS, gh, gh)
+
+    def _netmatrix_delivered(self, inbox) -> jax.Array:
+        """[GH, GH] count of this tick's deliveries per (sender group,
+        receiver group) cell, read off the popped inbox BEFORE any
+        virtual-id translation: ``inbox.src`` holds PHYSICAL provenance
+        lanes there (the matrix plane forces track_src on) and column j
+        IS receiver lane j. Host echo deliveries land in the hosts
+        row/column, so Σ cells == delivered_t exactly."""
+        gh = self._nm_gh
+        g = self._nm_group_of
+        srcg = g[jnp.clip(inbox.src, 0, self.n_lanes - 1)]
+        dstg = g[None, :]
+        idx = jnp.where(inbox.valid, srcg * gh + dstg, jnp.int32(gh * gh))
+        return (
+            jnp.zeros((gh * gh,), jnp.int32)
+            .at[idx.reshape(-1)]
+            .add(1, mode="drop")
+            .reshape(gh, gh)
+        )
+
     def _net_commit_phase(self, cal, link, step: dict, t, k_msg, dead, virt=None):
         """Transport commit: enqueue this tick's sends into the calendar
         (the PERF.md hot path — three scatter/gather ops under xla, the
         hand-tiled kernels under pallas) and apply the plan-driven link
-        reconfigurations. Returns ``(cal, fb, link, bw_changed_t)`` —
-        the last is this tick's count of bandwidth changes under a
-        standing backlog (the HTB bound-approximation counter).
+        reconfigurations. Returns ``(cal, fb, link, bw_changed_t,
+        nm_send)`` — ``bw_changed_t`` is this tick's count of bandwidth
+        changes under a standing backlog (the HTB bound-approximation
+        counter), ``nm_send`` the traffic-matrix send-side delta (None
+        when the matrix plane is compiled out). The matrix scatter reads
+        the transport's already-materialized per-message flow tensor —
+        OUTSIDE the pallas commit kernel — so both backends produce
+        bit-equal matrices.
 
         Under shape bucketing (``virt``), plan-emitted VIRTUAL
         destinations translate to physical lanes here — one select per
@@ -1267,9 +1401,16 @@ class SimProgram:
             # flight recorder: per-message transport fate for traced
             # send events (compiled out when no trace plan is declared)
             want_fate=self.trace is not None,
+            # traffic matrix: per-message flow counts (same tensors the
+            # fate plane reads, summed with .add instead of .max)
+            want_flow=self.netmatrix,
             transport=self.transport,
             dice_idx=midx,
         )
+        nm_send = None
+        if self.netmatrix:
+            with jax.named_scope("tg.netmatrix_send"):
+                nm_send = self._netmatrix_send(fb.flow, dst)
         new_link = apply_net_updates(
             link,
             step["net_shape"],
@@ -1294,7 +1435,7 @@ class SimProgram:
                 new_link.egress[_BW] != link.egress[_BW]
             ) & (fb.backlog > 0)
             bw_changed_t = jnp.sum(changed.astype(jnp.int32))
-        return cal, fb, new_link, bw_changed_t
+        return cal, fb, new_link, bw_changed_t, nm_send
 
     def _telemetry_phase(
         self,
@@ -1366,6 +1507,23 @@ class SimProgram:
         virt = self._virt(carry.live_counts)
         with jax.named_scope("tg.deliver"):
             cal, inbox_all = deliver(carry.cal, t, transport=self.transport)
+        nm_del = None
+        if self.netmatrix:
+            # receiver-side matrix capture on the PHYSICAL inbox (before
+            # any virtual-id translation below)
+            with jax.named_scope("tg.netmatrix_deliver"):
+                nm_del = self._netmatrix_delivered(inbox_all)
+            if not type(self.tc).TRACK_SRC:
+                # the plan opted out of provenance but the matrix plane
+                # forced the src plane on — hand the plan the all-zero
+                # src values a valid-plane calendar serves (net.deliver
+                # track_src=False contract), so plan behavior and
+                # results stay bit-equal with the plane off
+                inbox_all = Inbox(
+                    payload=inbox_all.payload,
+                    src=jnp.zeros_like(inbox_all.src),
+                    valid=inbox_all.valid,
+                )
         if virt is not None:
             # delivered provenance back to virtual ids (plans reply to
             # inbox.src — the values must match the unpadded run's)
@@ -1401,7 +1559,7 @@ class SimProgram:
 
         net_key, k_msg = jax.random.split(carry.net_key)
         with jax.named_scope("tg.net_commit"):
-            cal, fb, link, bw_changed_t = self._net_commit_phase(
+            cal, fb, link, bw_changed_t, nm_send = self._net_commit_phase(
                 cal, carry.link, step, t, k_msg, dead, virt=virt
             )
         with jax.named_scope("tg.sync"):
@@ -1467,6 +1625,25 @@ class SimProgram:
                     else None
                 ),
                 live_counts=carry.live_counts,
+                # traffic matrix: the fault-phase purge term is already
+                # inside carry.net_mat (accumulated there to keep the
+                # phase signature stable); fold in this tick's send-side
+                # channels and the receiver-side delivered cells
+                net_mat=(
+                    carry.net_mat + nm_send.at[NM_DELIVERED].add(nm_del)
+                    if self.netmatrix
+                    else None
+                ),
+                net_bw_hiwater=(
+                    jnp.maximum(
+                        carry.net_bw_hiwater,
+                        jnp.zeros_like(carry.net_bw_hiwater)
+                        .at[self._nm_group_of]
+                        .max(link.backlog),
+                    )
+                    if carry.net_bw_hiwater is not None
+                    else None
+                ),
             )
         )
         # flight-recorder event rows for this tick ([R, 5] int32; R = 0
@@ -1653,6 +1830,14 @@ class SimProgram:
                 carry, lat_hist=jnp.zeros_like(carry.lat_hist)
             )
             out[0] = carry
+        if self.netmatrix:
+            # flush-and-zero the traffic-matrix delta (same discipline:
+            # the host accumulates chunk deltas in int64)
+            out.append(carry.net_mat)
+            carry = dataclasses.replace(
+                carry, net_mat=jnp.zeros_like(carry.net_mat)
+            )
+            out[0] = carry
         if self.trace is not None:
             out.append(trows)
         return tuple(out)
@@ -1723,6 +1908,7 @@ class SimProgram:
         telemetry_cb: Callable[[np.ndarray], None] | None = None,
         lat_hist_cb: Callable[[np.ndarray], None] | None = None,
         trace_cb: Callable[[np.ndarray], None] | None = None,
+        netmatrix_cb: Callable[[np.ndarray], None] | None = None,
         chunk_timeout: float = 0.0,
         on_stall: Callable[[int, int], None] | None = None,
         nan_guard: bool = False,
@@ -1730,6 +1916,7 @@ class SimProgram:
         resume_carry=None,
         resume_ticks: int = 0,
         lat_hist_init=None,
+        net_mat_init=None,
         live_counts=None,
     ) -> dict[str, Any]:
         """Step to completion. Returns host-side results:
@@ -1762,14 +1949,20 @@ class SimProgram:
         leaf and tick range — a debug flag (each scan is a device→host
         read of the whole carry).
 
+        ``netmatrix_cb(delta)`` receives each chunk's traffic-matrix
+        delta ([NM_CHANNELS, GH, GH] host int64; netmatrix programs
+        only) under the same piggyback contract — the loop already read
+        it for the ``results()['net_matrix']`` accumulator, so the
+        callback adds no device traffic and no extra syncs.
+
         ``resume_carry`` seeds the loop with an already-device-resident
         carry instead of ``init_carry(seed)`` — the checkpoint plane's
         restore path (``sim/checkpoint.py``): ``resume_ticks`` fast-
         forwards the tick counter to the snapshot's chunk boundary and
-        ``lat_hist_init`` re-seeds the host-side latency-histogram
-        accumulator, so a resumed run's results are leaf-for-leaf those
-        of an uninterrupted one (pinned by
-        ``tests/test_sim_checkpoint.py``).
+        ``lat_hist_init`` / ``net_mat_init`` re-seed the host-side
+        latency-histogram and traffic-matrix accumulators, so a resumed
+        run's results are leaf-for-leaf those of an uninterrupted one
+        (pinned by ``tests/test_sim_checkpoint.py``).
 
         ``perf`` is a performance-ledger hook object (``sim/perf.py``):
         ``on_compile(lower_secs, compile_secs, compiled)`` fires once
@@ -1828,6 +2021,14 @@ class SimProgram:
                 if lat_hist_init is not None
                 else np.zeros((len(self.groups), LATENCY_BINS), np.int64)
             )
+        net_mat_acc = None
+        if self.netmatrix:
+            gh = self._nm_gh
+            net_mat_acc = (
+                np.asarray(net_mat_init, np.int64).copy()
+                if net_mat_init is not None
+                else np.zeros((NM_CHANNELS, gh, gh), np.int64)
+            )
         while ticks < max_ticks:
             # the first dispatch includes trace + XLA compile (and under
             # a mesh the second recompiles at the sharding fixed point —
@@ -1885,6 +2086,12 @@ class SimProgram:
                 if lat_hist_cb is not None:
                     lat_hist_cb(delta)
                 block_idx = 4
+            if self.netmatrix:
+                nm_delta = np.asarray(out[block_idx], dtype=np.int64)
+                net_mat_acc += nm_delta
+                if netmatrix_cb is not None:
+                    netmatrix_cb(nm_delta)
+                block_idx += 1
             if self.trace is not None and trace_cb is not None:
                 trace_cb(np.asarray(out[block_idx]))
             if on_chunk is not None:
@@ -1902,6 +2109,11 @@ class SimProgram:
             # telemetry.LATENCY_BINS) — Σ over bins == delivered plan
             # messages, exactly (host lanes excluded)
             res["lat_hist"] = lat_hist_acc.tolist()
+        if net_mat_acc is not None:
+            # cumulative src-group × dst-group traffic matrix
+            # ([NM_CHANNELS, GH, GH]; sim/netmatrix.py channel order) —
+            # per channel, Σ cells equals the flow total exactly
+            res["net_matrix"] = net_mat_acc.tolist()
         return res
 
     def virtual_groups(self, live_counts=None) -> tuple[GroupSpec, ...]:
@@ -2011,6 +2223,18 @@ class SimProgram:
             "faults_crashed": int(to_host(carry.faults_crashed)),
             "faults_restarted": int(to_host(carry.faults_restarted)),
             "fault_dropped": _acc_total(to_host(carry.fault_dropped)),
+            # bandwidth-queue depth high-water per src group (matrix
+            # plane + bandwidth_queue shaping only — monotone max, read
+            # once here rather than flushed per chunk)
+            **(
+                {
+                    "net_bw_hiwater": to_host(
+                        carry.net_bw_hiwater
+                    ).tolist()
+                }
+                if carry.net_bw_hiwater is not None
+                else {}
+            ),
             # device-resident carry footprint (eval_shape — no compile):
             # always reported so memory is part of every run's record
             "carry_bytes": self.estimate_carry_bytes(),

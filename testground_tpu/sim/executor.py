@@ -80,6 +80,17 @@ class SimJaxConfig:
     # flag poll (zero extra host syncs); off by default because a
     # 100k-tick run writes 100k jsonl rows
     telemetry: bool = False
+    # network topology plane (docs/OBSERVABILITY.md "Traffic matrix",
+    # sim/netmatrix.py): compile the src-group × dst-group traffic
+    # matrix into the jitted tick's carry and flush it once per chunk
+    # beside the telemetry block (zero extra host syncs) into
+    # sim_netmatrix.jsonl + journal sim.net_matrix — who talks to whom,
+    # per channel (sent/enqueued/delivered/dropped/rejected/
+    # fault_dropped), reconciling EXACTLY against the flow totals.
+    # Requires telemetry=true (refused loudly otherwise, same contract
+    # as the SLO plane); cohorts run matrix-free like every telemetry
+    # surface. The `tg netmap` backend. CLI: --run-cfg netmatrix=true
+    netmatrix: bool = False
     # performance ledger (docs/OBSERVABILITY.md "Performance ledger"):
     # per-chunk dispatch wall / ticks/s / peer·ticks/s rows into
     # sim_perf.jsonl, the AOT lower-vs-compile split, XLA cost/memory
@@ -288,6 +299,7 @@ def make_sim_program(
     trace,
     transport,
     live_counts,
+    netmatrix,
 ):
     """The ONE construction site for a run's SimProgram. Every
     program-shaping option is a REQUIRED keyword: adding one here forces
@@ -311,6 +323,7 @@ def make_sim_program(
         trace=trace,
         transport=transport,
         live_counts=live_counts,
+        netmatrix=netmatrix,
     )
 
 
@@ -757,7 +770,7 @@ def _execute_sim_run(
                 "the wrong topology"
             )
 
-    from .telemetry import SIM_SERIES_FILE
+    from .telemetry import NETMATRIX_FILE, SIM_SERIES_FILE
 
     artifact = job.groups[0].artifact_path
     spans.start("build")
@@ -835,6 +848,15 @@ def _execute_sim_run(
     # under bucketing the lowered masks then scatter onto the padded
     # physical axis (dead pad lanes are never selected)
     fault_schedule = build_fault_schedule(vgroups, fault_specs, cfg.tick_ms)
+    # network-topology plane: the static which-pairs-does-chaos-degrade
+    # view (journal sim.net_matrix.faulted_pairs) reads the schedule in
+    # the EXACT layout, so it is captured before any bucket remap
+    # scatters the masks onto the padded physical axis
+    nm_faulted = None
+    if fault_schedule is not None and bool(getattr(cfg, "netmatrix", False)):
+        from .netmatrix import faulted_pairs
+
+        nm_faulted = faulted_pairs(fault_schedule, vgroups)
     if fault_schedule is not None and bucket_plan is not None:
         from .faults import remap_schedule
 
@@ -905,6 +927,26 @@ def _execute_sim_run(
             job.run_id,
         )
         telemetry_on = False
+    # network topology plane: same gating discipline as telemetry (it
+    # IS a telemetry surface — the matrix rides the telemetry chunk
+    # flush). Cohorts silently shed it with the rest of the telemetry
+    # plane; a netmatrix request WITHOUT telemetry is refused loudly
+    # (shared message with the static checker, rule
+    # netmatrix.needs-telemetry) rather than silently unhonored.
+    netmatrix_on = bool(getattr(cfg, "netmatrix", False))
+    if netmatrix_on and getattr(cfg, "coordinator_address", ""):
+        ow.warn(
+            "sim:jax %s: traffic matrix disabled for the cohort config "
+            "(it rides the telemetry plane, which cohorts run without)",
+            job.run_id,
+        )
+        netmatrix_on = False
+    if netmatrix_on and not telemetry_on:
+        from .check import netmatrix_requires_telemetry_message
+
+        raise ValueError(
+            netmatrix_requires_telemetry_message(job.disable_metrics)
+        )
     # run health plane (docs/OBSERVABILITY.md "Run health plane"): lower
     # the composition's [[run.slo]] tables into a static SloPlan. NOT a
     # program-shaping option — evaluation is host-side over the chunk
@@ -1042,6 +1084,7 @@ def _execute_sim_run(
         live_counts=(
             bucket_plan.live_counts if bucket_plan is not None else None
         ),
+        netmatrix=netmatrix_on,
     )
     _precheck_device_memory(prog, cfg, mesh, ow)
     # the device-resident carry footprint is ALWAYS part of the run
@@ -1119,6 +1162,7 @@ def _execute_sim_run(
                 if bucket_plan is not None
                 else None
             ),
+            netmatrix=netmatrix_on,
         )
         source_run = None
         own_snaps = list_snapshots(run_dir) if run_dir is not None else []
@@ -1284,6 +1328,23 @@ def _execute_sim_run(
             rows_offset=int(resume_aux.get("telemetry_rows", 0) or 0),
         )
         if telemetry_on
+        else None
+    )
+    # Traffic-matrix sink (network topology plane): per-chunk sparse
+    # cell deltas stream to sim_netmatrix.jsonl as they arrive — the
+    # delta arrays are the ones the run loop already read for its own
+    # accumulator, so the writer adds no device traffic.
+    netmatrix_writer = (
+        _SimNetMatrixWriter(
+            prog,
+            row_ident,
+            os.path.join(run_dir, NETMATRIX_FILE)
+            if run_dir is not None
+            else None,
+            append=resume_state is not None,
+            chunks_offset=int(resume_aux.get("netmatrix_chunks", 0) or 0),
+        )
+        if netmatrix_on
         else None
     )
     # Flight-recorder sink: per-chunk [chunk, R, 5] event blocks stream
@@ -1510,6 +1571,15 @@ def _execute_sim_run(
                         )
                     except OSError:
                         pass
+            if netmatrix_writer is not None:
+                aux["netmatrix_chunks"] = netmatrix_writer.chunks_written
+                if netmatrix_writer.path is not None:
+                    try:
+                        streams[NETMATRIX_FILE] = os.path.getsize(
+                            netmatrix_writer.path
+                        )
+                    except OSError:
+                        pass
             if recorder.enabled:
                 aux["recorder"] = recorder.state_dict()
             aux["streams"] = streams
@@ -1546,6 +1616,8 @@ def _execute_sim_run(
             recorder.load_state(resume_aux["recorder"])
         if checkpointer is not None and resume_state.lat_hist is not None:
             checkpointer.seed_lat_hist(resume_state.lat_hist)
+        if checkpointer is not None and resume_state.net_matrix is not None:
+            checkpointer.seed_net_matrix(resume_state.net_matrix)
         resume_carry = restore_carry(
             prog, cfg.seed, resume_state.manifest, resume_state.leaves
         )
@@ -1606,6 +1678,28 @@ def _execute_sim_run(
             for cb in _lat_cbs:
                 cb(delta)
 
+    _nm_cbs = [
+        cb
+        for cb in (
+            netmatrix_writer.on_delta if netmatrix_writer else None,
+            (
+                checkpointer.on_net_matrix_delta
+                if checkpointer is not None and netmatrix_on
+                else None
+            ),
+        )
+        if cb is not None
+    ]
+    if not _nm_cbs:
+        _nm_cb = None
+    elif len(_nm_cbs) == 1:
+        _nm_cb = _nm_cbs[0]
+    else:
+
+        def _nm_cb(delta):
+            for cb in _nm_cbs:
+                cb(delta)
+
     def _run():
         return prog.run(
             seed=cfg.seed,
@@ -1616,6 +1710,7 @@ def _execute_sim_run(
             telemetry_cb=_tele_cb,
             lat_hist_cb=_lat_cb,
             trace_cb=trace_writer.on_block if trace_writer else None,
+            netmatrix_cb=_nm_cb,
             chunk_timeout=float(getattr(cfg, "chunk_timeout_secs", 0.0)),
             on_stall=on_stall,
             # same rule as telemetry: a leader-local full-carry read is
@@ -1628,6 +1723,11 @@ def _execute_sim_run(
             resume_ticks=resume_state.tick if resume_state else 0,
             lat_hist_init=(
                 resume_state.lat_hist if resume_state is not None else None
+            ),
+            net_mat_init=(
+                resume_state.net_matrix
+                if resume_state is not None
+                else None
             ),
         )
 
@@ -1818,6 +1918,66 @@ def _execute_sim_run(
                 "in_flight": res["cal_depth"],
                 "fault_dropped": res.get("fault_dropped", 0),
             },
+        }
+
+    # ------------------------------------------- network topology plane
+    # journaled under sim.net_matrix (sim/netmatrix.py): the [G(+hosts)]²
+    # traffic matrix accumulated on device, its EXACT conservation
+    # verdict against the flow totals, the bounded top-K pair view (the
+    # same contract the tg_net_pair_* gauges export — never raw G²),
+    # link-shaping observables, and the static faulted-window pair
+    # counts. A non-empty ``mismatches`` list is an engine bug: recorded
+    # loudly in the journal and the task log, never papered over.
+    net_matrix_block = None
+    if netmatrix_writer is not None:
+        netmatrix_writer.close()
+    if netmatrix_on and res.get("net_matrix") is not None:
+        from . import netmatrix as _netmatrix
+
+        nm_mat = np.asarray(res["net_matrix"], np.int64)
+        nm_labels = [g.id for g in groups]
+        if nm_mat.shape[1] > len(nm_labels):
+            nm_labels.append("hosts")
+        nm_pairs, nm_elided = _netmatrix.top_pairs(nm_mat, 16)
+        nm_mismatches = _netmatrix.reconcile(nm_mat, res)
+        if nm_mismatches:
+            ow.warn(
+                "sim:jax %s: traffic matrix failed conservation — %s",
+                job.run_id,
+                "; ".join(nm_mismatches),
+            )
+        net_matrix_block = {
+            "labels": nm_labels,
+            "matrix": nm_mat.tolist(),
+            "totals": _netmatrix.matrix_totals(nm_mat),
+            "bytes_total": int(_netmatrix.matrix_bytes(nm_mat).sum()),
+            "top_pairs": nm_pairs,
+            "elided_pairs": nm_elided,
+            "mismatches": nm_mismatches,
+            # per-src-group bandwidth-queue depth high-water (messages)
+            # — present only when the plan shapes with bandwidth_queue
+            **(
+                {"bw_queue_hiwater": res["net_bw_hiwater"]}
+                if res.get("net_bw_hiwater") is not None
+                else {}
+            ),
+            # which group pairs the declared chaos schedule degrades
+            # (drop/loss windows covering the pair) — static view,
+            # computed from the lowered schedule in the exact layout
+            **(
+                {"faulted_pairs": nm_faulted.tolist()}
+                if nm_faulted is not None
+                else {}
+            ),
+            **(
+                {
+                    "file": NETMATRIX_FILE,
+                    "chunks": netmatrix_writer.chunks_written,
+                }
+                if netmatrix_writer is not None
+                and netmatrix_writer.path is not None
+                else {}
+            ),
         }
 
     # ------------------------------------------- delivery-latency summary
@@ -2150,6 +2310,10 @@ def _execute_sim_run(
         # phase attribution plane (per-phase cost ledger + residual;
         # docs/OBSERVABILITY.md "Phase attribution") — opt-in, phases=true
         **({"phases": phases_block} if phases_block else {}),
+        # network topology plane (docs/OBSERVABILITY.md "Traffic
+        # matrix") — present when netmatrix=true resolved on; the block
+        # `tg netmap` and the tg_net_pair_* gauges read
+        **({"net_matrix": net_matrix_block} if net_matrix_block else {}),
         # checkpoint/resume plane (docs/CHECKPOINT.md) — present when
         # snapshots were armed or the run resumed from one
         **({"checkpoint": checkpoint_block} if checkpoint_block else {}),
@@ -2326,6 +2490,10 @@ def execute_packed_sim_runs(
         live_counts=(
             bucket_plan.live_counts if bucket_plan is not None else None
         ),
+        # the matrix plane is a pack exclusion (engine/pack.py): a
+        # member asking for netmatrix runs solo, so the shared pack
+        # program is always matrix-free
+        netmatrix=False,
     )
     width = pack_width(len(jobs), int(getattr(cfg, "pack_max", 8) or 8))
     runner = PackRunner(prog, width)
@@ -2896,6 +3064,10 @@ def sim_worker_loop(
             # runtime-N carry input is leader-local and a padded layout
             # would have to ride the broadcast symmetrically
             live_counts=None,
+            # cohorts run matrix-free (the leader sheds netmatrix with a
+            # warning — per-chunk leader-local delta reads are not
+            # symmetric across processes), so the spec never carries it
+            netmatrix=False,
         )
         res = prog.run(
             seed=spec["seed"],
@@ -3160,6 +3332,70 @@ class _SimTelemetryWriter:
         if self.path is None:
             return
         yield from iter_jsonl(self.path)
+
+
+class _SimNetMatrixWriter:
+    """Streams the chunk-flushed traffic-matrix deltas (network topology
+    plane, ``sim/netmatrix.py``) to the run's ``sim_netmatrix.jsonl``:
+    one row per chunk, sparse nonzero cells only, so a quiet topology
+    costs bytes-per-chunk and a hot one is bounded by the pairs that
+    actually talked. EXACTLY one row per chunk dispatch — deterministic
+    row count, which is what lets the checkpoint plane align the stream
+    byte-exactly on resume. Same best-effort discipline as the
+    telemetry writer: an unwritable file drops to counting, never fails
+    the run."""
+
+    def __init__(
+        self,
+        prog,
+        ident: dict,
+        path: str | None,
+        append: bool = False,
+        chunks_offset: int = 0,
+    ):
+        self.chunk = int(prog.chunk)
+        self.ident = ident
+        self.path = path
+        self.chunks_written = int(chunks_offset)
+        self._f = None
+        if path is not None:
+            try:
+                self._f = open(path, "a" if append else "w")
+            except OSError:
+                self.path = None
+
+    def on_delta(self, delta) -> None:
+        from .netmatrix import delta_row
+
+        idx = self.chunks_written
+        self.chunks_written += 1
+        if self._f is None:
+            return
+        row = delta_row(
+            delta,
+            tick=(idx + 1) * self.chunk,
+            chunk=idx,
+            ident=self.ident,
+        )
+        try:
+            self._f.write(json.dumps(row) + "\n")
+            self._f.flush()
+        except (OSError, ValueError):
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+            self.path = None
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                self.path = None
+            finally:
+                self._f = None
 
 
 class _SimTraceWriter:

@@ -64,6 +64,7 @@ __all__ = [
     "latency_histogram",
     "make_link_state",
     "purge_dst",
+    "purge_dst_matrix",
 ]
 
 # LinkShape plane indices (order of network.LinkShape fields,
@@ -189,6 +190,16 @@ class NetFeedback:
                None unless ``want_fate`` was requested (trace plane
                compiled in); duplicate-shaping copies report through
                their original's fate (enqueued if either copy made it)
+    flow:      [4, O·N] int32 | None — per-message flow COUNTS in the
+               ORIGINAL outbox order, for the traffic-matrix plane
+               (``sim/netmatrix.py``): row 0 copies entering the
+               transport (1 per valid outbox entry, +1 for a duplicate-
+               shaping copy), row 1 copies actually enqueued into the
+               calendar, row 2 rejected (0/1), row 3 fault-dropped
+               (0/1). Per message, dropped = row0 − row1 − row2 − row3,
+               so the scalar conservation identity closes CELL-WISE
+               after any per-(src, dst) scatter. None unless
+               ``want_flow`` was requested (identical program when off)
     """
 
     rejected: jax.Array
@@ -201,6 +212,7 @@ class NetFeedback:
     enqueued: jax.Array
     fault_dropped: jax.Array
     fate: jax.Array | None = None
+    flow: jax.Array | None = None
 
 
 @jax.tree_util.register_dataclass
@@ -435,6 +447,49 @@ def purge_dst(cal: Calendar, dst_mask: jax.Array) -> tuple[Calendar, jax.Array]:
     return cal, purged
 
 
+def purge_dst_matrix(
+    cal: Calendar, dst_mask: jax.Array, group_of, gh: int
+) -> tuple[Calendar, jax.Array, jax.Array]:
+    """:func:`purge_dst` with per-(src group, dst group) attribution for
+    the traffic-matrix plane: every purged in-flight message is charged
+    to the (sender group, crashed-receiver group) cell, so crash kills
+    land in the right ``fault_dropped`` cells and the matrix keeps exact
+    conservation through chaos. Needs provenance (``track_src=True`` —
+    the matrix plane forces it): the occupancy plane stores src+1, so
+    the sender of every live slot is recoverable without extra state.
+
+    ``group_of`` is [N] int32 lane → group (host lanes mapped to the
+    extra hosts row); ``gh`` the static matrix side (groups + hosts
+    row). Returns ``(cal', purged_count, mat [gh, gh] int32)``."""
+    assert cal.src is not None, (
+        "purge_dst_matrix needs a Calendar built with track_src=True"
+    )
+    slots = cal.slots
+    plane = cal.src
+    if cal.flat:
+        ns = plane.shape[0] // cal.horizon
+    else:
+        ns = plane.shape[1]
+    n = ns // slots
+    view = plane.reshape(-1, n)
+    kill = (view != 0) & dst_mask[None, :]
+    purged = jnp.sum(kill.astype(jnp.int32))
+    g = jnp.asarray(group_of, jnp.int32)
+    srcg = g[jnp.clip(view - 1, 0, n - 1)]  # [L·SLOTS, N]
+    dstg = g[None, :]  # column j IS receiver lane j
+    idx = jnp.where(kill, srcg * gh + dstg, jnp.int32(gh * gh))
+    mat = (
+        jnp.zeros((gh * gh,), jnp.int32)
+        .at[idx.reshape(-1)]
+        .add(1, mode="drop")
+        .reshape(gh, gh)
+    )
+    new_plane = jnp.where(kill, jnp.zeros_like(view), view).reshape(
+        plane.shape
+    )
+    return dataclasses.replace(cal, src=new_plane), purged, mat
+
+
 def latency_histogram(
     cal: Calendar,
     inbox: Inbox,
@@ -505,6 +560,7 @@ def enqueue(
     faults=None,
     dead: jax.Array | None = None,
     want_fate: bool = False,
+    want_flow: bool = False,
     transport: str = "xla",
     dice_idx: jax.Array | None = None,
 ) -> tuple[Calendar, NetFeedback]:
@@ -556,6 +612,11 @@ def enqueue(
     return ``NetFeedback.fate``, the per-message transport fate in
     original outbox order. Compiled out (fate = None, identical program)
     when False.
+
+    ``want_flow`` — traffic-matrix support (``sim/netmatrix.py``): also
+    return ``NetFeedback.flow``, the per-message flow counts in
+    original outbox order (see :class:`NetFeedback`). Compiled out
+    (flow = None, identical program) when False.
 
     A calendar built with ``track_etick=True`` additionally records each
     enqueued message's send tick, the latency plane's ground truth
@@ -613,6 +674,8 @@ def enqueue(
     # bounds masking — out-of-range dsts count as sent-then-dropped);
     # duplicate-shaping copies are added below so conservation closes
     sent = jnp.sum(val_f.astype(jnp.int32))
+    # traffic matrix (want_flow): the same quantity per ORIGINAL message
+    sent_m = val0.astype(jnp.int32) if want_flow else None
 
     def eg(plane):
         # per-message egress attribute: src_f == midx % n, so the gather
@@ -948,6 +1011,22 @@ def enqueue(
         f = jnp.where(survived, 0, f)  # enqueued
         return jnp.where(val0, f, -1)
 
+    def flow_of(enq_m):
+        """Per-message flow counts in original order (see
+        NetFeedback.flow); ``enq_m`` is [M] int32 enqueued-copy counts
+        (a duplicate-shaping original and its copy merge by sum)."""
+        if not want_flow:
+            return None
+        z = jnp.zeros((m,), jnp.int32)
+        return jnp.stack(
+            [
+                sent_m,
+                enq_m,
+                rej_m.astype(jnp.int32) if rej_m is not None else z,
+                fault_m.astype(jnp.int32) if fault_m is not None else z,
+            ]
+        )
+
     if slot_mode == "direct":
         # slot = the sender's outbox index: one scatter index per message
         # with no sort and no duplicate pass. Unique under the mode's
@@ -1026,6 +1105,7 @@ def enqueue(
                 enqueued=jnp.sum(val_f.astype(jnp.int32)),
                 fault_dropped=fault_dropped,
                 fate=fate_of(val_f),
+                flow=flow_of(val_f.astype(jnp.int32)),
             ),
         )
 
@@ -1035,6 +1115,8 @@ def enqueue(
         if is_ctrl is not None:
             dup = dup & ~is_ctrl
         sent = sent + jnp.sum(dup.astype(jnp.int32))
+        if want_flow:
+            sent_m = sent_m + dup.astype(jnp.int32)
         dst2 = jnp.concatenate([dst_safe, dst_safe])
         pay2 = [jnp.concatenate([p, p]) for p in pay_w]
         src2 = jnp.concatenate([src_f, src_f])
@@ -1049,9 +1131,14 @@ def enqueue(
             [delay, jnp.clip(delay + 1, 1, horizon - 1)]
         )
         m2 = 2 * m
-        # fate rides the sort as the original message index; a duplicate
-        # copy shares its original's index (their fates merge by max)
-        orig2 = jnp.concatenate([midx, midx]) if want_fate else None
+        # fate/flow ride the sort as the original message index; a
+        # duplicate copy shares its original's index (fates merge by
+        # max, flow counts by sum)
+        orig2 = (
+            jnp.concatenate([midx, midx])
+            if want_fate or want_flow
+            else None
+        )
     else:
         dst2, pay2, src2, val2, delay2, m2 = (
             dst_safe,
@@ -1061,7 +1148,7 @@ def enqueue(
             delay,
             m,
         )
-        orig2 = midx if want_fate else None
+        orig2 = midx if want_fate or want_flow else None
 
     bucket = jnp.mod(t + delay2, horizon)
 
@@ -1099,13 +1186,21 @@ def enqueue(
         )
         if orig_s is not None:
             # map sorted survival back to original order (duplicate
-            # copies share an index; enqueued if either copy was)
+            # copies share an index). Fate needs only "either copy made
+            # it" (max); flow needs the copy COUNT (add) — the fate-only
+            # program keeps its scatter-max so the trace plane's jaxpr
+            # is untouched when the matrix plane is off.
+            acc = jnp.zeros((m,), jnp.int32)
             surv_orig = (
-                jnp.zeros((m,), jnp.int32).at[orig_s].max(survived)
+                acc.at[orig_s].add(survived)
+                if want_flow
+                else acc.at[orig_s].max(survived)
             )
             fate = fate_of(surv_orig > 0)
+            flow = flow_of(surv_orig)
         else:
             fate = None
+            flow = None
         return (
             cal,
             NetFeedback(
@@ -1119,6 +1214,7 @@ def enqueue(
                 enqueued=jnp.sum(survived),
                 fault_dropped=fault_dropped,
                 fate=fate,
+                flow=flow,
             ),
         )
 
@@ -1183,15 +1279,20 @@ def enqueue(
 
     if orig_s is not None:
         # map slot survival back to original order (duplicate copies
-        # share an index, so scatter-max: enqueued if either copy was)
+        # share an index). Scatter-max for fate ("enqueued if either
+        # copy was"), scatter-add when the matrix plane wants copy
+        # counts — fate-only programs keep their pre-matrix jaxpr.
+        acc = jnp.zeros((m,), jnp.int32)
         surv = (
-            jnp.zeros((m,), jnp.int32)
-            .at[orig_s]
-            .max(val_s.astype(jnp.int32))
+            acc.at[orig_s].add(val_s.astype(jnp.int32))
+            if want_flow
+            else acc.at[orig_s].max(val_s.astype(jnp.int32))
         )
         fate = fate_of(surv > 0)
+        flow = flow_of(surv)
     else:
         fate = None
+        flow = None
 
     return (
         dataclasses.replace(
@@ -1212,6 +1313,7 @@ def enqueue(
             enqueued=jnp.sum(val_s.astype(jnp.int32)),
             fault_dropped=fault_dropped,
             fate=fate,
+            flow=flow,
         ),
     )
 

@@ -29,6 +29,7 @@ from typing import Any, Iterable
 __all__ = [
     "LATENCY_BINS",
     "LATENCY_FILE",
+    "NETMATRIX_FILE",
     "PERF_FILE",
     "PHASES_FILE",
     "SIM_SERIES_FILE",
@@ -57,6 +58,10 @@ PERF_FILE = "sim_perf.jsonl"
 # analysis + optional measured ms/tick, one row per phase plus the
 # residual and whole-program rows) — the ``tg perf --phases`` backend.
 PHASES_FILE = "sim_phases.jsonl"
+# Per-chunk traffic-matrix deltas (sim/netmatrix.py: sparse nonzero
+# src-group × dst-group cells per chunk) — the ``sim.netmatrix.*``
+# measurement family and the ``tg netmap`` backend.
+NETMATRIX_FILE = "sim_netmatrix.jsonl"
 
 # Delivery-latency histogram schema, shared by the device accumulator
 # (``sim/net.py::latency_histogram``) and every host-side consumer. Bins

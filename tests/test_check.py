@@ -269,6 +269,23 @@ BAD_MATRIX = [
         "slo.needs-telemetry",
     ),
     (
+        "netmatrix-no-telemetry",
+        dict(run_cfg={"netmatrix": True, "max_ticks": 32}),
+        "netmatrix.needs-telemetry",
+    ),
+    (
+        "netmatrix-disable-metrics",
+        dict(
+            run_cfg={
+                "netmatrix": True,
+                "telemetry": True,
+                "max_ticks": 32,
+            },
+            disable_metrics=True,
+        ),
+        "netmatrix.needs-telemetry",
+    ),
+    (
         "cohort-spec-oversize",
         dict(
             run_cfg={"coordinator_address": "127.0.0.1:1", "max_ticks": 32},
@@ -384,6 +401,7 @@ class TestWarnParity:
             run_cfg={
                 "coordinator_address": "127.0.0.1:1",
                 "telemetry": True,
+                "netmatrix": True,
                 "checkpoint_chunks": 2,
                 "nan_guard": True,
                 "resume_from": "sometask",
@@ -395,6 +413,7 @@ class TestWarnParity:
         fired = {f.rule for f in fs}
         assert {
             "telemetry.cohort-disabled",
+            "netmatrix.cohort-disabled",
             "trace.cohort-disabled",
             "slo.cohort-disabled",
             "checkpoint.cohort-disabled",

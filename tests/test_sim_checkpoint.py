@@ -390,6 +390,7 @@ def _exec(run_id, cancel=None, env=None, **cfg_kw):
     env = env or EnvConfig.load()
     cfg_kw.setdefault("chunk", 16)
     cfg_kw.setdefault("telemetry", True)
+    cfg_kw.setdefault("netmatrix", True)
     cfg_kw.setdefault("seed", 5)
     cfg = SimJaxConfig(**cfg_kw)
     job = RunInput(
@@ -506,6 +507,34 @@ class TestExecutorResume:
         assert rows_full, "reference run produced no telemetry rows"
         assert _series_rows(env, "res") == rows_full
         assert _series_rows(env, "cut") == rows_full  # in-place resume
+
+    def test_resumed_netmatrix_stream_is_byte_equal(self, resumed_runs):
+        """The traffic-matrix stream is resume-aligned like telemetry:
+        a resumed run reproduces ``sim_netmatrix.jsonl`` row for row
+        (the writer seeks to the cut's chunk count, never duplicates or
+        skips a chunk delta)."""
+        env = resumed_runs["env"]
+        rows_full = _series_rows(env, "full", "sim_netmatrix.jsonl")
+        assert rows_full, "reference run produced no netmatrix rows"
+        # one row per chunk, ticks continue monotonically across resume
+        assert [r["chunk"] for r in rows_full] == list(range(len(rows_full)))
+        assert _series_rows(env, "res", "sim_netmatrix.jsonl") == rows_full
+        assert _series_rows(env, "cut", "sim_netmatrix.jsonl") == rows_full
+
+    def test_resumed_netmatrix_journal_equals_uninterrupted(
+        self, resumed_runs
+    ):
+        """The host-side matrix accumulator is aux checkpoint state: a
+        resume seeds it from the snapshot and lands on the exact same
+        totals as the uninterrupted run — conservation intact."""
+        nf = resumed_runs["full"].result.journal["sim"]["net_matrix"]
+        assert nf["mismatches"] == []
+        for label in ("res", "auto"):
+            nr = resumed_runs[label].result.journal["sim"]["net_matrix"]
+            assert nr["matrix"] == nf["matrix"], label
+            assert nr["totals"] == nf["totals"], label
+            assert nr["bytes_total"] == nf["bytes_total"], label
+            assert nr["mismatches"] == [], label
 
     def test_resume_provenance_recorded(self, resumed_runs):
         jr = resumed_runs["res"].result.journal["sim"]["checkpoint"]

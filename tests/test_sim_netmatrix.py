@@ -1,0 +1,619 @@
+"""Network-topology observability plane (docs/OBSERVABILITY.md "Traffic
+matrix"): the per-group [NM_CHANNELS, GH, GH] traffic matrix accumulated
+inside the jitted tick's carry and flushed once per chunk.
+
+Pins, mirroring the telemetry plane's acceptance style:
+
+1. **Exact conservation** — Σ matrix cells per channel equals the run's
+   cumulative flow totals, cell-wise send identity included, on BOTH
+   transports (xla and the pallas interpret gate) and with a hosts row.
+2. **Zero overhead** — the plane off leaves the chunk jaxpr untouched
+   and the plane on adds no blocking device→host sync beyond the
+   one done-flag poll per chunk the loop already pays.
+3. **Chaos bit-equality** — enabling the matrix perturbs NOTHING: the
+   flow totals and statuses of a faulted run are identical on/off, and
+   crash purges land in the fault_dropped channel at the right cells.
+4. **Bucketed demux** — a padded (bucketed) run reports the exact-N
+   matrix bit for bit.
+5. **The cut advisor** — exhaustive optimality on small G, greedy
+   cluster recovery on large G, the balance cap, canonical numbering.
+6. **Bounded cardinality** — the Prometheus page exports top-K pairs
+   plus one elision gauge, never raw G².
+"""
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from testground_tpu.api import RunGroup
+from testground_tpu.sim import engine as engine_mod
+from testground_tpu.sim import netmatrix as nm
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import (
+    instantiate_testcase,
+    load_sim_testcases,
+)
+
+from tests.test_sim_faults import _SlowPinger, conservation_ok, sched
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+def make_groups(*counts, params=None):
+    return build_groups(
+        [
+            RunGroup(id=f"g{i}", instances=c, parameters=dict(params or {}))
+            for i, c in enumerate(counts)
+        ]
+    )
+
+
+def plan_case(plan, case):
+    return load_sim_testcases(os.path.join(PLANS, plan))[case]()
+
+
+def pingpong_prog(counts=(2, 2), transport="xla", **kw):
+    kw.setdefault("chunk", 16)
+    kw.setdefault("telemetry", True)
+    return SimProgram(
+        plan_case("network", "ping-pong"),
+        make_groups(*counts),
+        transport=transport,
+        **kw,
+    )
+
+
+# ------------------------------------------------------------ schema pins
+
+
+class TestSchemaPins:
+    def test_msg_bytes_matches_the_wire_size(self):
+        """NM_MSG_BYTES is duplicated so sim/netmatrix.py stays jax-free
+        — it MUST track the transport's fixed message size."""
+        from testground_tpu.sim.net import MSG_BYTES
+
+        assert nm.NM_MSG_BYTES == MSG_BYTES
+
+    def test_channel_order_is_frozen(self):
+        """The jsonl cell schema and every host surface index by this
+        order — changing it is a wire-format break."""
+        assert nm.NM_CHANNEL_NAMES == (
+            "sent",
+            "enqueued",
+            "delivered",
+            "dropped",
+            "rejected",
+            "fault_dropped",
+        )
+        assert [
+            nm.NM_SENT,
+            nm.NM_ENQUEUED,
+            nm.NM_DELIVERED,
+            nm.NM_DROPPED,
+            nm.NM_REJECTED,
+            nm.NM_FAULT,
+        ] == list(range(nm.NM_CHANNELS))
+
+    def test_delta_rows_round_trip_the_matrix(self):
+        delta = np.zeros((nm.NM_CHANNELS, 3, 3), np.int64)
+        delta[nm.NM_SENT, 0, 2] = 7
+        delta[nm.NM_ENQUEUED, 0, 2] = 5
+        delta[nm.NM_DROPPED, 0, 2] = 2
+        delta[nm.NM_DELIVERED, 2, 1] = 4
+        row = nm.delta_row(delta, tick=16, chunk=0, ident={"run": "r"})
+        assert row["run"] == "r" and row["tick"] == 16
+        # sparse: only the two touched pairs, row-major
+        assert [c[:2] for c in row["cells"]] == [[0, 2], [2, 1]]
+        back = nm.matrix_from_rows([json.loads(json.dumps(row))], 3)
+        assert np.array_equal(back, delta)
+
+    def test_matrix_totals_and_bytes(self):
+        delta = np.zeros((nm.NM_CHANNELS, 2, 2), np.int64)
+        delta[nm.NM_ENQUEUED] = [[1, 2], [3, 4]]
+        assert nm.matrix_totals(delta)["enqueued"] == 10
+        assert nm.matrix_bytes(delta).sum() == 10 * nm.NM_MSG_BYTES
+
+
+# ----------------------------------------------------------- conservation
+
+
+class TestConservation:
+    @pytest.mark.parametrize("transport", ["xla", "pallas"])
+    def test_matrix_reconciles_exactly(self, transport):
+        """The acceptance invariant on both transports: per channel,
+        Σ cells == the engine's cumulative flow total, and the send-side
+        identity closes CELL-WISE."""
+        res = pingpong_prog(transport=transport, netmatrix=True).run(
+            max_ticks=256
+        )
+        mat = np.asarray(res["net_matrix"], np.int64)
+        assert mat.shape == (nm.NM_CHANNELS, 2, 2)
+        assert res["msgs_delivered"] > 0, "no traffic to meter"
+        assert nm.reconcile(mat, res) == []
+        # cell-wise send identity: sent = enqueued + dropped + rejected
+        # + fault_dropped at every (src, dst) pair
+        assert np.array_equal(
+            mat[nm.NM_SENT],
+            mat[nm.NM_ENQUEUED]
+            + mat[nm.NM_DROPPED]
+            + mat[nm.NM_REJECTED]
+            + mat[nm.NM_FAULT],
+        )
+
+    def test_chunk_deltas_sum_to_the_final_matrix(self):
+        """netmatrix_cb receives one host delta per chunk; their sum —
+        and the jsonl rows they encode — reconstruct results()'s
+        accumulated matrix bit for bit."""
+        prog = pingpong_prog(netmatrix=True)
+        deltas = []
+        res = prog.run(max_ticks=256, netmatrix_cb=deltas.append)
+        chunks = res["ticks"] // 16
+        assert len(deltas) == chunks, "expected one delta per chunk"
+        mat = np.asarray(res["net_matrix"], np.int64)
+        assert np.array_equal(np.sum(deltas, axis=0), mat)
+        rows = [
+            nm.delta_row(d, tick=(i + 1) * 16, chunk=i)
+            for i, d in enumerate(deltas)
+        ]
+        assert np.array_equal(nm.matrix_from_rows(rows, 2), mat)
+
+    def test_hosts_row_carries_echo_traffic(self):
+        """additional_hosts lanes land in the extra hosts row/column so
+        the matrix total still equals msgs_delivered exactly."""
+        prog = SimProgram(
+            plan_case("additional_hosts", "additional_hosts"),
+            make_groups(4),
+            chunk=16,
+            hosts=("http-echo",),
+            telemetry=True,
+            netmatrix=True,
+        )
+        res = prog.run(max_ticks=64)
+        mat = np.asarray(res["net_matrix"], np.int64)
+        assert mat.shape == (nm.NM_CHANNELS, 2, 2)  # g0 + hosts
+        assert nm.reconcile(mat, res) == []
+        # the echo round trip: requests into the hosts column, echoes
+        # back out of the hosts row
+        assert mat[nm.NM_DELIVERED, 0, 1] > 0  # g0 → hosts
+        assert mat[nm.NM_DELIVERED, 1, 0] > 0  # hosts → g0
+
+
+# ------------------------------------------------------------------ chaos
+
+
+class TestChaos:
+    def _run(self, netmatrix):
+        groups = make_groups(2, 2)
+        prog = SimProgram(
+            _SlowPinger(),  # 4-tick latency keeps messages in flight
+            groups,
+            chunk=8,
+            telemetry=True,
+            netmatrix=netmatrix,
+            faults=sched(
+                groups,
+                [{"kind": "crash", "start_ms": 10, "instances": "2:4"}],
+            ),
+        )
+        return prog.run(max_ticks=32)
+
+    def test_enabling_the_matrix_perturbs_nothing(self):
+        """Bit-equality under chaos: the matrix plane observes the same
+        deterministic run — every flow total and status identical with
+        the plane on or off."""
+        on, off = self._run(True), self._run(False)
+        for key in (
+            "ticks",
+            "msgs_sent",
+            "msgs_enqueued",
+            "msgs_delivered",
+            "msgs_dropped",
+            "msgs_rejected",
+            "fault_dropped",
+            "cal_depth",
+            "faults_crashed",
+        ):
+            assert on[key] == off[key], key
+        assert np.array_equal(on["status"], off["status"])
+        assert np.array_equal(on["finished_at"], off["finished_at"])
+        assert "net_matrix" not in off
+
+    def test_fault_drops_charge_the_crashed_cells(self):
+        """Crash losses (in-flight purges + send-time kills) land in the
+        fault_dropped channel at (sender, crashed-receiver) cells only —
+        g1 is the crashed group, so column g0 stays clean."""
+        res = self._run(True)
+        assert res["fault_dropped"] > 0 and conservation_ok(res)
+        mat = np.asarray(res["net_matrix"], np.int64)
+        assert nm.reconcile(mat, res) == []
+        fault = mat[nm.NM_FAULT]
+        assert fault[:, 1].sum() == res["fault_dropped"]
+        assert fault[:, 0].sum() == 0  # nobody lost traffic TO g0
+
+
+# ----------------------------------------------------------- zero overhead
+
+
+class TestZeroOverhead:
+    def test_plane_off_leaves_the_chunk_jaxpr_untouched(self):
+        """netmatrix=False (the default) is not merely 'matrix unused':
+        the traced chunk program is the identical jaxpr, and the carry
+        holds no matrix leaf to allocate or thread."""
+        a = pingpong_prog(netmatrix=False)
+        b = pingpong_prog()  # knob omitted entirely
+        carry = jax.eval_shape(lambda: a.init_carry(0))
+        assert carry.net_mat is None and carry.net_bw_hiwater is None
+        assert str(jax.make_jaxpr(a._chunk_step)(carry)) == str(
+            jax.make_jaxpr(b._chunk_step)(carry)
+        )
+        # ...while ON is program-shaping: the matrix leaf rides the carry
+        on = jax.eval_shape(
+            lambda: pingpong_prog(netmatrix=True).init_carry(0)
+        )
+        assert on.net_mat.shape == (nm.NM_CHANNELS, 2, 2)
+
+    def test_matrix_adds_no_host_syncs(self, monkeypatch):
+        """One blocking device→host sync per chunk (the done-flag poll),
+        matrix on or off — the delta rides the same dispatch result as
+        the telemetry block."""
+        calls = {"n": 0}
+        real = engine_mod._poll_done
+
+        def counting(done):
+            calls["n"] += 1
+            return real(done)
+
+        monkeypatch.setattr(engine_mod, "_poll_done", counting)
+
+        def run(netmatrix):
+            calls["n"] = 0
+            deltas = []
+            res = pingpong_prog(netmatrix=netmatrix).run(
+                max_ticks=256,
+                netmatrix_cb=deltas.append if netmatrix else None,
+            )
+            return calls["n"], res["ticks"] // 16, deltas
+
+        syncs_off, chunks_off, _ = run(False)
+        syncs_on, chunks_on, deltas = run(True)
+        assert chunks_on == chunks_off
+        assert syncs_off == chunks_off  # one poll per dispatch
+        assert syncs_on == syncs_off  # the matrix adds ZERO syncs
+        assert len(deltas) == chunks_on  # yet every chunk flushed
+
+    def test_matrix_requires_telemetry(self):
+        """The matrix flushes beside the telemetry block — without that
+        ride-along there is no zero-sync path, so the program refuses
+        loudly instead of silently paying a new sync."""
+        with pytest.raises(ValueError, match="telemetry"):
+            pingpong_prog(telemetry=False, netmatrix=True)
+
+
+# ---------------------------------------------------------- bucketed demux
+
+
+class TestBucketedDemux:
+    def test_padded_run_reports_the_exact_matrix(self):
+        """Shape bucketing pads lanes, not groups: dead lanes send
+        nothing, so the padded run's demuxed matrix is bit-equal to the
+        exact-N run's."""
+        from testground_tpu.sim.buckets import plan_buckets
+
+        exact = pingpong_prog(netmatrix=True)
+        res_e = exact.run(max_ticks=256)
+        bp = plan_buckets([2, 2], "auto", (8,))
+        assert bp is not None
+        padded = build_groups(
+            [
+                RunGroup(id=g.id, instances=p, parameters=dict(g.params))
+                for g, p in zip(exact.groups, bp.padded_counts)
+            ]
+        )
+        prog_p = SimProgram(
+            instantiate_testcase(
+                type(exact.tc), padded, tick_ms=exact.tick_ms
+            ),
+            padded,
+            chunk=16,
+            telemetry=True,
+            netmatrix=True,
+            live_counts=bp.live_counts,
+        )
+        res_p = prog_p.run(max_ticks=256)
+        mat_e = np.asarray(res_e["net_matrix"], np.int64)
+        mat_p = np.asarray(res_p["net_matrix"], np.int64)
+        assert np.array_equal(mat_p, mat_e)
+        assert nm.reconcile(mat_p, res_p) == []
+
+
+# ------------------------------------------------------------- cut advisor
+
+
+def two_cluster_traffic(heavy=1000, light=1):
+    """4 groups, clusters {0,1} and {2,3}: heavy intra, light cross."""
+    w = np.full((4, 4), light, np.int64)
+    np.fill_diagonal(w, 0)
+    w[0, 1] = w[1, 0] = w[2, 3] = w[3, 2] = heavy
+    return w
+
+
+class TestCutAdvisor:
+    def test_exhaustive_recovers_the_cluster_split(self):
+        rec = nm.cut_advisor(
+            two_cluster_traffic(), 2, labels=["a", "b", "c", "d"]
+        )
+        assert rec["method"] == "exhaustive"
+        assert rec["assignment"] == [0, 0, 1, 1]
+        assert rec["shards"] == [["a", "b"], ["c", "d"]]
+        # the cut severs only the light cross-cluster pairs: 4 unordered
+        # pairs × (1 + 1 symmetrized) = 8; the heavy links stay inside
+        assert rec["cut"] == 8.0
+        assert rec["total"] == 2 * 2000 + 8
+        assert rec["cut_fraction"] == pytest.approx(8 / 4008)
+
+    def test_greedy_recovers_clusters_at_scale(self):
+        """Past the exhaustive budget the agglomerative pass still
+        co-locates heavy talkers: two 5-group cliques reassemble."""
+        g_n = 10
+        w = np.ones((g_n, g_n), np.int64)
+        np.fill_diagonal(w, 0)
+        for c in (range(5), range(5, 10)):
+            for i in c:
+                for j in c:
+                    if i != j:
+                        w[i, j] = 500
+        rec = nm.cut_advisor(w, 2, exhaustive_limit=10)
+        assert rec["method"] == "greedy"
+        assert rec["assignment"] == [0] * 5 + [1] * 5
+
+    def test_balance_cap_blocks_the_trivial_answer(self):
+        """Uniform traffic: any split costs the same, but no shard may
+        hold more than ⌈G/N⌉ groups — all-on-one is never 'optimal'."""
+        w = np.ones((6, 6), np.int64)
+        np.fill_diagonal(w, 0)
+        for shards in (2, 3):
+            rec = nm.cut_advisor(w, shards)
+            sizes = np.bincount(rec["assignment"], minlength=shards)
+            assert sizes.max() <= -(-6 // shards)
+            assert (sizes > 0).all()  # every shard used when G >= N
+
+    def test_canonical_numbering_and_shard_overflow(self):
+        rec = nm.cut_advisor(two_cluster_traffic(), 2)
+        assert rec["assignment"][0] == 0  # first-appearance order
+        # more shards than groups degrades to one group per shard
+        rec = nm.cut_advisor(np.zeros((3, 3)), 10)
+        assert sorted(rec["assignment"]) == [0, 1, 2]
+
+    def test_zero_traffic_has_zero_cut_fraction(self):
+        rec = nm.cut_advisor(np.zeros((4, 4)), 2)
+        assert rec["cut"] == 0.0 and rec["cut_fraction"] == 0.0
+
+    def test_input_validation(self):
+        with pytest.raises(ValueError, match="square"):
+            nm.cut_advisor(np.zeros((2, 3)), 2)
+        with pytest.raises(ValueError, match="at least 1"):
+            nm.cut_advisor(np.zeros((2, 2)), 0)
+        with pytest.raises(ValueError, match="labels"):
+            nm.cut_advisor(np.zeros((2, 2)), 2, labels=["only-one"])
+
+    def test_top_pairs_order_and_elision(self):
+        mat = np.zeros((nm.NM_CHANNELS, 3, 3), np.int64)
+        mat[nm.NM_SENT, 0, 1] = 50
+        mat[nm.NM_SENT, 2, 0] = 90
+        mat[nm.NM_SENT, 1, 2] = 50  # ties break on (src, dst)
+        mat[nm.NM_DROPPED, 2, 2] = 1  # nonzero pair with zero sent
+        pairs, elided = nm.top_pairs(mat, 2)
+        assert [(p["src"], p["dst"]) for p in pairs] == [(2, 0), (0, 1)]
+        assert pairs[0]["sent"] == 90
+        assert elided == 2
+        # k >= nonzero pairs elides nothing
+        assert nm.top_pairs(mat, 99)[1] == 0
+
+
+# --------------------------------------------------------- executor e2e
+
+
+@pytest.fixture(scope="class")
+def netmatrix_run(tmp_path_factory):
+    """One executor run with the plane on, asserted many ways."""
+    from testground_tpu.api import RunInput
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.rpc import discard_writer
+    from testground_tpu.sim.executor import SimJaxConfig, execute_sim_run
+
+    home = tmp_path_factory.mktemp("tghome")
+    old = os.environ.get("TESTGROUND_HOME")
+    os.environ["TESTGROUND_HOME"] = str(home)
+    try:
+        env = EnvConfig.load()
+        job = RunInput(
+            run_id="nmrun",
+            test_plan="network",
+            test_case="ping-pong",
+            total_instances=4,
+            groups=[
+                RunGroup(
+                    id=g,
+                    instances=2,
+                    artifact_path=os.path.join(PLANS, "network"),
+                )
+                for g in ("c0", "c1")
+            ],
+            runner_config=SimJaxConfig(
+                telemetry=True,
+                netmatrix=True,
+                chunk=16,
+                seed=5,
+                max_ticks=512,
+            ),
+            env=env,
+        )
+        out = execute_sim_run(job, discard_writer(), threading.Event())
+        yield {"env": env, "out": out}
+    finally:
+        if old is None:
+            os.environ.pop("TESTGROUND_HOME", None)
+        else:
+            os.environ["TESTGROUND_HOME"] = old
+
+
+class TestExecutorSurface:
+    def test_journal_block_reconciles(self, netmatrix_run):
+        sim = netmatrix_run["out"].result.journal["sim"]
+        block = sim["net_matrix"]
+        assert block["labels"] == ["c0", "c1"]
+        assert block["mismatches"] == []
+        mat = np.asarray(block["matrix"], np.int64)
+        assert nm.matrix_totals(mat) == block["totals"]
+        assert block["totals"]["delivered"] == sim["msgs_delivered"]
+        assert block["totals"]["sent"] == sim["msgs_sent"]
+        assert (
+            block["bytes_total"]
+            == block["totals"]["enqueued"] * nm.NM_MSG_BYTES
+        )
+        assert block["top_pairs"] == nm.top_pairs(mat, 16)[0]
+
+    def test_stream_file_reconstructs_the_journal_matrix(
+        self, netmatrix_run
+    ):
+        """sim_netmatrix.jsonl: one row per chunk, ticks contiguous, and
+        the sparse cells sum back to the journal's dense matrix bit for
+        bit — the contract resume alignment depends on."""
+        block = netmatrix_run["out"].result.journal["sim"]["net_matrix"]
+        env = netmatrix_run["env"]
+        path = os.path.join(
+            env.dirs.outputs(), "network", "nmrun", block["file"]
+        )
+        rows = list(nm.iter_rows(path))
+        assert len(rows) == block["chunks"] > 0
+        assert [r["chunk"] for r in rows] == list(range(len(rows)))
+        assert [r["tick"] for r in rows] == [
+            (i + 1) * 16 for i in range(len(rows))
+        ]
+        assert all(r["run"] == "nmrun" for r in rows)
+        back = nm.matrix_from_rows(rows, 2)
+        assert np.array_equal(
+            back, np.asarray(block["matrix"], np.int64)
+        )
+
+    def test_stats_payload_and_renderers(self, netmatrix_run):
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+        from testground_tpu.runners.pretty import (
+            render_netmap,
+            render_netmap_cut,
+        )
+
+        t = Task(
+            id="nmrun",
+            type=TaskType.RUN,
+            plan="network",
+            case="ping-pong",
+            states=[DatedState(state=State.COMPLETE, created=0.0)],
+            result=netmatrix_run["out"].result.to_dict(),
+        )
+        block = (t.stats_payload().get("sim") or {}).get("net_matrix")
+        assert block, "sim.net_matrix missing from the stats payload"
+        screen = render_netmap(block, ident="nmrun")
+        assert "c0" in screen and "c1" in screen
+        assert "conservation" in screen
+        rec = nm.cut_advisor(
+            nm.matrix_bytes(np.asarray(block["matrix"], np.int64)),
+            2,
+            labels=block["labels"],
+        )
+        cut_screen = render_netmap_cut(rec, 2)
+        assert "shard" in cut_screen
+
+    def test_prometheus_rides_the_task(self, netmatrix_run):
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        t = Task(
+            id="nmrun",
+            type=TaskType.RUN,
+            plan="network",
+            case="ping-pong",
+            states=[DatedState(state=State.COMPLETE, created=0.0)],
+            result=netmatrix_run["out"].result.to_dict(),
+        )
+        text = render_prometheus([t], per_task_limit=10)
+        assert 'tg_net_pair_msgs_total{' in text
+        assert 'flow="delivered"' in text
+        assert 'src="c0"' in text
+        assert "tg_net_pairs_elided" in text
+        assert "tg_net_conservation_mismatches" in text
+
+
+class TestPrometheusCardinality:
+    def test_exposition_is_topk_bounded_never_g_squared(self):
+        """A 30-group all-talking matrix (900 nonzero pairs) must export
+        ≤ 16 pair series per metric plus the elision gauge — the page
+        never scales with G²."""
+        from testground_tpu.engine.task import (
+            DatedState,
+            State,
+            Task,
+            TaskType,
+        )
+        from testground_tpu.metrics.prometheus import render_prometheus
+
+        g_n = 30
+        mat = np.zeros((nm.NM_CHANNELS, g_n, g_n), np.int64)
+        rng = np.random.default_rng(7)
+        sent = rng.integers(1, 1000, size=(g_n, g_n))
+        mat[nm.NM_SENT] = sent
+        mat[nm.NM_ENQUEUED] = sent
+        pairs, elided = nm.top_pairs(mat, 16)
+        assert len(pairs) == 16 and elided == g_n * g_n - 16
+        block = {
+            "labels": [f"g{i}" for i in range(g_n)],
+            "matrix": mat.tolist(),
+            "totals": nm.matrix_totals(mat),
+            "bytes_total": int(nm.matrix_bytes(mat).sum()),
+            "top_pairs": pairs,
+            "elided_pairs": elided,
+            "mismatches": [],
+        }
+        t = Task(
+            id="big",
+            type=TaskType.RUN,
+            plan="p",
+            case="c",
+            states=[DatedState(state=State.COMPLETE, created=0.0)],
+            result={"journal": {"sim": {"net_matrix": block}}},
+        )
+        text = render_prometheus([t], per_task_limit=10)
+        msg_series = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith("tg_net_pair_msgs_total{")
+        ]
+        byte_series = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith("tg_net_pair_bytes_total{")
+        ]
+        assert len(msg_series) == 16 * 5  # top-K pairs × flow legs
+        assert len(byte_series) == 16
+        assert "tg_net_pairs_elided" in text
+        elided_lines = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith("tg_net_pairs_elided{")
+        ]
+        assert elided_lines and elided_lines[0].endswith(str(elided))

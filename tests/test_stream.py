@@ -158,6 +158,32 @@ class TestTailGenerator:
         )
         assert [r["stream"] for r in rows] == ["perf"]
 
+    def test_netmatrix_family_streams_and_filters(self, tmp_path):
+        """``sim_netmatrix.jsonl`` rows ride the stream tagged
+        ``netmatrix`` and the family filter narrows to them."""
+        d = self.run_dir(tmp_path)
+        (d / "sim_netmatrix.jsonl").write_text(
+            json.dumps(
+                {"tick": 16, "chunk": 0, "cells": [[0, 1, 4, 4, 4, 0, 0, 0]]}
+            )
+            + "\n"
+        )
+        (d / "sim_perf.jsonl").write_text(json.dumps({"chunk": 0}) + "\n")
+        rows = list(
+            stream_task_rows(
+                str(tmp_path), "plan", "task1", is_done=lambda: True,
+            )
+        )
+        assert {r["stream"] for r in rows} == {"netmatrix", "perf"}
+        only = list(
+            stream_task_rows(
+                str(tmp_path), "plan", "task1", is_done=lambda: True,
+                families=("netmatrix",),
+            )
+        )
+        assert [r["stream"] for r in only] == ["netmatrix"]
+        assert only[0]["cells"] == [[0, 1, 4, 4, 4, 0, 0, 0]]
+
     def test_large_backlog_drains_in_bounded_chunks(
         self, tmp_path, monkeypatch
     ):

@@ -1,0 +1,224 @@
+"""``make netmap-smoke``: drive the network-topology plane end to end
+through a real daemon — a clustered composition (two ping-pong pairs,
+four singleton groups, zero cross-cluster traffic) with
+``netmatrix = true``, then assert every surface:
+
+1. the journal's ``sim.net_matrix`` block reconciles exactly
+   (conservation, cell-wise send identity);
+2. ``sim_netmatrix.jsonl`` streams as the ``netmatrix`` family on
+   ``GET /stream`` and is fetchable via ``GET /artifact``;
+3. ``tg netmap <task>`` (the real CLI against ``--endpoint``) renders
+   the heatmap with every group label and an exact conservation line;
+4. ``tg netmap <task> --cut 2`` recommends the cluster split — each
+   ping-pong pair co-located, the two pairs on different shards;
+5. the Prometheus page is a valid exposition and carries the bounded
+   ``tg_net_pair_*`` series plus the elision gauge.
+
+Exits non-zero with a readable message on any violation; prints a
+one-line summary on success. Self-contained: runs against a temporary
+$TESTGROUND_HOME on the CPU backend, so it is safe in CI.
+"""
+
+import io
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+from contextlib import redirect_stdout
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+GROUPS = ("c0a", "c0b", "c1a", "c1b")  # pairs: (c0a,c0b) and (c1a,c1b)
+
+
+def fail(msg: str) -> "None":
+    print(f"netmap-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def tg(args) -> tuple[int, str]:
+    """Invoke the real CLI entry point, capturing stdout."""
+    from testground_tpu.cli.main import main
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(args)
+    return rc, buf.getvalue()
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-smoke-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from testground_tpu.client import Client
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.daemon import Daemon
+    from testground_tpu.sim import netmatrix as nm
+
+    daemon = Daemon(env=EnvConfig.load(), listen="localhost:0")
+    daemon.start()
+    try:
+        client = Client(daemon.address)
+        client.import_plan(os.path.join(REPO_ROOT, "plans", "network"))
+        tid = client.run(
+            {
+                "metadata": {"name": "netmap-smoke"},
+                "global": {
+                    "plan": "network",
+                    "case": "ping-pong",
+                    "builder": "sim:plan",
+                    "runner": "sim:jax",
+                    "run_config": {
+                        "telemetry": True,
+                        "netmatrix": True,
+                        "chunk": 16,
+                        "max_ticks": 512,
+                    },
+                },
+                "groups": [
+                    {"id": g, "instances": {"count": 1}} for g in GROUPS
+                ],
+            }
+        )
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            t = client.status(tid)
+            if t["states"][-1]["state"] in ("complete", "canceled"):
+                break
+            time.sleep(0.2)
+        else:
+            fail(f"task {tid} did not finish")
+        if t.get("error"):
+            fail(f"run errored: {t['error']}")
+
+        # --- 1. journal block: exact conservation on a clustered run
+        sim = client.stats(tid).get("sim") or {}
+        block = sim.get("net_matrix")
+        if not block:
+            fail("journal has no sim.net_matrix block")
+        if block["labels"] != list(GROUPS):
+            fail(f"labels {block['labels']} != {list(GROUPS)}")
+        if block["mismatches"]:
+            fail(f"conservation mismatches: {block['mismatches']}")
+        mat = np.asarray(block["matrix"], np.int64)
+        if block["totals"]["delivered"] != sim.get("msgs_delivered"):
+            fail("matrix delivered total != journal msgs_delivered")
+        if block["totals"]["delivered"] <= 0:
+            fail("clustered run delivered no traffic")
+        send_lhs = mat[nm.NM_SENT]
+        send_rhs = (
+            mat[nm.NM_ENQUEUED]
+            + mat[nm.NM_DROPPED]
+            + mat[nm.NM_REJECTED]
+            + mat[nm.NM_FAULT]
+        )
+        if not np.array_equal(send_lhs, send_rhs):
+            fail("cell-wise send identity does not close")
+        # the composition is two isolated pairs: no cross-cluster cells
+        cross = mat[:, :2, 2:].sum() + mat[:, 2:, :2].sum()
+        if cross != 0:
+            fail(f"unexpected cross-cluster traffic ({cross} msgs)")
+
+        # --- 2. the stream family and the artifact route
+        rows = [
+            r
+            for r in client.stream(tid, families=("netmatrix",))
+            if r is not None
+        ]
+        if not rows or {r["stream"] for r in rows} != {"netmatrix"}:
+            fail("GET /stream served no netmatrix-family rows")
+        if [r["chunk"] for r in rows] != list(range(len(rows))):
+            fail("netmatrix rows are not one-per-chunk contiguous")
+        back = nm.matrix_from_rows(rows, len(GROUPS))
+        if not np.array_equal(back, mat):
+            fail("streamed cells do not reconstruct the journal matrix")
+        art = client.artifact(tid, "sim_netmatrix.jsonl")
+        if len(art.splitlines()) != len(rows):
+            fail("GET /artifact sim_netmatrix.jsonl row count mismatch")
+
+        # --- 3. the real CLI: heatmap screen
+        rc, screen = tg(["--endpoint", daemon.address, "netmap", tid])
+        if rc != 0:
+            fail(f"tg netmap exited {rc}")
+        for label in GROUPS:
+            if label not in screen:
+                fail(f"heatmap is missing group {label!r}")
+        if "conservation" not in screen:
+            fail("heatmap is missing the conservation verdict")
+        if "FAILED" in screen:
+            fail(f"tg netmap reports failure:\n{screen}")
+        rc, out = tg(
+            ["--endpoint", daemon.address, "netmap", tid, "--json"]
+        )
+        if rc != 0:
+            fail(f"tg netmap --json exited {rc}")
+        if json.loads(out)["totals"] != block["totals"]:
+            fail("tg netmap --json totals != journal totals")
+
+        # --- 4. the cut advisor recommends the cluster split
+        rc, cut_screen = tg(
+            ["--endpoint", daemon.address, "netmap", tid, "--cut", "2"]
+        )
+        if rc != 0:
+            fail(f"tg netmap --cut 2 exited {rc}")
+        rec = nm.cut_advisor(nm.matrix_bytes(mat), 2, labels=GROUPS)
+        shards = [set(s) for s in rec["shards"]]
+        if shards != [{"c0a", "c0b"}, {"c1a", "c1b"}]:
+            fail(f"--cut 2 did not recover the clusters: {rec['shards']}")
+        if rec["cut"] != 0.0:
+            fail(f"cluster split should cut nothing, got {rec['cut']}")
+        for pair in ("c0a", "c0b"), ("c1a", "c1b"):
+            line = next(
+                (
+                    ln
+                    for ln in cut_screen.splitlines()
+                    if pair[0] in ln and pair[1] in ln
+                ),
+                None,
+            )
+            if line is None:
+                fail(f"--cut 2 screen does not co-locate {pair}")
+
+        # --- 5. Prometheus: valid exposition, bounded pair series
+        text = client.metrics()
+        series = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$"
+        )
+        for ln in text.splitlines():
+            if ln and not ln.startswith("#") and not series.match(ln):
+                fail(f"invalid exposition line: {ln!r}")
+        for name in (
+            "tg_net_pair_msgs_total{",
+            "tg_net_pair_bytes_total{",
+            "tg_net_pairs_elided{",
+            "tg_net_conservation_mismatches{",
+        ):
+            if name not in text:
+                fail(f"{name.rstrip('{')} missing from /metrics")
+        n_pairs = len(
+            set(re.findall(r'tg_net_pair_bytes_total\{[^}]*\}', text))
+        )
+        if not 0 < n_pairs <= 16:
+            fail(f"pair-series cardinality {n_pairs} outside (0, 16]")
+    finally:
+        daemon.stop()
+
+    print(
+        f"netmap-smoke: OK — {len(rows)} chunk rows, "
+        f"delivered={block['totals']['delivered']} "
+        f"cut2={rec['shards']} (cut {rec['cut']:.0f}B) "
+        f"pair_series={n_pairs}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
