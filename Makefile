@@ -7,7 +7,7 @@ PY ?= python
 	telemetry-smoke chaos-smoke trace-smoke fleet-smoke perf-smoke slo-smoke \
 	phases-smoke checkpoint-smoke preempt-smoke crosshost-smoke \
 	pack-smoke sync-fanin-smoke transport-smoke check-smoke \
-	netmap-smoke check-plans test-sync-tsan
+	netmap-smoke diff-smoke check-plans test-sync-tsan
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -179,6 +179,19 @@ check-smoke:
 # part of the observability-smoke CI set
 netmap-smoke:
 	$(PY) tools/netmap_smoke.py
+
+# differential run analysis + bench sentinel end to end
+# (docs/OBSERVABILITY.md "Run diff / bench sentinel"): two
+# identically-seeded daemon runs must diff CLEAN through the real
+# `tg diff` CLI (exact counter equality, zero findings, zero
+# significant throughput verdicts), a debug_chunk_sleep_ms-slowed run
+# must be flagged `regressed` with a significant Mann–Whitney p-value,
+# and the bench sentinel must round-trip: a tiny `bench.py --bank` run
+# passes tools/bench_regression.py against the committed
+# BENCH_HISTORY.jsonl baseline while a fabricated 3x-slower row fails
+# it — part of the observability-smoke CI set
+diff-smoke:
+	$(PY) tools/diff_smoke.py
 
 # `tg check` over every checked-in composition: the gallery's
 # pre-lint gate (docs/CHECKING.md) — any error-severity finding in a

@@ -447,6 +447,20 @@ def main() -> int:
     # ms/tick calibration (per-phase jit + K timed reps, post-bench).
     p.add_argument("--phases", action="store_true")
     p.add_argument("--phase-reps", type=int, default=0)
+    # bench banking (analysis/bench_history.py; ROADMAP item 5's
+    # "banked verdicts"): --bank appends this run's headline numbers as
+    # ONE env-fingerprinted row (workload, rung, backend, jax version,
+    # device kind, cpu count, git sha) to the append-only history file
+    # tools/bench_regression.py gates on. --history overrides the
+    # default repo-root BENCH_HISTORY.jsonl (tests/smokes bank to a
+    # temp file).
+    p.add_argument("--bank", action="store_true")
+    p.add_argument(
+        "--history",
+        default=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
+        ),
+    )
     args = p.parse_args()
 
     # compiled programs are the framework's build artifact: warm processes
@@ -588,6 +602,56 @@ def main() -> int:
             )
 
     print(json.dumps(result))
+
+    if args.bank:
+        from datetime import datetime, timezone
+
+        from testground_tpu.analysis.bench_history import (
+            bank_row,
+            env_fingerprint,
+        )
+
+        # one row per banked workload: the sustained headline always,
+        # plus a flood row when the secondary pass ran — each gates
+        # independently under its own (workload, rung, backend,
+        # transport) key
+        fp = env_fingerprint()
+        ts = datetime.now(timezone.utc).isoformat(timespec="seconds")
+        rows = [
+            {
+                "ts": ts,
+                "workload": "sustained",
+                "instances": n,
+                "ticks": ticks,
+                "transport": args.transport,
+                "metric": result["metric"],
+                "value": result["value"],
+                "compile_secs": result["compile_secs"],
+                "warm_compile_secs": result["warm_compile_secs"],
+                "fingerprint": fp,
+            }
+        ]
+        sec = result.get("secondary") or {}
+        if sec.get("flood_peer_ticks_per_sec") is not None:
+            rows.append(
+                {
+                    "ts": ts,
+                    "workload": "flood",
+                    "instances": n,
+                    "ticks": ticks,
+                    "transport": args.transport,
+                    "metric": "sim_peer_ticks_per_sec",
+                    "value": sec["flood_peer_ticks_per_sec"],
+                    "compile_secs": sec.get("flood_compile_secs"),
+                    "fingerprint": fp,
+                }
+            )
+        for row in rows:
+            bank_row(args.history, row)
+        print(
+            f"# banked {len(rows)} row(s) to {args.history}",
+            file=sys.stderr,
+        )
     return 0
 
 

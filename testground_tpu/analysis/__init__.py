@@ -1,0 +1,18 @@
+"""Cross-run analysis plane (docs/OBSERVABILITY.md "Run diff / bench
+sentinel").
+
+Everything under this package is deliberately import-light (stdlib
+only, no jax/numpy at module scope): the diff engine runs against
+ARCHIVED tasks — a `tg diff` of two finished runs, or the CI bench
+sentinel over BENCH_HISTORY.jsonl — where paying a device-backend
+import for pure host-side arithmetic would be wasted startup.
+
+- :mod:`testground_tpu.analysis.diff` — the RunDiff document builder:
+  deterministic counters compared exactly, throughput judged from
+  per-chunk samples with noise-robust statistics (median ratio +
+  Mann-Whitney U). Backend of ``tg diff`` / ``GET /diff`` and the one
+  comparison codepath behind ``tg perf --compare``.
+- :mod:`testground_tpu.analysis.bench_history` — the append-only
+  env-fingerprinted bench bank (``bench.py --bank``) and the regression
+  sentinel verdicts (``tools/bench_regression.py``).
+"""

@@ -1764,6 +1764,71 @@ def netmap_cmd(args) -> int:
         engine.stop()
 
 
+def register_diff(sub) -> None:
+    p = sub.add_parser(
+        "diff",
+        help="differential run analysis of two tasks: deterministic "
+        "counters compared exactly (a mismatch between identically-"
+        "seeded runs is a correctness finding), throughput judged "
+        "from per-chunk samples with noise-robust statistics "
+        "(median ratio + Mann-Whitney U) — docs/OBSERVABILITY.md "
+        "'Run diff'. Exit 1 on correctness findings.",
+    )
+    p.add_argument("task_a", help="baseline task id (A)")
+    p.add_argument("task_b", help="candidate task id (B)")
+    p.add_argument(
+        "--planes",
+        default="",
+        metavar="P1,P2",
+        help="comma-separated plane subset "
+        "(counters,perf,latency,phases,slo,netmatrix; default all)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the full RunDiff document as JSON (machine-readable; "
+        "the same shape as GET /diff)",
+    )
+    p.set_defaults(func=diff_cmd)
+
+
+def diff_cmd(args) -> int:
+    import json
+
+    from testground_tpu.analysis.diff import validate_planes
+    from testground_tpu.runners.pretty import render_run_diff
+
+    # validate the plane selection client-side so an unknown plane is
+    # the same usage error (exit 2) in-process and remote — a daemon
+    # 400 would otherwise surface as a generic DaemonError (exit 1)
+    try:
+        validate_planes(args.planes or None)
+    except ValueError as e:
+        print(f"tg diff: {e}", file=sys.stderr)
+        return 2
+    engine = _engine(args)
+    try:
+        # in-process and remote engines expose the same diff_tasks verb
+        # (the document is always built by Engine.diff_tasks — ONE
+        # comparison codepath, daemon-side when remote)
+        try:
+            doc = engine.diff_tasks(
+                args.task_a, args.task_b, planes=args.planes or None
+            )
+        except ValueError as e:  # unknown plane — usage error
+            print(f"tg diff: {e}", file=sys.stderr)
+            return 2
+        if getattr(args, "json", False):
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            print(render_run_diff(doc))
+        # correctness findings gate (exit 1); perf verdicts inform but
+        # never fail `tg diff` itself — the bench sentinel gates perf
+        return 1 if doc.get("findings") else 0
+    finally:
+        engine.stop()
+
+
 def register_top(sub) -> None:
     p = sub.add_parser(
         "top",

@@ -46,6 +46,7 @@ def build_parser() -> argparse.ArgumentParser:
     commands.register_perf(sub)
     commands.register_watch(sub)
     commands.register_netmap(sub)
+    commands.register_diff(sub)
     commands.register_top(sub)
     commands.register_trace(sub)
     commands.register_logs(sub)

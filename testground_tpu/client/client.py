@@ -220,6 +220,18 @@ class Client:
         sim.perf ledger + task-level queue/runner timings."""
         return self._get_json("/perf", {"task_id": task_id})
 
+    def diff(self, a: str, b: str, planes=None) -> dict:
+        """GET /diff — the differential run analysis of two tasks (the
+        ``tg diff`` backend; docs/OBSERVABILITY.md "Run diff"): exact
+        counter comparison + noise-aware throughput verdicts, built
+        daemon-side so archived tasks diff over HTTP."""
+        params = {"a": a, "b": b}
+        if planes:
+            params["planes"] = (
+                planes if isinstance(planes, str) else ",".join(planes)
+            )
+        return self._get_json("/diff", params)
+
     def metrics(self) -> str:
         """GET /metrics — the daemon's Prometheus text exposition
         (task gauges, flow counters, perf gauges)."""
@@ -460,6 +472,13 @@ class RemoteEngine:
         of ``tg trace``; in-process engines read the run outputs via
         sim.trace.read_trace_events)."""
         return self.client.trace(task_id, limit=limit)
+
+    def diff_tasks(self, a: str, b: str, planes=None) -> dict:
+        """One round trip to the daemon's /diff route, named like
+        Engine.diff_tasks so ``tg diff`` works identically in-process
+        and remote (the document is built daemon-side by the same
+        engine method)."""
+        return self.client.diff(a, b, planes=planes)
 
     def fleet_payload(self) -> dict:
         """The daemon's /fleet route, shaped like Engine.fleet_payload

@@ -135,6 +135,7 @@ class _Handler(BaseHTTPRequestHandler):
             "/journal": lambda: self._journal(q),
             "/stats": lambda: self._stats(q),
             "/perf": lambda: self._perf(q),
+            "/diff": lambda: self._diff(q),
             "/stream": lambda: self._stream(q),
             "/metrics": lambda: self._metrics(q),
             "/trace": lambda: self._trace(q),
@@ -525,6 +526,24 @@ class _Handler(BaseHTTPRequestHandler):
         if t is None:
             return self._send_error_json(f"unknown task {task_id}", 404)
         self._send_json(t.stats_payload())
+
+    def _diff(self, q: dict) -> None:
+        """GET /diff?a=&b=[&planes=p1,p2] — the differential run
+        analysis document (the ``tg diff`` backend; docs/OBSERVABILITY.md
+        "Run diff"): deterministic counters compared exactly, throughput
+        judged from per-chunk samples. Built by Engine.diff_tasks — the
+        one codepath shared with the in-process CLI — so it works
+        against archived tasks over HTTP."""
+        a, b = q.get("a", ""), q.get("b", "")
+        if not a or not b:
+            return self._send_error_json("a and b task params required", 400)
+        try:
+            doc = self.engine.diff_tasks(a, b, planes=q.get("planes"))
+        except FileNotFoundError as e:
+            return self._send_error_json(str(e), 404)
+        except ValueError as e:
+            return self._send_error_json(str(e), 400)
+        self._send_json(doc)
 
     def _perf(self, q: dict) -> None:
         """GET /perf?task_id= — the task's performance-ledger payload

@@ -651,6 +651,43 @@ class Engine:
             heartbeat_secs=heartbeat_secs,
         )
 
+    def diff_tasks(self, a: str, b: str, planes=None) -> dict:
+        """Differential run analysis (docs/OBSERVABILITY.md "Run diff"):
+        load both tasks' journals + swept ``sim_perf.jsonl`` chunk rows
+        and build the RunDiff document — deterministic counters compared
+        exactly, throughput judged from the per-chunk samples
+        (``analysis/diff.py``). Works on ARCHIVED tasks: everything read
+        here (task store + run outputs) survives daemon restarts.
+
+        Raises ``FileNotFoundError`` for an unknown task and
+        ``ValueError`` for an unknown plane — the daemon route maps
+        these to 404/400; backend of ``tg diff`` and ``Client.diff``.
+        """
+        from testground_tpu.analysis.diff import (
+            build_run_diff,
+            task_snapshot,
+            validate_planes,
+        )
+
+        planes = validate_planes(planes)
+        snaps = []
+        for tid in (a, b):
+            tsk = self.get_task(tid)
+            if tsk is None:
+                raise FileNotFoundError(f"unknown task {tid}")
+            try:
+                rows = [
+                    r
+                    for r in self.stream_rows(
+                        tid, follow=False, families=("perf",)
+                    )
+                    if isinstance(r, dict)
+                ]
+            except FileNotFoundError:
+                rows = []
+            snaps.append(task_snapshot(tsk.to_dict(), rows))
+        return build_run_diff(snaps[0], snaps[1], planes=planes)
+
     # ----------------------------------------------------------------- fleet
 
     def fleet_worker_state(self, idx: int, task_id: str) -> None:

@@ -24,6 +24,7 @@ __all__ = [
     "render_netmap_cut",
     "render_perf_summary",
     "render_phase_table",
+    "render_run_diff",
     "render_sync_stats",
     "render_telemetry_summary",
 ]
@@ -577,6 +578,129 @@ def render_perf_summary(payload: dict) -> str:
         rows.append(("series", shown))
     width = max(len(k) for k, _ in rows)
     return "\n".join(f"{k:<{width}}  {v}" for k, v in rows)
+
+
+def _fmt_diff_value(v) -> str:
+    """One side of an exact-compared row: scalars verbatim, digested
+    objects (the traffic matrix) as their bounded summary."""
+    if isinstance(v, dict) and "sha1" in v:
+        return f"Σ{_fmt_count(v.get('sum'))} #{v['sha1']}"
+    if isinstance(v, float):
+        return _fmt(v, "{:g}")
+    if v is None:
+        return "absent"
+    return str(v)
+
+
+def render_run_diff(doc: dict) -> str:
+    """Render a RunDiff document as an aligned table — the console
+    surface of the cross-run analysis plane (``tg diff <a> <b>``;
+    docs/OBSERVABILITY.md "Run diff").
+
+    Exact planes render their compared/mismatched counts with one line
+    per MISMATCH (equality is the expected, quiet case); the perf plane
+    renders every judged metric with its verdict, sample counts and
+    p-value so the statistics stay auditable; the final line is the
+    roll-up verdict."""
+    a, b = doc.get("a") or {}, doc.get("b") or {}
+    rows: list[tuple[str, str]] = []
+    for side, ident in (("a", a), ("b", b)):
+        shown = (
+            f"{ident.get('plan', '?')}:{ident.get('case', '?')}  "
+            f"({ident.get('task_id', '?')})  {ident.get('outcome', '?')}"
+        )
+        if _num(ident.get("ticks")) is not None:
+            shown += f"  {_fmt_count(ident['ticks'])} ticks"
+        if _num(ident.get("wall_secs")) is not None:
+            shown += f" / {_fmt(ident['wall_secs'])}s"
+        rows.append((side, shown))
+    setup = doc.get("setup") or {}
+    if setup.get("identical"):
+        shown = (
+            "identical composition + seed — every deterministic counter "
+            "must match exactly"
+        )
+    else:
+        diffs = setup.get("diffs") or []
+        shown = "setups differ"
+        if diffs:
+            shown += f" ({', '.join(diffs[:6])}"
+            shown += ", …)" if len(diffs) > 6 else ")"
+        elif setup.get("note"):
+            shown += f" ({setup['note']})"
+        shown += " — counter deltas are informational"
+    rows.append(("setup", shown))
+    # ----- exact planes: compared/mismatched + one line per mismatch
+    for plane in ("counters", "latency", "phases", "slo", "netmatrix"):
+        block = doc.get(plane)
+        if not isinstance(block, dict):
+            continue
+        if block.get("absent"):
+            rows.append((plane, block["absent"]))
+            continue
+        compared = block.get("compared", 0)
+        mismatched = block.get("mismatched", 0)
+        verdict = (
+            "exact equality"
+            if not mismatched
+            else f"{mismatched} MISMATCH(ES)"
+        )
+        rows.append((plane, f"{compared} compared — {verdict}"))
+        for row in block.get("rows") or []:
+            if row.get("equal"):
+                continue
+            rows.append(
+                (
+                    "",
+                    f"  {row.get('name')}: "
+                    f"a={_fmt_diff_value(row.get('a'))}  "
+                    f"b={_fmt_diff_value(row.get('b'))}",
+                )
+            )
+    # ----- perf plane: judged metrics with auditable statistics
+    perf = doc.get("perf")
+    if isinstance(perf, dict):
+        if perf.get("absent"):
+            rows.append(("perf", perf["absent"]))
+        for m in perf.get("metrics") or []:
+            shown = (
+                f"{m.get('verdict', '?'):<12} "
+                f"a~{_fmt_rate(m.get('median_a'))} "
+                f"b~{_fmt_rate(m.get('median_b'))}"
+            )
+            if _num(m.get("ratio")) is not None:
+                shown += f"  x{_fmt(m['ratio'], '{:.3f}')}"
+            if _num(m.get("p_value")) is not None:
+                shown += f"  p={_fmt(m['p_value'], '{:.4g}')}"
+            shown += f"  (n={m.get('n_a', 0)}/{m.get('n_b', 0)})"
+            rows.append((str(m.get("metric", "?")), shown))
+        for s in perf.get("scalars") or []:
+            rows.append(
+                (
+                    str(s.get("metric", "?")),
+                    f"a={_fmt_rate(s.get('a'))} b={_fmt_rate(s.get('b'))} "
+                    f"x{_fmt(s.get('ratio'), '{:.3f}')}  "
+                    "(summary — one sample, no verdict)",
+                )
+            )
+    # ----- roll-up
+    findings = doc.get("findings") or []
+    verdict = str(doc.get("verdict", "?"))
+    if findings:
+        verdict += (
+            f" — {len(findings)} CORRECTNESS finding(s): deterministic "
+            "counters diverged between identically-seeded runs"
+        )
+    elif doc.get("regressed"):
+        verdict += f" — {', '.join(doc['regressed'])}"
+    elif doc.get("improved"):
+        verdict += f" — {', '.join(doc['improved'])}"
+    rows.append(("verdict", verdict))
+    width = max(len(k) for k, _ in rows)
+    return "\n".join(
+        f"{k:<{width}}  {v}" if k else f"{'':<{width}}  {v}"
+        for k, v in rows
+    )
 
 
 def render_phase_table(payload: dict) -> str:

@@ -1910,6 +1910,7 @@ class SimProgram:
         trace_cb: Callable[[np.ndarray], None] | None = None,
         netmatrix_cb: Callable[[np.ndarray], None] | None = None,
         chunk_timeout: float = 0.0,
+        chunk_sleep_ms: float = 0.0,
         on_stall: Callable[[int, int], None] | None = None,
         nan_guard: bool = False,
         perf=None,
@@ -1944,7 +1945,10 @@ class SimProgram:
 
         ``chunk_timeout`` > 0 arms the per-chunk wall-clock watchdog
         (see :meth:`_dispatch_watched`); ``on_stall(last_tick, chunk)``
-        is its journaling hook. ``nan_guard`` scans every float leaf of
+        is its journaling hook. ``chunk_sleep_ms`` > 0 sleeps host-side
+        inside each chunk's timed window — the deterministic synthetic
+        slowdown behind ``SimJaxConfig.debug_chunk_sleep_ms`` (the
+        comparison plane's test knob; never program-shaping). ``nan_guard`` scans every float leaf of
         the carry after each chunk and fails fast naming the offending
         leaf and tick range — a debug flag (each scan is a device→host
         read of the whole carry).
@@ -2055,6 +2059,12 @@ class SimProgram:
                 # count _poll_done calls to pin the telemetry plane's
                 # zero-extra-syncs contract).
                 done_host = _poll_done(done)
+            if chunk_sleep_ms > 0:
+                # debug slowdown (SimJaxConfig.debug_chunk_sleep_ms):
+                # inside the timed window on purpose, so the ledger's
+                # per-chunk walls — and everything judged from them —
+                # see a deterministic synthetic regression
+                _time.sleep(chunk_sleep_ms / 1000.0)
             if perf is not None:
                 # host-clock wall of this dispatch + done poll — no
                 # device reads beyond the poll the loop already paid

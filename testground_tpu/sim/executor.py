@@ -73,6 +73,13 @@ class SimJaxConfig:
     # naming the offending leaf and tick range (each scan is a full
     # device→host carry read, so strictly a debug flag)
     nan_guard: bool = False
+    # debug: host-side sleep per chunk dispatch, in milliseconds — a
+    # deterministic synthetic slowdown for exercising the comparison
+    # plane (`tg diff` / the bench sentinel must flag a slowed run;
+    # tools/diff_smoke.py). Inflates the per-chunk dispatch wall the
+    # perf ledger records; shapes NO part of the program and never
+    # belongs in a real run
+    debug_chunk_sleep_ms: float = 0.0
     # telemetry plane (docs/OBSERVABILITY.md): compile a per-tick counter
     # block into the jitted tick and flush it once per chunk dispatch
     # into the run's sim_timeseries.jsonl — message flow, calendar depth,
@@ -1712,6 +1719,7 @@ def _execute_sim_run(
             trace_cb=trace_writer.on_block if trace_writer else None,
             netmatrix_cb=_nm_cb,
             chunk_timeout=float(getattr(cfg, "chunk_timeout_secs", 0.0)),
+            chunk_sleep_ms=float(getattr(cfg, "debug_chunk_sleep_ms", 0.0)),
             on_stall=on_stall,
             # same rule as telemetry: a leader-local full-carry read is
             # not symmetric across a cohort (and np.asarray on a

@@ -36,6 +36,18 @@ import json
 import math
 from typing import Any
 
+# the comparison codepath moved to the cross-run analysis plane
+# (analysis/diff.py — stdlib-only, shared with `tg diff` and the bench
+# sentinel); these names re-export so every historical import site
+# (`from testground_tpu.sim.perf import perf_compare`) keeps working
+# and there is exactly ONE implementation
+from testground_tpu.analysis.diff import (  # noqa: F401 — re-exports
+    extract_ledger_metrics as _extract_metrics,
+    fmt_rate,
+    num,
+    perf_compare,
+)
+
 # the writer-owned file-name constant lives beside its siblings
 # (SIM_SERIES_FILE / SPAN_FILE / LATENCY_FILE) in sim/telemetry.py
 from .telemetry import PERF_FILE
@@ -52,30 +64,6 @@ __all__ = [
     "perf_compare",
     "timed_lower_compile",
 ]
-
-
-def num(v, default=None):
-    """A finite number, or ``default`` — perf/stats payloads are decoded
-    JSON from possibly foreign writers, so a null/NaN/string field must
-    degrade gracefully, never TypeError. Shared by every ledger consumer
-    (``runners/pretty.py`` tables, the Prometheus exposition)."""
-    if isinstance(v, bool) or not isinstance(v, (int, float)):
-        return default
-    if not math.isfinite(v):
-        return default
-    return v
-
-
-def fmt_rate(v, missing: str = "?") -> str:
-    """A rate with a G/M/k suffix (``?`` for absent/non-finite) — the one
-    formatter behind both the ``tg perf`` table and ``--compare`` lines."""
-    n = num(v)
-    if n is None:
-        return missing
-    for div, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
-        if abs(n) >= div:
-            return f"{n / div:.2f}{suffix}"
-    return f"{n:.1f}"
 
 
 def device_memory_stats(device=None) -> dict:
@@ -375,109 +363,6 @@ class PerfLedger:
 
 
 # --------------------------------------------------------------- compare
-
-
-def _extract_metrics(obj: dict) -> dict:
-    """Pull the comparable numbers out of any ledger-bearing shape:
-
-    - a ``tg perf --json`` payload (``{"perf": {...}, "sim": {...}}``)
-    - a journal ``sim`` block (``{"perf": {...}, "wall_secs": ...}``)
-    - a bare ledger block (``{"compile": ..., "execute": ...}``)
-    - a ``bench.py`` / BENCH_rNN.json line
-      (``{"metric": "sim_peer_ticks_per_sec", "value": ..., "perf": ...}``)
-    - the bench-trajectory wrapper the driver records (``{"tail":
-      "<log>\\n{bench json line}"}``) — the embedded line is unwrapped
-
-    Returns ``{peer_ticks_per_sec?, compile_secs?, lower_secs?,
-    xla_compile_secs?, wall_secs?, ticks?}`` — only what the shape holds.
-    """
-    out: dict[str, float] = {}
-    if not isinstance(obj, dict):
-        return out
-    if (
-        isinstance(obj.get("tail"), str)
-        and "metric" not in obj
-        and "perf" not in obj
-        and "sim" not in obj
-    ):
-        for line in reversed(obj["tail"].splitlines()):
-            line = line.strip()
-            if not line.startswith("{"):
-                continue
-            try:
-                return _extract_metrics(json.loads(line))
-            except ValueError:
-                continue
-        return out
-    perf = obj
-    if isinstance(obj.get("perf"), dict):
-        perf = obj["perf"]
-    elif isinstance(obj.get("sim"), dict):
-        perf = obj["sim"].get("perf", {})
-    sim = obj.get("sim") if isinstance(obj.get("sim"), dict) else obj
-    # the module-level finite coercion — json.loads admits NaN/Infinity
-    # literals, and a hand-edited baseline must not print 'xnan' ratios
-    ex = perf.get("execute") if isinstance(perf.get("execute"), dict) else {}
-    co = perf.get("compile") if isinstance(perf.get("compile"), dict) else {}
-    for key, src in (
-        ("peer_ticks_per_sec", ex.get("steady_peer_ticks_per_sec")),
-        ("peer_ticks_per_sec", ex.get("peer_ticks_per_sec")),
-        ("wall_secs", ex.get("wall_secs")),
-        ("ticks", ex.get("ticks")),
-        ("lower_secs", co.get("lower_secs")),
-        ("xla_compile_secs", co.get("compile_secs")),
-    ):
-        v = num(src)
-        if v is not None and key not in out:
-            out[key] = v
-    # bench.py headline line (BENCH_rNN.json)
-    if obj.get("metric") == "sim_peer_ticks_per_sec":
-        v = num(obj.get("value"))
-        if v is not None:
-            out.setdefault("peer_ticks_per_sec", v)
-        v = num(obj.get("compile_secs"))
-        if v is not None:
-            out.setdefault("compile_secs", v)
-    # journal sim block fields
-    if isinstance(sim, dict):
-        for key, name in (("wall_secs", "wall_secs"), ("ticks", "ticks")):
-            v = num(sim.get(key))
-            if v is not None:
-                out.setdefault(name, v)
-        v = num(sim.get("compile_secs"))
-        if v is not None:
-            out.setdefault("compile_secs", v)
-    return out
-
-
-def perf_compare(current: dict, baseline: dict, label: str = "baseline") -> list[str]:
-    """Human-readable throughput deltas between two ledger-bearing
-    dicts — the ``tg perf --compare`` body. Returns one line per
-    comparable metric; a single explanatory line when nothing overlaps
-    (never raises on shape mismatches — review-time tooling must not
-    crash on a hand-edited baseline)."""
-    cur, base = _extract_metrics(current), _extract_metrics(baseline)
-    lines: list[str] = []
-    c, b = cur.get("peer_ticks_per_sec"), base.get("peer_ticks_per_sec")
-    if c and b:
-        lines.append(
-            f"peer·ticks/s  {fmt_rate(c)} vs {fmt_rate(b)} {label} "
-            f"(x{c / b:.3f})"
-        )
-    c, b = cur.get("compile_secs"), base.get("compile_secs")
-    if c is None:
-        c = (cur.get("lower_secs") or 0) + (cur.get("xla_compile_secs") or 0) or None
-    if b is None:
-        b = (base.get("lower_secs") or 0) + (base.get("xla_compile_secs") or 0) or None
-    if c and b:
-        lines.append(f"compile       {c:.2f}s vs {b:.2f}s {label} (x{c / b:.3f})")
-    c, b = cur.get("wall_secs"), base.get("wall_secs")
-    if c and b:
-        lines.append(f"wall          {c:.2f}s vs {b:.2f}s {label} (x{c / b:.3f})")
-    if not lines:
-        lines.append(
-            f"no comparable throughput fields between this task and {label} "
-            "(expected a perf ledger, a journal sim block, or a bench.py "
-            "JSON line)"
-        )
-    return lines
+# `perf_compare` / `_extract_metrics` now live in analysis/diff.py
+# (re-exported above): ONE comparison codepath shared with `tg diff`,
+# output pinned compatible by tests/test_sim_perf.py.

@@ -1,0 +1,485 @@
+"""Differential run analysis (docs/OBSERVABILITY.md "Run diff / bench
+sentinel"): the hand-rolled rank test, the noise-aware verdict policy,
+RunDiff assembly over sparse/corrupt inputs, the engine/daemon/CLI
+surfaces, the ``perf_compare`` adapter pin, and the bench-history
+sentinel.
+
+The statistical policy under test is the load-bearing part: two
+identically-seeded runs on a ±40% noisy box must NEVER judge
+``regressed``/``improved`` (alpha=0.01 AND a ≥10% median shift are both
+required), while a genuine slowdown flags with an auditable p-value.
+Constants pinned here were cross-checked by hand against the normal
+approximation with tie + continuity correction.
+"""
+
+import json
+import os
+import random
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from testground_tpu.analysis import bench_history as bh
+from testground_tpu.analysis.diff import (
+    DIFF_PLANES,
+    build_run_diff,
+    judge_samples,
+    mann_whitney_u,
+    task_snapshot,
+    validate_planes,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLANS = os.path.join(REPO_ROOT, "plans")
+
+
+class TestMannWhitney:
+    def test_known_p_value_5v5_separation(self):
+        """Complete separation at n=5 per side: U₁=0 and the corrected
+        normal approximation gives p≈0.0122 (hand-checked:
+        z=(0.5-12.5)/sqrt(275/12), p=erfc(|z|/√2))."""
+        u1, p = mann_whitney_u([1, 2, 3, 4, 5], [6, 7, 8, 9, 10])
+        assert u1 == 0.0
+        assert p == pytest.approx(0.0121857803, rel=1e-6)
+
+    def test_known_p_value_8v8_separation(self):
+        u1, p = mann_whitney_u(list(range(8)), list(range(10, 18)))
+        assert u1 == 0.0
+        assert p == pytest.approx(0.0009391056, rel=1e-6)
+
+    def test_statistic_symmetry(self):
+        """U₁ + U₂ = n₁·n₂ — the defining identity of the statistic."""
+        xs, ys = [3, 1, 4, 1, 5, 9, 2, 6], [5, 3, 5, 8, 9, 7]
+        u1, p1 = mann_whitney_u(xs, ys)
+        u2, p2 = mann_whitney_u(ys, xs)
+        assert u1 + u2 == pytest.approx(len(xs) * len(ys))
+        assert p1 == pytest.approx(p2)
+
+    def test_identical_samples_not_significant(self):
+        _, p = mann_whitney_u([1, 2, 3, 4, 5], [1, 2, 3, 4, 5])
+        assert p == 1.0
+
+    def test_degenerate_inputs_never_crash(self):
+        assert mann_whitney_u([], [1, 2]) == (0.0, 1.0)
+        assert mann_whitney_u([1, 2], []) == (0.0, 1.0)
+        # every value tied: zero variance, no evidence of a shift
+        assert mann_whitney_u([5, 5, 5], [5, 5, 5])[1] == 1.0
+
+
+class TestJudgeSamples:
+    def test_improved_when_significant_and_shifted(self):
+        row = judge_samples(range(100, 108), range(150, 158))
+        assert row["verdict"] == "improved"
+        assert row["ratio"] == pytest.approx(1.483, abs=1e-3)
+        assert row["p_value"] < 0.01
+        assert row["n_a"] == row["n_b"] == 8
+
+    def test_regressed_when_significant_and_shifted(self):
+        row = judge_samples(range(100, 108), range(50, 58))
+        assert row["verdict"] == "regressed"
+        assert row["p_value"] < 0.01
+
+    def test_lower_is_better_inverts_direction(self):
+        """Wall-seconds semantics: larger B samples = slower = regressed."""
+        a = [1.0 + 0.01 * i for i in range(8)]
+        b = [2.0 + 0.01 * i for i in range(8)]
+        assert (
+            judge_samples(a, b, higher_is_better=False)["verdict"]
+            == "regressed"
+        )
+        assert (
+            judge_samples(b, a, higher_is_better=False)["verdict"]
+            == "improved"
+        )
+
+    def test_identical_runs_unchanged(self):
+        xs = [100 + (i % 7) for i in range(20)]
+        row = judge_samples(xs, list(xs))
+        assert row["verdict"] == "unchanged"
+
+    def test_too_few_samples_inconclusive(self):
+        row = judge_samples([1, 2], [30, 40])
+        assert row["verdict"] == "inconclusive"
+        assert "too few samples" in row["reason"]
+
+    def test_shifted_but_not_significant_inconclusive(self):
+        """A 25% median shift the rank test cannot confirm (p≈0.14 at
+        n=5 with heavy overlap) must stay inconclusive — never a gate."""
+        row = judge_samples([80, 90, 100, 110, 120], [95, 105, 125, 135, 145])
+        assert row["verdict"] == "inconclusive"
+        assert row["p_value"] == pytest.approx(0.1437, abs=1e-3)
+
+    def test_forty_percent_noise_never_flags(self):
+        """The acceptance property for the serving box: two sample sets
+        drawn around the SAME underlying rate with ±40% uniform noise
+        must never judge improved/regressed (fixed seed: deterministic)."""
+        r = random.Random(7)
+        for _ in range(10):
+            a = [100 * (1 + r.uniform(-0.4, 0.4)) for _ in range(30)]
+            b = [100 * (1 + r.uniform(-0.4, 0.4)) for _ in range(30)]
+            verdict = judge_samples(a, b)["verdict"]
+            assert verdict in ("unchanged", "inconclusive"), verdict
+
+
+class TestPlaneValidation:
+    def test_default_is_all_planes(self):
+        assert validate_planes(None) == DIFF_PLANES
+        assert validate_planes("") == DIFF_PLANES
+
+    def test_subset_and_ordering(self):
+        assert validate_planes("perf,counters") == ("perf", "counters")
+        assert validate_planes(["latency"]) == ("latency",)
+
+    def test_unknown_plane_raises_naming_known(self):
+        with pytest.raises(ValueError, match="counters"):
+            validate_planes("counters,bogus")
+
+
+class TestRunDiffTolerance:
+    def test_empty_tasks_build_without_planes(self):
+        doc = build_run_diff(task_snapshot({}, []), task_snapshot({}, []))
+        assert doc["findings"] == []
+        assert doc["verdict"] == "clean"
+        for plane in DIFF_PLANES:
+            assert "absent" in doc[plane]
+
+    def test_corrupt_blocks_never_raise(self):
+        """Journal blocks of the wrong shape (a crashed run, a future
+        schema) degrade to absent planes, never a traceback."""
+        garbage = {
+            "sim": "not-a-dict",
+            "telemetry": [1, 2, 3],
+            "slo": {"rules": "nope"},
+            "composition": 7,
+        }
+        rows = [{"stream": "perf", "chunk": "NaN"}, "junk", None]
+        doc = build_run_diff(
+            task_snapshot(garbage, rows), task_snapshot({}, [])
+        )
+        assert doc["verdict"] in ("clean", "inconclusive")
+        assert doc["findings"] == []
+
+    def test_identical_snapshots_exact_equality(self):
+        task = {
+            "id": "t1",
+            "composition": {
+                "global": {"plan": "p", "case": "c", "run_config": {"seed": 3}}
+            },
+            "result": {
+                "journal": {
+                    "sim": {
+                        "ticks": 512,
+                        "tick_ms": 100,
+                        "processes": 2,
+                        "msgs_delivered": 99,
+                        "msgs_sent": 100,
+                        "msgs_dropped": 1,
+                        "latency": {
+                            "all": {"count": 99, "p50_ms": 1, "p95_ms": 2}
+                        },
+                    }
+                }
+            },
+        }
+        snap = task_snapshot(task, [])
+        doc = build_run_diff(snap, dict(snap))
+        assert doc["setup"]["identical"] is True
+        assert doc["counters"]["mismatched"] == 0
+        assert doc["counters"]["compared"] > 0
+        assert doc["latency"]["mismatched"] == 0
+        assert doc["findings"] == []
+
+    def test_counter_mismatch_is_a_correctness_finding(self):
+        base = {
+            "id": "tA",
+            "composition": {"global": {"run_config": {"seed": 3}}},
+            "result": {
+                "journal": {"sim": {"ticks": 512, "msgs_delivered": 99}}
+            },
+        }
+        other = json.loads(json.dumps(base))
+        other["id"] = "tB"
+        other["result"]["journal"]["sim"]["msgs_delivered"] = 98
+        doc = build_run_diff(task_snapshot(base, []), task_snapshot(other, []))
+        assert doc["counters"]["mismatched"] == 1
+        assert doc["findings"], "flow-total mismatch must be a finding"
+        assert doc["findings"][0]["severity"] == "correctness"
+        assert doc["verdict"] == "findings"
+
+    def test_different_setup_suppresses_findings(self):
+        """Counter deltas between runs of DIFFERENT compositions are
+        expected, not correctness findings."""
+        base = {
+            "composition": {"global": {"run_config": {"seed": 3}}},
+            "result": {"journal": {"sim": {"msgs_delivered": 99}}},
+        }
+        other = {
+            "composition": {"global": {"run_config": {"seed": 4}}},
+            "result": {"journal": {"sim": {"msgs_delivered": 55}}},
+        }
+        doc = build_run_diff(task_snapshot(base, []), task_snapshot(other, []))
+        assert doc["setup"]["identical"] is False
+        assert doc["counters"]["mismatched"] == 1
+        assert doc["findings"] == []
+
+
+class TestPerfCompareAdapter:
+    def test_sim_perf_reexports_the_engine(self):
+        """Satellite pin: sim.perf's compare surface IS analysis.diff's
+        (one comparison codepath — `tg perf --compare` and `tg diff`
+        can never drift apart)."""
+        from testground_tpu.analysis import diff as adiff
+        from testground_tpu.sim import perf as sperf
+
+        assert sperf.perf_compare is adiff.perf_compare
+        assert sperf._extract_metrics is adiff.extract_ledger_metrics
+        assert sperf.fmt_rate is adiff.fmt_rate
+        assert sperf.num is adiff.num
+
+
+class TestBenchHistory:
+    def _row(self, value, ts="2026-01-01T00:00:00+00:00", **over):
+        row = {
+            "ts": ts,
+            "workload": "sustained",
+            "instances": 512,
+            "transport": "xla",
+            "metric": "sim_peer_ticks_per_sec",
+            "value": value,
+            "fingerprint": {"backend": "cpu", "device_kind": "cpu"},
+        }
+        row.update(over)
+        return row
+
+    def test_bank_and_load_round_trip(self, tmp_path):
+        path = str(tmp_path / "h.jsonl")
+        bh.bank_row(path, self._row(100.0))
+        bh.bank_row(path, self._row(110.0))
+        with open(path, "a") as f:
+            f.write("{corrupt\n")  # a crashed bench half-line
+        rows = bh.load_history(path)
+        assert [r["value"] for r in rows] == [100.0, 110.0]
+
+    def test_sentinel_verdicts(self):
+        rows = [self._row(100.0), self._row(104.0), self._row(101.0)]
+        report = bh.sentinel_report(rows)
+        assert report["regressions"] == 0
+        (key,) = report["keys"]
+        assert key["verdict"] == "ok"
+        assert key["baseline"] == pytest.approx(102.0)  # median of priors
+
+    def test_sentinel_flags_confident_regression_only(self):
+        base = [self._row(100.0), self._row(100.0)]
+        # 30% slower: within the generous 2.5x bound — journaled only
+        within = bh.sentinel_report(base + [self._row(70.0)])
+        assert within["regressions"] == 0
+        assert within["keys"][0]["verdict"] == "inconclusive"
+        # 3x slower: no plausible noise explains it — gate
+        beyond = bh.sentinel_report(base + [self._row(33.0)])
+        assert beyond["regressions"] == 1
+        assert beyond["keys"][0]["verdict"] == "regressed"
+
+    def test_first_row_per_key_inconclusive(self):
+        report = bh.sentinel_report([self._row(100.0)])
+        assert report["regressions"] == 0
+        assert report["inconclusive"] == 1
+
+    def test_keys_do_not_cross_hardware(self):
+        tpu = self._row(
+            500.0, fingerprint={"backend": "tpu", "device_kind": "TPU v4"}
+        )
+        report = bh.sentinel_report([self._row(100.0), tpu])
+        assert len(report["keys"]) == 2
+        assert report["regressions"] == 0
+
+    def test_committed_history_parses_and_passes(self):
+        """The checked-in bank (the smoke's baseline) must always load
+        and hold no regression verdicts at HEAD."""
+        rows = bh.load_history(os.path.join(REPO_ROOT, bh.HISTORY_FILE))
+        assert rows, "committed BENCH_HISTORY.jsonl is empty/unreadable"
+        assert bh.sentinel_report(rows)["regressions"] == 0
+
+
+class TestDaemonRouteErrors:
+    """The /diff route's error surface needs no finished runs, so these
+    stay fast (daemon startup only)."""
+
+    @pytest.fixture()
+    def daemon(self, tg_home):
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.daemon import Daemon
+
+        d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+        d.start()
+        yield d
+        d.stop()
+
+    def _get_code(self, url):
+        try:
+            urllib.request.urlopen(url, timeout=30)
+            return 200
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    def test_missing_params_400(self, daemon):
+        assert self._get_code(daemon.address + "/diff") == 400
+        assert self._get_code(daemon.address + "/diff?a=x") == 400
+
+    def test_unknown_task_404(self, daemon):
+        assert self._get_code(daemon.address + "/diff?a=ghost&b=ghost2") == 404
+
+    def test_unknown_plane_400(self, daemon):
+        assert (
+            self._get_code(daemon.address + "/diff?a=x&b=y&planes=bogus")
+            == 400
+        )
+
+    def test_auth_required_when_configured(self, tg_home):
+        from testground_tpu.client import Client, DaemonError
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.daemon import Daemon
+
+        env = EnvConfig.load()
+        env.daemon.tokens = ["sekrit"]
+        d = Daemon(env=env, listen="localhost:0")
+        d.start()
+        try:
+            with pytest.raises(DaemonError, match="unauthorized"):
+                Client(d.address).diff("a", "b")
+            # with the token the request reaches the handler (404: no
+            # such tasks — proving auth, not routing, was the barrier)
+            with pytest.raises(DaemonError, match="unknown task"):
+                Client(d.address, token="sekrit").diff("a", "b")
+        finally:
+            d.stop()
+
+
+@pytest.mark.slow  # two real daemon-served sim runs (compile + 512 ticks
+# each) feed every e2e assertion; well past the non-slow ~5s ceiling
+class TestEndToEnd:
+    @pytest.fixture(scope="class")
+    def daemon(self, tmp_path_factory):
+        home = tmp_path_factory.mktemp("tg-home")
+        old = os.environ.get("TESTGROUND_HOME")
+        os.environ["TESTGROUND_HOME"] = str(home)
+        from testground_tpu.config import EnvConfig
+        from testground_tpu.daemon import Daemon
+
+        d = Daemon(env=EnvConfig.load(), listen="localhost:0")
+        d.start()
+        yield d
+        d.stop()
+        if old is None:
+            os.environ.pop("TESTGROUND_HOME", None)
+        else:
+            os.environ["TESTGROUND_HOME"] = old
+
+    def _run(self, daemon, extra=None):
+        from testground_tpu.client import Client
+
+        client = Client(daemon.address)
+        client.import_plan(os.path.join(PLANS, "network"))
+        cfg = {"telemetry": True, "chunk": 16, "max_ticks": 512}
+        cfg.update(extra or {})
+        tid = client.run(
+            {
+                "global": {
+                    "plan": "network",
+                    "case": "ping-pong",
+                    "builder": "sim:plan",
+                    "runner": "sim:jax",
+                    "run_config": cfg,
+                },
+                "groups": [
+                    {"id": "ping", "instances": {"count": 1}},
+                    {"id": "pong", "instances": {"count": 1}},
+                ],
+            }
+        )
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            t = client.status(tid)
+            if t["states"][-1]["state"] in ("complete", "canceled"):
+                assert t["outcome"] == "success"
+                return tid
+            time.sleep(0.2)
+        raise TimeoutError(tid)
+
+    @pytest.fixture(scope="class")
+    def pair(self, daemon):
+        # warmup: the first in-process run pays cold-compile and
+        # first-touch costs that would otherwise shift the A/B medians
+        self._run(daemon)
+        return self._run(daemon), self._run(daemon)
+
+    def test_identically_seeded_runs_diff_exactly(self, daemon, pair):
+        """The headline acceptance: same composition, same seed ⇒ every
+        deterministic counter equal, zero findings."""
+        from testground_tpu.client import Client
+
+        doc = Client(daemon.address).diff(*pair)
+        assert doc["setup"]["identical"] is True
+        assert doc["counters"]["mismatched"] == 0
+        assert doc["counters"]["compared"] >= 15
+        assert doc["latency"]["mismatched"] == 0
+        assert doc["findings"] == []
+        for row in doc["perf"].get("metrics", []):
+            assert row["verdict"] in ("unchanged", "inconclusive"), row
+
+    def test_planes_param_narrows_document(self, daemon, pair):
+        from testground_tpu.client import Client
+
+        doc = Client(daemon.address).diff(*pair, planes="counters")
+        assert doc["planes"] == ["counters"]
+        assert "perf" not in doc
+
+    def test_cli_diff_renders_and_exits_clean(self, daemon, pair, capsys):
+        from testground_tpu.cli.main import main
+
+        rc = main(["--endpoint", daemon.address, "diff", *pair])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "exact equality" in out
+        assert "verdict" in out
+        assert "MISMATCH" not in out
+
+    def test_cli_diff_json_contract(self, daemon, pair, capsys):
+        from testground_tpu.cli.main import main
+
+        rc = main(["--endpoint", daemon.address, "diff", *pair, "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["counters"]["mismatched"] == 0
+
+    def test_cli_unknown_plane_exits_2(self, daemon, pair, capsys):
+        from testground_tpu.cli.main import main
+
+        rc = main(
+            [
+                "--endpoint",
+                daemon.address,
+                "diff",
+                *pair,
+                "--planes",
+                "bogus",
+            ]
+        )
+        assert rc == 2
+        assert "bogus" in capsys.readouterr().err
+
+    def test_slowed_run_flags_regressed(self, daemon, pair):
+        """debug_chunk_sleep_ms inflates every chunk wall inside the
+        timed window: the rank test must flag it with p far below
+        alpha, and the rollup verdict must say so."""
+        from testground_tpu.client import Client
+
+        slow = self._run(daemon, {"debug_chunk_sleep_ms": 25})
+        doc = Client(daemon.address).diff(pair[0], slow)
+        rows = {r["metric"]: r for r in doc["perf"]["metrics"]}
+        assert rows["chunk_ticks_per_sec"]["verdict"] == "regressed"
+        assert rows["chunk_ticks_per_sec"]["p_value"] < 0.01
+        assert doc["verdict"] == "regressed"
+        # a debug knob is not a correctness delta: no findings
+        assert doc["findings"] == []

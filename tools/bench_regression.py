@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: gate the newest banked bench row per key.
+
+Reads the append-only ``BENCH_HISTORY.jsonl`` written by ``bench.py
+--bank`` and, for every history key ``(workload, instances, backend,
+device_kind, transport)``, compares the newest row's headline value
+against the median of the prior rows for that key.  A confident
+regression — newest value slower than baseline by more than the
+tolerance factor — exits non-zero so CI fails; ``inconclusive`` rows
+(no baseline yet, or slower but within the noise bound) are journaled
+to stderr and pass.
+
+The default tolerance is deliberately generous (2.5x): bench boxes in
+CI are shared and noisy (±40% run-to-run has been observed), so only
+an unambiguous slowdown should gate.  Tighten with ``--tolerance`` on
+quieter hardware.
+
+Usage:
+    python tools/bench_regression.py [--history PATH] [--tolerance X]
+                                     [--json]
+
+Exit codes: 0 ok/improved/inconclusive only, 1 at least one confident
+regression, 2 usage or unreadable history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from testground_tpu.analysis.bench_history import (  # noqa: E402
+    HISTORY_FILE,
+    load_history,
+    sentinel_report,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--history",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            HISTORY_FILE,
+        ),
+        help="bench history jsonl (default: repo-root BENCH_HISTORY.jsonl)",
+    )
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=2.5,
+        help="regression bound: fail when newest < baseline/tolerance "
+        "(default 2.5, i.e. only >2.5x slowdowns gate)",
+    )
+    p.add_argument("--json", action="store_true", help="emit the report as JSON")
+    args = p.parse_args()
+
+    if args.tolerance <= 1.0:
+        print("error: --tolerance must be > 1.0", file=sys.stderr)
+        return 2
+
+    rows = load_history(args.history)
+    if not rows:
+        print(f"error: no readable rows in {args.history}", file=sys.stderr)
+        return 2
+
+    report = sentinel_report(rows, tolerance=args.tolerance)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for key in report["keys"]:
+            label = (
+                f"{key['workload']}/{key['instances']} "
+                f"{key['backend']}:{key['device_kind']} {key['transport']}"
+            )
+            line = f"{key['verdict']:<13} {label}  value={key['value']:.1f}"
+            if key.get("baseline") is not None:
+                line += f"  baseline={key['baseline']:.1f}  x{key['ratio']:.3f}"
+            line += f"  ({key['reason']})"
+            print(line)
+    if report["inconclusive"]:
+        print(
+            f"# {report['inconclusive']} inconclusive key(s) — journaled, "
+            "not gating",
+            file=sys.stderr,
+        )
+    if report["regressions"]:
+        print(
+            f"error: {report['regressions']} confident regression(s) "
+            f"(tolerance {args.tolerance:g}x)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,235 @@
+"""``make diff-smoke``: drive differential run analysis end to end
+through a real daemon — the CI teeth for ``tg diff`` and the bench
+sentinel (docs/OBSERVABILITY.md "Run diff / bench sentinel"):
+
+1. two identically-seeded ping-pong runs diff CLEAN through the real
+   CLI (``tg diff --endpoint``): every deterministic plane reports
+   exact equality, zero correctness findings, and the noise-aware
+   throughput judgment reports zero significant deltas;
+2. a third run deliberately slowed with ``debug_chunk_sleep_ms`` (the
+   synthetic-slowdown debug knob — inflates chunk walls without
+   touching program semantics) is flagged ``regressed`` with an
+   auditable Mann–Whitney p-value;
+3. the bench sentinel round-trips: a tiny ``bench.py --bank`` run
+   banks against a copy of the committed BENCH_HISTORY.jsonl and
+   ``tools/bench_regression.py`` passes (inconclusive rows pass but
+   are journaled), then a fabricated 3x-slower row flips it to a
+   non-zero exit.
+
+Exits non-zero with a readable message on any violation; prints a
+one-line summary on success. Self-contained: runs against a temporary
+$TESTGROUND_HOME on the CPU backend, so it is safe in CI. A warmup run
+precedes the A/B pair so cold-compile asymmetry cannot masquerade as a
+throughput shift.
+"""
+
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from contextlib import redirect_stdout
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+RUN_CONFIG = {"telemetry": True, "chunk": 16, "max_ticks": 512}
+
+
+def fail(msg: str) -> "None":
+    print(f"diff-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def tg(args) -> tuple[int, str]:
+    """Invoke the real CLI entry point, capturing stdout."""
+    from testground_tpu.cli.main import main
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(args)
+    return rc, buf.getvalue()
+
+
+def main() -> int:
+    os.environ["TESTGROUND_HOME"] = tempfile.mkdtemp(prefix="tg-smoke-")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from testground_tpu.client import Client
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.daemon import Daemon
+
+    daemon = Daemon(env=EnvConfig.load(), listen="localhost:0")
+    daemon.start()
+    try:
+        client = Client(daemon.address)
+        client.import_plan(os.path.join(REPO_ROOT, "plans", "network"))
+
+        def run(name, extra=None):
+            cfg = dict(RUN_CONFIG, **(extra or {}))
+            tid = client.run(
+                {
+                    "metadata": {"name": name},
+                    "global": {
+                        "plan": "network",
+                        "case": "ping-pong",
+                        "builder": "sim:plan",
+                        "runner": "sim:jax",
+                        "run_config": cfg,
+                    },
+                    "groups": [
+                        {"id": "ping", "instances": {"count": 1}},
+                        {"id": "pong", "instances": {"count": 1}},
+                    ],
+                }
+            )
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                t = client.status(tid)
+                if t["states"][-1]["state"] in ("complete", "canceled"):
+                    break
+                time.sleep(0.2)
+            else:
+                fail(f"task {tid} ({name}) did not finish")
+            if t.get("error"):
+                fail(f"run {name} errored: {t['error']}")
+            return tid
+
+        # warmup: the first in-process run pays cold-compile and
+        # first-touch costs that would otherwise shift the A/B medians
+        run("diff-smoke-warmup")
+        a = run("diff-smoke-a")
+        b = run("diff-smoke-b")
+
+        # --- 1. identically-seeded pair diffs clean through the CLI
+        rc, screen = tg(["--endpoint", daemon.address, "diff", a, b])
+        if rc != 0:
+            fail(f"tg diff on identical runs exited {rc}:\n{screen}")
+        if "exact equality" not in screen:
+            fail(f"screen is missing the exact-equality verdict:\n{screen}")
+        if "MISMATCH" in screen:
+            fail(f"identical runs report a counter mismatch:\n{screen}")
+        if "regressed" in screen or "improved" in screen:
+            fail(f"identical runs report a throughput shift:\n{screen}")
+        rc, out = tg(["--endpoint", daemon.address, "diff", a, b, "--json"])
+        if rc != 0:
+            fail(f"tg diff --json exited {rc}")
+        doc = json.loads(out)
+        if doc["findings"]:
+            fail(f"identical runs yield findings: {doc['findings']}")
+        if not doc["setup"]["identical"]:
+            fail("identical compositions not recognised as identical")
+        ctr = doc["counters"]
+        if ctr.get("mismatched") != 0 or not ctr.get("compared"):
+            fail(f"counters plane not exactly equal: {ctr}")
+        shifted = [
+            r
+            for r in doc["perf"].get("metrics", [])
+            if r["verdict"] in ("regressed", "improved")
+        ]
+        if shifted:
+            fail(f"identical runs judged shifted: {shifted}")
+
+        # --- 2. the deliberately-slowed run is flagged regressed
+        slow = run("diff-smoke-slow", {"debug_chunk_sleep_ms": 25})
+        rc, out = tg(
+            ["--endpoint", daemon.address, "diff", a, slow, "--json"]
+        )
+        if rc != 0:
+            # the slowed run differs only in a debug knob: no
+            # correctness findings, so the exit code stays 0
+            fail(f"tg diff vs slowed run exited {rc}")
+        sdoc = json.loads(out)
+        regressed = {
+            r["metric"]: r
+            for r in sdoc["perf"].get("metrics", [])
+            if r["verdict"] == "regressed"
+        }
+        if "chunk_ticks_per_sec" not in regressed:
+            fail(
+                "slowed run not flagged regressed on chunk_ticks_per_sec: "
+                f"{sdoc['perf'].get('metrics')}"
+            )
+        pval = regressed["chunk_ticks_per_sec"]["p_value"]
+        if not (isinstance(pval, float) and pval < 0.01):
+            fail(f"regression p-value not significant: {pval}")
+        if sdoc["verdict"] != "regressed":
+            fail(f"rollup verdict {sdoc['verdict']!r} != 'regressed'")
+
+        # --- 3. bench sentinel round-trip against the committed bank
+        tmp = os.path.join(os.environ["TESTGROUND_HOME"], "history.jsonl")
+        shutil.copy(os.path.join(REPO_ROOT, "BENCH_HISTORY.jsonl"), tmp)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        bench = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "bench.py"),
+                "--instances", "512",
+                "--ticks", "512",
+                "--skip-secondary",
+                "--bank",
+                "--history", tmp,
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=REPO_ROOT,
+            timeout=600,
+        )
+        if bench.returncode != 0:
+            fail(f"tiny bench --bank exited {bench.returncode}:\n{bench.stderr}")
+        if "# banked" not in bench.stderr:
+            fail("bench.py --bank did not report banking")
+        sentinel = [
+            sys.executable,
+            os.path.join(REPO_ROOT, "tools", "bench_regression.py"),
+            "--history", tmp,
+        ]
+        ok = subprocess.run(
+            sentinel, capture_output=True, text=True, env=env, timeout=120
+        )
+        if ok.returncode != 0:
+            fail(
+                f"sentinel failed against committed baseline "
+                f"(rc {ok.returncode}):\n{ok.stdout}\n{ok.stderr}"
+            )
+        # fabricate a confident regression: clone the freshly-banked
+        # row (guaranteed key match) at a third of its value
+        with open(tmp) as f:
+            last = json.loads(f.readlines()[-1])
+        last["value"] = last["value"] / 3.0
+        last["ts"] = "9999-01-01T00:00:00+00:00"
+        with open(tmp, "a") as f:
+            f.write(json.dumps(last, sort_keys=True) + "\n")
+        bad = subprocess.run(
+            sentinel, capture_output=True, text=True, env=env, timeout=120
+        )
+        if bad.returncode != 1:
+            fail(
+                f"sentinel did not flag the 3x-slower row "
+                f"(rc {bad.returncode}):\n{bad.stdout}\n{bad.stderr}"
+            )
+        if "regressed" not in bad.stdout:
+            fail(f"sentinel output lacks a regressed verdict:\n{bad.stdout}")
+    finally:
+        daemon.stop()
+
+    n_judged = len(sdoc["perf"].get("metrics", []))
+    print(
+        f"diff-smoke: OK — counters {ctr['compared']} exact, "
+        f"{n_judged} judged metrics, slowdown p={pval:.2e} "
+        f"x{regressed['chunk_ticks_per_sec']['ratio']:.3f}, "
+        f"sentinel ok→regressed round-trip"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
