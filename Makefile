@@ -7,7 +7,7 @@ PY ?= python
 	telemetry-smoke chaos-smoke trace-smoke fleet-smoke perf-smoke slo-smoke \
 	phases-smoke checkpoint-smoke preempt-smoke crosshost-smoke \
 	pack-smoke sync-fanin-smoke transport-smoke check-smoke \
-	netmap-smoke diff-smoke check-plans test-sync-tsan
+	netmap-smoke diff-smoke mesh-smoke check-plans test-sync-tsan
 
 # the full ladder (SURVEY.md §4): unit + sim kernel + daemon/CLI e2e.
 # pyproject addopts applies --durations=15 to every invocation, keeping
@@ -158,6 +158,15 @@ sync-fanin-smoke:
 # part of the observability-smoke CI set
 transport-smoke:
 	$(PY) tools/transport_smoke.py
+
+# the sharded serving plane (PERF.md "Sharded serving plane"): two
+# tenants bucketed + packed on a 4-virtual-device mesh through the
+# real CLI path with transport=auto must journal sim.mesh + a scored
+# decision (stats mesh line, tg_mesh_shards gauge, mesh label) and
+# keep every flow total bit-equal to unmeshed, unpacked solo runs —
+# part of the observability-smoke CI set
+mesh-smoke:
+	$(PY) tools/mesh_smoke.py
 
 # static-analysis plane contract check (docs/CHECKING.md): a clean
 # composition checks to zero findings / exit 0; a seeded-bad one

@@ -141,7 +141,7 @@ def _workloads_for(transport, n, only=None):
     return names
 
 
-def build_bench_programs(n, ticks, transport="xla", only=None):
+def build_bench_programs(n, ticks, transport="xla", only=None, mesh_shape=""):
     """`tg build` for the bench surface: trace + compile EVERY bench
     workload's program into the persistent compile cache, so a
     driver-fresh timed bench is a pure cache read for every workload —
@@ -154,7 +154,9 @@ def build_bench_programs(n, ticks, transport="xla", only=None):
     walls = {}
     for name in _workloads_for(transport, n, only):
         plan, case, params, chunk = _bench_shape(name, n, ticks)
-        prog = _build(plan, case, n, params, chunk, transport)
+        prog = _build(
+            plan, case, n, params, chunk, transport, mesh_shape=mesh_shape
+        )
         t0 = time.perf_counter()
         carry = jax.jit(lambda: prog.init_carry(0))()  # noqa: B023
         fn = prog.compiled_chunk()
@@ -224,7 +226,16 @@ def build_bucket_programs(n, ticks, ladder=None, only=None):
     return walls
 
 
-def _build(plan, case, n, params, chunk, transport="xla", live_counts=None):
+def _build(
+    plan,
+    case,
+    n,
+    params,
+    chunk,
+    transport="xla",
+    live_counts=None,
+    mesh_shape="",
+):
     from testground_tpu.api import RunGroup
     from testground_tpu.sim.engine import SimProgram, build_groups
     from testground_tpu.sim.executor import (
@@ -241,13 +252,25 @@ def _build(plan, case, n, params, chunk, transport="xla", live_counts=None):
     import numpy as np
 
     devs = jax.devices()
-    # transport=pallas is single-device by contract (the cross-shard
-    # scatter IS the mesh traffic): A/B runs compare one chip's hot path
-    mesh = (
-        jax.sharding.Mesh(np.asarray(devs), ("i",))
-        if len(devs) > 1 and transport != "pallas" and live_counts is None
-        else None
-    )
+    if mesh_shape:
+        # an explicit --mesh rung (sim/meshplan.py): the layout applies
+        # to EVERY arm, pallas included — the shard_map commit variant
+        # is what a meshed A/B round measures. Divisibility failures
+        # surface as the engine's own loud refusal, not a silent skip.
+        from testground_tpu.sim.meshplan import make_mesh
+
+        mesh = make_mesh(mesh_shape)
+    else:
+        # default ladder, unchanged since r01: shard over every visible
+        # device under xla; transport=pallas single-device (the A/B
+        # rounds compare one chip's hot path), bucketed builds too
+        mesh = (
+            jax.sharding.Mesh(np.asarray(devs), ("i",))
+            if len(devs) > 1
+            and transport != "pallas"
+            and live_counts is None
+            else None
+        )
     return SimProgram(
         tc,
         groups,
@@ -314,11 +337,14 @@ def _timed_ticks(prog, ticks, ledger=None):
     return carry, run_ticks, time.perf_counter() - t0, compile_secs
 
 
-def bench_sustained(n, ticks, transport="xla"):
+def bench_sustained(n, ticks, transport="xla", mesh_shape=""):
     from testground_tpu.sim.perf import PerfLedger
 
     plan, case, params, chunk = _bench_shape("sustained", n, ticks)
-    prog = _build(plan, case, n, params, chunk, transport=transport)
+    prog = _build(
+        plan, case, n, params, chunk, transport=transport,
+        mesh_shape=mesh_shape,
+    )
     import jax
 
     # the ledger makes bench emit the exact journal sim.perf schema, so
@@ -371,9 +397,12 @@ def bench_sustained(n, ticks, transport="xla"):
     return n * run_ticks / wall, compile_secs, warm_compile_secs, ledger.summary()
 
 
-def bench_flood(n, ticks, transport="xla"):
+def bench_flood(n, ticks, transport="xla", mesh_shape=""):
     plan, case, params, chunk = _bench_shape("flood", n, ticks)
-    prog = _build(plan, case, n, params, chunk, transport=transport)
+    prog = _build(
+        plan, case, n, params, chunk, transport=transport,
+        mesh_shape=mesh_shape,
+    )
     _, run_ticks, wall, compile_secs = _timed_ticks(prog, ticks)
     print(
         f"# fast path: {run_ticks} ticks in {wall:.2f}s "
@@ -383,9 +412,12 @@ def bench_flood(n, ticks, transport="xla"):
     return n * run_ticks / wall, compile_secs
 
 
-def bench_storm(n, transport="xla"):
+def bench_storm(n, transport="xla", mesh_shape=""):
     plan, case, params, chunk = _bench_shape("storm", n, 0)
-    prog = _build(plan, case, n, params, chunk, transport=transport)
+    prog = _build(
+        plan, case, n, params, chunk, transport=transport,
+        mesh_shape=mesh_shape,
+    )
     carry, run_ticks, wall, compile_secs = _timed_ticks(prog, 4096)
     import numpy as np
 
@@ -398,9 +430,12 @@ def bench_storm(n, transport="xla"):
     return n * run_ticks / wall, ok, compile_secs
 
 
-def bench_pingpong_correctness(n, transport="xla"):
+def bench_pingpong_correctness(n, transport="xla", mesh_shape=""):
     plan, case, params, chunk = _bench_shape("pingpong", n, 0)
-    prog = _build(plan, case, n, params, chunk, transport=transport)
+    prog = _build(
+        plan, case, n, params, chunk, transport=transport,
+        mesh_shape=mesh_shape,
+    )
     import numpy as np
 
     carry, run_ticks, wall, compile_secs = _timed_ticks(prog, 2048)
@@ -426,6 +461,12 @@ def main() -> int:
     p.add_argument(
         "--transport", choices=("xla", "pallas"), default="xla"
     )
+    # explicit mesh rung (sim/meshplan.py): "4" = 4 peer shards, "2x4"
+    # = runs x peers. Applies to every arm — pallas included (the
+    # shard_map commit). Banked rows key the layout, so meshed and
+    # unmeshed rungs never gate each other. Empty = the historical
+    # default (1-D over all devices under xla, single-device pallas).
+    p.add_argument("--mesh", default="")
     # `tg build` for the bench surface: compile every workload program
     # into the persistent cache and exit — a driver runs this once, and
     # the timed bench that follows is warm for EVERY workload (VERDICT
@@ -476,7 +517,8 @@ def main() -> int:
     devs = jax.devices()
     print(
         f"# bench: {n} instances on {jax.default_backend()} "
-        f"({len(devs)} device(s))",
+        f"({len(devs)} device(s))"
+        + (f", mesh {args.mesh}" if args.mesh else ""),
         file=sys.stderr,
     )
 
@@ -490,7 +532,9 @@ def main() -> int:
         if unknown:
             print(f"unknown workloads: {sorted(unknown)}", file=sys.stderr)
             return 2
-        walls = build_bench_programs(n, ticks, args.transport, only=only)
+        walls = build_bench_programs(
+            n, ticks, args.transport, only=only, mesh_shape=args.mesh
+        )
         if args.buckets:
             walls.update(
                 build_bucket_programs(
@@ -504,11 +548,12 @@ def main() -> int:
         return 2
 
     full, full_compile, warm_compile, perf_block = bench_sustained(
-        n, ticks, args.transport
+        n, ticks, args.transport, mesh_shape=args.mesh
     )
     result = {
         "metric": "sim_peer_ticks_per_sec",
         "transport": args.transport,
+        **({"mesh": args.mesh} if args.mesh else {}),
         "value": round(full, 1),
         "unit": "peer*ticks/s (full-path pingpong-sustained @ %dk peers)"
         % (n // 1000),
@@ -572,9 +617,11 @@ def main() -> int:
         )
 
     if not args.skip_secondary:
-        flood, flood_compile = bench_flood(n, ticks, args.transport)
+        flood, flood_compile = bench_flood(
+            n, ticks, args.transport, mesh_shape=args.mesh
+        )
         pp_ok, pp_wall, pp_compile = bench_pingpong_correctness(
-            n, args.transport
+            n, args.transport, mesh_shape=args.mesh
         )
         result["secondary"] = {
             "flood_peer_ticks_per_sec": round(flood, 1),
@@ -590,7 +637,9 @@ def main() -> int:
             "pingpong_100ms_compile_secs": round(pp_compile, 2),
         }
         if "storm" in _workloads_for(args.transport, n):
-            storm, storm_ok, storm_compile = bench_storm(n, args.transport)
+            storm, storm_ok, storm_compile = bench_storm(
+                n, args.transport, mesh_shape=args.mesh
+            )
             result["secondary"].update(
                 storm_peer_ticks_per_sec=round(storm, 1),
                 storm_ok=storm_ok,
@@ -624,6 +673,7 @@ def main() -> int:
                 "instances": n,
                 "ticks": ticks,
                 "transport": args.transport,
+                **({"mesh": args.mesh} if args.mesh else {}),
                 "metric": result["metric"],
                 "value": result["value"],
                 "compile_secs": result["compile_secs"],
@@ -640,6 +690,7 @@ def main() -> int:
                     "instances": n,
                     "ticks": ticks,
                     "transport": args.transport,
+                    **({"mesh": args.mesh} if args.mesh else {}),
                     "metric": "sim_peer_ticks_per_sec",
                     "value": sec["flood_peer_ticks_per_sec"],
                     "compile_secs": sec.get("flood_compile_secs"),

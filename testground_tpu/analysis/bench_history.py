@@ -5,8 +5,8 @@ an append-only ``BENCH_HISTORY.jsonl`` (committed at the repo root, the
 machine-readable successor to the hand-curated BENCH_r*.json prose
 trajectory — ROADMAP item 5's "banked verdicts"). Rows group by
 :func:`history_key` — (workload, rung, backend, device kind,
-transport) — so numbers from different machines or scales never gate
-each other.
+transport, mesh layout) — so numbers from different machines, scales,
+or shardings never gate each other.
 
 ``tools/bench_regression.py`` turns the bank into a CI gate via
 :func:`sentinel_report`: the newest row per key against the median of
@@ -83,7 +83,10 @@ def env_fingerprint() -> dict:
 def history_key(row: dict) -> tuple:
     """The comparison group a banked row belongs to. Rows only gate
     rows measured at the same workload + rung on the same kind of
-    hardware and transport — a TPU number never judges a CPU number."""
+    hardware, transport, and mesh layout — a TPU number never judges a
+    CPU number, and a 4-shard rung never judges an unmeshed one (a
+    sharded program is a different machine, not noise). Pre-mesh rows
+    carry no ``mesh`` field and default to the unmeshed group."""
     fp = row.get("fingerprint") if isinstance(row.get("fingerprint"), dict) else {}
     return (
         str(row.get("workload") or ""),
@@ -91,6 +94,7 @@ def history_key(row: dict) -> tuple:
         str(fp.get("backend") or ""),
         str(fp.get("device_kind") or ""),
         str(row.get("transport") or ""),
+        str(row.get("mesh") or ""),
     )
 
 
@@ -168,6 +172,7 @@ def sentinel_report(
             "backend": key[2],
             "device_kind": key[3],
             "transport": key[4],
+            "mesh": key[5],
             "value": value,
             "samples": len(series),
             "ts": newest.get("ts"),

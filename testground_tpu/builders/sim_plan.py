@@ -139,6 +139,7 @@ class SimPlanBuilder(Builder, Precompiler):
             trace_specs_of,
         )
         from testground_tpu.sim.faults import build_fault_schedule
+        from testground_tpu.sim.meshplan import layout_str as _layout_str
         from testground_tpu.sim.trace import build_trace_plan
 
         artifacts = {g.id: g.run.artifact for g in comp.groups}
@@ -172,23 +173,29 @@ class SimPlanBuilder(Builder, Precompiler):
         # collapse to the matrix-OFF variant here
         netmatrix = telemetry and bool(getattr(cfg, "netmatrix", False))
         # transport gate mirrors the executor (resolve_transport is the
-        # shared gate): a mesh forces xla, so the build must precompile
-        # the variant the run will actually trace. A cohort resolves
-        # against the GLOBAL mesh at run time (always multi-device), so
-        # coordinator_address forces xla here too — like the telemetry
-        # gate above, or the build warms a program the run never traces.
-        # transport=auto needs each run's SPECIALIZED shapes to score,
-        # so single-device auto resolves per run inside the loop below
-        # (same cost model, same decision cache — the executor then
-        # reuses the cached decision verbatim).
+        # shared gate): the mesh layout shapes the decision (divisible
+        # layouts score the sharded arms, indivisible ones resolve to
+        # xla), so the build must precompile the variant the run will
+        # actually trace. A cohort resolves against the GLOBAL mesh at
+        # run time (always multi-device), so coordinator_address forces
+        # xla here — like the telemetry gate above, or the build warms
+        # a program the run never traces. transport=auto needs each
+        # run's SPECIALIZED shapes to score, so auto resolves per run
+        # inside the loop below against the build mesh (same cost
+        # model, same decision cache — the executor then reuses the
+        # cached decision verbatim).
+        build_mesh = (
+            None
+            if getattr(cfg, "coordinator_address", "")
+            else _make_mesh(cfg.shard, getattr(cfg, "mesh", ""))
+        )
         transport_auto = (
             str(getattr(cfg, "transport", "xla") or "xla").lower() == "auto"
             and not getattr(cfg, "coordinator_address", "")
-            and _make_mesh(cfg.shard) is None
         )
         transport = None
         if not transport_auto:
-            transport = resolve_transport(cfg, _make_mesh(cfg.shard))
+            transport = resolve_transport(cfg, build_mesh)
             if getattr(cfg, "coordinator_address", ""):
                 transport = "xla"
         digests = {
@@ -254,11 +261,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 [
                     rg.calculated_instance_count for rg in run.groups
                 ],
-                mesh=(
-                    None
-                    if getattr(cfg, "coordinator_address", "")
-                    else _make_mesh(cfg.shard)
-                ),
+                mesh=build_mesh,
                 warn=ow.warn,
             )
             from testground_tpu.api import RunGroup
@@ -352,7 +355,7 @@ class SimPlanBuilder(Builder, Precompiler):
                 loaded = (testcase, groups)
                 run_transport = resolve_transport(
                     cfg,
-                    None,
+                    build_mesh,
                     warn=ow.warn,
                     context=TransportContext(
                         testcase=testcase,
@@ -405,6 +408,14 @@ class SimPlanBuilder(Builder, Precompiler):
                 # keyed only when the matrix plane is on — same
                 # backward-compatible idiom as the bucket key
                 **({"netmatrix": True} if netmatrix else {}),
+                # the mesh layout shapes the program (sharding
+                # constraints + the shard_map transport variant) —
+                # keyed only when meshed, same idiom as the bucket key
+                **(
+                    {"mesh": _layout_str(build_mesh)}
+                    if build_mesh is not None
+                    else {}
+                ),
             }
             key = hashlib.sha256(
                 json.dumps(spec, sort_keys=True).encode()
@@ -471,7 +482,7 @@ class SimPlanBuilder(Builder, Precompiler):
                     bucket_plan.index_map(),
                     bucket_plan.padded_n,
                 )
-            mesh = _make_mesh(cfg.shard)
+            mesh = build_mesh
             prog = make_sim_program(
                 testcase,
                 groups,
@@ -595,14 +606,14 @@ class SimPlanBuilder(Builder, Precompiler):
         if getattr(cfg, "coordinator_address", ""):
             ow.warn("bucket-ladder warming skipped under a cohort config")
             return
-        mesh = _make_mesh(cfg.shard)
-        if mesh is not None:
-            ow.warn(
-                "bucket-ladder warming skipped on a %d-device mesh "
-                "(shape bucketing is single-device for now)",
-                int(mesh.devices.size),
-            )
-            return
+        # a mesh narrows the ladder instead of refusing it: only rungs
+        # whose padded count divides across the peer shards compile the
+        # sharded program (sim/meshplan.py) — indivisible rungs are
+        # skipped loudly per rung inside the loop below
+        mesh = _make_mesh(cfg.shard, getattr(cfg, "mesh", ""))
+        from testground_tpu.sim.meshplan import peer_shards
+
+        shards = peer_shards(mesh)
         # transport=auto scores PER RUNG (the decision is shape-
         # dependent: a 4k bucket and a 1M bucket may pick different
         # backends) — resolved inside the loop with each rung's
@@ -624,6 +635,15 @@ class SimPlanBuilder(Builder, Precompiler):
                 return
             if any(c > rung for c in counts):
                 continue  # this rung cannot hold the composition
+            if shards > 1 and rung % shards != 0:
+                ow.warn(
+                    "bucket %d skipped: it does not divide across %d "
+                    "peer shard(s) — pick ladder rungs that are "
+                    "multiples of the shard count to warm them meshed",
+                    rung,
+                    shards,
+                )
+                continue
             t0 = _time.perf_counter()
             try:
                 testcase, groups = load_and_specialize(
@@ -646,7 +666,7 @@ class SimPlanBuilder(Builder, Precompiler):
 
                     rung_transport = resolve_transport(
                         cfg,
-                        None,
+                        mesh,
                         warn=ow.warn,
                         context=TransportContext(
                             testcase=testcase,
@@ -677,7 +697,7 @@ class SimPlanBuilder(Builder, Precompiler):
                     test_case=comp.global_.case,
                     test_run="build",
                     tick_ms=cfg.tick_ms,
-                    mesh=None,
+                    mesh=mesh,
                     chunk=cfg.chunk,
                     hosts=hosts,
                     validate=bool(getattr(cfg, "validate", False)),
@@ -691,7 +711,7 @@ class SimPlanBuilder(Builder, Precompiler):
                     netmatrix=telemetry
                     and bool(getattr(cfg, "netmatrix", False)),
                 )
-                _precheck_device_memory(prog, cfg, None, ow)
+                _precheck_device_memory(prog, cfg, mesh, ow)
                 carry = jax.jit(
                     lambda s, lc: prog.init_carry(s, lc)  # noqa: B023
                 )(

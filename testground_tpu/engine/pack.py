@@ -256,6 +256,10 @@ def _signature_or_reason(
         "telemetry": _truthy(cfg.get("telemetry")),
         "validate": _truthy(cfg.get("validate")),
         "pack_max": int(cfg.get("pack_max") or 8),
+        # the mesh layout shapes the packed program (the stacked carry
+        # shards over it — sim/meshplan.py), so meshed and unmeshed
+        # members never share a pack
+        "mesh": str(cfg.get("mesh") or ""),
     }
     return (
         hashlib.sha256(

@@ -783,13 +783,18 @@ def render_prometheus(
             if isinstance(sim.get("transport"), dict)
             else {}
         )
+        # the mesh plane (journal["sim"]["mesh"], docs/OBSERVABILITY.md
+        # "Mesh plane"): layout labels are bounded by real hardware
+        # topologies ("1", "4", "2x4", ...), never free-form
+        mh = sim.get("mesh") if isinstance(sim.get("mesh"), dict) else {}
         if tr.get("resolved"):
             exp.add(
                 "tg_transport_resolved",
                 "gauge",
                 "Transport gate resolution for this run (info gauge, "
-                "value always 1): requested knob, resolved backend, and "
-                "the cost model's evidence source under transport=auto.",
+                "value always 1): requested knob, resolved backend, the "
+                "cost model's evidence source under transport=auto, and "
+                "the mesh layout the decision was scored against.",
                 {
                     **ident,
                     "requested": str(tr.get("requested", "?")),
@@ -797,8 +802,26 @@ def render_prometheus(
                     "source": str(
                         (tr.get("scores") or {}).get("source", "explicit")
                     ),
+                    "mesh": str(mh.get("axes") or "1"),
                 },
                 1,
+            )
+        if mh:
+            exp.add(
+                "tg_mesh_shards",
+                "gauge",
+                "Peer shards the run's carry planes partitioned across "
+                "(the mesh's instance axis; absent on a single device).",
+                {**ident, "mesh": str(mh.get("axes") or "?")},
+                mh.get("shards"),
+            )
+            exp.add(
+                "tg_mesh_cross_shard_bytes_est",
+                "gauge",
+                "Modeled per-commit ICI exchange bytes of the sharded "
+                "transport (the sorted stream's cross-shard fraction).",
+                {**ident, "mesh": str(mh.get("axes") or "?")},
+                mh.get("cross_shard_bytes_est"),
             )
         # phase attribution plane (journal["sim"]["phases"],
         # docs/OBSERVABILITY.md "Phase attribution"): per-phase cost

@@ -110,6 +110,26 @@ def render_telemetry_summary(stats: dict) -> str:
             rows.append(
                 ("carry", f"{carry / 2**20:.2f} MiB device-resident")
             )
+        # the mesh plane (journal["sim"]["mesh"]): layout + shard
+        # extents + the modeled ICI exchange the transport decision
+        # priced — one line, the full rule table stays in the journal
+        mh = sim.get("mesh") or {}
+        if mh.get("axes"):
+            xb = _num(mh.get("cross_shard_bytes_est"))
+            rows.append(
+                (
+                    "mesh",
+                    "{a} ({s} peer shard(s) x {r} run shard(s), "
+                    "~{x} ICI exchange/commit)".format(
+                        a=mh.get("axes"),
+                        s=_fmt_count(mh.get("shards")),
+                        r=_fmt_count(mh.get("runs"), "1"),
+                        x=f"{xb / 2**10:.1f} KiB"
+                        if xb is not None
+                        else "?",
+                    ),
+                )
+            )
         # transport resolution (journal["sim"]["transport"]): requested
         # vs resolved plus the cost model's reason — e.g. "auto → pallas
         # (commit+deliver bytes 2.1x the single-pass kernel estimate)"
@@ -464,6 +484,17 @@ def render_perf_summary(payload: dict) -> str:
                 f"{_fmt(sim.get('compile_secs'))}s first dispatch{split}",
             )
         )
+        # the mesh the ledger's rates were measured on — a 4-shard run
+        # and a single-device run are different machines, not noise
+        mh = sim.get("mesh") or {}
+        if mh.get("axes"):
+            rows.append(
+                (
+                    "mesh",
+                    f"{mh.get('axes')} "
+                    f"({_fmt_count(mh.get('shards'))} peer shard(s))",
+                )
+            )
         # transport resolution — the backend this ledger measured, and
         # why the gate picked it (the cost model's reason under auto)
         tr = sim.get("transport") or {}

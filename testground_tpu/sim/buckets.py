@@ -60,6 +60,10 @@ def parse_ladder(raw) -> tuple[int, ...]:
         return DEFAULT_LADDER
     if isinstance(raw, str):
         parts = [p for p in (s.strip() for s in raw.split(",")) if p]
+    elif isinstance(raw, int):
+        # `--run-cfg bucket_ladder=32` (a single rung) coalesces as a
+        # bare int, not a "32" string
+        parts = [raw]
     else:
         parts = list(raw)
     try:

@@ -43,9 +43,10 @@ Three layers share the engine:
    hazards).
 
 Import-light on purpose for layer 1 (stdlib + numpy + the sibling gate
-modules): without ``--trace-plans`` the only jax touch is device
-detection for the mesh-bound rules — and an explicit ``devices=N``
-skips even that.
+modules): without ``--trace-plans`` the only jax touches are device
+detection for the mesh-bound rules (skipped by an explicit
+``devices=N``) and the divisibility arithmetic ``sim/meshplan.py``
+hosts, paid only when a multi-device mesh is actually in play.
 """
 
 from __future__ import annotations
@@ -104,10 +105,18 @@ RULES: tuple[Rule, ...] = (
         "transport knob is not xla|pallas|auto",
     ),
     Rule(
-        "transport.mesh-fallback",
+        "transport.mesh-indivisible",
         "warn",
         "transport",
-        "pallas/auto on a multi-device mesh falls back to xla",
+        "pallas/auto lanes do not divide across the mesh peer shards; "
+        "resolves to xla",
+    ),
+    # ---- mesh layout
+    Rule(
+        "mesh.shape-invalid",
+        "error",
+        "mesh",
+        "mesh knob is not N or AxB (e.g. '4' or '2x4')",
     ),
     # ---- shape buckets
     Rule(
@@ -129,10 +138,11 @@ RULES: tuple[Rule, ...] = (
         "bucketing disabled under a cohort config",
     ),
     Rule(
-        "buckets.mesh-disabled",
+        "buckets.mesh-indivisible",
         "warn",
         "buckets",
-        "bucketing disabled on a multi-device mesh",
+        "a padded rung does not divide across the mesh peer shards; "
+        "runs exact shapes",
     ),
     Rule(
         "buckets.over-ladder",
@@ -398,28 +408,78 @@ class CheckContext:
         return bool(getattr(self.cfg, "coordinator_address", ""))
 
     @property
+    def mesh_layout(self) -> str:
+        """The explicit ``mesh`` knob (``mesh="2x4"``); empty when
+        unset, under a cohort (the cohort builds the global mesh), or
+        malformed (``mesh.shape-invalid`` reports that refusal)."""
+        if self.cohort:
+            return ""
+        layout = str(getattr(self.cfg, "mesh", "") or "")
+        return layout if _parse_layout(layout) is not None else ""
+
+    @property
     def mesh_devices(self) -> int:
-        """Devices the executor's ``_make_mesh`` would mesh over: > 1
-        only when sharding is on and this is not a cohort config (a
-        cohort builds the global mesh instead — which is always
-        multi-device, so cohort gates subsume the mesh gates there)."""
+        """Devices the executor's ``_make_mesh`` would mesh over: the
+        explicit layout's extent product when the ``mesh`` knob is set,
+        else > 1 only when sharding is on and this is not a cohort
+        config (a cohort builds the global mesh instead — which is
+        always multi-device, so cohort gates subsume the mesh gates
+        there)."""
+        dims = _parse_layout(self.mesh_layout) if self.mesh_layout else None
+        if dims is not None:
+            n = 1
+            for d in dims:
+                n *= int(d)
+            return n
         if not getattr(self.cfg, "shard", True) or self.cohort:
             return 1
         return max(int(self.devices), 1)
 
+    @property
+    def peer_shards(self) -> int:
+        """Extent of the instance (``i``) axis the divisibility gates
+        divide by — the LAST layout extent (a 2-D mesh spends its
+        leading extent on the pack run axis), the device count for the
+        implicit 1-D ``shard=true`` mesh."""
+        dims = _parse_layout(self.mesh_layout) if self.mesh_layout else None
+        if dims is not None:
+            return int(dims[-1])
+        return self.mesh_devices
+
+
+def _parse_layout(text: str) -> tuple[int, ...] | None:
+    """``meshplan.parse_mesh_shape``'s grammar without the jax import
+    (the config layer stays import-light); returns None instead of
+    raising — the ``mesh.shape-invalid`` pass reports the refusal with
+    the real function's message."""
+    parts = str(text).lower().replace("×", "x").split("x")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        return None
+    if not (1 <= len(dims) <= 2) or any(d < 1 for d in dims):
+        return None
+    return dims
+
 
 class _FakeMesh:
-    """Duck-typed stand-in for a ``jax.sharding.Mesh`` where the gates
-    only read ``mesh.devices.size`` — lets the config layer evaluate
-    mesh rules without importing jax."""
+    """Duck-typed stand-in for a ``jax.sharding.Mesh``: the gates read
+    ``mesh.devices.size`` and — when an explicit layout is known — the
+    ``shape`` Mapping (``meshplan.peer_shards``/``layout_str`` fall
+    back duck-type safely when it is absent), letting the config layer
+    evaluate mesh rules without importing jax."""
 
-    def __init__(self, n: int):
+    def __init__(self, n: int, layout: str = ""):
         self.devices = types.SimpleNamespace(size=int(n))
+        dims = _parse_layout(layout) if layout else None
+        if dims is not None:
+            names = ("i",) if len(dims) == 1 else ("runs", "i")
+            self.shape = dict(zip(names, (int(d) for d in dims)))
 
 
 def _mesh_of(ctx: CheckContext):
     n = ctx.mesh_devices
-    return _FakeMesh(n) if n > 1 else None
+    return _FakeMesh(n, ctx.mesh_layout) if n > 1 else None
 
 
 def _group_layout(run_groups):
@@ -505,8 +565,32 @@ def _check_run_cfg_keys(ctx, findings) -> None:
             )
 
 
+def _check_mesh(ctx, findings) -> None:
+    """An explicit ``mesh`` knob that fails the layout grammar — the
+    executor's ``parse_mesh_shape`` refusal, reported statically. The
+    meshplan import (and its jax dependency) is paid only on the
+    failing path; the happy path parses locally."""
+    layout = str(getattr(ctx.cfg, "mesh", "") or "")
+    if not layout or _parse_layout(layout) is not None:
+        return
+    from .meshplan import parse_mesh_shape
+
+    try:
+        parse_mesh_shape(layout)
+    except ValueError as e:
+        _add(findings, "mesh.shape-invalid", str(e))
+
+
 def _check_transport(ctx, findings) -> None:
-    from .transport_model import TRANSPORTS, decide_transport
+    """The transport knob's static gates. A multi-device mesh no longer
+    falls anything back wholesale (ISSUE 20): only an INDIVISIBLE lane
+    count does, per run — the same arithmetic ``decide_transport``
+    applies, with the same message (``mesh_lanes_message``)."""
+    from .transport_model import (
+        TRANSPORTS,
+        decide_transport,
+        mesh_lanes_message,
+    )
 
     requested = str(getattr(ctx.cfg, "transport", "xla") or "xla").lower()
     if requested not in TRANSPORTS:
@@ -515,11 +599,23 @@ def _check_transport(ctx, findings) -> None:
         except ValueError as e:
             _add(findings, "transport.unknown", str(e))
         return
-    if requested != "xla" and ctx.mesh_devices > 1:
-        warns = _WarnCollector()
-        decide_transport(ctx.cfg, _FakeMesh(ctx.mesh_devices), warn=warns)
-        for line in warns.lines:
-            _add(findings, "transport.mesh-fallback", line)
+    shards = ctx.peer_shards
+    if requested == "xla" or shards <= 1:
+        return
+    from .executor import _parse_hosts
+
+    hosts = _parse_hosts(getattr(ctx.cfg, "additional_hosts", None))
+    for run in ctx.comp.runs:
+        n_lanes = sum(
+            int(rg.calculated_instance_count) for rg in run.groups
+        ) + len(hosts)
+        if n_lanes % shards != 0:
+            _add(
+                findings,
+                "transport.mesh-indivisible",
+                mesh_lanes_message(requested, n_lanes, shards),
+                run=run.id,
+            )
 
 
 def _check_buckets(ctx, run, findings):
@@ -544,8 +640,8 @@ def _check_buckets(ctx, run, findings):
     for line in warns.lines:
         if "cohort" in line:
             rule = "buckets.cohort-disabled"
-        elif "single device" in line:
-            rule = "buckets.mesh-disabled"
+        elif "divide" in line:
+            rule = "buckets.mesh-indivisible"
         else:
             rule = "buckets.over-ladder"
         _add(findings, rule, line, run=run.id)
@@ -1157,6 +1253,7 @@ def check_composition(
     ctx.raw_env_layer = dict(env_layer or {})
 
     _check_run_cfg_keys(ctx, findings)
+    _check_mesh(ctx, findings)
     _check_transport(ctx, findings)
     _check_pack(ctx, findings)
     _check_resume_multi_runs(ctx, findings)

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import meshplan as _meshplan
 from .api import (
     CRASH,
     RUNNING,
@@ -263,6 +264,55 @@ def build_groups(run_groups, parameters_of=None) -> tuple[GroupSpec, ...]:
     return tuple(specs)
 
 
+def constrain_carry(carry: "SimCarry", plan, lead: str | None = None):
+    """Apply the ONE placement rule table (sim/meshplan.py) to every
+    constrained carry plane. ``lead`` names the mesh axis a STACKED
+    carry's leading run dimension maps to (the pack lift, sim/pack.py
+    passes ``"runs"``) — same table, one decision for solo and packed
+    carries alike. Rank-clamped per leaf (``ndim=``) so a pack's FLAT
+    calendar planes keep only the constraints that align."""
+    if plan is None:
+        return carry
+    wsc = jax.lax.with_sharding_constraint
+
+    def sh(x, path):
+        return wsc(x, plan.sharding_for(path, lead=lead, ndim=x.ndim))
+
+    return dataclasses.replace(
+        carry,
+        status=sh(carry.status, "status"),
+        finished_at=sh(carry.finished_at, "finished_at"),
+        cal=dataclasses.replace(
+            carry.cal,  # statics (slots/flat/horizon) survive
+            payload=tuple(
+                sh(p, f"cal.payload.{i}")
+                for i, p in enumerate(carry.cal.payload)
+            ),
+            src=sh(carry.cal.src, "cal.src")
+            if carry.cal.src is not None
+            else None,
+            valid=sh(carry.cal.valid, "cal.valid")
+            if carry.cal.valid is not None
+            else None,
+            etick=sh(carry.cal.etick, "cal.etick")
+            if carry.cal.etick is not None
+            else None,
+        ),
+        link=LinkState(
+            egress=sh(carry.link.egress, "link.egress"),
+            filters=sh(carry.link.filters, "link.filters"),
+            region_of=sh(carry.link.region_of, "link.region_of"),
+            backlog=sh(carry.link.backlog, "link.backlog")
+            if carry.link.backlog is not None
+            else None,
+            rules=sh(carry.link.rules, "link.rules")
+            if carry.link.rules is not None
+            else None,
+        ),
+        rejected=sh(carry.rejected, "rejected"),
+    )
+
+
 class SimProgram:
     def __init__(
         self,
@@ -353,14 +403,23 @@ class SimProgram:
                 f"unknown transport {transport!r}: expected 'xla' or "
                 "'pallas'"
             )
-        if transport == "pallas" and mesh is not None:
-            raise ValueError(
-                "transport=pallas supports single-device programs only: "
-                "the cross-shard calendar scatter IS the inter-chip "
-                "traffic on a mesh, and the single-device kernel cannot "
-                "express it — drop the mesh (shard=false) or use "
-                "transport=xla"
-            )
+        # Mesh placement rides the ONE rule table (sim/meshplan.py):
+        # every constrained carry plane resolves its PartitionSpec
+        # there, and the sharded Pallas commit/deliver kernels
+        # (shard_map over per-chip lane ranges) require the lane axis
+        # to divide across the peer shards.
+        self.meshplan = _meshplan.plan_for(mesh)
+        if transport == "pallas" and self.meshplan is not None:
+            shards = self.meshplan.shards
+            if self.n_lanes % shards != 0:
+                raise ValueError(
+                    f"transport=pallas on a mesh needs the lane count to "
+                    f"divide across the peer shards: {self.n_lanes} "
+                    f"lane(s) ({self.n} instances + {len(self.hosts)} "
+                    f"host(s)) do not divide by {shards} — pad the "
+                    "instance counts (shape bucketing does this), drop "
+                    "the hosts, or use transport=xla"
+                )
         self.transport = transport
         # Per-tick counter block (telemetry plane): when enabled, every
         # tick emits one K-vector through the scan's ys output and the
@@ -560,52 +619,17 @@ class SimProgram:
 
     # ------------------------------------------------------------ sharding
 
-    def _ishard(self, axis: int = 0):
-        """NamedSharding placing the instance axis on mesh axis 'i'."""
-        if self.mesh is None:
+    def _pshard(self, path: str):
+        """NamedSharding for a logical carry plane, resolved through
+        the ONE placement rule table (sim/meshplan.py)."""
+        if self.meshplan is None:
             return None
-        spec = [None] * (axis + 1)
-        spec[axis] = "i"
-        return jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec(*spec)
-        )
+        return self.meshplan.sharding_for(path)
 
     def _constrain(self, carry: SimCarry) -> SimCarry:
         if self.mesh is None:
             return carry
-        wsc = jax.lax.with_sharding_constraint
-        return dataclasses.replace(
-            carry,
-            status=wsc(carry.status, self._ishard(0)),
-            finished_at=wsc(carry.finished_at, self._ishard(0)),
-            cal=dataclasses.replace(
-                carry.cal,  # statics (slots/flat/horizon) survive
-                payload=tuple(
-                    wsc(p, self._ishard(1)) for p in carry.cal.payload
-                ),
-                src=wsc(carry.cal.src, self._ishard(1))
-                if carry.cal.src is not None
-                else None,
-                valid=wsc(carry.cal.valid, self._ishard(1))
-                if carry.cal.valid is not None
-                else None,
-                etick=wsc(carry.cal.etick, self._ishard(1))
-                if carry.cal.etick is not None
-                else None,
-            ),
-            link=LinkState(
-                egress=wsc(carry.link.egress, self._ishard(1)),
-                filters=wsc(carry.link.filters, self._ishard(1)),
-                region_of=wsc(carry.link.region_of, self._ishard(0)),
-                backlog=wsc(carry.link.backlog, self._ishard(0))
-                if carry.link.backlog is not None
-                else None,
-                rules=wsc(carry.link.rules, self._ishard(2))
-                if carry.link.rules is not None
-                else None,
-            ),
-            rejected=wsc(carry.rejected, self._ishard(0)),
-        )
+        return constrain_carry(carry, self.meshplan)
 
     # ------------------------------------------------------------ buckets
 
@@ -1406,6 +1430,7 @@ class SimProgram:
             want_flow=self.netmatrix,
             transport=self.transport,
             dice_idx=midx,
+            mesh=self.mesh,
         )
         nm_send = None
         if self.netmatrix:
@@ -1506,7 +1531,9 @@ class SimProgram:
 
         virt = self._virt(carry.live_counts)
         with jax.named_scope("tg.deliver"):
-            cal, inbox_all = deliver(carry.cal, t, transport=self.transport)
+            cal, inbox_all = deliver(
+                carry.cal, t, transport=self.transport, mesh=self.mesh
+            )
         nm_del = None
         if self.netmatrix:
             # receiver-side matrix capture on the PHYSICAL inbox (before

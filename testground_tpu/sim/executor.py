@@ -49,6 +49,12 @@ class SimJaxConfig:
     chunk: int = 128  # ticks per device dispatch
     seed: int = 0
     shard: bool = True  # shard instance axis over available devices
+    # explicit mesh layout (sim/meshplan.py): "" = the shard default
+    # (all visible devices on a 1-D peers mesh), "4" = 4 peer shards,
+    # "2x4" = 2 run shards × 4 peer shards (the run axis feeds packs).
+    # The layout keys the transport decision cache, the precompile
+    # BuildKey, and bench bank rows. CLI: --run-cfg mesh=4
+    mesh: str = ""
     write_outputs_max: int = 2048  # cap on per-instance output dirs
     keep_outputs: bool = True
     # metric time-series sampling cadence in ticks (0 disables) — the analog
@@ -139,12 +145,16 @@ class SimJaxConfig:
     # "auto" — the measured cost model (sim/transport_model.py) scores
     # the two per workload shape (banked chip verdicts > opt-in
     # measured probe > static phase-ledger bytes) and journals the
-    # decision under sim.transport. Single-device only: under a mesh
-    # every value resolves to xla with a warning (the cross-shard
-    # scatter is the inter-chip traffic). The RESOLVED value is a
-    # program-shaping option like telemetry: broadcast to cohort
-    # followers and keyed into the precompile BuildKey. CLI:
-    # --run-cfg transport=auto
+    # decision under sim.transport. Mesh-aware: on a mesh whose peer
+    # shards divide the lane count, "pallas" shard_maps the segmented
+    # kernels over per-chip plane shards (cross-shard messages routed
+    # via an exchange stage before commit) and "auto" scores the mesh
+    # arms from the same cost model — per-shard bytes plus modeled ICI
+    # exchange traffic (sim/meshplan.py); an indivisible layout
+    # resolves to xla with a loud, rule-cataloged warning. The
+    # RESOLVED value is a program-shaping option like telemetry:
+    # broadcast to cohort followers and keyed into the precompile
+    # BuildKey. CLI: --run-cfg transport=auto
     transport: str = "xla"
     # opt-in measured calibration for transport=auto: > 0 times both
     # candidate backends' transport phases (deliver + net_commit)
@@ -161,8 +171,10 @@ class SimJaxConfig:
     # n). Any composition in the same bucket then compiles — and the
     # persistent cache serves — ONE program, so `tg build --buckets`
     # makes the cache warm-for-anyone. Results/telemetry stay exact-N,
-    # pinned bit-equal to an unpadded run. Single-device, trace-free,
-    # cohort-free. CLI: --run-cfg bucket=auto
+    # pinned bit-equal to an unpadded run. Mesh-compatible when every
+    # rung's padded count divides the peer shard count (the gate
+    # refuses indivisible layouts loudly); trace-free, cohort-free.
+    # CLI: --run-cfg bucket=auto
     bucket: str = "off"
     # the canonical instance-count ladder, comma-separated (default
     # sim/buckets.DEFAULT_LADDER: 4096,32768,131072,1048576); a group
@@ -336,7 +348,7 @@ def make_sim_program(
 
 def resolve_transport(cfg, mesh, warn=None, context=None) -> str:
     """The ONE transport-gate: validate the runner-config knob, apply
-    the single-device bound, and resolve ``transport=auto`` through the
+    the mesh divisibility bound, and resolve ``transport=auto`` through the
     measured cost model (``sim/transport_model.py``). Shared by the
     executor, the sim-worker followers, the pack path, and the
     sim:plan precompile so all four resolve the same program variant
@@ -401,17 +413,29 @@ def resolve_buckets(cfg, counts, mesh=None, warn=None):
                 "cannot reproduce symmetrically)"
             )
         return None
-    if mesh is not None:
-        if warn is not None:
-            warn(
-                "shape bucketing supports a single device only for now "
-                "(the padded instance axis would reshard per bucket) — "
-                "running exact shapes on this %d-device mesh",
-                int(mesh.devices.size),
-            )
-        return None
     ladder = parse_ladder(getattr(cfg, "bucket_ladder", "") or None)
     plan = plan_buckets(counts, mode, ladder)
+    if plan is not None and mesh is not None:
+        # mesh gate (sim/meshplan.py): a bucketed run shards the PADDED
+        # instance axis, so every rung's padded count must divide across
+        # the peer shards — equal contiguous per-chip blocks, no
+        # resharding between rungs. Indivisible → exact shapes, loudly
+        # (`tg check` catalogs this as buckets.mesh-indivisible).
+        from .meshplan import indivisible_counts, peer_shards
+
+        shards = peer_shards(mesh)
+        bad = indivisible_counts(plan.padded_counts, shards)
+        if bad:
+            if warn is not None:
+                warn(
+                    "shape bucketing skipped on this mesh: padded "
+                    "count(s) %s do not divide across %d peer shard(s) "
+                    "— running exact shapes; pick a bucket ladder whose "
+                    "rungs are multiples of the shard count",
+                    ",".join(str(c) for c in bad),
+                    shards,
+                )
+            return None
     if plan is None:
         if warn is not None:
             warn(
@@ -527,13 +551,53 @@ def _parse_hosts(raw) -> tuple[str, ...]:
     return tuple(s for s in (str(h).strip() for h in raw) if s)
 
 
-def _make_mesh(shard: bool):
-    import jax
+def _make_mesh(shard: bool, shape: str = ""):
+    """The executor's mesh gate (sim/meshplan.py): an explicit
+    ``mesh="4"``/``"2x4"`` layout wins over the boolean ``shard``
+    default (all visible devices, 1-D). Either way a single-device
+    world returns None — the flat-layout fast path."""
+    from .meshplan import make_mesh
 
-    devs = jax.devices()
-    if not shard or len(devs) <= 1:
+    if shape:
+        return make_mesh(shape)
+    if not shard:
         return None
-    return jax.sharding.Mesh(np.asarray(devs), ("i",))
+    return make_mesh(None)
+
+
+def _mesh_journal_block(mesh, testcase, groups, hosts):
+    """The ``sim.mesh`` journal block (sim/meshplan.py,
+    docs/OBSERVABILITY.md "Mesh plane"): the layout string, the shard
+    extents, the rule table that placed every carry plane, and the
+    modeled per-commit ICI exchange bytes (what the sharded pallas
+    commit's stream all-gather moves). None on a single device. The
+    `tg stats` mesh line and the tg_mesh_shards gauge read this."""
+    if mesh is None:
+        return None
+    import types as _types
+
+    from .meshplan import cross_shard_bytes_est, layout_str, plan_for
+    from .transport_model import _stream_bytes_per_tick
+
+    plan = plan_for(mesh)
+    return {
+        "axes": layout_str(mesh),
+        "shards": plan.shards,
+        "runs": plan.runs,
+        "layout_table": plan.layout_table(),
+        "cross_shard_bytes_est": int(
+            cross_shard_bytes_est(
+                stream_bytes=_stream_bytes_per_tick(
+                    _types.SimpleNamespace(
+                        testcase=testcase,
+                        groups=tuple(groups),
+                        hosts=tuple(hosts),
+                    )
+                ),
+                shards=plan.shards,
+            )
+        ),
+    }
 
 
 # headroom multiplier over the exact carry footprint: donation double-
@@ -793,7 +857,7 @@ def _execute_sim_run(
         mesh=(
             None
             if getattr(cfg, "coordinator_address", "")
-            else _make_mesh(cfg.shard)
+            else _make_mesh(cfg.shard, getattr(cfg, "mesh", ""))
         ),
         warn=ow.warn,
     )
@@ -1046,7 +1110,7 @@ def _execute_sim_run(
                 "before any program collective"
             )
     else:
-        mesh = _make_mesh(cfg.shard)
+        mesh = _make_mesh(cfg.shard, getattr(cfg, "mesh", ""))
         transport_decision = _decide_transport_for(
             job, cfg, mesh, testcase, groups, hosts, telemetry_on, ow
         )
@@ -2271,6 +2335,8 @@ def _execute_sim_run(
 
     import jax as _jax
 
+    mesh_block = _mesh_journal_block(mesh, testcase, groups, hosts)
+
     result.journal["sim"] = {
         "ticks": res["ticks"],
         "tick_ms": cfg.tick_ms,
@@ -2329,6 +2395,9 @@ def _execute_sim_run(
         # present when the run was padded to a canonical bucket; all
         # totals above remain exact-N (dead lanes contribute nothing)
         **({"bucket": bucket_block} if bucket_block else {}),
+        # mesh placement plane (sim/meshplan.py, docs/OBSERVABILITY.md
+        # "Mesh plane") — present when the run was sharded
+        **({"mesh": mesh_block} if mesh_block else {}),
     }
     result.update_outcome()
     if cancel.is_set():
@@ -2435,18 +2504,37 @@ def execute_packed_sim_runs(
     )
 
     # ---------------------------------------------------- shared program
-    # a pack is single-device BY CONSTRUCTION (the run axis takes the
-    # vmap; make_sim_program below gets mesh=None), so the bucket gate
-    # must see the same single-device world — otherwise a multi-device
-    # host would silently drop bucketing AFTER the admission signature
-    # promised a shared bucketed program, and members of different
-    # sizes would run the wrong program
+    # The run axis takes the vmap, but the INSTANCE axis may still
+    # shard: the inner program is built unmeshed (make_sim_program
+    # below gets mesh=None — a sharding constraint under the vmap
+    # would pin per-member layouts) and PackRunner places the stacked
+    # carry through the same rule table OUTSIDE the vmap
+    # (sim/meshplan.py). The bucket gate sees the pack's real mesh so
+    # padded counts divide the peer shards; when they do not, the pack
+    # falls back to the unmeshed single-device world rather than
+    # breaking the admission signature's bucketed promise.
+    pack_mesh = (
+        None
+        if getattr(cfg, "coordinator_address", "")
+        else _make_mesh(cfg.shard, getattr(cfg, "mesh", ""))
+    )
     bucket_plan = resolve_buckets(
         cfg,
         [g.instances for g in job0.groups],
-        mesh=None,
+        mesh=pack_mesh,
         warn=ows[0].warn,
     )
+    if bucket_plan is None and pack_mesh is not None:
+        unmeshed_plan = resolve_buckets(
+            cfg, [g.instances for g in job0.groups], mesh=None
+        )
+        if unmeshed_plan is not None:
+            ows[0].warn(
+                "pack runs on a single device: the bucket ladder does "
+                "not divide across the mesh peer shards"
+            )
+            pack_mesh = None
+            bucket_plan = unmeshed_plan
     if bucket_plan is None:
         for j in jobs[1:]:
             if [g.instances for g in j.groups] != [
@@ -2472,14 +2560,30 @@ def execute_packed_sim_runs(
     telemetry_on = bool(getattr(cfg, "telemetry", False)) and not any(
         j.disable_metrics for j in jobs
     )
-    # a pack is single-device by construction, so the gate sees mesh=None;
-    # auto resolves ONCE for the whole pack (admission already grouped
-    # members by the same plan/case/shape signature, so the decision is
-    # shared by construction)
+    # auto resolves ONCE for the whole pack against the pack's real
+    # mesh (admission already grouped members by the same
+    # plan/case/shape signature, so the decision is shared by
+    # construction). A meshed pack cannot run the pallas kernels — the
+    # vmapped single-device calls do not partition over the mesh, and
+    # the shard_map variant is the solo path — so pallas resolves to
+    # xla here, loudly, with the override journaled.
     transport_decision = _decide_transport_for(
-        job0, cfg, None, testcase, groups, (), telemetry_on, ows[0]
+        job0, cfg, pack_mesh, testcase, groups, (), telemetry_on, ows[0]
     )
     transport = transport_decision.resolved
+    if transport == "pallas" and pack_mesh is not None:
+        ows[0].warn(
+            "transport=pallas on a packed mesh resolves to xla (the "
+            "vmapped kernels cannot shard over the run axis and the "
+            "mesh at once)"
+        )
+        transport_decision = dataclasses.replace(
+            transport_decision,
+            resolved="xla",
+            reason=transport_decision.reason
+            + " — overridden: a packed mesh run uses the XLA transport",
+        )
+        transport = "xla"
     prog = make_sim_program(
         testcase,
         groups,
@@ -2504,7 +2608,7 @@ def execute_packed_sim_runs(
         netmatrix=False,
     )
     width = pack_width(len(jobs), int(getattr(cfg, "pack_max", 8) or 8))
-    runner = PackRunner(prog, width)
+    runner = PackRunner(prog, width, mesh=pack_mesh)
 
     # ------------------------------------------------ per-member plumbing
     members: list[PackMember] = []
@@ -2712,6 +2816,7 @@ def execute_packed_sim_runs(
         raise
     wall = time.monotonic() - t0
     hits_delta = cache_event_counts()["hits"] - cache_before["hits"]
+    mesh_block = _mesh_journal_block(pack_mesh, testcase, groups, ())
 
     # ------------------------------------------------- per-member collect
     outs: list = []
@@ -2735,6 +2840,7 @@ def execute_packed_sim_runs(
                     compile_cache_on,
                     hits_delta,
                     outputs_root,
+                    mesh_block=mesh_block,
                 )
             )
         except Exception as e:  # noqa: BLE001 — member-local failure
@@ -2768,9 +2874,10 @@ def _collect_pack_member(
     compile_cache_on,
     hits_delta,
     outputs_root,
+    mesh_block=None,
 ):
     """Assemble one pack member's RunOutput: outcomes, metrics, journal
-    (sim block + pack/bucket annotations), instance outputs — the
+    (sim block + pack/bucket/mesh annotations), instance outputs — the
     reduced-plane analog of ``_execute_sim_run``'s collect phase."""
     job, ow, spans = ctx["job"], ctx["ow"], ctx["spans"]
     cancel = ctx["cancel"]
@@ -2883,7 +2990,11 @@ def _collect_pack_member(
         "wall_secs": wall,
         "processes": 1,
         "compile_secs": round(res.get("compile_secs", 0.0), 3),
-        "devices": 1,
+        "devices": (
+            int(mesh_block["shards"]) * int(mesh_block["runs"])
+            if mesh_block
+            else 1
+        ),
         "pub_dropped": res["pub_dropped"].tolist(),
         "latency_clamped": res.get("latency_clamped", 0),
         "bw_queue_dropped": res.get("bw_queue_dropped", 0),
@@ -2912,6 +3023,9 @@ def _collect_pack_member(
         **({"latency": latency} if latency else {}),
         **({"perf": perf_summary} if perf_summary else {}),
         **({"bucket": bucket_block} if bucket_block else {}),
+        # mesh placement plane (sim/meshplan.py) — the pack-shared
+        # layout, present when the stacked carry sharded over a mesh
+        **({"mesh": mesh_block} if mesh_block else {}),
     }
     result.update_outcome()
     if member.canceled and cancel.is_set():

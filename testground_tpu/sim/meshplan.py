@@ -1,0 +1,284 @@
+"""Mesh placement plane: THE one partition decision for sharded runs.
+
+Every mesh gate in the serving plane — bucket resolution, pack
+admission, the sharded Pallas commit, and the transport cost model —
+used to make its own single-device-only call. This module replaces
+those with ONE explicit rule table (the EasyLM ``match_partition_rules``
+idiom: regex on a logical leaf path → :class:`PartitionSpec`), consumed
+by all four:
+
+* the engine's carry constraint (``SimProgram._constrain``) resolves
+  every carry plane through :meth:`MeshPlan.spec_for`;
+* ``resolve_buckets`` accepts a mesh exactly when every rung's padded
+  group count divides across the ``i`` (peers) shards
+  (:func:`indivisible_counts`);
+* ``PackRunner`` maps the pack run axis per the table (replicated, or
+  ``runs``-sharded on a 2-D mesh) via ``spec_for(..., lead=...)``;
+* ``decide_transport`` scores mesh arms from
+  :func:`cross_shard_bytes_est` instead of refusing, and the mesh
+  layout string (:func:`layout_str`) keys its decision cache and the
+  precompile BuildKey.
+
+Axis conventions: the instance (padded lane) axis shards on mesh axis
+``"i"`` — the name the engine has always used — and a 2-D mesh adds a
+leading ``"runs"`` axis for the pack run dimension. ``parse_mesh_shape``
+accepts ``"4"`` (1-D, 4 peer shards) or ``"2x4"`` (2 run shards × 4
+peer shards).
+
+The table is deliberately tiny and total: the LAST rule is a match-all
+mapping to replicated, so scalars, per-group states, sync counters and
+every future carry leaf stay replicated unless a rule says otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections.abc import Mapping
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "MeshPlan",
+    "DEFAULT_RULES",
+    "parse_mesh_shape",
+    "make_mesh",
+    "plan_for",
+    "layout_str",
+    "peer_shards",
+    "indivisible_counts",
+    "cross_shard_bytes_est",
+]
+
+# The rule table. First match wins; paths are the engine's logical
+# carry-plane names (NOT jax keystr output — the engine resolves each
+# plane it constrains by name, so the table survives dataclass
+# refactors). Axis position is encoded in the spec itself: a calendar
+# plane is [L, slots*N] so the instance axis is axis 1; link rules are
+# [R, F, N] so it is axis 2.
+DEFAULT_RULES: tuple[tuple[str, str, P], ...] = (
+    # per-lane status rows: [N_lanes]
+    ("instance-rows", r"^(status|finished_at|rejected)$", P("i")),
+    # calendar planes: [L, slots*N] (payload tuple members included)
+    ("calendar-planes", r"^cal\.(payload(\.\d+)?|src|valid|etick)$", P(None, "i")),
+    # link lane planes: [E, N] egress targets / filters
+    ("link-lane-planes", r"^link\.(egress|filters)$", P(None, "i")),
+    # link per-node rows: [N]
+    ("link-node-rows", r"^link\.(region_of|backlog)$", P("i")),
+    # link shaping rules: [R, F, N]
+    ("link-rules", r"^link\.rules$", P(None, None, "i")),
+    # everything else — scalars, per-group states, sync state, flow
+    # accumulators, histograms — is replicated
+    ("replicated", r".*", P()),
+)
+
+
+def parse_mesh_shape(text: str) -> tuple[int, ...]:
+    """``"4"`` → ``(4,)``; ``"2x4"`` → ``(2, 4)``. 1-D is (peers,);
+    2-D is (runs, peers). Anything else refuses loudly."""
+    parts = str(text).lower().replace("×", "x").split("x")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        raise ValueError(
+            f"mesh shape {text!r} is not N or AxB (e.g. '4' or '2x4')"
+        ) from None
+    if not (1 <= len(dims) <= 2) or any(d < 1 for d in dims):
+        raise ValueError(
+            f"mesh shape {text!r} must be 1-D (peers) or 2-D (runs x peers) "
+            "with positive extents"
+        )
+    return dims
+
+
+def mesh_axis_names(ndim: int) -> tuple[str, ...]:
+    return ("i",) if ndim == 1 else ("runs", "i")
+
+
+def make_mesh(
+    shape: Sequence[int] | str | None = None,
+    *,
+    devices: Sequence[Any] | None = None,
+) -> Mesh | None:
+    """Build the serving mesh, or None for a single device.
+
+    With ``shape=None`` every visible device lands on a 1-D ``("i",)``
+    mesh (the historical ``shard=true`` behavior). An explicit shape
+    must multiply out to a device count we actually have; fewer than
+    all devices is fine (bench rungs pin 4 of 8 virtual devices).
+    """
+    if isinstance(shape, str):
+        shape = parse_mesh_shape(shape)
+    elif isinstance(shape, int):
+        # `--run-cfg mesh=4` coalesces as a bare int (the run-config
+        # layer does not coerce scalars to the declared field type)
+        shape = (int(shape),)
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        if len(devs) <= 1:
+            return None
+        return Mesh(np.asarray(devs), ("i",))
+    need = int(np.prod(shape))
+    if need == 1:
+        return None
+    if need > len(devs):
+        raise ValueError(
+            f"mesh shape {tuple(shape)} needs {need} devices, "
+            f"only {len(devs)} visible"
+        )
+    arr = np.asarray(devs[:need]).reshape(tuple(shape))
+    return Mesh(arr, mesh_axis_names(len(shape)))
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A mesh plus the partition-rule table resolved against it.
+
+    ``spec_for(path)`` is the ONE placement query: every consumer —
+    engine constraint, pack stacking, pallas shard_map specs, journal
+    rendering — resolves leaf placement through it.
+    """
+
+    mesh: Mesh
+    rules: tuple[tuple[str, str, P], ...] = DEFAULT_RULES
+
+    @property
+    def shards(self) -> int:
+        """Extent of the instance (``i``) axis."""
+        return int(self.mesh.shape["i"])
+
+    @property
+    def runs(self) -> int:
+        """Extent of the pack run axis (1 when the mesh is 1-D)."""
+        return int(self.mesh.shape.get("runs", 1))
+
+    @property
+    def devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def spec_for(
+        self,
+        path: str,
+        *,
+        lead: str | None = None,
+        ndim: int | None = None,
+    ) -> P:
+        """Resolve a logical carry path to its PartitionSpec.
+
+        ``lead`` prepends an axis for stacked (packed) carries: the
+        pack run axis maps to the ``runs`` mesh axis when the mesh has
+        one, else it is replicated — per the same table discipline, one
+        decision for every stacked leaf. ``ndim`` clamps the spec to
+        the leaf's actual rank (keeping the LEADING entries): a FLAT
+        calendar plane folds [L, slots·N] into one axis whose slot-
+        major positions admit no aligned instance slicing, so only the
+        leading (run-axis) constraint survives and GSPMD places the
+        rest.
+        """
+        for _name, pat, spec in self.rules:
+            if re.match(pat, path):
+                break
+        else:  # unreachable: DEFAULT_RULES ends in a match-all
+            spec = P()
+        if lead is not None:
+            lead_axis = lead if lead in self.mesh.shape else None
+            spec = P(lead_axis, *tuple(spec))
+        if ndim is not None and len(tuple(spec)) > ndim:
+            spec = P(*tuple(spec)[:ndim])
+        return spec
+
+    def sharding_for(
+        self,
+        path: str,
+        *,
+        lead: str | None = None,
+        ndim: int | None = None,
+    ) -> NamedSharding:
+        return NamedSharding(
+            self.mesh, self.spec_for(path, lead=lead, ndim=ndim)
+        )
+
+    def layout_table(self) -> list[dict[str, str]]:
+        """The rule table in journal form — stable, human-diffable."""
+        return [
+            {"rule": name, "path": pat, "spec": _spec_str(spec)}
+            for name, pat, spec in self.rules
+        ]
+
+
+def _spec_str(spec: P) -> str:
+    parts = []
+    for ax in tuple(spec):
+        if ax is None:
+            parts.append("-")
+        elif isinstance(ax, (tuple, list)):
+            parts.append("+".join(str(a) for a in ax))
+        else:
+            parts.append(str(ax))
+    return "(" + ",".join(parts) + ")" if parts else "replicated"
+
+
+def plan_for(mesh: Mesh | None) -> MeshPlan | None:
+    return None if mesh is None else MeshPlan(mesh)
+
+
+def layout_str(mesh: Mesh | None) -> str:
+    """Canonical mesh layout key — ``"1"`` single device, ``"4"`` 1-D,
+    ``"2x4"`` 2-D — used by the transport decision cache, the
+    precompile BuildKey, bench bank rows, and metric labels. The label
+    space is bounded by real hardware topologies."""
+    if mesh is None:
+        return "1"
+    shape = getattr(mesh, "shape", None)
+    if not isinstance(shape, Mapping):  # `tg check` device-count stand-in
+        return str(int(mesh.devices.size))
+    if "runs" in shape:
+        return f"{int(shape['runs'])}x{int(shape['i'])}"
+    return str(int(shape["i"]))
+
+
+def peer_shards(mesh: Any) -> int:
+    """Extent of the instance (``i``) axis, duck-type safe: `tg check`
+    probes the bucket gate with a stand-in object exposing only
+    ``devices.size`` (a real Mesh is not constructible offline), so
+    fall back to the device count — correct for every 1-D mesh, which
+    is all a stand-in models."""
+    if mesh is None:
+        return 1
+    shape = getattr(mesh, "shape", None)
+    if isinstance(shape, Mapping) and "i" in shape:
+        return int(shape["i"])
+    return int(mesh.devices.size)
+
+
+def indivisible_counts(
+    counts: Sequence[int], shards: int
+) -> tuple[int, ...]:
+    """The padded group counts that do NOT divide across ``shards``
+    peer shards — empty means the layout is supported. This is the
+    whole divisibility contract: every sharded plane slices the padded
+    instance axis into equal contiguous blocks, so each padded count
+    (and their sum) must be a multiple of the shard count."""
+    return tuple(int(c) for c in counts if int(c) % int(shards) != 0)
+
+
+def cross_shard_bytes_est(
+    *,
+    stream_bytes: int,
+    shards: int,
+    payload_bytes_per_msg: int = 0,
+) -> int:
+    """Modeled per-commit ICI exchange traffic for the sharded Pallas
+    commit: the sorted message stream is exchanged so every shard sees
+    the messages addressed to its lane range (the all-gather IS the
+    exchange stage — each shard receives the (shards-1)/shards fraction
+    it does not already hold). ``payload_bytes_per_msg`` is already
+    folded into ``stream_bytes`` by callers that know the width; the
+    parameter exists so the transport model can itemize."""
+    if shards <= 1:
+        return 0
+    del payload_bytes_per_msg  # itemization handled by callers
+    return int(stream_bytes) * (int(shards) - 1) // int(shards)

@@ -329,7 +329,7 @@ def make_link_state(
 
 
 def deliver(
-    cal: Calendar, t: jax.Array, transport: str = "xla"
+    cal: Calendar, t: jax.Array, transport: str = "xla", mesh=None
 ) -> tuple[Calendar, Inbox]:
     """Pop the bucket arriving at tick ``t`` → inboxes in plane layout
     (payload [W, SLOTS, N], src/valid [SLOTS, N]); the bucket's occupancy
@@ -350,7 +350,7 @@ def deliver(
 
         horizon, ns = cal.occupancy_plane.shape
         n = ns // slots
-        cal, occ_row, pay_rows = pop_bucket(cal, t)
+        cal, occ_row, pay_rows = pop_bucket(cal, t, mesh=mesh)
         if cal.src is not None:
             row_v = occ_row != 0
             row_s = occ_row - 1
@@ -563,6 +563,7 @@ def enqueue(
     want_flow: bool = False,
     transport: str = "xla",
     dice_idx: jax.Array | None = None,
+    mesh=None,
 ) -> tuple[Calendar, NetFeedback]:
     """Shape + schedule this tick's sends (inputs in plane layout, message
     m = o·N + src). Returns (cal', NetFeedback).
@@ -1160,8 +1161,28 @@ def enqueue(
     # AND validity (invalid ⇒ key = big, sorting to the end) — so only
     # src and the payload words ride along as sort values; bucket/dst/
     # valid are re-derived from the sorted key instead of sorted.
+    #
+    # Sharded pallas commit: the key becomes SHARD-major —
+    # (dst_shard, bucket, local_dst) — so one global stable sort yields
+    # every shard's segment contiguously, in exactly the order the
+    # per-shard kernel walk expects after rebasing (the (bucket, dst)
+    # equivalence classes are unchanged, so within-class stable order —
+    # and therefore slot assignment — is bit-identical to the
+    # bucket-major key). `big = horizon·n` still sorts invalids last:
+    # the max valid shard-major key is shards·horizon·n_loc − 1 = big−1.
     big = jnp.int32(horizon * n)
-    sort_key = jnp.where(val2, bucket * n + dst2, big)
+    if transport == "pallas" and mesh is not None:
+        shards = int(mesh.shape["i"])
+        n_loc = n // shards
+        sort_key = jnp.where(
+            val2,
+            (dst2 // n_loc) * jnp.int32(horizon * n_loc)
+            + bucket * n_loc
+            + jnp.mod(dst2, n_loc),
+            big,
+        )
+    else:
+        sort_key = jnp.where(val2, bucket * n + dst2, big)
     sort_vals = [sort_key, src2] + list(pay2)
     if orig2 is not None:
         sort_vals.append(orig2)
@@ -1182,7 +1203,7 @@ def enqueue(
             src_s + 1 if cal.src is not None else jnp.ones_like(src_s)
         )
         cal, survived = commit_calendar(
-            cal, sk, occ_vals, list(pay_s), t, stacking=stacking
+            cal, sk, occ_vals, list(pay_s), t, stacking=stacking, mesh=mesh
         )
         if orig_s is not None:
             # map sorted survival back to original order (duplicate

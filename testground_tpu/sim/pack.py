@@ -91,12 +91,24 @@ class PackMember:
 class PackRunner:
     """Vmapped executor for N compatible runs over ONE SimProgram.
 
-    The program must be single-device, trace-free, fault-free (the
-    admission key guarantees it). ``prog.live_counts`` decides whether
-    members carry per-run exact counts (shape bucketing) — when set,
-    every member's ``live_counts`` must be provided."""
+    The program must be trace-free and fault-free (the admission key
+    guarantees it). ``prog.live_counts`` decides whether members carry
+    per-run exact counts (shape bucketing) — when set, every member's
+    ``live_counts`` must be provided.
 
-    def __init__(self, prog, width: int):
+    **Mesh placement** (ISSUE 20): the inner program is ALWAYS built
+    unmeshed — a ``with_sharding_constraint`` under the run-axis vmap
+    would pin per-member layouts at trace time — and the pack's real
+    mesh arrives here instead. PackRunner places the STACKED ``[R,
+    ...]`` carry through the one rule table (sim/meshplan.py) outside
+    the vmap: instance-axis planes shard on the ``i`` peers axis, the
+    run axis maps to a 2-D mesh's ``runs`` axis (replicated on a 1-D
+    mesh). Packs therefore compile once per (width, mesh layout), and
+    members still demux/snapshot/cancel independently."""
+
+    def __init__(self, prog, width: int, mesh=None):
+        from . import meshplan as _meshplan
+
         self.prog = prog
         self.width = int(width)
         if prog.trace is not None or prog.faults is not None:
@@ -106,8 +118,18 @@ class PackRunner:
             )
         if prog.mesh is not None:
             raise ValueError(
-                "run packing is single-device (the run axis would "
-                "compete with the instance axis for the mesh)"
+                "the pack's inner program must be built unmeshed "
+                "(mesh=None): PackRunner places the stacked carry "
+                "through the rule table outside the vmap — pass the "
+                "mesh to PackRunner instead"
+            )
+        self.meshplan = _meshplan.plan_for(mesh)
+        if self.meshplan is not None and prog.transport == "pallas":
+            raise ValueError(
+                "a packed mesh run cannot use transport=pallas (the "
+                "vmapped single-device kernels do not partition over "
+                "the mesh; the shard_map variant is the solo path) — "
+                "the transport gate resolves this to xla"
             )
         self._init_fn = None
         self._chunk_fn = None
@@ -132,7 +154,17 @@ class PackRunner:
         status = jnp.where(
             live_run[:, None], carry.status, jnp.int32(CRASH)
         )
-        return dataclasses.replace(carry, status=status)
+        carry = dataclasses.replace(carry, status=status)
+        return self._constrain_stacked(carry)
+
+    def _constrain_stacked(self, carry):
+        """Place the stacked carry per the rule table — OUTSIDE the
+        vmap, so the constraint sees the real [R, ...] leaves."""
+        if self.meshplan is None:
+            return carry
+        from .engine import constrain_carry
+
+        return constrain_carry(carry, self.meshplan, lead="runs")
 
     def packed_init(self):
         if self._init_fn is None:
@@ -145,9 +177,18 @@ class PackRunner:
         if self._chunk_fn is None:
             import jax
 
-            self._chunk_fn = jax.jit(
-                jax.vmap(self.prog._chunk_step), donate_argnums=0
-            )
+            vstep = jax.vmap(self.prog._chunk_step)
+            if self.meshplan is None:
+                step = vstep
+            else:
+
+                def step(carry):
+                    out = vstep(carry)
+                    return (self._constrain_stacked(out[0]),) + tuple(
+                        out[1:]
+                    )
+
+            self._chunk_fn = jax.jit(step, donate_argnums=0)
         return self._chunk_fn
 
     # --------------------------------------------------------------- run

@@ -60,10 +60,26 @@ nothing here. The N·SLOTS axis stays minor (the net.py layout rule).
 
 Scope: the sorted enqueue path and ``deliver``. Direct slot mode keeps
 its XLA scatter (one index per message, no sort — there is no bucket
-ordering for the kernel to exploit), and mesh-sharded programs keep the
-XLA path entirely (the cross-shard scatter IS the inter-chip traffic;
-a single-device kernel cannot express it) — ``SimProgram`` enforces the
-single-device bound. VMEM envelope (segmented): ~2·(3+W)·T words of
+ordering for the kernel to exploit).
+
+**Mesh sharding** (ISSUE 20): on a mesh the SAME kernels run per chip
+under ``shard_map``, each over its own destination-range shard of the
+calendar planes (the free ``[L, SLOTS, N] → P(None, None, 'i')`` view
+of the slot-major row axis). The cross-shard message exchange happens
+BEFORE the kernel: net.py sorts the stream by a SHARD-major key
+((dst_shard, bucket, local_dst) — same (bucket, dst) equivalence
+classes, so slot assignment is bit-identical), and the sorted stream
+enters every shard replicated — the implicit all-gather IS the
+exchange stage, costed by the transport model as
+``meshplan.cross_shard_bytes_est``. Inside each shard the keys are
+rebased by −shard·L·n_loc (still ascending: earlier shards' messages
+go negative, later shards' past the local window) and the interval
+table clips the walk to the shard's own valid segment
+[starts[0], starts[L]) — the kernel body is UNCHANGED, it just sees
+n = n_loc. Per-shard survival tiles are zero outside the shard's
+segment, so a sum over the shard axis reassembles the exact global
+mask. ``SimProgram`` enforces the divisibility bound
+(lane count % shards == 0). VMEM envelope (segmented): ~2·(3+W)·T words of
 stream tiles plus ~2·2·(1+W+E) row blocks of N·SLOTS words (E = 1 with
 the etick plane) — the m2 term is GONE, so the envelope no longer
 depends on the message-stream length at all; only the per-bucket row
@@ -330,6 +346,60 @@ def _commit_call(
     )
 
 
+def _interval_tables(
+    sk: jax.Array, horizon: int, n: int, m2p: int, tile_w: int, k_tiles: int
+):
+    """The segmented kernel's per-grid-step scalar tables, from a sorted
+    key stream: (bucket, tile, lo, hi) per interval.
+
+    Bucket b's sorted segment is [starts[b], starts[b+1]); invalid
+    messages carry key ≥ horizon·n and fall past starts[horizon]. The
+    interval table cuts the stream at every bucket start AND every tile
+    start: each interval lies in one bucket and one tile, and there are
+    exactly K + L + 1 of them (the static grid).
+
+    The walk bounds clamp to the VALID WINDOW [starts[0], starts[L]):
+    unsharded, starts[0] is always 0 and only the invalid tail is
+    clamped; per shard (keys rebased by −shard·L·n_loc, still
+    ascending), earlier shards' messages sit below 0 and later shards'
+    at/past L·n_loc, so the same clamp walks exactly the shard's own
+    segment. The RAW interval still drives the tile index so every
+    survival tile (the out-of-window spans included) is visited and
+    zeroed."""
+    starts = jnp.searchsorted(
+        sk, jnp.arange(horizon + 1, dtype=jnp.int32) * jnp.int32(n)
+    ).astype(jnp.int32)
+    valid_begin = starts[0]
+    valid_end = starts[horizon]
+    bounds = jnp.sort(
+        jnp.concatenate(
+            [jnp.arange(k_tiles, dtype=jnp.int32) * jnp.int32(tile_w), starts]
+        )
+    )
+    lo_raw = bounds
+    hi_raw = jnp.concatenate(
+        [bounds[1:], jnp.full((1,), m2p, jnp.int32)]
+    )
+    steps_lo = jnp.clip(lo_raw, valid_begin, valid_end)
+    steps_hi = jnp.clip(hi_raw, valid_begin, valid_end)
+    steps_tile = jnp.clip(lo_raw // tile_w, 0, k_tiles - 1).astype(
+        jnp.int32
+    )
+    # bucket of the interval's first in-window message; out-of-window
+    # intervals inherit the nearest in-window message's bucket so an
+    # already-flushed row is never re-fetched (they do no row work —
+    # the clamp only parks the block index on a real bucket)
+    pos_b = jnp.clip(
+        lo_raw, valid_begin, jnp.maximum(valid_end - 1, valid_begin)
+    )
+    steps_b = jnp.clip(
+        jnp.searchsorted(starts, pos_b, side="right").astype(jnp.int32) - 1,
+        0,
+        horizon - 1,
+    )
+    return steps_b, steps_tile, steps_lo, steps_hi
+
+
 def commit_calendar(
     cal,
     sk: jax.Array,  # [m2] int32, sorted keys (bucket·n + dst; big = invalid)
@@ -339,6 +409,7 @@ def commit_calendar(
     *,
     stacking: bool = True,
     tile: int | None = None,
+    mesh=None,
 ):
     """Commit one tick's sorted message stream into the calendar planes.
 
@@ -351,7 +422,23 @@ def commit_calendar(
     pin the tile-boundary rank carry); default per
     :func:`commit_tile_words`. The stream is padded up to the tile
     grain with invalid keys — padding never survives and is sliced off
-    the returned mask."""
+    the returned mask.
+
+    ``mesh`` routes through the sharded variant: the same kernel per
+    chip under ``shard_map``, each over its destination-range shard of
+    the planes, with ``sk`` sorted by the SHARD-major key net.py builds
+    on a mesh (see the module docstring's mesh section)."""
+    if mesh is not None:
+        return _commit_calendar_sharded(
+            cal,
+            sk,
+            occ_vals,
+            pay_sorted,
+            t,
+            stacking=stacking,
+            tile=tile,
+            mesh=mesh,
+        )
     assert not cal.flat, "pallas transport requires 2-D calendar planes"
     slots = cal.slots
     width = cal.width
@@ -378,41 +465,8 @@ def commit_calendar(
             for p in pay_sorted
         ]
 
-    # bucket b's sorted segment is [starts[b], starts[b+1]); invalid
-    # messages carry key = horizon·n and fall past starts[horizon].
-    # The interval table cuts the stream at every bucket start AND
-    # every tile start: each interval lies in one bucket and one tile,
-    # and there are exactly K + L + 1 of them (the static grid).
-    starts = jnp.searchsorted(
-        sk, jnp.arange(horizon + 1, dtype=jnp.int32) * jnp.int32(n)
-    ).astype(jnp.int32)
-    valid_end = starts[horizon]
-    bounds = jnp.sort(
-        jnp.concatenate(
-            [jnp.arange(k_tiles, dtype=jnp.int32) * jnp.int32(tile_w), starts]
-        )
-    )
-    lo_raw = bounds
-    hi_raw = jnp.concatenate(
-        [bounds[1:], jnp.full((1,), m2p, jnp.int32)]
-    )
-    # message walk bounds clamp at the valid prefix; the RAW interval
-    # still drives the tile index so every survival tile (the invalid
-    # tail included) is visited and zeroed
-    steps_lo = jnp.minimum(lo_raw, valid_end)
-    steps_hi = jnp.minimum(hi_raw, valid_end)
-    steps_tile = jnp.clip(lo_raw // tile_w, 0, k_tiles - 1).astype(
-        jnp.int32
-    )
-    # bucket of the interval's first message; tail intervals inherit the
-    # LAST valid message's bucket so an already-flushed row is never
-    # re-fetched (they do no row work — the clamp only parks the block
-    # index on the final real bucket)
-    pos_b = jnp.minimum(lo_raw, jnp.maximum(valid_end - 1, 0))
-    steps_b = jnp.clip(
-        jnp.searchsorted(starts, pos_b, side="right").astype(jnp.int32) - 1,
-        0,
-        horizon - 1,
+    steps_b, steps_tile, steps_lo, steps_hi = _interval_tables(
+        sk, horizon, n, m2p, tile_w, k_tiles
     )
     tvec = jnp.reshape(jnp.asarray(t, jnp.int32), (1,))
 
@@ -445,6 +499,169 @@ def commit_calendar(
     # occupancy plane lands in — the kernel itself is identical either
     # way, which is exactly why track_src is NOT part of the call cache
     # key anymore
+    track_src = cal.src is not None
+    cal = dataclasses.replace(
+        cal,
+        payload=new_payload,
+        src=new_occ if track_src else None,
+        valid=None if track_src else new_occ,
+        etick=new_etick,
+    )
+    return cal, survived
+
+
+def _commit_calendar_sharded(
+    cal,
+    sk: jax.Array,  # [m2] int32, SHARD-major sorted keys (net.py on a mesh)
+    occ_vals: jax.Array,
+    pay_sorted,
+    t: jax.Array,
+    *,
+    stacking: bool,
+    tile: int | None,
+    mesh,
+):
+    """The mesh variant of :func:`commit_calendar`: ``shard_map`` the
+    UNCHANGED segmented kernel over each chip's destination-range shard
+    of the calendar planes.
+
+    The planes enter through the free ``[L, SLOTS, N]`` view with the
+    lane axis sharded (``P(None, None, 'i')`` — slot-major rows make
+    this a zero-copy reshape), so each shard holds a locally slot-major
+    ``[L, SLOTS·n_loc]`` plane the kernel addresses with n = n_loc. The
+    sorted stream enters REPLICATED (``P()``): that resharding is the
+    cross-shard message exchange, in one collective, before commit.
+    Inside each shard the keys are rebased by −shard·L·n_loc — still
+    ascending — and :func:`_interval_tables` clips the walk to the
+    shard's own contiguous segment. Per-shard survival tiles are zeroed
+    everywhere and marked only inside the shard's segment, so summing
+    the stacked per-shard masks reassembles the exact global mask the
+    unsharded kernel would emit; the (bucket, dst) equivalence classes
+    of the shard-major key equal the bucket-major key's, so slot
+    assignment — and thus every plane write — is bit-identical."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    assert not cal.flat, "pallas transport requires 2-D calendar planes"
+    slots = cal.slots
+    width = cal.width
+    occ = cal.occupancy_plane
+    horizon, ns = occ.shape
+    n = ns // slots
+    shards = int(mesh.shape["i"])
+    assert n % shards == 0, (
+        f"sharded pallas commit needs lane count {n} divisible by "
+        f"{shards} shards (SimProgram enforces this)"
+    )
+    n_loc = n // shards
+    ns_loc = n_loc * slots
+    m2 = int(sk.shape[0])
+    has_etick = cal.etick is not None
+    if m2 == 0:  # degenerate direct call: nothing to commit
+        return cal, jnp.zeros((0,), jnp.int32)
+
+    tile_w = commit_tile_words(tile)
+    m2p = -(-m2 // tile_w) * tile_w
+    k_tiles = m2p // tile_w
+    pad = m2p - m2
+    if pad:
+        # same invalid fill: big = horizon·n = shards·horizon·n_loc is
+        # one past the max shard-major key, so padding sorts last here too
+        big_fill = jnp.full((pad,), horizon * n, jnp.int32)
+        sk = jnp.concatenate([sk, big_fill])
+        occ_vals = jnp.concatenate(
+            [occ_vals, jnp.zeros((pad,), occ_vals.dtype)]
+        )
+        pay_sorted = [
+            jnp.concatenate([p, jnp.zeros((pad,), p.dtype)])
+            for p in pay_sorted
+        ]
+    tvec = jnp.reshape(jnp.asarray(t, jnp.int32), (1,))
+
+    call = _commit_call(
+        horizon,
+        n_loc,
+        slots,
+        width,
+        m2p,
+        tile_w,
+        has_etick,
+        bool(stacking),
+        occ.dtype == jnp.bool_,
+        pallas_interpret(),
+    )
+    seg = jnp.int32(horizon * n_loc)
+
+    def shard_body(sk_r, occv_r, pays_r, tv, occ3, pays3, et3):
+        s = jax.lax.axis_index("i").astype(jnp.int32)
+        # rebase to the shard's local key space: the shard's own
+        # messages land in [0, L·n_loc) encoded exactly as the
+        # unsharded key (bucket·n_loc + local_dst); earlier shards'
+        # go negative, later shards' and invalids past the window —
+        # NO clamping here (it would break sortedness), the interval
+        # tables clip the walk instead
+        rk = sk_r - s * seg
+        tables = _interval_tables(rk, horizon, n_loc, m2p, tile_w, k_tiles)
+        occ_l = occ3.reshape(horizon, ns_loc)
+        args = [*tables, tv, rk[None, :], occv_r[None, :]]
+        args += [p[None, :] for p in pays_r]
+        args.append(occ_l)
+        args += [p.reshape(horizon, ns_loc) for p in pays3]
+        if has_etick:
+            args.append(et3.reshape(horizon, ns_loc))
+        out = call(*args)
+        surv = out[0]
+        occ_out = out[1].reshape(horizon, slots, n_loc)
+        pay_out = [
+            p.reshape(horizon, slots, n_loc) for p in out[2 : 2 + width]
+        ]
+        et_out = (
+            out[2 + width].reshape(horizon, slots, n_loc)
+            if has_etick
+            else jnp.zeros((0,), jnp.int32)
+        )
+        return surv, occ_out, pay_out, et_out
+
+    plane3 = P(None, None, "i")
+    et3_in = (
+        cal.etick.reshape(horizon, slots, n)
+        if has_etick
+        else jnp.zeros((0,), jnp.int32)
+    )
+    surv_g, occ_g, pay_g, et_g = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            P(),
+            [P()] * width,
+            P(),
+            plane3,
+            [plane3] * width,
+            plane3 if has_etick else P(),
+        ),
+        out_specs=(
+            # per-shard survival stacks on a leading shard axis (summed
+            # below — avoids claiming replication for a psum'd output)
+            P("i", None),
+            plane3,
+            [plane3] * width,
+            plane3 if has_etick else P("i"),
+        ),
+        check_rep=False,
+    )(
+        sk,
+        occ_vals,
+        list(pay_sorted),
+        tvec,
+        occ.reshape(horizon, slots, n),
+        [p.reshape(horizon, slots, n) for p in cal.payload],
+        et3_in,
+    )
+    survived = jnp.sum(surv_g, axis=0)[:m2]
+    new_occ = occ_g.reshape(horizon, ns)
+    new_payload = tuple(p.reshape(horizon, ns) for p in pay_g)
+    new_etick = et_g.reshape(horizon, ns) if has_etick else None
     track_src = cal.src is not None
     cal = dataclasses.replace(
         cal,
@@ -502,11 +719,15 @@ def _pop_call(
     )
 
 
-def pop_bucket(cal, t: jax.Array):
+def pop_bucket(cal, t: jax.Array, mesh=None):
     """Pop the bucket arriving at tick ``t``: returns ``(cal', occ_row,
     pay_rows)`` with the rows as [N·SLOTS] vectors and the occupancy row
     cleared in the returned calendar (payload stays stale-but-masked,
-    exactly like the XLA ``deliver``)."""
+    exactly like the XLA ``deliver``). ``mesh`` runs the same kernel
+    per chip over its destination-range plane shard (the delivery pop
+    is embarrassingly shard-local — no exchange stage)."""
+    if mesh is not None:
+        return _pop_bucket_sharded(cal, t, mesh)
     assert not cal.flat, "pallas transport requires 2-D calendar planes"
     width = cal.width
     occ = cal.occupancy_plane
@@ -521,6 +742,69 @@ def pop_bucket(cal, t: jax.Array):
     new_occ = out[0]
     occ_row = out[1][0]
     pay_rows = [r[0] for r in out[2 : 2 + width]]
+    track_src = cal.src is not None
+    cal = dataclasses.replace(
+        cal,
+        src=new_occ if track_src else None,
+        valid=None if track_src else new_occ,
+    )
+    return cal, occ_row, pay_rows
+
+
+def _pop_bucket_sharded(cal, t: jax.Array, mesh):
+    """Mesh variant of :func:`pop_bucket`: the pop kernel per chip over
+    its ``[L, SLOTS, n_loc]`` plane shard (same free view as the commit
+    side). The popped [SLOTS, n_loc] rows reassemble along the lane
+    axis into the global slot-major [N·SLOTS] row — delivery reads and
+    clears only lane-local state, so no collective is needed at all."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    assert not cal.flat, "pallas transport requires 2-D calendar planes"
+    slots = cal.slots
+    width = cal.width
+    occ = cal.occupancy_plane
+    horizon, ns = occ.shape
+    n = ns // slots
+    shards = int(mesh.shape["i"])
+    assert n % shards == 0, (
+        f"sharded pallas pop needs lane count {n} divisible by "
+        f"{shards} shards (SimProgram enforces this)"
+    )
+    n_loc = n // shards
+    ns_loc = n_loc * slots
+    bvec = jnp.reshape(
+        jnp.mod(jnp.asarray(t, jnp.int32), horizon), (1,)
+    )
+    call = _pop_call(
+        horizon, ns_loc, width, occ.dtype == jnp.bool_, pallas_interpret()
+    )
+
+    def shard_body(bv, occ3, pays3):
+        out = call(bv, occ3.reshape(horizon, ns_loc), *[
+            p.reshape(horizon, ns_loc) for p in pays3
+        ])
+        new_occ = out[0].reshape(horizon, slots, n_loc)
+        occ_row = out[1][0].reshape(slots, n_loc)
+        pay_rows = [r[0].reshape(slots, n_loc) for r in out[2 : 2 + width]]
+        return new_occ, occ_row, pay_rows
+
+    plane3 = P(None, None, "i")
+    row2 = P(None, "i")
+    occ_g, row_g, pay_g = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), plane3, [plane3] * width),
+        out_specs=(plane3, row2, [row2] * width),
+        check_rep=False,
+    )(
+        bvec,
+        occ.reshape(horizon, slots, n),
+        [p.reshape(horizon, slots, n) for p in cal.payload],
+    )
+    new_occ = occ_g.reshape(horizon, ns)
+    occ_row = row_g.reshape(ns)
+    pay_rows = [r.reshape(ns) for r in pay_g]
     track_src = cal.src is not None
     cal = dataclasses.replace(
         cal,

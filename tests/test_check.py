@@ -349,13 +349,16 @@ class TestWarnParity:
     executor emits when it falls back (matched by content — executor
     lines carry run-id prefixes)."""
 
-    def test_transport_mesh_fallback(self):
+    def test_transport_mesh_indivisible(self):
         # conftest pins an 8-device virtual CPU mesh, so shard=True
-        # meshes and the transport gate must fall back loudly
+        # meshes — and 2 lanes do not divide across 8 peer shards, so
+        # the gate must fall back to xla loudly (a DIVISIBLE layout
+        # runs sharded instead; tests/test_sim_mesh.py pins that side)
         kwargs = dict(run_cfg={"transport": "pallas", "max_ticks": 32})
         fs = checker(make_comp(**kwargs), devices=8)
-        fired = [f for f in fs if f.rule == "transport.mesh-fallback"]
+        fired = [f for f in fs if f.rule == "transport.mesh-indivisible"]
         assert len(fired) == 1
+        assert "2 lane(s)" in fired[0].message
         exc, warns = drive_executor(make_comp(**kwargs))
         assert exc is None
         assert any(fired[0].message == w for w in warns), (
@@ -363,14 +366,31 @@ class TestWarnParity:
             warns,
         )
 
-    def test_bucket_mesh_disabled(self):
-        kwargs = dict(run_cfg={"bucket": "auto", "max_ticks": 32})
+    def test_bucket_mesh_indivisible(self):
+        # rung 6 holds the 2 instances but does not divide across the
+        # 8 peer shards — bucketing falls back to exact shapes loudly
+        kwargs = dict(
+            run_cfg={
+                "bucket": "auto",
+                "bucket_ladder": "6",
+                "max_ticks": 32,
+            }
+        )
         fs = checker(make_comp(**kwargs), devices=8)
-        fired = [f for f in fs if f.rule == "buckets.mesh-disabled"]
+        fired = [f for f in fs if f.rule == "buckets.mesh-indivisible"]
         assert len(fired) == 1
         exc, warns = drive_executor(make_comp(**kwargs))
         assert exc is None
         assert any(fired[0].message == w for w in warns)
+
+    def test_mesh_shape_invalid(self):
+        fs = checker(
+            make_comp(run_cfg={"mesh": "nope", "max_ticks": 32}),
+            devices=8,
+        )
+        fired = [f for f in fs if f.rule == "mesh.shape-invalid"]
+        assert len(fired) == 1
+        assert "'nope'" in fired[0].message
 
     def test_trace_disabled_under_bucketing(self):
         kwargs = dict(

@@ -20,7 +20,7 @@ Contracts pinned here:
    results, telemetry counter stream, and latency histograms bit for
    bit — plus a hypothesis fuzz arm mixing padding with random chaos
    schedules.
-5. **Gating**: the resolve_buckets single-device/cohort/coverage
+5. **Gating**: the resolve_buckets mesh-divisibility/cohort/coverage
    bounds, ladder/mode parsing, and the engine-level refusals.
 6. **Exact-N normalization**: the perf ledger divides by live
    instances, never the bucket size (the `tg perf --compare` /
@@ -483,20 +483,30 @@ class TestGatingAndUnits:
             is None
         )
         assert warned and "cohort" in warned[0]
-        # a mesh runs exact shapes, loudly
+        # a divisible mesh buckets exactly like an unmeshed run
         devs = jax.devices()[:2]
         mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
         warned.clear()
+        plan = resolve_buckets(
+            cfg("auto", "32", ""),
+            [5],
+            mesh=mesh,
+            warn=lambda fmt, *a: warned.append(fmt % a),
+        )
+        assert plan is not None and plan.padded_counts == (32,)
+        assert not warned
+        # an indivisible rung runs exact shapes, loudly
+        warned.clear()
         assert (
             resolve_buckets(
-                cfg("auto", "32", ""),
+                cfg("auto", "33", ""),
                 [5],
                 mesh=mesh,
                 warn=lambda fmt, *a: warned.append(fmt % a),
             )
             is None
         )
-        assert warned and "single device" in warned[0]
+        assert warned and "divide" in warned[0]
         # over-coverage groups run exact shapes, loudly
         warned.clear()
         assert (

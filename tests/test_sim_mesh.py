@@ -1,0 +1,344 @@
+"""Sharded serving plane (PERF.md "Sharded serving plane"): the
+partition-rule table (`sim/meshplan.py`) and its four consumers.
+
+Contracts pinned here:
+
+1. **The rule table**: `parse_mesh_shape`/`make_mesh` layouts, the
+   regex rules resolving leaf paths to PartitionSpecs (with `lead` and
+   `ndim` clamping), `layout_str`/`peer_shards` duck-typing, and the
+   divisibility arithmetic (`indivisible_counts`,
+   `cross_shard_bytes_est`).
+2. **Bit-equality on a mesh**: an engine run on a 4-virtual-device
+   mesh — xla AND pallas (interpret, shard_map'ed commit) — matches
+   its unsharded twin leaf for leaf; same for a BUCKETED (padded +
+   live_counts) program and a PACKED (vmapped) batch on 1-D and 2-D
+   layouts. The full workload matrix rides the dryrun gate
+   (`__graft_entry__.dryrun_multichip`, MULTICHIP_r06.json); this file
+   keeps fast representatives in tier-1.
+3. **Divisibility refusals**: indivisible lane counts refuse loudly at
+   every gate (engine backstop, pack admission) instead of computing
+   wrong shards.
+4. **Mesh-keyed decisions**: the transport decision cache and its key
+   include the mesh layout — a meshed and an unmeshed run never share
+   a decision.
+5. **Sharded checkpoint/resume**: a run on a mesh snapshotted mid-way
+   and resumed on a mesh reproduces the uninterrupted meshed run leaf
+   for leaf.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+import __graft_entry__ as ge
+from testground_tpu.api import RunGroup
+from testground_tpu.sim.buckets import plan_buckets
+from testground_tpu.sim.engine import SimProgram, build_groups
+from testground_tpu.sim.executor import instantiate_testcase
+from testground_tpu.sim.meshplan import (
+    MeshPlan,
+    cross_shard_bytes_est,
+    indivisible_counts,
+    layout_str,
+    make_mesh,
+    mesh_axis_names,
+    parse_mesh_shape,
+    peer_shards,
+    plan_for,
+)
+from testground_tpu.sim.pack import PackMember, PackRunner
+from testground_tpu.sim.transport_model import (
+    _cache_key,
+    clear_decision_cache,
+    decide_transport,
+)
+
+from tests.test_sim_checkpoint import assert_results_equal
+
+P = jax.sharding.PartitionSpec
+
+
+def _assert_runs_equal(label, res_a, res_b):
+    for key in (
+        "status",
+        "finished_at",
+        "ticks",
+        "msgs_delivered",
+        "msgs_sent",
+        "msgs_enqueued",
+        "msgs_dropped",
+        "msgs_rejected",
+        "cal_depth",
+    ):
+        a, b = np.asarray(res_a[key]), np.asarray(res_b[key])
+        assert np.array_equal(a, b), f"[{label}] {key}: {a} vs {b}"
+    la, ta = jax.tree.flatten(res_a["states"])
+    lb, tb = jax.tree.flatten(res_b["states"])
+    assert ta == tb, f"[{label}] state structure drifted"
+    for i, (a, b) in enumerate(zip(la, lb)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"[{label}] state leaf {i} differs"
+        )
+
+
+# ------------------------------------------------------- the rule table
+
+
+class TestMeshPlanUnits:
+    def test_parse_mesh_shape(self):
+        assert parse_mesh_shape("4") == (4,)
+        assert parse_mesh_shape("2x4") == (2, 4)
+        assert parse_mesh_shape("2×4") == (2, 4)  # unicode ×
+        for bad in ("nope", "", "2x2x2", "0", "-1x2"):
+            with pytest.raises(ValueError):
+                parse_mesh_shape(bad)
+
+    def test_axis_names_and_layouts(self):
+        assert mesh_axis_names(1) == ("i",)
+        assert mesh_axis_names(2) == ("runs", "i")
+        assert layout_str(None) == "1"
+        assert layout_str(make_mesh("4")) == "4"
+        assert layout_str(make_mesh("2x4")) == "2x4"
+        assert make_mesh("1") is None  # a 1-extent mesh IS single-device
+        # `--run-cfg mesh=4` coalesces as a bare int, not a str
+        assert layout_str(make_mesh(4)) == "4"
+        assert make_mesh(1) is None
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            make_mesh("4x4")
+
+    def test_explicit_shape_may_use_fewer_devices(self):
+        mesh = make_mesh("4")
+        assert mesh.devices.size == 4 < len(jax.devices())
+        assert peer_shards(mesh) == 4
+        assert peer_shards(make_mesh("2x4")) == 4
+        assert peer_shards(None) == 1
+
+    def test_peer_shards_duck_types_device_count_standins(self):
+        # `tg check` probes with an offline stand-in exposing only
+        # devices.size (sim/check.py _FakeMesh)
+        fake = dataclasses.make_dataclass("F", [("devices", object)])(
+            np.zeros(4)
+        )
+        assert peer_shards(fake) == 4
+        assert layout_str(fake) == "4"
+
+    def test_rule_table_resolves_known_paths(self):
+        plan = MeshPlan(make_mesh("4"))
+        assert plan.spec_for("status") == P("i")
+        assert plan.spec_for("finished_at") == P("i")
+        assert plan.spec_for("cal.payload.0") == P(None, "i")
+        assert plan.spec_for("cal.src") == P(None, "i")
+        assert plan.spec_for("unmatched.anything") == P()
+
+    def test_spec_lead_and_ndim_clamp(self):
+        plan = MeshPlan(make_mesh("2x4"))
+        assert plan.shards == 4 and plan.runs == 2
+        # a stacked [R, ...] leaf maps the run axis to the mesh's runs
+        assert plan.spec_for("status", lead="runs") == P("runs", "i")
+        # 1-D mesh has no runs axis: the lead entry replicates
+        plan1 = MeshPlan(make_mesh("4"))
+        assert plan1.spec_for("status", lead="runs") == P(None, "i")
+        # a FLAT plane keeps only the leading entries at its real rank
+        assert plan.spec_for("cal.payload.0", lead="runs", ndim=2) == P(
+            "runs", None
+        )
+        assert plan_for(None) is None
+
+    def test_divisibility_arithmetic(self):
+        assert indivisible_counts((32, 64), 4) == ()
+        assert indivisible_counts((32, 33), 4) == (33,)
+        assert indivisible_counts((5,), 1) == ()
+        # each shard receives the (shards-1)/shards fraction it lacks
+        assert cross_shard_bytes_est(stream_bytes=1024, shards=4) == 768
+        assert cross_shard_bytes_est(stream_bytes=1024, shards=1) == 0
+
+
+# ----------------------------------------------- engine mesh bit-equality
+
+
+class TestShardedEngineEquality:
+    @pytest.mark.parametrize("transport", ["xla", "pallas"])
+    def test_pingpong_mesh_bit_equal(self, transport):
+        mesh = make_mesh("4")
+        res_m = ge._pingpong_program(
+            32, mesh=mesh, transport=transport
+        ).run(max_ticks=512)
+        res_s = ge._pingpong_program(32, transport=transport).run(
+            max_ticks=512
+        )
+        assert int((np.asarray(res_m["status"]) == 1).sum()) == 32
+        _assert_runs_equal(f"pingpong/{transport}", res_m, res_s)
+
+    def test_pallas_mesh_equals_xla_mesh(self):
+        mesh = make_mesh("4")
+        res_p = ge._pingpong_program(
+            32, mesh=mesh, transport="pallas"
+        ).run(max_ticks=512)
+        res_x = ge._pingpong_program(32, mesh=mesh, transport="xla").run(
+            max_ticks=512
+        )
+        _assert_runs_equal("pingpong/pallas-vs-xla-meshed", res_p, res_x)
+
+
+# ------------------------------------------------- bucketed mesh equality
+
+
+class TestBucketedMeshEquality:
+    def _padded_prog(self, live_n, rung, mesh, transport="xla"):
+        base = ge._pingpong_program(live_n, transport=transport)
+        bp = plan_buckets([g.count for g in base.groups], "auto", (rung,))
+        assert bp is not None and bp.padded_counts == (rung,)
+        padded = build_groups(
+            [
+                RunGroup(id=g.id, instances=p, parameters=dict(g.params))
+                for g, p in zip(base.groups, bp.padded_counts)
+            ]
+        )
+        tc = instantiate_testcase(
+            type(base.tc), padded, tick_ms=base.tick_ms
+        )
+        return SimProgram(
+            tc,
+            padded,
+            test_plan=base.meta["test_plan"],
+            test_case=base.meta["test_case"],
+            tick_ms=base.tick_ms,
+            chunk=base.chunk,
+            hosts=base.hosts,
+            transport=transport,
+            live_counts=bp.live_counts,
+            mesh=mesh,
+        )
+
+    def test_padded_mesh_bit_equal_to_padded_unmeshed(self):
+        # 24 live lanes padded to a 32 rung: the PADDED axis (not the
+        # live count) is what must divide across the 4 peer shards
+        mesh = make_mesh("4")
+        res_m = self._padded_prog(24, 32, mesh).run(max_ticks=512)
+        res_s = self._padded_prog(24, 32, None).run(max_ticks=512)
+        assert np.asarray(res_m["status"]).shape == (24,)  # exact-N demux
+        _assert_runs_equal("pingpong/padded-meshed", res_m, res_s)
+
+
+# --------------------------------------------------- packed mesh equality
+
+
+class TestPackedMeshEquality:
+    def _solo(self, seed):
+        return ge._pingpong_program(32).run(max_ticks=512, seed=seed)
+
+    def test_pack_1d_mesh_bit_equal_to_solo(self):
+        runner = PackRunner(
+            ge._pingpong_program(32), 4, mesh=make_mesh("4")
+        )
+        members = [PackMember(seed=s, max_ticks=512) for s in (1, 2)]
+        for m, res in zip(members, runner.run(members)):
+            _assert_runs_equal(f"pack-1d/seed{m.seed}", res, self._solo(m.seed))
+
+    def test_pack_2d_mesh_bit_equal_to_solo(self):
+        # the stacked [R, ...] carry maps its run axis to "runs"
+        runner = PackRunner(
+            ge._pingpong_program(32), 4, mesh=make_mesh("2x2")
+        )
+        members = [PackMember(seed=s, max_ticks=512) for s in (1, 2)]
+        for m, res in zip(members, runner.run(members)):
+            _assert_runs_equal(f"pack-2d/seed{m.seed}", res, self._solo(m.seed))
+
+    def test_pack_refuses_pallas_inner_program_on_mesh(self):
+        with pytest.raises(ValueError, match="pallas"):
+            PackRunner(
+                ge._pingpong_program(32, transport="pallas"),
+                4,
+                mesh=make_mesh("4"),
+            )
+
+
+# ------------------------------------------------- mesh-keyed decisions
+
+
+class TestDecisionCacheMeshKeying:
+    def test_cache_key_includes_layout(self):
+        from tests.test_transport_model import _sorted_ctx
+
+        ctx = _sorted_ctx()
+        k1 = _cache_key(ctx, "cpu", None)
+        k4 = _cache_key(ctx, "cpu", make_mesh("4"))
+        k24 = _cache_key(ctx, "cpu", make_mesh("2x4"))
+        assert len({k1, k4, k24}) == 3
+        assert k1[:-1] == k4[:-1] == k24[:-1]  # ONLY the layout differs
+
+    def test_meshed_and_unmeshed_decisions_never_shared(self):
+        from tests.test_transport_model import Cfg, _sorted_ctx
+
+        clear_decision_cache()
+        try:
+            d1 = decide_transport(Cfg("auto"), None, context=_sorted_ctx())
+            dm = decide_transport(
+                Cfg("auto"), make_mesh("4"), context=_sorted_ctx()
+            )
+            assert dm is not d1
+            # each layout then hits its OWN cached decision
+            assert decide_transport(
+                Cfg("auto"), None, context=_sorted_ctx()
+            ) is d1
+            assert decide_transport(
+                Cfg("auto"), make_mesh("4"), context=_sorted_ctx()
+            ) is dm
+        finally:
+            clear_decision_cache()
+
+
+# -------------------------------------------- sharded checkpoint/resume
+
+
+class TestShardedCheckpointResume:
+    def test_meshed_resume_bit_equal(self, tmp_path):
+        """A meshed run cut mid-way and resumed ON THE MESH through the
+        real on-disk snapshot format reproduces the uninterrupted
+        meshed run leaf for leaf (which TestShardedEngineEquality pins
+        equal to the unsharded run)."""
+        from testground_tpu.sim.checkpoint import (
+            FORMAT_VERSION,
+            load_snapshot,
+            restore_carry,
+            save_snapshot,
+            snapshot_carry,
+        )
+
+        mesh = make_mesh("4")
+
+        def prog():
+            return ge._pingpong_program(32, mesh=mesh, chunk=4)
+
+        res_full = prog().run(seed=3, max_ticks=64)
+        cut = 8
+        assert res_full["ticks"] > cut
+
+        captured = {}
+
+        def observer(ticks, carry):
+            if ticks == cut:
+                captured["leaves"], captured["metas"] = snapshot_carry(
+                    carry
+                )
+
+        prog().run(seed=3, max_ticks=cut, observer=observer)
+        path, _, _ = save_snapshot(
+            str(tmp_path),
+            {
+                "version": FORMAT_VERSION,
+                "tick": cut,
+                "leaves": captured["metas"],
+                "aux": {},
+            },
+            captured["leaves"],
+        )
+        manifest, leaves = load_snapshot(path)
+        prog_res = prog()
+        carry = restore_carry(prog_res, 3, manifest, leaves)
+        res_res = prog_res.run(
+            seed=3, max_ticks=64, resume_carry=carry, resume_ticks=cut
+        )
+        assert_results_equal(res_full, res_res, label="meshed-resume")

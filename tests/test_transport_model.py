@@ -154,8 +154,9 @@ class TestProgramIdentity:
 
 
 class TestHardGates:
-    def test_mesh_resolves_to_xla_loudly(self):
-        devs = jax.devices()[:2]
+    def test_indivisible_mesh_resolves_to_xla_loudly(self):
+        # 512 lanes across 3 peer shards: no equal per-chip blocks
+        devs = jax.devices()[:3]
         mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
         warned = []
         d = decide_transport(
@@ -165,8 +166,24 @@ class TestHardGates:
             warn=lambda fmt, *a: warned.append(fmt % a),
         )
         assert d.resolved == "xla"
-        assert "mesh" in d.reason
-        assert warned and "single device" in warned[0]
+        assert "divide" in d.reason
+        assert warned and "3 peer shard(s)" in warned[0]
+
+    def test_divisible_mesh_scores_statically(self):
+        # 512 lanes across 2 peer shards divide — auto SCORES the mesh
+        # arms (per-shard bytes + modeled ICI) instead of refusing
+        devs = jax.devices()[:2]
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+        warned = []
+        d = decide_transport(
+            Cfg("auto"),
+            mesh,
+            context=_sorted_ctx(),
+            warn=lambda fmt, *a: warned.append(fmt % a),
+        )
+        assert not warned
+        assert d.scores is not None
+        assert "2 peer shard(s)" in d.reason
 
     def test_direct_slot_mode_resolves_to_xla(self):
         d = decide_transport(Cfg("auto"), None, context=_direct_ctx())

@@ -12,9 +12,9 @@ Three contracts pinned here:
 2. **Zero-overhead default**: `transport="xla"` (the default) compiles a
    jaxpr-identical program to one built without the knob, with no pallas
    ops and the flat plane layout intact — the pre-PR program, unchanged.
-3. **Gating**: the single-device bound (`resolve_transport` falls back
-   to xla on a mesh, loudly; `SimProgram` refuses a pallas+mesh build)
-   and unknown-value refusal.
+3. **Gating**: the mesh divisibility bound (`decide_transport` resolves
+   indivisible lane counts to xla, loudly; `SimProgram`'s own backstop
+   refuses an indivisible pallas+mesh build) and unknown-value refusal.
 
 Plus chaos equality: a crash/partition/loss schedule with telemetry on
 produces the identical per-tick counter stream through both backends.
@@ -665,11 +665,17 @@ class TestTransportGating:
         with pytest.raises(ValueError, match="unknown transport"):
             ge._pingpong_program(8, transport="cuda")
 
-    def test_pallas_on_mesh_refused_by_program(self):
+    def test_pallas_on_indivisible_mesh_refused_by_program(self):
+        # 8 lanes do not divide across 3 peer shards — the engine's own
+        # divisibility backstop refuses; a divisible mesh builds fine
+        devs = jax.devices()[:3]
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
+        with pytest.raises(ValueError, match="divide across the peer"):
+            ge._pingpong_program(8, mesh=mesh, transport="pallas")
         devs = jax.devices()[:2]
         mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
-        with pytest.raises(ValueError, match="single-device"):
-            ge._pingpong_program(8, mesh=mesh, transport="pallas")
+        prog = ge._pingpong_program(8, mesh=mesh, transport="pallas")
+        assert prog.transport == "pallas"
 
     def test_resolve_transport_gate(self):
         cfg = dataclasses.make_dataclass("Cfg", [("transport", str)])
@@ -681,7 +687,9 @@ class TestTransportGating:
         with pytest.raises(ValueError, match="unknown transport"):
             resolve_transport(cfg("tpu"), None)
 
-        # a mesh forces xla, loudly — the single-device bound
+        # explicit pallas on a mesh passes through contextless — the
+        # divisibility check needs lane counts, so without a context the
+        # gate defers to the engine's own backstop instead of guessing
         devs = jax.devices()[:2]
         mesh = jax.sharding.Mesh(np.asarray(devs), ("i",))
         warned = []
@@ -689,8 +697,8 @@ class TestTransportGating:
             resolve_transport(
                 cfg("pallas"), mesh, lambda fmt, *a: warned.append(fmt % a)
             )
-            == "xla"
+            == "pallas"
         )
-        assert warned and "single device" in warned[0]
+        assert not warned
         # xla on a mesh stays silent
         assert resolve_transport(cfg("xla"), mesh) == "xla"

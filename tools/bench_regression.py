@@ -77,6 +77,8 @@ def main() -> int:
                 f"{key['workload']}/{key['instances']} "
                 f"{key['backend']}:{key['device_kind']} {key['transport']}"
             )
+            if key.get("mesh"):
+                label += f" mesh={key['mesh']}"
             line = f"{key['verdict']:<13} {label}  value={key['value']:.1f}"
             if key.get("baseline") is not None:
                 line += f"  baseline={key['baseline']:.1f}  x{key['ratio']:.3f}"

@@ -1,0 +1,241 @@
+"""``make mesh-smoke``: the sharded serving plane end-to-end on a
+4-virtual-device CPU mesh (PERF.md "Sharded serving plane") —
+
+1. **Bucketed + packed + meshed through the real CLI path**: two
+   tenants at different live sizes (one 32 bucket rung, which divides
+   the 4 peer shards) queued with ``bucket=auto pack=true mesh=4
+   transport=auto`` against one engine — both must pack into one
+   vmapped device program laid out on the mesh.
+2. **The journal carries the placement**: each run journals
+   ``sim.mesh {axes, shards, layout_table, cross_shard_bytes_est}``
+   and a SCORED ``sim.transport`` decision (mesh arms priced from the
+   cost model, not refused); the ``tg stats`` render shows the mesh
+   line and the Prometheus exposition carries ``tg_mesh_shards`` plus
+   the ``mesh`` label on ``tg_transport_resolved``.
+3. **Bit-equality to one device**: each tenant's flow totals (ticks,
+   delivered/sent/enqueued/dropped/rejected/in-flight, pub_dropped)
+   match an unmeshed, unpacked solo run of the same seed exactly.
+
+Exits non-zero with a readable message on any violation. Self-contained:
+temporary $TESTGROUND_HOME, CPU backend with virtual devices — safe in
+CI (mirrors ``tools/pack_smoke.py``).
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the virtual mesh: must be set before jax initializes anywhere
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+LADDER = "32"
+MESH = "4"
+# two live sizes, one 32 rung — 32 divides the 4 peer shards
+TENANT_SIZES = (20, 24)
+RUN_CFG = {
+    "bucket": "auto",
+    "bucket_ladder": LADDER,
+    "transport": "auto",
+    "max_ticks": 2048,
+    "chunk": 16,
+}
+FLOW_KEYS = (
+    "ticks",
+    "msgs_delivered",
+    "msgs_sent",
+    "msgs_enqueued",
+    "msgs_dropped",
+    "msgs_rejected",
+    "msgs_in_flight",
+    "pub_dropped",
+)
+
+
+def fail(msg: str) -> None:
+    print(f"mesh-smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _comp(n: int, seed: int, *, mesh: str, pack: bool):
+    from testground_tpu.api import (
+        Composition,
+        Global,
+        Group,
+        Instances,
+        generate_default_run,
+    )
+
+    return generate_default_run(
+        Composition(
+            global_=Global(
+                plan="network",
+                case="ping-pong",
+                builder="sim:plan",
+                runner="sim:jax",
+                run_config={
+                    **RUN_CFG,
+                    "mesh": mesh,
+                    "pack": pack,
+                    "seed": seed,
+                },
+            ),
+            groups=[Group(id="all", instances=Instances(count=n))],
+        )
+    )
+
+
+def _wait(engine, tids, budget=600):
+    from testground_tpu.engine import State
+
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        done = [
+            engine.get_task(t).state().state
+            in (State.COMPLETE, State.CANCELED)
+            for t in tids
+        ]
+        if all(done):
+            return [engine.get_task(t) for t in tids]
+        time.sleep(0.2)
+    fail(f"tasks did not finish within {budget}s")
+
+
+def main() -> int:
+    home = tempfile.mkdtemp(prefix="tg-mesh-smoke-")
+    os.environ["TESTGROUND_HOME"] = home
+    os.makedirs(os.path.join(home, "plans"), exist_ok=True)
+    shutil.copytree(
+        os.path.join(REPO_ROOT, "plans", "network"),
+        os.path.join(home, "plans", "network"),
+    )
+    sources = os.path.join(home, "plans", "network")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 4:
+        fail(f"expected >= 4 virtual devices, found {len(jax.devices())}")
+
+    from testground_tpu.api import TestPlanManifest
+    from testground_tpu.builders.sim_plan import SimPlanBuilder
+    from testground_tpu.config import EnvConfig
+    from testground_tpu.engine import Engine, EngineConfig, Outcome
+    from testground_tpu.metrics.prometheus import render_prometheus
+    from testground_tpu.runners.pretty import render_telemetry_summary
+    from testground_tpu.sim.runner import SimJaxRunner
+
+    env = EnvConfig.load()
+    manifest = TestPlanManifest.load_file(
+        os.path.join(sources, "manifest.toml")
+    )
+
+    # ---- 1. the meshed batch: both tenants queued BEFORE the single
+    # worker starts, so pack admission claims them as one meshed pack
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    engine.env.daemon.scheduler.workers = 1
+    t0 = time.time()
+    tids = [
+        engine.queue_run(
+            _comp(n, i, mesh=MESH, pack=True), manifest, sources_dir=sources
+        )
+        for i, n in enumerate(TENANT_SIZES)
+    ]
+    engine.start_workers()
+    meshed = _wait(engine, tids)
+    meshed_wall = time.time() - t0
+
+    sims = []
+    for task, n in zip(meshed, TENANT_SIZES):
+        if task.outcome() != Outcome.SUCCESS:
+            fail(f"meshed tenant n={n} outcome {task.outcome().value}: "
+                 f"{task.error}")
+        sim = (task.result.get("journal") or {}).get("sim") or {}
+        sims.append(sim)
+        mesh_block = sim.get("mesh") or {}
+        if mesh_block.get("axes") != MESH:
+            fail(f"n={n}: journal sim.mesh.axes != {MESH!r}: {mesh_block}")
+        if int(mesh_block.get("shards") or 0) != 4:
+            fail(f"n={n}: journal sim.mesh.shards != 4: {mesh_block}")
+        if not mesh_block.get("layout_table"):
+            fail(f"n={n}: journal sim.mesh has no layout_table")
+        if int(mesh_block.get("cross_shard_bytes_est") or -1) < 0:
+            fail(f"n={n}: bogus cross_shard_bytes_est: {mesh_block}")
+        tr = sim.get("transport") or {}
+        if tr.get("requested") != "auto" or not tr.get("reason"):
+            fail(f"n={n}: sim.transport not a scored auto decision: {tr}")
+        pk = sim.get("pack") or {}
+        if int(pk.get("members") or 1) != len(TENANT_SIZES):
+            fail(
+                f"n={n}: expected one pack of {len(TENANT_SIZES)}, "
+                f"journal sim.pack = {pk}"
+            )
+
+    stats = render_telemetry_summary(
+        {"plan": "network", "case": "ping-pong", **meshed[0].result["journal"]}
+    )
+    if "mesh" not in stats:
+        fail(f"tg stats render lacks the mesh line:\n{stats}")
+    text = render_prometheus(meshed)
+    if "\ntg_mesh_shards{" not in text:
+        fail("tg_mesh_shards absent from the Prometheus exposition")
+    if f'mesh="{MESH}"' not in text:
+        fail("tg_transport_resolved lacks the mesh label")
+
+    # ---- 2. the unmeshed, unpacked twins — bit-equality to one device
+    engine.stop()
+    engine = Engine(
+        EngineConfig(
+            env=env, builders=[SimPlanBuilder()], runners=[SimJaxRunner()]
+        )
+    )
+    tids = [
+        engine.queue_run(
+            _comp(n, i, mesh="", pack=False), manifest, sources_dir=sources
+        )
+        for i, n in enumerate(TENANT_SIZES)
+    ]
+    engine.start_workers()
+    solos = _wait(engine, tids)
+    engine.stop()
+
+    for task, solo, n in zip(meshed, solos, TENANT_SIZES):
+        if solo.outcome() != Outcome.SUCCESS:
+            fail(f"solo tenant n={n} outcome {solo.outcome().value}: "
+                 f"{solo.error}")
+        sim_m = (task.result.get("journal") or {}).get("sim") or {}
+        sim_s = (solo.result.get("journal") or {}).get("sim") or {}
+        for key in FLOW_KEYS:
+            if sim_m.get(key) != sim_s.get(key):
+                fail(
+                    f"n={n}: meshed {key} != solo: "
+                    f"{sim_m.get(key)} vs {sim_s.get(key)}"
+                )
+        if not sim_m.get("msgs_delivered"):
+            fail(f"n={n}: the meshed run moved no traffic")
+
+    print(
+        f"mesh-smoke: OK — {len(TENANT_SIZES)} tenants bucketed+packed on "
+        f"a {MESH}-shard mesh in {meshed_wall:.1f}s, transport "
+        f"auto→{(sims[0].get('transport') or {}).get('resolved')}, "
+        f"flow totals bit-equal to one device over "
+        f"{sims[0].get('msgs_delivered')} delivered msgs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
